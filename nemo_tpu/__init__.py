@@ -1,0 +1,25 @@
+"""nemo_tpu — a TPU-native rebuild of Nemo, the provenance-graph debugger.
+
+Nemo ingests fault-injection output from Molly (per-run antecedent/consequent
+provenance graphs plus failure specs), analyzes it, and emits an HTML debugging
+report.  The reference implementation (Go + Neo4j, see /root/reference) runs its
+analyses as Cypher traversals; here the same analyses run as batched
+integer/boolean array kernels under JAX, vmapped over fault-injection runs and
+sharded across a TPU mesh.
+
+Layout (mirrors the reference's layer map, SURVEY.md §1):
+  ingest/    - Molly output ETL (reference: faultinjectors/)
+  graphs/    - packed-array graph representation + vocab interning
+  backend/   - GraphBackend interface (reference: main.go:33-44) with a pure
+               Python oracle backend and the JAX/TPU backend
+  ops/       - JAX kernels: masked BFS, condition marking, chain contraction,
+               longest paths, prototype bitsets, differential provenance
+  parallel/  - device-mesh sharding of run batches, collectives
+  analysis/  - pipeline orchestration, corrections/extensions synthesis
+  report/    - DOT model, figure generation, SVG rendering, HTML report
+  models/    - protocol case-study models + the flagship batched pipeline
+  dedalus/   - mini Dedalus evaluator + fault injector (stands in for Molly)
+  utils/     - timing, logging
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,137 @@
+"""Molly fault-injector output loader (ETL).
+
+Reads a Molly output directory — runs.json plus per-run
+run_<i>_{pre,post}_provenance.json and run_<i>_spacetime.dot — into RunData
+structures, preserving the reference's ingestion invariants
+(reference: faultinjectors/molly.go:15-163):
+
+  * holds-maps are keyed by the *string* timestep in the last column of the
+    model's 'pre'/'post' table rows (molly.go:38-48);
+  * runs partition into success/failed on the exact status "success"
+    (molly.go:52-57);
+  * goals of table "clock" get their time extracted from the label via the
+    two regexes `, (\\d+), __WILDCARD__\\)` and `, (\\d+), (\\d+)\\)`
+    (molly.go:76-89);
+  * every goal/rule/edge ID is namespaced `run_<iter>_{pre,post}_<origID>`
+    (molly.go:92,101,106-107,140,149,154-155);
+  * goals start with cond_holds=False until condition marking (molly.go:96).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from dataclasses import dataclass, field
+
+from .datatypes import ProvData, RunData
+
+_CLOCK_TIME_WILD = re.compile(r", (\d+), __WILDCARD__\)")
+_CLOCK_TIME_TWO = re.compile(r", (\d+), (\d+)\)")
+
+
+def _fix_clock_times(prov: ProvData) -> None:
+    """Extract goal timesteps for clock goals from their labels.
+
+    Reference: faultinjectors/molly.go:72-89 (pre) / :120-137 (post).  Note the
+    reference applies the two-number regex *after* the wildcard regex, so when
+    both match, the two-number match wins.
+    """
+    for g in prov.goals:
+        if g.table == "clock":
+            m_wild = _CLOCK_TIME_WILD.search(g.label)
+            m_two = _CLOCK_TIME_TWO.search(g.label)
+            if m_wild:
+                g.time = m_wild.group(1)
+            if m_two:
+                g.time = m_two.group(1)
+
+
+def _namespace_prov(prov: ProvData, iteration: int, cond: str) -> None:
+    """Prefix all IDs with run_<iter>_<cond>_ (faultinjectors/molly.go:92-107)."""
+    prefix = f"run_{iteration}_{cond}_"
+    for g in prov.goals:
+        g.id = prefix + g.id
+        g.cond_holds = False
+    for r in prov.rules:
+        r.id = prefix + r.id
+    for e in prov.edges:
+        e.src = prefix + e.src
+        e.dst = prefix + e.dst
+
+
+@dataclass
+class MollyOutput:
+    """Parsed contents of one Molly output directory.
+
+    Mirrors the reference FaultInjector interface surface (main.go:22-30):
+    runs, per-status iteration lists, failure spec, messages of failed runs.
+    """
+
+    run_name: str = ""
+    output_dir: str = ""
+    runs: list[RunData] = field(default_factory=list)
+    runs_iters: list[int] = field(default_factory=list)
+    success_runs_iters: list[int] = field(default_factory=list)
+    failed_runs_iters: list[int] = field(default_factory=list)
+
+    # -- FaultInjector getters (reference: faultinjectors/molly.go:166-201) --
+
+    def get_failure_spec(self):
+        return self.runs[0].failure_spec
+
+    def get_msgs_failed_runs(self):
+        return [self.runs[i].messages for i in self.failed_runs_iters]
+
+    def get_output(self):
+        return self.runs
+
+    def get_runs_iters(self):
+        return self.runs_iters
+
+    def get_success_runs_iters(self):
+        return self.success_runs_iters
+
+    def get_failed_runs_iters(self):
+        return self.failed_runs_iters
+
+    def spacetime_dot_path(self, iteration: int) -> str:
+        """Path of Molly's space-time diagram for one run
+        (reference: graphing/hazard-analysis.go:25)."""
+        return os.path.join(self.output_dir, f"run_{iteration}_spacetime.dot")
+
+
+def load_molly_output(output_dir: str) -> MollyOutput:
+    """Load a Molly output directory.  Reference: faultinjectors/molly.go:15-163."""
+    out = MollyOutput(run_name=os.path.basename(os.path.normpath(output_dir)), output_dir=output_dir)
+
+    runs_path = os.path.join(output_dir, "runs.json")
+    with open(runs_path, "r", encoding="utf-8") as f:
+        raw_runs = json.load(f)
+
+    out.runs = [RunData.from_json(r) for r in raw_runs]
+
+    for i, run in enumerate(out.runs):
+        # Holds-maps: keyed by the string timestep in the last column of each
+        # 'pre'/'post' model-table row (molly.go:38-48).
+        tables = run.model.tables if run.model else {}
+        run.time_pre_holds = {row[-1]: True for row in tables.get("pre", []) if row}
+        run.time_post_holds = {row[-1]: True for row in tables.get("post", []) if row}
+
+        out.runs_iters.append(run.iteration)
+        if run.succeeded:
+            out.success_runs_iters.append(run.iteration)
+        else:
+            out.failed_runs_iters.append(run.iteration)
+
+        # Per-run provenance files are indexed by position i, not by the
+        # iteration field (molly.go:59-60).
+        for cond, attr in (("pre", "pre_prov"), ("post", "post_prov")):
+            prov_path = os.path.join(output_dir, f"run_{i}_{cond}_provenance.json")
+            with open(prov_path, "r", encoding="utf-8") as f:
+                prov = ProvData.from_json(json.load(f))
+            _fix_clock_times(prov)
+            _namespace_prov(prov, run.iteration, cond)
+            setattr(run, attr, prov)
+
+    return out

@@ -1,0 +1,329 @@
+"""Synthetic Molly-corpus generator.
+
+Molly (the external Scala fault injector the reference consumes,
+reference: README.md:5-8) is not available in this environment, so this module
+fabricates Molly-format output directories — runs.json,
+run_<i>_{pre,post}_provenance.json, run_<i>_spacetime.dot — with the exact JSON
+schema of reference faultinjectors/data-types.go:6-98 and the file layout read
+by faultinjectors/molly.go:18,59-60 and graphing/hazard-analysis.go:25.
+
+The generated protocol is an asynchronous primary/backup replication in the
+spirit of the reference case study (case-studies/pb_asynchronous.ded): a client
+C sends a request to primary P, which acks immediately (antecedent `pre` =
+payload acked) and replicates to backups in the background (consequent `post` =
+payload logged on all correct replicas).  Fault-injection runs either:
+
+  * succeed with full replication (kind "success");
+  * lose a replicate message, violating the invariant (kind "fail");
+  * lose the initial request, so the antecedent is never achieved and the
+    invariant holds vacuously (kind "vacuous" — still status "success").
+
+Provenance graphs are built with realistic structure: alternating
+goal->rule->goal edges, @next persistence chains of varying length (these are
+what graph simplification contracts), @async network rules, and clock goals.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class ProvBuilder:
+    """Accumulates one provenance graph in Molly JSON form."""
+
+    goals: list[dict[str, Any]] = field(default_factory=list)
+    rules: list[dict[str, Any]] = field(default_factory=list)
+    edges: list[dict[str, Any]] = field(default_factory=list)
+    _n: int = 0
+
+    def goal(self, table: str, args: list[str], time: int | str = "") -> str:
+        gid = f"goal_{self._n}"
+        self._n += 1
+        label = f"{table}({', '.join(str(a) for a in args)})"
+        self.goals.append({"id": gid, "label": label, "table": table, "time": str(time)})
+        return gid
+
+    def clock_goal(self, frm: str, to: str, t: int, wildcard: bool = False) -> str:
+        """Clock goals carry their time inside the label; the loader extracts it
+        with the reference's regexes (faultinjectors/molly.go:76-89)."""
+        last = "__WILDCARD__" if wildcard else str(t + 1)
+        return self.goal("clock", [frm, to, str(t), last])
+
+    def rule(self, table: str, rtype: str = "", label: str | None = None) -> str:
+        rid = f"rule_{self._n}"
+        self._n += 1
+        self.rules.append(
+            {"id": rid, "label": label if label is not None else table, "table": table, "type": rtype}
+        )
+        return rid
+
+    def edge(self, src: str, dst: str) -> None:
+        self.edges.append({"from": src, "to": dst})
+
+    def next_chain(self, table: str, args: list[str], t_hi: int, t_lo: int) -> tuple[str, str]:
+        """Build goal@t_hi -> next-rule -> goal@t_hi-1 -> ... -> goal@t_lo.
+
+        Returns (top goal id, bottom goal id).  This is the @next
+        timer/persistence chain shape that SimplifyProv contracts
+        (reference: graphing/preprocessing.go:70-78).
+        """
+        top = self.goal(table, args, t_hi)
+        cur = top
+        for t in range(t_hi - 1, t_lo - 1, -1):
+            r = self.rule(table, "next", label=f"{table}_next")
+            g = self.goal(table, args, t)
+            self.edge(cur, r)
+            self.edge(r, g)
+            cur = g
+        return top, cur
+
+    def build(self) -> dict[str, Any]:
+        return {"goals": self.goals, "rules": self.rules, "edges": self.edges}
+
+
+def _build_pre_prov(
+    achieved: bool, eot: int, ack_time: int, client: str, primary: str, payload: str
+) -> dict[str, Any]:
+    """Antecedent provenance: pre(payload) <- acked(...) <- ack@async <- request@async."""
+    b = ProvBuilder()
+    if not achieved:
+        # Antecedent never held: only the inert begin fact has provenance.
+        g_begin = b.goal("begin", [client, payload], 1)
+        r_begin = b.rule("begin")
+        b.edge(g_begin, r_begin)
+        g_clock = b.clock_goal(client, client, 1)
+        b.edge(r_begin, g_clock)
+        return b.build()
+
+    g_pre = b.goal("pre", [payload], eot)
+    r_pre = b.rule("pre")
+    b.edge(g_pre, r_pre)
+
+    # acked persistence chain from eot down to the ack time.
+    g_acked_top, g_acked_bot = b.next_chain("acked", [client, primary, payload], eot, ack_time)
+    b.edge(r_pre, g_acked_top)
+
+    # acked(...) :- ack(...): deductive rule under the bottom of the chain.
+    r_acked = b.rule("acked")
+    b.edge(g_acked_bot, r_acked)
+    g_ack = b.goal("ack", [client, primary, payload], ack_time)
+    b.edge(r_acked, g_ack)
+
+    # ack@async :- request: network hop primary -> client.
+    r_ack = b.rule("ack", "async")
+    b.edge(g_ack, r_ack)
+    g_req = b.goal("request", [primary, payload, client], ack_time - 1)
+    b.edge(r_ack, g_req)
+    b.edge(r_ack, b.clock_goal(primary, client, ack_time - 1))
+
+    # request@async :- begin, conn_out: network hop client -> primary.
+    r_req = b.rule("request", "async")
+    b.edge(g_req, r_req)
+    b.edge(r_req, b.goal("begin", [client, payload], 1))
+    b.edge(r_req, b.goal("conn_out", [client, primary], 1))
+    b.edge(r_req, b.clock_goal(client, primary, 1))
+
+    return b.build()
+
+
+def _build_post_prov(
+    replicas_logged: list[str],
+    eot: int,
+    log_time: int,
+    achieved: bool,
+    primary: str,
+    client: str,
+    payload: str,
+) -> dict[str, Any]:
+    """Consequent provenance: post(payload) <- log(Rep, payload) for each replica."""
+    b = ProvBuilder()
+    if achieved:
+        g_post = b.goal("post", [payload], eot)
+        r_post = b.rule("post")
+        b.edge(g_post, r_post)
+
+    g_req = None
+    for rep in replicas_logged:
+        g_log_top, g_log_bot = b.next_chain("log", [rep, payload], eot, log_time)
+        if achieved:
+            b.edge(r_post, g_log_top)
+
+        # log(Rep, payload) :- replicate(Rep, payload, ...).
+        r_log = b.rule("log")
+        b.edge(g_log_bot, r_log)
+        g_repl = b.goal("replicate", [rep, payload, primary, client], log_time - 1)
+        b.edge(r_log, g_repl)
+
+        # replicate@async :- request, replica: network hop primary -> replica.
+        r_repl = b.rule("replicate", "async")
+        b.edge(g_repl, r_repl)
+        if g_req is None:
+            g_req = b.goal("request", [primary, payload, client], 1)
+        b.edge(r_repl, g_req)
+        b.edge(r_repl, b.goal("replica", [primary, rep], 1))
+        b.edge(r_repl, b.clock_goal(primary, rep, log_time - 1))
+
+    return b.build()
+
+
+def _build_spacetime_dot(nodes: list[str], eot: int, messages: list[dict[str, Any]]) -> str:
+    """Space-time DOT diagram in the shape hazard analysis parses: node names
+    end in _<timestep> (reference: graphing/hazard-analysis.go:48-54)."""
+    lines = ["digraph spacetime {"]
+    for n in nodes:
+        for t in range(1, eot + 1):
+            lines.append(f'\t"{n}_{t}" [label="{n}@{t}"];')
+        for t in range(1, eot):
+            lines.append(f'\t"{n}_{t}" -> "{n}_{t + 1}";')
+    for m in messages:
+        if m["sendTime"] < eot:
+            lines.append(f'\t"{m["from"]}_{m["sendTime"]}" -> "{m["to"]}_{m["receiveTime"]}";')
+    lines.append("}")
+    return "\n".join(lines)
+
+
+@dataclass
+class SynthSpec:
+    """Configuration for one synthetic corpus."""
+
+    n_runs: int = 4
+    seed: int = 0
+    eot: int = 6
+    eff: int = 4
+    name: str = "pb_synth"
+    # Fraction of runs (beyond run 0, which always succeeds) per kind.
+    fail_fraction: float = 0.5
+    vacuous_fraction: float = 0.25
+
+
+def generate_corpus(spec: SynthSpec) -> dict[str, Any]:
+    """Generate an in-memory corpus: file name -> JSON-serializable content.
+
+    Run 0 always succeeds with full replication — the reference assumes the
+    first run is the successful one everywhere it hardcodes run 0
+    (e.g. graphing/corrections.go:210-216, differential-provenance.go:26).
+    """
+    rng = random.Random(spec.seed)
+    client, primary = "C", "a"
+    replicas = ["b", "c"]
+    nodes = [client, primary] + replicas
+    payload = "foo"
+
+    files: dict[str, Any] = {}
+    runs_json = []
+
+    for i in range(spec.n_runs):
+        if i == 0:
+            kind = "success"
+        else:
+            u = rng.random()
+            if u < spec.fail_fraction:
+                kind = "fail"
+            elif u < spec.fail_fraction + spec.vacuous_fraction:
+                kind = "vacuous"
+            else:
+                kind = "success"
+
+        eot = spec.eot
+        ack_time = rng.randint(3, max(3, eot - 2))
+        log_time = rng.randint(3, max(3, eot - 1))
+
+        omissions: list[dict[str, Any]] = []
+        crashes: list[dict[str, Any]] = []
+
+        if kind == "fail":
+            # Lose the replicate message to one replica.
+            lost = rng.choice(replicas)
+            logged = [r for r in replicas if r != lost]
+            omissions.append({"from": primary, "to": lost, "time": log_time - 1})
+            pre_achieved, post_achieved = True, False
+            status = "fail"
+        elif kind == "vacuous":
+            # Lose the initial request: antecedent never achieved.
+            logged = []
+            omissions.append({"from": client, "to": primary, "time": 1})
+            pre_achieved, post_achieved = False, False
+            status = "success"
+        else:
+            logged = list(replicas)
+            pre_achieved, post_achieved = True, True
+            status = "success"
+
+        messages = [
+            {"table": "request", "from": client, "to": primary, "sendTime": 1, "receiveTime": 2},
+        ]
+        if pre_achieved:
+            messages.append(
+                {
+                    "table": "ack",
+                    "from": primary,
+                    "to": client,
+                    "sendTime": ack_time - 1,
+                    "receiveTime": ack_time,
+                }
+            )
+            for rep in logged:
+                messages.append(
+                    {
+                        "table": "replicate",
+                        "from": primary,
+                        "to": rep,
+                        "sendTime": log_time - 1,
+                        "receiveTime": log_time,
+                    }
+                )
+
+        # Model tables: last column of each 'pre'/'post' row is the timestep at
+        # which the condition held (faultinjectors/molly.go:38-48).
+        tables: dict[str, list[list[str]]] = {"pre": [], "post": []}
+        if pre_achieved:
+            tables["pre"] = [[payload, str(t)] for t in range(ack_time, eot + 1)]
+        if post_achieved:
+            tables["post"] = [[payload, str(t)] for t in range(log_time, eot + 1)]
+
+        runs_json.append(
+            {
+                "iteration": i,
+                "status": status,
+                "failureSpec": {
+                    "eot": eot,
+                    "eff": spec.eff,
+                    "maxCrashes": 1,
+                    "nodes": nodes,
+                    "crashes": crashes,
+                    "omissions": omissions,
+                },
+                "model": {"tables": tables},
+                "messages": messages,
+            }
+        )
+
+        files[f"run_{i}_pre_provenance.json"] = _build_pre_prov(
+            pre_achieved, eot, ack_time, client, primary, payload
+        )
+        files[f"run_{i}_post_provenance.json"] = _build_post_prov(
+            logged, eot, log_time, post_achieved, primary, client, payload
+        )
+        files[f"run_{i}_spacetime.dot"] = _build_spacetime_dot(nodes, eot, messages)
+
+    files["runs.json"] = runs_json
+    return files
+
+
+def write_corpus(spec: SynthSpec, out_dir: str) -> str:
+    """Write a generated corpus as a Molly output directory; returns its path."""
+    corpus_dir = os.path.join(out_dir, spec.name)
+    os.makedirs(corpus_dir, exist_ok=True)
+    for name, content in generate_corpus(spec).items():
+        path = os.path.join(corpus_dir, name)
+        with open(path, "w", encoding="utf-8") as f:
+            if name.endswith(".json"):
+                json.dump(content, f, indent=1)
+            else:
+                f.write(content)
+    return corpus_dir

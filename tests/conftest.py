@@ -1,0 +1,25 @@
+"""Test configuration.
+
+Force JAX onto a virtual 8-device CPU platform so mesh/sharding code is
+exercised without TPU hardware (SURVEY.md §4d).  Must run before jax imports.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+from nemo_tpu.models.synth import SynthSpec, write_corpus  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def corpus_dir(tmp_path_factory) -> str:
+    """A small deterministic synthetic Molly corpus shared across tests."""
+    root = tmp_path_factory.mktemp("molly_out")
+    return write_corpus(SynthSpec(n_runs=6, seed=7, eot=6), str(root))

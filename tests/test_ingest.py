@@ -1,0 +1,77 @@
+"""Ingestion tests: schema parity with the reference loader invariants
+(reference: faultinjectors/molly.go:15-163)."""
+
+from nemo_tpu.ingest.datatypes import ProvData
+from nemo_tpu.ingest.molly import load_molly_output
+
+
+def test_load_corpus_shape(corpus_dir):
+    out = load_molly_output(corpus_dir)
+    assert len(out.runs) == 6
+    assert out.runs_iters == [0, 1, 2, 3, 4, 5]
+    # Run 0 always succeeds in synthetic corpora.
+    assert 0 in out.success_runs_iters
+    assert sorted(out.success_runs_iters + out.failed_runs_iters) == out.runs_iters
+    assert out.get_failure_spec().eot == 6
+    assert out.get_failure_spec().nodes == ["C", "a", "b", "c"]
+
+
+def test_id_namespacing(corpus_dir):
+    """IDs must be prefixed run_<iter>_{pre,post}_ (molly.go:92,101,106-107)."""
+    out = load_molly_output(corpus_dir)
+    for run in out.runs:
+        for prov, cond in ((run.pre_prov, "pre"), (run.post_prov, "post")):
+            prefix = f"run_{run.iteration}_{cond}_"
+            for g in prov.goals:
+                assert g.id.startswith(prefix)
+                assert not g.cond_holds  # tentative False until marking (molly.go:96)
+            for r in prov.rules:
+                assert r.id.startswith(prefix)
+            for e in prov.edges:
+                assert e.src.startswith(prefix) and e.dst.startswith(prefix)
+
+
+def test_clock_time_extraction():
+    """Clock goal times come from labels via the reference regexes
+    (molly.go:76-89); the two-number regex wins over the wildcard one."""
+    prov = ProvData.from_json(
+        {
+            "goals": [
+                {"id": "goal_0", "label": "clock(a, b, 3, __WILDCARD__)", "table": "clock", "time": ""},
+                {"id": "goal_1", "label": "clock(a, b, 4, 5)", "table": "clock", "time": ""},
+                {"id": "goal_2", "label": "log(b, foo)", "table": "log", "time": "2"},
+            ],
+            "rules": [],
+            "edges": [],
+        }
+    )
+    from nemo_tpu.ingest.molly import _fix_clock_times
+
+    _fix_clock_times(prov)
+    assert prov.goals[0].time == "3"
+    assert prov.goals[1].time == "4"
+    assert prov.goals[2].time == "2"
+
+
+def test_holds_maps(corpus_dir):
+    """Holds maps key on the string timestep in the last column of the
+    model's pre/post rows (molly.go:38-48)."""
+    out = load_molly_output(corpus_dir)
+    run0 = out.runs[0]
+    assert run0.time_pre_holds  # run 0 achieves the antecedent
+    assert all(isinstance(k, str) for k in run0.time_pre_holds)
+    assert str(run0.failure_spec.eot) in run0.time_pre_holds
+
+
+def test_edge_endpoint_resolution(corpus_dir):
+    """Every edge endpoint resolves to a goal or rule of the same graph."""
+    out = load_molly_output(corpus_dir)
+    for run in out.runs:
+        for prov in (run.pre_prov, run.post_prov):
+            ids = {g.id for g in prov.goals} | {r.id for r in prov.rules}
+            for e in prov.edges:
+                assert e.src in ids and e.dst in ids
+            # Graphs are bipartite: edges alternate goal->rule / rule->goal.
+            goal_ids = {g.id for g in prov.goals}
+            for e in prov.edges:
+                assert (e.src in goal_ids) != (e.dst in goal_ids)
