@@ -1,0 +1,128 @@
+"""End-to-end debugging pipeline orchestration.
+
+Mirrors the reference's main() fixed stage order (main.go:106-292):
+ingest -> init backend -> load raw provenance -> simplify -> hazard analysis
+-> prototypes -> pull provenance DOTs -> differential provenance ->
+corrections (only when failures exist) -> extensions -> recommendation
+assembly -> report (debugging.json + 7 figure families).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+
+from nemo_tpu.backend.base import GraphBackend
+from nemo_tpu.ingest.molly import MollyOutput, load_molly_output
+from nemo_tpu.report.writer import Reporter
+from nemo_tpu.utils.timing import PhaseTimer
+
+# Top-level recommendation texts (reference: main.go:195,205,212,216).
+REC_FAULT = "A fault occurred. Let's try making the protocol correct first."
+REC_EXTEND = (
+    "Good job, no specification violation. At least one run did not establish "
+    "the antecedent, though. Maybe double-check the fault tolerance of the "
+    "following rules:"
+)
+REC_CANT_HELP = (
+    "Nemo can't help with this type of bug. Please use the graphs below "
+    "regarding differential provenance for guidance to root cause."
+)
+REC_WELL_DONE = "Well done! No faults, no missing fault tolerance."
+
+
+@dataclass
+class DebugResult:
+    molly: MollyOutput
+    report_dir: str
+    timings: dict[str, float]
+
+
+def run_debug(
+    fault_inj_out: str,
+    results_root: str,
+    backend: GraphBackend,
+    conn: str = "",
+    reporter: Reporter | None = None,
+) -> DebugResult:
+    timer = PhaseTimer()
+
+    with timer.phase("ingest"):
+        molly = load_molly_output(fault_inj_out)
+    iters = molly.get_runs_iters()
+    failed_iters = molly.get_failed_runs_iters()
+
+    with timer.phase("init"):
+        backend.init_graph_db(conn, molly)
+    try:
+        with timer.phase("load_raw_provenance"):
+            backend.load_raw_provenance()
+        with timer.phase("simplify"):
+            backend.simplify_prov(iters)
+        with timer.phase("hazard"):
+            hazard_dots = backend.create_hazard_analysis(fault_inj_out)
+        with timer.phase("prototypes"):
+            inter, inter_miss, union, union_miss = backend.create_prototypes(
+                molly.get_success_runs_iters(), failed_iters
+            )
+        with timer.phase("pull_prov"):
+            pre_dots, post_dots, pre_clean_dots, post_clean_dots = backend.pull_pre_post_prov()
+        with timer.phase("diff_prov"):
+            diff_dots, failed_dots, missing_events = backend.create_naive_diff_prov(
+                False, failed_iters, post_dots[0]
+            )
+
+        corrections: list[str] = []
+        if failed_iters:
+            with timer.phase("corrections"):
+                corrections = backend.generate_corrections()
+        with timer.phase("extensions"):
+            all_achieved_pre, extensions = backend.generate_extensions()
+    finally:
+        backend.close_db()
+
+    # Recommendation assembly, 4-way priority (main.go:190-217).  The
+    # reference indexes its positional runs slice with iteration numbers
+    # (main.go:195); resolve by iteration explicitly so non-contiguous or
+    # reordered iterations stay correct.
+    runs = molly.get_output()
+    by_iter = {r.iteration: r for r in runs}
+    for i in iters:
+        run = by_iter[i]
+        if corrections:
+            run.recommendation = [REC_FAULT, *corrections]
+        elif extensions:
+            run.recommendation = [REC_EXTEND, *extensions]
+        elif not all_achieved_pre:
+            run.recommendation = [REC_CANT_HELP]
+        else:
+            run.recommendation = [REC_WELL_DONE]
+        run.inter_proto = inter
+        run.union_proto = union
+
+    for j, f in enumerate(failed_iters):
+        run = by_iter[f]
+        run.corrections = corrections
+        run.missing_events = missing_events[j]
+        run.inter_proto_missing = inter_miss[j]
+        run.union_proto_missing = union_miss[j]
+
+    # Reporting (main.go:239-292).
+    with timer.phase("report"):
+        reporter = reporter or Reporter()
+        this_results_dir = os.path.join(results_root, molly.run_name)
+        reporter.prepare(results_root, this_results_dir)
+
+        with open(os.path.join(this_results_dir, "debugging.json"), "w", encoding="utf-8") as fh:
+            json.dump([r.to_json() for r in runs], fh)
+
+        reporter.generate_figures(iters, "spacetime", hazard_dots)
+        reporter.generate_figures(iters, "pre_prov", pre_dots)
+        reporter.generate_figures(iters, "post_prov", post_dots)
+        reporter.generate_figures(iters, "pre_prov_clean", pre_clean_dots)
+        reporter.generate_figures(iters, "post_prov_clean", post_clean_dots)
+        reporter.generate_figures(failed_iters, "diff_post_prov-diff", diff_dots)
+        reporter.generate_figures(failed_iters, "diff_post_prov-failed", failed_dots)
+
+    return DebugResult(molly=molly, report_dir=this_results_dir, timings=timer.as_dict())

@@ -1,0 +1,146 @@
+"""GraphBackend: the framework's central backend interface.
+
+The reference defines this interface implicitly against Neo4j
+(main.go:33-44, ten methods); here it is explicit, with two implementations:
+
+  * backend.python_ref.PythonBackend — in-process property-graph oracle that
+    mirrors the reference's Cypher semantics exactly; serves as the measured
+    baseline and as the differential-test oracle;
+  * backend.jax_backend.JaxBackend — batched packed-array kernels on TPU.
+
+Shadow-run numbering follows the reference: simplified graphs live at run
+1000+i (preprocessing.go:15), differential graphs at 2000+i
+(differential-provenance.go:40).
+
+Determinism note: the reference iterates Go maps in several outputs
+(corrections, extensions, prototype collection order), so its output ordering
+is nondeterministic (SURVEY.md §7 hard part 5).  This rebuild defines canonical
+deterministic orders, documented on each method; parity comparisons against
+the reference must compare as sets.
+"""
+
+from __future__ import annotations
+
+import abc
+
+from nemo_tpu.ingest.datatypes import MissingEvent
+from nemo_tpu.ingest.molly import MollyOutput
+from nemo_tpu.report.dot import DotGraph
+
+
+class GraphBackend(abc.ABC):
+    """Interface over the graph analytics engine (reference: main.go:33-44)."""
+
+    @abc.abstractmethod
+    def init_graph_db(self, conn: str, molly: MollyOutput) -> None:
+        """Attach to the backing store and register the runs
+        (reference: InitGraphDB, graphing/helpers.go:17-55)."""
+
+    @abc.abstractmethod
+    def close_db(self) -> None:
+        """Release resources (reference: CloseDB, graphing/helpers.go:58-86)."""
+
+    @abc.abstractmethod
+    def load_raw_provenance(self) -> None:
+        """Load every run's pre/post provenance and mark condition_holds
+        (reference: LoadRawProvenance, graphing/pre-post-prov.go:247-285).
+
+        Condition marking semantics (pre-post-prov.go:220-228): find the root
+        goal (table == condition, no incoming edge), its child rules with
+        table == condition, and THEIR child goals g; set condition_holds on
+        every goal whose table equals the condition or equals any g.table.
+        """
+
+    @abc.abstractmethod
+    def simplify_prov(self, iters: list[int]) -> None:
+        """Create simplified shadow graphs at run 1000+i
+        (reference: SimplifyProv, graphing/preprocessing.go:351-387).
+
+        Two passes per (run, condition):
+        (a) clean copy (preprocessing.go:17-27): keep nodes/edges on
+            Goal-[*0..]->Goal paths — i.e. keep all goals, drop rules lacking
+            an incoming or outgoing goal edge, keep edge g->r iff r has an
+            outgoing goal, r->g iff r has an incoming goal;
+        (b) @next chain contraction (preprocessing.go:66-348): replace each
+            connected component (>=2 rules) of the {type=="next" rules +
+            goals strictly between two next rules} subgraph by one synthetic
+            Rule{type: "collapsed", table: t, label: "t_collapsed", id:
+            "run_<1000+i>_<cond>_<t>_collapsed_<k>"}, connecting the goal
+            predecessors of the component's head rules and the goal successors
+            of its tail rules, then deleting the component.  (The reference
+            enumerates variable-length paths greedily longest-first with a
+            seen-set, which both under- and over-merges on branching chains
+            and is order-dependent; component semantics are its deterministic
+            closure and coincide on linear chains — the shape @next chains
+            actually take.)
+        """
+
+    @abc.abstractmethod
+    def create_hazard_analysis(self, fault_inj_out: str) -> list[DotGraph]:
+        """Recolored space-time diagram per run
+        (reference: CreateHazardAnalysis, graphing/hazard-analysis.go:16-88)."""
+
+    @abc.abstractmethod
+    def create_prototypes(
+        self, success_iters: list[int], failed_iters: list[int]
+    ) -> tuple[list[str], list[list[str]], list[str], list[list[str]]]:
+        """Success prototypes over simplified consequent provenance
+        (reference: CreatePrototypes, graphing/prototype.go:209-256).
+
+        Returns (inter_proto, inter_proto_missing_per_failed_run, union_proto,
+        union_proto_missing_per_failed_run), all entries wrapped in <code>
+        for report parity (prototype.go:196,246,250).
+
+        Per achieving run, the rule set is every rule table on a path
+        root-[1]->rule-[*1..]->rule from an in-degree-0 goal (prototype.go:12),
+        gated on the run having achieved pre (prototype.go:13-15).  Canonical
+        per-run order: ascending min rule-depth, then table name.  The
+        intersection keeps the first achieving run's order (prototype.go:82);
+        the union interleaves runs positionally (prototype.go:114-130).  The
+        condition's own table is excluded from both (prototype.go:106,120).
+        """
+
+    @abc.abstractmethod
+    def pull_pre_post_prov(
+        self,
+    ) -> tuple[list[DotGraph], list[DotGraph], list[DotGraph], list[DotGraph]]:
+        """Per-run DOT graphs: (pre, post, pre_clean, post_clean)
+        (reference: PullPrePostProv, graphing/pre-post-prov.go:288-459)."""
+
+    @abc.abstractmethod
+    def create_naive_diff_prov(
+        self, symmetric: bool, failed_iters: list[int], success_post_dot: DotGraph
+    ) -> tuple[list[DotGraph], list[DotGraph], list[list[MissingEvent]]]:
+        """Differential provenance good-minus-bad per failed run
+        (reference: CreateNaiveDiffProv, differential-provenance.go:18-243).
+
+        Diff graph (per failed run f) = nodes/edges on paths g1-[*0..]->g2 of
+        run 0's raw consequent provenance whose ENDPOINT goals' labels do not
+        occur among run f's consequent goal labels (endpoints only are
+        filtered, differential-provenance.go:23-28).  Missing events = for the
+        longest root->leaf paths of the diff graph, the terminal rule and all
+        its goal children (differential-provenance.go:82-98; the child match
+        at :94 has no leaf constraint).  `symmetric` is accepted but unused,
+        matching the reference (:18).
+
+        Unlike the reference — whose template-substitution bug diffs every
+        failed run after the first against the FIRST failed run's labels
+        (differential-provenance.go:43) — each failed run is diffed against
+        its own labels.
+        """
+
+    @abc.abstractmethod
+    def generate_corrections(self) -> list[str]:
+        """Correction suggestions from run 0's trigger boundaries
+        (reference: GenerateCorrections, graphing/corrections.go:202-328).
+        Output strings are presentation-ready HTML, format-identical to the
+        reference; canonical order = aggregation-rule tables sorted, triggers
+        in edge order, consequent triggers sorted by (receiver, table)."""
+
+    @abc.abstractmethod
+    def generate_extensions(self) -> tuple[bool, list[str]]:
+        """(all_runs_achieved_pre, extension suggestions)
+        (reference: GenerateExtensions, graphing/extensions.go:13-99).
+        Extensions are async rules of run 0's antecedent provenance adjacent
+        to the condition boundary, suggested for hardening; canonical order =
+        sorted by rule table."""

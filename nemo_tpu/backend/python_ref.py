@@ -1,0 +1,506 @@
+"""PythonBackend: in-process property-graph oracle.
+
+Implements every GraphBackend verb with direct graph traversals that mirror
+the reference's Cypher semantics (see base.py docstrings for the per-verb
+spec and reference citations).  This backend plays two roles:
+
+  * the measured baseline the JAX/TPU backend must beat (the reference's
+    Neo4j container is not runnable here; this is the same sequential
+    one-run-at-a-time execution model without the network round-trips, i.e.
+    a strictly stronger baseline than Neo4j per SURVEY.md §6's cost model);
+  * the differential-test oracle: tests assert the JAX kernels reproduce
+    these results exactly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from nemo_tpu.analysis.corrections import (
+    PostTrigger,
+    PreTrigger,
+    parse_receiver,
+    synthesize_corrections,
+    synthesize_extensions,
+)
+from nemo_tpu.analysis.protos import intersect_proto, missing_from, union_proto, wrap_code
+from nemo_tpu.graphs.pgraph import PGraph, PNode, build_pgraph
+from nemo_tpu.ingest.datatypes import Goal, MissingEvent, Rule
+from nemo_tpu.ingest.molly import MollyOutput
+from nemo_tpu.report.dot import DotGraph
+from nemo_tpu.report.figures import create_diff_dot, create_dot, create_hazard_dot
+
+from .base import GraphBackend
+
+CLEAN_OFFSET = 1000  # shadow run offset for simplified graphs (preprocessing.go:15)
+DIFF_OFFSET = 2000  # shadow run offset for diff graphs (differential-provenance.go:40)
+
+
+class PythonBackend(GraphBackend):
+    def __init__(self) -> None:
+        self.molly: MollyOutput | None = None
+        # (run_id, condition) -> graph; shadow runs use offset run ids.
+        self.graphs: dict[tuple[int, str], PGraph] = {}
+
+    # ------------------------------------------------------------------ setup
+
+    def init_graph_db(self, conn: str, molly: MollyOutput) -> None:
+        # No external store: conn is accepted for CLI parity and ignored.
+        self.molly = molly
+        self.graphs = {}
+
+    def close_db(self) -> None:
+        self.graphs = {}
+
+    # ------------------------------------------------------------------- load
+
+    def load_raw_provenance(self) -> None:
+        assert self.molly is not None
+        for run in self.molly.runs:
+            for cond, prov in (("pre", run.pre_prov), ("post", run.post_prov)):
+                g = build_pgraph(prov)
+                self._mark_condition_holds(g, cond)
+                self.graphs[(run.iteration, cond)] = g
+
+    @staticmethod
+    def _mark_condition_holds(g: PGraph, condition: str) -> None:
+        """Reference: graphing/pre-post-prov.go:218-244 (see base.py)."""
+        trigger_tables: set[str] = set()
+        for root in g.roots():
+            if not root.is_goal or root.table != condition:
+                continue
+            for rule_id in g.out[root.id]:
+                rule = g.nodes[rule_id]
+                if rule.is_goal or rule.table != condition:
+                    continue
+                for goal_id in g.out[rule_id]:
+                    child = g.nodes[goal_id]
+                    if child.is_goal:
+                        trigger_tables.add(child.table)
+        if trigger_tables:
+            for node in g.nodes.values():
+                if node.is_goal and (node.table == condition or node.table in trigger_tables):
+                    node.cond_holds = True
+
+    # --------------------------------------------------------------- simplify
+
+    def simplify_prov(self, iters: list[int]) -> None:
+        for i in iters:
+            for cond in ("pre", "post"):
+                clean = self._clean_copy(self.graphs[(i, cond)], i, cond)
+                self._collapse_next_chains(clean, i, cond)
+                self.graphs[(CLEAN_OFFSET + i, cond)] = clean
+
+    @staticmethod
+    def _clean_copy(g: PGraph, iteration: int, cond: str) -> PGraph:
+        """Goal-[*0..]->Goal path restriction (preprocessing.go:17-27; see
+        base.py for the degree-mask formulation).  Node IDs are rewritten from
+        run_<i>_ to run_<1000+i>_ exactly as the reference's sed pass does
+        (preprocessing.go:33-54)."""
+        old_prefix = f"run_{iteration}_"
+        new_prefix = f"run_{CLEAN_OFFSET + iteration}_"
+
+        def rename(nid: str) -> str:
+            return new_prefix + nid[len(old_prefix):] if nid.startswith(old_prefix) else nid
+
+        out = PGraph()
+        keep: set[str] = set()
+        for node in g.nodes.values():
+            if node.is_goal:
+                keep.add(node.id)
+            else:
+                has_in = bool(g.inn[node.id])
+                has_out = bool(g.out[node.id])
+                if has_in and has_out:
+                    keep.add(node.id)
+        for nid in keep:
+            out.add_node(dataclasses.replace(g.nodes[nid], id=rename(nid)))
+        for src, dst in g.edge_order:
+            if src in keep and dst in keep:
+                out.add_edge(rename(src), rename(dst))
+        return out
+
+    @staticmethod
+    def _collapse_next_chains(g: PGraph, iteration: int, cond: str) -> None:
+        """@next chain contraction (preprocessing.go:66-348; deterministic
+        component semantics per base.py docstring), applied in place."""
+        run = CLEAN_OFFSET + iteration
+        next_rules = {n.id for n in g.nodes.values() if not n.is_goal and n.type == "next"}
+        chain_goals = {
+            n.id
+            for n in g.nodes.values()
+            if n.is_goal
+            and any(p in next_rules for p in g.inn[n.id])
+            and any(s in next_rules for s in g.out[n.id])
+        }
+        members = next_rules | chain_goals
+        if not members:
+            return
+
+        # Weakly-connected components of the induced subgraph, discovered in
+        # node insertion order for determinism.
+        comp_of: dict[str, int] = {}
+        components: list[list[str]] = []
+        for start in g.nodes:
+            if start not in members or start in comp_of:
+                continue
+            comp = []
+            stack = [start]
+            comp_of[start] = len(components)
+            while stack:
+                v = stack.pop()
+                comp.append(v)
+                for w in list(g.out[v]) + list(g.inn[v]):
+                    if w in members and w not in comp_of:
+                        comp_of[w] = len(components)
+                        stack.append(w)
+            components.append(comp)
+
+        k = 0
+        for comp in components:
+            comp_set = set(comp)
+            comp_rules = [v for v in comp if v in next_rules]
+            if len(comp_rules) < 2:
+                continue  # a path needs two next rules (preprocessing.go:71)
+
+            # Head rules: no predecessor chain goal within the component;
+            # tail rules: no successor chain goal within the component.
+            heads = [r for r in comp_rules if not any(p in comp_set for p in g.inn[r])]
+            tails = [r for r in comp_rules if not any(s in comp_set for s in g.out[r])]
+            # Preds/succs outside the component (preprocessing.go:146-245).
+            preds: list[str] = []
+            for r in heads:
+                preds.extend(p for p in g.inn[r] if p not in comp_set and g.nodes[p].is_goal)
+            succs: list[str] = []
+            for r in tails:
+                succs.extend(s for s in g.out[r] if s not in comp_set and g.nodes[s].is_goal)
+
+            table = g.nodes[(heads or comp_rules)[0]].table
+            label = f"{table}_collapsed"
+            # ID format per preprocessing.go:252.
+            coll_id = f"run_{run}_{cond}_{label}_{k}"
+            k += 1
+            g.add_node(
+                PNode(id=coll_id, is_goal=False, label=label, table=table, type="collapsed")
+            )
+            for p in dict.fromkeys(preds):
+                g.add_edge(p, coll_id)
+            for s in dict.fromkeys(succs):
+                g.add_edge(coll_id, s)
+            for v in comp:
+                g.remove_node(v)
+
+    # ----------------------------------------------------------------- hazard
+
+    def create_hazard_analysis(self, fault_inj_out: str) -> list[DotGraph]:
+        assert self.molly is not None
+        dots = []
+        for run in self.molly.runs:
+            with open(self.molly.spacetime_dot_path(run.iteration), "r", encoding="utf-8") as f:
+                text = f.read()
+            dots.append(create_hazard_dot(text, run.time_pre_holds, run.time_post_holds))
+        return dots
+
+    # ------------------------------------------------------------- prototypes
+
+    def _achieved_pre(self, iteration: int) -> bool:
+        """Any goal in the run's simplified antecedent graph with
+        condition_holds (prototype.go:13-15, queried at run 1000+i)."""
+        g = self.graphs[(CLEAN_OFFSET + iteration, "pre")]
+        return any(n.cond_holds for n in g.goals())
+
+    def proto_rule_tables(self, iteration: int, condition: str) -> list[str]:
+        """Ordered rule tables on root-[1]->rule-[*1..]->rule paths of the
+        simplified graph (prototype.go:11-24), gated on achieving pre.
+        Canonical order: (min rule-depth, table)."""
+        if not self._achieved_pre(iteration):
+            return []
+        g = self.graphs[(CLEAN_OFFSET + iteration, condition)]
+        root_ids = [n.id for n in g.roots() if n.is_goal]
+        if not root_ids:
+            return []
+        reach = set()
+        for rid in root_ids:
+            reach |= g.descendants(rid)
+        qualifying: dict[str, int] = {}  # table -> min rule-depth
+        # Rule-depth: number of rules on the shortest root path (BFS by hops).
+        depth: dict[str, int] = {}
+        frontier = list(root_ids)
+        hops = 0
+        seen = set(root_ids)
+        while frontier:
+            nxt = []
+            for v in frontier:
+                for w in g.out[v]:
+                    if w not in seen:
+                        seen.add(w)
+                        depth[w] = hops + 1
+                        nxt.append(w)
+            frontier = nxt
+            hops += 1
+        for rid in reach:
+            node = g.nodes[rid]
+            if node.is_goal:
+                continue
+            has_rule_descendant = any(not g.nodes[d].is_goal for d in g.descendants(rid))
+            has_rule_ancestor = any(
+                not g.nodes[a].is_goal for a in g.coreachable_to([rid]) if a != rid and a in reach
+            )
+            if has_rule_descendant or has_rule_ancestor:
+                rule_depth = (depth.get(rid, 0) + 1) // 2  # hops alternate goal/rule
+                prev = qualifying.get(node.table)
+                if prev is None or rule_depth < prev:
+                    qualifying[node.table] = rule_depth
+        return [t for t, _ in sorted(qualifying.items(), key=lambda kv: (kv[1], kv[0]))]
+
+    def clean_rule_tables(self, iteration: int, condition: str) -> set[str]:
+        """All distinct rule tables of the simplified graph (prototype.go:143-147)."""
+        g = self.graphs[(CLEAN_OFFSET + iteration, condition)]
+        return {n.table for n in g.rules()}
+
+    def create_prototypes(
+        self, success_iters: list[int], failed_iters: list[int]
+    ) -> tuple[list[str], list[list[str]], list[str], list[list[str]]]:
+        per_run = [self.proto_rule_tables(i, "post") for i in success_iters]
+        inter = intersect_proto(per_run, "post")
+        union = union_proto(per_run, "post")
+        inter_miss = []
+        union_miss = []
+        for f in failed_iters:
+            present = self.clean_rule_tables(f, "post")
+            inter_miss.append(missing_from(inter, present))
+            union_miss.append(missing_from(union, present))
+        return wrap_code(inter), inter_miss, wrap_code(union), union_miss
+
+    # ------------------------------------------------------------------- pull
+
+    def pull_pre_post_prov(
+        self,
+    ) -> tuple[list[DotGraph], list[DotGraph], list[DotGraph], list[DotGraph]]:
+        assert self.molly is not None
+        pre, post, pre_clean, post_clean = [], [], [], []
+        for run in self.molly.runs:
+            i = run.iteration
+            pre.append(create_dot(self.graphs[(i, "pre")], "pre"))
+            post.append(create_dot(self.graphs[(i, "post")], "post"))
+            pre_clean.append(create_dot(self.graphs[(CLEAN_OFFSET + i, "pre")], "pre"))
+            post_clean.append(create_dot(self.graphs[(CLEAN_OFFSET + i, "post")], "post"))
+        return pre, post, pre_clean, post_clean
+
+    # ------------------------------------------------------------------- diff
+
+    def diff_graph(self, failed_iter: int) -> PGraph:
+        """Good-minus-bad subgraph for one failed run (see base.py spec)."""
+        good = self.graphs[(0, "post")]
+        bad = self.graphs[(failed_iter, "post")]
+        fail_labels = {n.label for n in bad.goals()}
+        ok_goals = [n.id for n in good.goals() if n.label not in fail_labels]
+        fwd = good.reachable_from(ok_goals)  # >=0 hops from an ok goal
+        bwd = good.coreachable_to(ok_goals)  # >=0 hops to an ok goal
+
+        old_prefix = "run_0_"
+        new_prefix = f"run_{DIFF_OFFSET + failed_iter}_"
+
+        def rename(nid: str) -> str:
+            return new_prefix + nid[len(old_prefix):] if nid.startswith(old_prefix) else nid
+
+        out = PGraph()
+        for nid in good.nodes:
+            if nid in fwd and nid in bwd:
+                out.add_node(dataclasses.replace(good.nodes[nid], id=rename(nid)))
+        for src, dst in good.edge_order:
+            # Edge lies on an ok-goal->ok-goal path iff its source is
+            # forward-reachable and its target backward-reachable; that also
+            # implies both endpoints are in the node set.
+            if src in fwd and dst in bwd:
+                out.add_edge(rename(src), rename(dst))
+        return out
+
+    @staticmethod
+    def _diff_missing(diff: PGraph) -> list[MissingEvent]:
+        """Frontier of the diff graph: rules under the longest root->leaf
+        paths plus all their goal children (differential-provenance.go:82-98)."""
+        roots = [n.id for n in diff.roots() if n.is_goal]
+        # Longest path DP over the DAG from roots.
+        order: list[str] = []
+        indeg = {nid: len(diff.inn[nid]) for nid in diff.nodes}
+        stack = [nid for nid, d in indeg.items() if d == 0]
+        while stack:
+            v = stack.pop()
+            order.append(v)
+            for w in diff.out[v]:
+                indeg[w] -= 1
+                if indeg[w] == 0:
+                    stack.append(w)
+        dist = {nid: (0 if nid in roots else -(10**9)) for nid in diff.nodes}
+        for v in order:
+            for w in diff.out[v]:
+                if dist[v] + 1 > dist[w]:
+                    dist[w] = dist[v] + 1
+        best = -1
+        frontier_rules: dict[int, list[str]] = {}
+        for nid, node in diff.nodes.items():
+            if node.is_goal or not diff.out[nid]:
+                continue
+            for child in diff.out[nid]:
+                cnode = diff.nodes[child]
+                if cnode.is_goal and not diff.out[child] and dist[child] > -(10**9):
+                    frontier_rules.setdefault(dist[child], [])
+                    if nid not in frontier_rules[dist[child]]:
+                        frontier_rules[dist[child]].append(nid)
+                    best = max(best, dist[child])
+        if best < 0:
+            return []
+        missing = []
+        for rid in sorted(frontier_rules[best]):
+            rule = diff.nodes[rid]
+            goals = [
+                diff.nodes[c]
+                for c in diff.out[rid]
+                if diff.nodes[c].is_goal  # all goal children, not only leaves (:94)
+            ]
+            missing.append(
+                MissingEvent(
+                    rule=Rule(id=rule.id, label=rule.label, table=rule.table, type=rule.type),
+                    goals=[
+                        Goal(
+                            id=c.id,
+                            label=c.label,
+                            table=c.table,
+                            time=c.time,
+                            cond_holds=c.cond_holds,
+                        )
+                        for c in goals
+                    ],
+                )
+            )
+        return missing
+
+    def create_naive_diff_prov(
+        self, symmetric: bool, failed_iters: list[int], success_post_dot: DotGraph
+    ) -> tuple[list[DotGraph], list[DotGraph], list[list[MissingEvent]]]:
+        diff_dots, failed_dots, missing_events = [], [], []
+        for f in failed_iters:
+            diff = self.diff_graph(f)
+            self.graphs[(DIFF_OFFSET + f, "post")] = diff
+            missing = self._diff_missing(diff)
+            diff_dot, failed_dot = create_diff_dot(
+                DIFF_OFFSET + f, diff, self.graphs[(f, "post")], 0, success_post_dot, missing
+            )
+            diff_dots.append(diff_dot)
+            failed_dots.append(failed_dot)
+            missing_events.append(missing)
+        return diff_dots, failed_dots, missing_events
+
+    # ------------------------------------------------------------ corrections
+
+    def find_pre_triggers(self, run: int) -> list[PreTrigger]:
+        """(a:Rule)->(g:Goal !holds)->(r:Rule) with a holding goal above a
+        (corrections.go:30-34), in edge order."""
+        g = self.graphs[(run, "pre")]
+        out = []
+        for a in g.nodes.values():
+            if a.is_goal:
+                continue
+            if not any(g.nodes[p].is_goal and g.nodes[p].cond_holds for p in g.inn[a.id]):
+                continue
+            for gid in g.out[a.id]:
+                goal = g.nodes[gid]
+                if not goal.is_goal or goal.cond_holds:
+                    continue
+                for rid in g.out[gid]:
+                    rule = g.nodes[rid]
+                    if rule.is_goal:
+                        continue
+                    out.append(
+                        PreTrigger(
+                            agg=Rule(id=a.id, label=a.label, table=a.table, type=a.type),
+                            goal=Goal(
+                                id=goal.id,
+                                label=goal.label,
+                                table=goal.table,
+                                time=goal.time,
+                                cond_holds=goal.cond_holds,
+                                receiver=parse_receiver(goal.label, goal.table),
+                            ),
+                            rule=Rule(id=rule.id, label=rule.label, table=rule.table, type=rule.type),
+                        )
+                    )
+        return out
+
+    def find_post_triggers(self, run: int) -> list[PostTrigger]:
+        """(g:Goal holds)->(r:Rule) with a rule above g and a non-holding goal
+        below r that itself has a rule below (corrections.go:121-125)."""
+        g = self.graphs[(run, "post")]
+        out = []
+        for goal in g.nodes.values():
+            if not goal.is_goal or not goal.cond_holds:
+                continue
+            if not any(not g.nodes[p].is_goal for p in g.inn[goal.id]):
+                continue
+            for rid in g.out[goal.id]:
+                rule = g.nodes[rid]
+                if rule.is_goal:
+                    continue
+                qualifies = any(
+                    g.nodes[c].is_goal
+                    and not g.nodes[c].cond_holds
+                    and any(not g.nodes[cr].is_goal for cr in g.out[c])
+                    for c in g.out[rid]
+                )
+                if qualifies:
+                    out.append(
+                        PostTrigger(
+                            goal=Goal(
+                                id=goal.id,
+                                label=goal.label,
+                                table=goal.table,
+                                time=goal.time,
+                                cond_holds=goal.cond_holds,
+                                receiver=parse_receiver(goal.label, goal.table),
+                            ),
+                            rule=Rule(id=rule.id, label=rule.label, table=rule.table, type=rule.type),
+                        )
+                    )
+        return out
+
+    def generate_corrections(self) -> list[str]:
+        return synthesize_corrections(self.find_pre_triggers(0), self.find_post_triggers(0))
+
+    # ------------------------------------------------------------- extensions
+
+    def generate_extensions(self) -> tuple[bool, list[str]]:
+        assert self.molly is not None
+        # Count goals with table == "pre" and condition_holds across all raw
+        # antecedent graphs (extensions.go:25-50 counts goals, not runs).
+        achieved = sum(
+            1
+            for run in self.molly.runs
+            for n in self.graphs[(run.iteration, "pre")].goals()
+            if n.table == "pre" and n.cond_holds
+        )
+        all_achieved = achieved >= len(self.molly.runs)
+        if all_achieved:
+            return True, []
+
+        g = self.graphs[(0, "pre")]
+        candidates = []
+        for r in g.nodes.values():
+            if r.is_goal or r.type != "async":
+                continue
+            # (holding goal)->r->(non-holding goal)->(rule)  OR
+            # (non-holding goal)->r   (extensions.go:63-67).
+            cond_a = any(
+                g.nodes[p].is_goal and g.nodes[p].cond_holds for p in g.inn[r.id]
+            ) and any(
+                g.nodes[c].is_goal
+                and not g.nodes[c].cond_holds
+                and any(not g.nodes[cr].is_goal for cr in g.out[c])
+                for c in g.out[r.id]
+            )
+            cond_b = any(
+                g.nodes[p].is_goal and not g.nodes[p].cond_holds for p in g.inn[r.id]
+            )
+            if cond_a or cond_b:
+                candidates.append(r.table)
+        return False, synthesize_extensions(candidates)

@@ -1,0 +1,88 @@
+"""nemo-tpu command-line interface.
+
+CLI parity with the reference binary (main.go:68-78): `-faultInjOut` (required
+path to the fault injector's output directory) and `-graphDBConn` (accepted
+for compatibility; only meaningful to external-store backends).  Grows the
+`--graph-backend={python,jax}` selector the north star prescribes
+(SURVEY.md §0): `python` is the in-process oracle baseline, `jax` the
+batched TPU backend.
+
+Usage:
+    python -m nemo_tpu.cli -faultInjOut <dir> [--graph-backend=jax]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from nemo_tpu.analysis.pipeline import run_debug
+
+
+def make_backend(name: str):
+    if name == "python":
+        from nemo_tpu.backend.python_ref import PythonBackend
+
+        return PythonBackend()
+    if name == "jax":
+        from nemo_tpu.backend.jax_backend import JaxBackend
+
+        return JaxBackend()
+    raise SystemExit(f"unknown graph backend: {name!r} (expected python or jax)")
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="nemo-tpu", description="Provenance-graph debugging of distributed protocols."
+    )
+    # Single-dash long options for reference CLI parity (Go flag style).
+    parser.add_argument(
+        "-faultInjOut",
+        "--fault-inj-out",
+        dest="fault_inj_out",
+        required=True,
+        help="file system path to output directory of fault injector",
+    )
+    parser.add_argument(
+        "-graphDBConn",
+        "--graph-db-conn",
+        dest="graph_db_conn",
+        default="bolt://127.0.0.1:7687",
+        help="connection URI for external graph-database backends (unused by "
+        "the in-process backends)",
+    )
+    parser.add_argument(
+        "--graph-backend",
+        choices=("python", "jax"),
+        default="python",
+        help="graph analytics engine: in-process Python oracle or batched JAX/TPU",
+    )
+    parser.add_argument(
+        "--results-dir",
+        default=os.path.join(os.getcwd(), "results"),
+        help="root directory for generated reports (default ./results)",
+    )
+    parser.add_argument(
+        "--timings", action="store_true", help="print per-phase wall-clock timings"
+    )
+    args = parser.parse_args(argv)
+
+    if not os.path.isdir(args.fault_inj_out):
+        parser.error(f"fault injector output directory not found: {args.fault_inj_out}")
+
+    backend = make_backend(args.graph_backend)
+    result = run_debug(
+        args.fault_inj_out, args.results_dir, backend, conn=args.graph_db_conn
+    )
+
+    if args.timings:
+        for phase, secs in result.timings.items():
+            print(f"{phase:>22s}  {secs * 1e3:9.1f} ms")
+
+    print(f"All done! Find the debug report here: {os.path.join(result.report_dir, 'index.html')}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

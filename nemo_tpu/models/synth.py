@@ -197,8 +197,11 @@ class SynthSpec:
     eff: int = 4
     name: str = "pb_synth"
     # Fraction of runs (beyond run 0, which always succeeds) per kind.
-    fail_fraction: float = 0.5
-    vacuous_fraction: float = 0.25
+    fail_fraction: float = 0.4
+    vacuous_fraction: float = 0.2
+    # A total replication failure: every replicate message lost, so the failed
+    # run's consequent provenance is empty and whole rule tables go missing.
+    fail_all_fraction: float = 0.15
 
 
 def generate_corpus(spec: SynthSpec) -> dict[str, Any]:
@@ -226,6 +229,8 @@ def generate_corpus(spec: SynthSpec) -> dict[str, Any]:
                 kind = "fail"
             elif u < spec.fail_fraction + spec.vacuous_fraction:
                 kind = "vacuous"
+            elif u < spec.fail_fraction + spec.vacuous_fraction + spec.fail_all_fraction:
+                kind = "fail_all"
             else:
                 kind = "success"
 
@@ -241,6 +246,15 @@ def generate_corpus(spec: SynthSpec) -> dict[str, Any]:
             lost = rng.choice(replicas)
             logged = [r for r in replicas if r != lost]
             omissions.append({"from": primary, "to": lost, "time": log_time - 1})
+            pre_achieved, post_achieved = True, False
+            status = "fail"
+        elif kind == "fail_all":
+            # Lose every replicate message: the ack still happens (async
+            # primary/backup acks before replicating) but the consequent
+            # provenance is empty and whole rule tables go missing.
+            logged = []
+            for rep in replicas:
+                omissions.append({"from": primary, "to": rep, "time": log_time - 1})
             pre_achieved, post_achieved = True, False
             status = "fail"
         elif kind == "vacuous":

@@ -1,0 +1,174 @@
+/* Nemo debugging report viewer.
+ *
+ * Reads ./debugging.json (the array of run objects the pipeline marshals,
+ * same schema as the reference, faultinjectors/data-types.go:81-98) and
+ * renders: the runs table, top-level recommendations (from run 0), and one
+ * expandable section per run with hazard, provenance, differential
+ * provenance, prototype, and correction views.
+ */
+"use strict";
+
+function el(tag, attrs, ...children) {
+  const node = document.createElement(tag);
+  for (const [k, v] of Object.entries(attrs || {})) {
+    if (k === "html") node.innerHTML = v;
+    else node.setAttribute(k, v);
+  }
+  for (const c of children) {
+    node.append(c);
+  }
+  return node;
+}
+
+function figure(path, title) {
+  const wrap = el("div");
+  if (title) wrap.append(el("h4", {}, title));
+  const scroll = el("div", { class: "figure-scroll" });
+  scroll.append(el("img", { src: path, alt: title || path }));
+  wrap.append(scroll);
+  return wrap;
+}
+
+function layerStack(iter) {
+  // Differential provenance as stacked layers over the good run's graph:
+  // good (run 0 post prov) at the bottom, failed overlay, diff overlay —
+  // mirroring the reference's checkbox-controlled z-ordered layers.
+  const wrap = el("div");
+  wrap.append(el("h4", {}, "Differential provenance (good − bad)"));
+  const controls = el("div", { class: "layer-controls" });
+  const stack = el("div", { class: "layer-stack" });
+  const layers = [
+    ["good", `figures/run_0_post_prov.svg`, true],
+    ["failed", `figures/run_${iter}_diff_post_prov-failed.svg`, true],
+    ["diff", `figures/run_${iter}_diff_post_prov-diff.svg`, true],
+  ];
+  layers.forEach(([name, src, on], i) => {
+    const img = el("img", { src, alt: name });
+    if (i > 0) img.classList.add("overlay");
+    if (!on) img.style.display = "none";
+    stack.append(img);
+    const box = el("input", { type: "checkbox" });
+    box.checked = on;
+    box.addEventListener("change", () => {
+      img.style.display = box.checked ? "" : "none";
+    });
+    const label = el("label", {});
+    label.append(box, ` ${name}`);
+    controls.append(label);
+  });
+  wrap.append(controls, stack);
+  return wrap;
+}
+
+function protoList(title, items) {
+  const wrap = el("div");
+  wrap.append(el("h4", {}, title));
+  if (!items || !items.length) {
+    wrap.append(el("p", { class: "empty-note" }, "none"));
+    return wrap;
+  }
+  const ul = el("ul", { class: "proto-list" });
+  for (const it of items) ul.append(el("li", { html: it }));
+  wrap.append(ul);
+  return wrap;
+}
+
+function missingEvents(events) {
+  const wrap = el("div");
+  wrap.append(el("h4", {}, "Missing events (differential frontier)"));
+  if (!events || !events.length) {
+    wrap.append(el("p", { class: "empty-note" }, "none"));
+    return wrap;
+  }
+  const ul = el("ul", { class: "proto-list" });
+  for (const m of events) {
+    const goals = (m.Goals || []).map((g) => g.label).join(", ");
+    ul.append(
+      el(
+        "li",
+        {},
+        el("span", { class: "missing-rule" }, m.Rule ? m.Rule.label : "?"),
+        goals ? ` ← ${goals}` : ""
+      )
+    );
+  }
+  wrap.append(ul);
+  return wrap;
+}
+
+function runSection(run) {
+  const failed = run.status !== "success";
+  const details = el("details", { class: "run", id: `run-${run.iteration}` });
+  details.append(
+    el(
+      "summary",
+      {},
+      `Run ${run.iteration} — `,
+      el("span", { class: failed ? "status-fail" : "status-success" }, run.status)
+    )
+  );
+
+  if (failed && run.corrections && run.corrections.length) {
+    details.append(protoList("Correction suggestions", run.corrections));
+  }
+  if (failed) {
+    details.append(layerStack(run.iteration));
+    details.append(missingEvents(run.missingEvents));
+    details.append(
+      protoList("Missing from intersection prototype", run.interProtoMissing),
+      protoList("Missing from union prototype", run.unionProtoMissing)
+    );
+  }
+  details.append(figure(`figures/run_${run.iteration}_spacetime.svg`, "Hazard window (space-time)"));
+  details.append(
+    figure(`figures/run_${run.iteration}_pre_prov.svg`, "Antecedent provenance (raw)"),
+    figure(`figures/run_${run.iteration}_pre_prov_clean.svg`, "Antecedent provenance (simplified)"),
+    figure(`figures/run_${run.iteration}_post_prov.svg`, "Consequent provenance (raw)"),
+    figure(`figures/run_${run.iteration}_post_prov_clean.svg`, "Consequent provenance (simplified)")
+  );
+  details.append(
+    protoList("Intersection prototype", run.interProto),
+    protoList("Union prototype", run.unionProto)
+  );
+  return details;
+}
+
+async function main() {
+  const resp = await fetch("debugging.json");
+  const runs = await resp.json();
+
+  const tbody = document.querySelector("#runs-table tbody");
+  for (const run of runs) {
+    const spec = run.failureSpec || {};
+    const crashes = (spec.crashes || []).map((c) => `${c.node}@${c.time}`).join(", ") || "—";
+    const losses =
+      (spec.omissions || []).map((o) => `${o.from}→${o.to}@${o.time}`).join(", ") || "—";
+    const row = el(
+      "tr",
+      { class: "run-row" },
+      el("td", {}, String(run.iteration)),
+      el(
+        "td",
+        { class: run.status === "success" ? "status-success" : "status-fail" },
+        run.status
+      ),
+      el("td", {}, crashes),
+      el("td", {}, losses)
+    );
+    row.addEventListener("click", () => {
+      const d = document.getElementById(`run-${run.iteration}`);
+      d.open = true;
+      d.scrollIntoView({ behavior: "smooth" });
+    });
+    tbody.append(row);
+  }
+
+  const recList = document.getElementById("rec-list");
+  const recs = (runs[0] && runs[0].recommendation) || [];
+  for (const r of recs) recList.append(el("li", { html: r }));
+
+  const runsRoot = document.getElementById("runs");
+  for (const run of runs) runsRoot.append(runSection(run));
+}
+
+main();

@@ -1,0 +1,189 @@
+"""Minimal DOT graph model: build, serialize, and parse.
+
+Replaces the reference's vendored gographviz (used to build provenance figures,
+graphing/diagrams.go, and to parse Molly's spacetime diagrams,
+graphing/hazard-analysis.go:34).  Only the DOT subset those paths need is
+supported: a single directed graph, node statements with attributes, edge
+statements, graph-level attributes.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+
+def _quote(s: str) -> str:
+    return '"' + s.replace('"', '\\"') + '"'
+
+
+@dataclass
+class DotNode:
+    name: str
+    attrs: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class DotEdge:
+    src: str
+    dst: str
+    attrs: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class DotGraph:
+    """A directed DOT graph with insertion-ordered nodes and edges."""
+
+    name: str = "dataflow"
+    graph_attrs: dict[str, str] = field(default_factory=dict)
+    nodes: list[DotNode] = field(default_factory=list)
+    edges: list[DotEdge] = field(default_factory=list)
+    _lookup: dict[str, DotNode] = field(default_factory=dict)
+
+    def add_node(self, name: str, attrs: dict[str, str] | None = None) -> DotNode:
+        """Add or update a node (last-writer-wins per attribute, matching
+        gographviz AddNode semantics used at diagrams.go:109-118)."""
+        node = self._lookup.get(name)
+        if node is None:
+            node = DotNode(name=name, attrs={})
+            self.nodes.append(node)
+            self._lookup[name] = node
+        if attrs:
+            node.attrs.update(attrs)
+        return node
+
+    def add_edge(self, src: str, dst: str, attrs: dict[str, str] | None = None) -> DotEdge:
+        for endpoint in (src, dst):
+            if endpoint not in self._lookup:
+                self.add_node(endpoint)
+        edge = DotEdge(src=src, dst=dst, attrs=dict(attrs or {}))
+        self.edges.append(edge)
+        return edge
+
+    def lookup(self, name: str) -> DotNode | None:
+        return self._lookup.get(name)
+
+    def edges_between(self, src: str, dst: str) -> list[DotEdge]:
+        return [e for e in self.edges if e.src == src and e.dst == dst]
+
+    def to_string(self) -> str:
+        lines = [f"digraph {self.name} {{"]
+        if self.graph_attrs:
+            attrs = ",".join(f"{k}={_quote(v)}" for k, v in sorted(self.graph_attrs.items()))
+            lines.append(f"\tgraph [ {attrs} ];")
+        for n in self.nodes:
+            if n.attrs:
+                attrs = ", ".join(f"{k}={_quote(v)}" for k, v in sorted(n.attrs.items()))
+                lines.append(f"\t{_quote(n.name)} [ {attrs} ];")
+            else:
+                lines.append(f"\t{_quote(n.name)};")
+        for e in self.edges:
+            if e.attrs:
+                attrs = ", ".join(f"{k}={_quote(v)}" for k, v in sorted(e.attrs.items()))
+                lines.append(f"\t{_quote(e.src)} -> {_quote(e.dst)} [ {attrs} ];")
+            else:
+                lines.append(f"\t{_quote(e.src)} -> {_quote(e.dst)};")
+        lines.append("}")
+        return "\n".join(lines) + "\n"
+
+
+_TOKEN_RE = re.compile(
+    r"""
+    \s*(?:
+        (?P<comment>//[^\n]*|\#[^\n]*|/\*.*?\*/)
+      | (?P<quoted>"(?:[^"\\]|\\.)*")
+      | (?P<arrow>->)
+      | (?P<punct>[{}\[\];=,])
+      | (?P<word>[^\s{}\[\];=,"]+)
+    )
+    """,
+    re.VERBOSE | re.DOTALL,
+)
+
+
+def _tokenize(text: str) -> list[str]:
+    tokens = []
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN_RE.match(text, pos)
+        if not m:
+            break
+        pos = m.end()
+        if m.lastgroup == "comment":
+            continue
+        tok = m.group(0).strip()
+        if tok:
+            tokens.append(tok)
+    return tokens
+
+
+def _unquote(tok: str) -> str:
+    if len(tok) >= 2 and tok[0] == '"' and tok[-1] == '"':
+        return tok[1:-1].replace('\\"', '"')
+    return tok
+
+
+def parse_dot(text: str) -> DotGraph:
+    """Parse the DOT subset Molly's spacetime diagrams use
+    (graphing/hazard-analysis.go:34 reads them with gographviz)."""
+    tokens = _tokenize(text)
+    g = DotGraph()
+    i = 0
+    # Header: [strict] (digraph|graph) [name] {
+    while i < len(tokens) and tokens[i] != "{":
+        if tokens[i].lower() not in ("strict", "digraph", "graph"):
+            g.name = _unquote(tokens[i])
+        i += 1
+    i += 1  # consume {
+
+    def parse_attr_list(j: int) -> tuple[dict[str, str], int]:
+        attrs: dict[str, str] = {}
+        while j < len(tokens) and tokens[j] == "[":
+            j += 1
+            while j < len(tokens) and tokens[j] != "]":
+                key = _unquote(tokens[j])
+                if j + 2 < len(tokens) and tokens[j + 1] == "=":
+                    attrs[key] = _unquote(tokens[j + 2])
+                    j += 3
+                else:
+                    attrs[key] = ""
+                    j += 1
+                if j < len(tokens) and tokens[j] == ",":
+                    j += 1
+            j += 1  # consume ]
+        return attrs, j
+
+    while i < len(tokens):
+        tok = tokens[i]
+        if tok == "}":
+            break
+        if tok == ";":
+            i += 1
+            continue
+        if tok.lower() in ("graph", "node", "edge") and i + 1 < len(tokens) and tokens[i + 1] == "[":
+            attrs, i = parse_attr_list(i + 1)
+            if tok.lower() == "graph":
+                g.graph_attrs.update(attrs)
+            continue  # default node/edge attrs are not tracked
+        if tok.lower() == "subgraph" or tok == "{":
+            i += 1  # flatten subgraph contents
+            continue
+        name = _unquote(tok)
+        if i + 1 < len(tokens) and tokens[i + 1] == "=":
+            g.graph_attrs[name] = _unquote(tokens[i + 2])
+            i += 3
+            continue
+        if i + 1 < len(tokens) and tokens[i + 1] == "->":
+            chain = [name]
+            j = i + 1
+            while j < len(tokens) and tokens[j] == "->":
+                chain.append(_unquote(tokens[j + 1]))
+                j += 2
+            attrs, j = parse_attr_list(j)
+            for a, b in zip(chain, chain[1:]):
+                g.add_edge(a, b, dict(attrs))
+            i = j
+            continue
+        attrs, i = parse_attr_list(i + 1)
+        g.add_node(name, attrs)
+    return g

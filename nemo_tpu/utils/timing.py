@@ -1,0 +1,28 @@
+"""Structured per-phase timing.
+
+The reference's only observability is printf phase banners
+(e.g. graphing/pre-post-prov.go:249); here every pipeline phase gets a wall
+timer so the benchmark metrics (provenance-graphs/sec, per-phase p50) are
+first-class (SURVEY.md §5 'Tracing / profiling').
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+
+class PhaseTimer:
+    def __init__(self) -> None:
+        self._timings: dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._timings[name] = self._timings.get(name, 0.0) + time.perf_counter() - start
+
+    def as_dict(self) -> dict[str, float]:
+        return dict(self._timings)

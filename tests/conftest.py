@@ -20,6 +20,11 @@ from nemo_tpu.models.synth import SynthSpec, write_corpus  # noqa: E402
 
 @pytest.fixture(scope="session")
 def corpus_dir(tmp_path_factory) -> str:
-    """A small deterministic synthetic Molly corpus shared across tests."""
+    """A small deterministic synthetic Molly corpus shared across tests.
+
+    Seed 2 / 8 runs covers all four run kinds: success, partial replication
+    failure, vacuous success (antecedent never achieved), and total
+    replication failure (empty consequent provenance).
+    """
     root = tmp_path_factory.mktemp("molly_out")
-    return write_corpus(SynthSpec(n_runs=6, seed=7, eot=6), str(root))
+    return write_corpus(SynthSpec(n_runs=8, seed=2, eot=6), str(root))

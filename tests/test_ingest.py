@@ -7,8 +7,8 @@ from nemo_tpu.ingest.molly import load_molly_output
 
 def test_load_corpus_shape(corpus_dir):
     out = load_molly_output(corpus_dir)
-    assert len(out.runs) == 6
-    assert out.runs_iters == [0, 1, 2, 3, 4, 5]
+    assert len(out.runs) == 8
+    assert out.runs_iters == list(range(8))
     # Run 0 always succeeds in synthetic corpora.
     assert 0 in out.success_runs_iters
     assert sorted(out.success_runs_iters + out.failed_runs_iters) == out.runs_iters

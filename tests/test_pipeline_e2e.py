@@ -1,0 +1,77 @@
+"""End-to-end pipeline tests: CLI-equivalent flow producing the report."""
+
+import json
+import os
+
+from nemo_tpu.analysis.pipeline import (
+    REC_FAULT,
+    run_debug,
+)
+from nemo_tpu.backend.python_ref import PythonBackend
+
+
+def test_full_pipeline_python_backend(corpus_dir, tmp_path):
+    result = run_debug(corpus_dir, str(tmp_path / "results"), PythonBackend())
+    report_dir = result.report_dir
+    assert os.path.isfile(os.path.join(report_dir, "index.html"))
+    assert os.path.isfile(os.path.join(report_dir, "app.js"))
+
+    with open(os.path.join(report_dir, "debugging.json")) as f:
+        runs = json.load(f)
+    assert len(runs) == len(result.molly.runs)
+
+    # Failures exist in the corpus -> corrections lead the recommendations
+    # (priority at main.go:190-217).
+    assert runs[0]["recommendation"][0] == REC_FAULT
+    assert len(runs[0]["recommendation"]) > 1
+    assert runs[0]["interProto"] == ["<code>log</code>", "<code>replicate</code>"]
+
+    failed = [r for r in runs if r["status"] != "success"]
+    assert failed
+    for r in failed:
+        assert "corrections" in r
+        assert "missingEvents" in r
+        for m in r["missingEvents"]:
+            assert "Rule" in m and "Goals" in m  # Go field-name casing parity
+
+    # All 7 figure families, .dot + .svg each.
+    figures = os.listdir(os.path.join(report_dir, "figures"))
+    n, nf = len(runs), len(failed)
+    for fam, count in [
+        ("spacetime", n),
+        ("pre_prov", n),
+        ("post_prov", n),
+        ("pre_prov_clean", n),
+        ("post_prov_clean", n),
+        ("diff_post_prov-diff", nf),
+        ("diff_post_prov-failed", nf),
+    ]:
+        svgs = [f for f in figures if f.endswith(f"_{fam}.svg")]
+        dots = [f for f in figures if f.endswith(f"_{fam}.dot")]
+        assert len(svgs) == count, f"{fam}: {len(svgs)} != {count}"
+        assert len(dots) == count
+
+    # SVGs are well-formed enough to contain node shapes.
+    with open(os.path.join(report_dir, "figures", "run_0_post_prov.svg")) as f:
+        svg = f.read()
+    assert svg.startswith("<svg") and "<ellipse" in svg and "<rect" in svg
+
+
+def test_cli_smoke(corpus_dir, tmp_path, capsys):
+    from nemo_tpu.cli import main
+
+    rc = main(
+        [
+            "-faultInjOut",
+            corpus_dir,
+            "--graph-backend",
+            "python",
+            "--results-dir",
+            str(tmp_path / "results"),
+            "--timings",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "All done!" in out
+    assert "ingest" in out  # timings table

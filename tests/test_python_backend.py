@@ -1,0 +1,237 @@
+"""Oracle-backend tests: each GraphBackend verb against hand-checked
+expectations on the synthetic primary/backup corpus, plus micro-graphs for
+edge-case Cypher semantics."""
+
+import pytest
+
+from nemo_tpu.backend.python_ref import CLEAN_OFFSET, PythonBackend
+from nemo_tpu.graphs.pgraph import PGraph, PNode
+from nemo_tpu.ingest.molly import load_molly_output
+
+
+@pytest.fixture(scope="module")
+def backend(corpus_dir):
+    molly = load_molly_output(corpus_dir)
+    b = PythonBackend()
+    b.init_graph_db("", molly)
+    b.load_raw_provenance()
+    b.simplify_prov(molly.runs_iters)
+    return b
+
+
+def test_condition_marking(backend):
+    """Goals of the condition table and of the trigger tables (two hops below
+    the root) hold; everything else does not (pre-post-prov.go:220-228)."""
+    g = backend.graphs[(0, "pre")]
+    held = {n.table for n in g.goals() if n.cond_holds}
+    unheld = {n.table for n in g.goals() if not n.cond_holds}
+    assert held == {"pre", "acked"}
+    assert "ack" in unheld and "request" in unheld
+
+    g_post = backend.graphs[(0, "post")]
+    assert {n.table for n in g_post.goals() if n.cond_holds} == {"post", "log"}
+
+
+def test_condition_marking_requires_root():
+    """No marking happens when the condition-table goal has an incoming edge
+    (the NOT ()-->() clause of pre-post-prov.go:222)."""
+    g = PGraph()
+    g.add_node(PNode(id="g_top", is_goal=True, label="x(1)", table="x"))
+    g.add_node(PNode(id="r_top", is_goal=False, label="pre", table="pre"))
+    g.add_node(PNode(id="g_pre", is_goal=True, label="pre(1)", table="pre"))
+    g.add_node(PNode(id="r_mid", is_goal=False, label="pre", table="pre"))
+    g.add_node(PNode(id="g_y", is_goal=True, label="y(1)", table="y"))
+    for s, d in [("g_top", "r_top"), ("r_top", "g_pre"), ("g_pre", "r_mid"), ("r_mid", "g_y")]:
+        g.add_edge(s, d)
+    PythonBackend._mark_condition_holds(g, "pre")
+    assert not any(n.cond_holds for n in g.goals())
+
+
+def test_clean_copy_drops_dead_end_rules():
+    """Clean copy keeps all goals but drops rules lacking an incoming or an
+    outgoing goal edge, with their edges (preprocessing.go:17-27)."""
+    g = PGraph()
+    g.add_node(PNode(id="run_0_pre_goal_a", is_goal=True, label="a(1)", table="a"))
+    g.add_node(PNode(id="run_0_pre_rule_ok", is_goal=False, label="r", table="r"))
+    g.add_node(PNode(id="run_0_pre_goal_b", is_goal=True, label="b(1)", table="b"))
+    g.add_node(PNode(id="run_0_pre_rule_deadend", is_goal=False, label="d", table="d"))
+    g.add_node(PNode(id="run_0_pre_rule_orphanhead", is_goal=False, label="o", table="o"))
+    g.add_node(PNode(id="run_0_pre_goal_c", is_goal=True, label="c(1)", table="c"))
+    g.add_edge("run_0_pre_goal_a", "run_0_pre_rule_ok")
+    g.add_edge("run_0_pre_rule_ok", "run_0_pre_goal_b")
+    g.add_edge("run_0_pre_goal_b", "run_0_pre_rule_deadend")  # rule with no out-goal
+    g.add_edge("run_0_pre_rule_orphanhead", "run_0_pre_goal_c")  # rule with no in-goal
+    clean = PythonBackend._clean_copy(g, 0, "pre")
+    names = set(clean.nodes)
+    assert names == {
+        "run_1000_pre_goal_a",
+        "run_1000_pre_rule_ok",
+        "run_1000_pre_goal_b",
+        "run_1000_pre_goal_c",
+    }
+    assert set(clean.edge_order) == {
+        ("run_1000_pre_goal_a", "run_1000_pre_rule_ok"),
+        ("run_1000_pre_rule_ok", "run_1000_pre_goal_b"),
+    }
+
+
+def test_collapse_next_chains(backend):
+    """The acked@next persistence chain contracts to one collapsed rule
+    between the top and bottom chain goals (preprocessing.go:249-308)."""
+    clean = backend.graphs[(CLEAN_OFFSET + 0, "pre")]
+    collapsed = [n for n in clean.rules() if n.type == "collapsed"]
+    assert len(collapsed) == 1
+    c = collapsed[0]
+    assert c.table == "acked" and c.label == "acked_collapsed"
+    assert c.id.startswith("run_1000_pre_acked_collapsed_")
+    assert not any(n.type == "next" for n in clean.rules())
+    # Structure: top acked goal -> collapsed -> bottom acked goal -> acked rule.
+    preds = clean.inn[c.id]
+    succs = clean.out[c.id]
+    assert len(preds) == 1 and clean.nodes[preds[0]].table == "acked"
+    assert len(succs) == 1 and clean.nodes[succs[0]].table == "acked"
+    assert preds[0] != succs[0]
+
+
+def test_collapse_preserves_non_chain_rules(backend):
+    clean = backend.graphs[(CLEAN_OFFSET + 0, "post")]
+    tables = {n.table for n in clean.rules()}
+    assert "post" in tables and "log" in tables and "replicate" in tables
+    # Two log chains (replicas b and c) -> two collapsed rules.
+    assert sum(1 for n in clean.rules() if n.type == "collapsed") == 2
+
+
+def test_prototypes(backend):
+    molly = backend.molly
+    inter, inter_miss, union, union_miss = backend.create_prototypes(
+        molly.success_runs_iters, molly.failed_runs_iters
+    )
+    # The consequent skeleton of achieving runs: log then replicate (by rule
+    # depth); the condition table 'post' is excluded.
+    assert inter == ["<code>log</code>", "<code>replicate</code>"]
+    assert union == ["<code>log</code>", "<code>replicate</code>"]
+    assert len(inter_miss) == len(molly.failed_runs_iters)
+    for f, miss in zip(molly.failed_runs_iters, inter_miss):
+        if len(backend.graphs[(f, "post")].nodes) == 0:
+            assert miss == ["<code>log</code>", "<code>replicate</code>"]
+        else:
+            assert miss == []  # partial failures still have both tables
+
+
+def test_proto_gate_on_pre_achievement(backend):
+    """Vacuous runs (antecedent never achieved) contribute no rule tables
+    (prototype.go:13-15)."""
+    for run in backend.molly.runs:
+        achieved = any(
+            n.cond_holds for n in backend.graphs[(run.iteration, "pre")].goals()
+        )
+        tables = backend.proto_rule_tables(run.iteration, "post")
+        if not achieved:
+            assert tables == []
+
+
+def test_diff_prov(backend):
+    molly = backend.molly
+    _, post_dots, _, _ = backend.pull_pre_post_prov()
+    diff_dots, failed_dots, missing = backend.create_naive_diff_prov(
+        False, molly.failed_runs_iters, post_dots[0]
+    )
+    assert len(diff_dots) == len(molly.failed_runs_iters)
+    for f, miss in zip(molly.failed_runs_iters, missing):
+        failed_graph = backend.graphs[(f, "post")]
+        if len(failed_graph.nodes) == 0:
+            # Empty failed prov: diff is the whole good graph; frontier is the
+            # deepest rule (replicate, async) with its body goals.
+            assert len(miss) >= 1
+            assert all(m.rule.table == "replicate" for m in miss)
+            assert any(g.table in ("request", "replica", "clock") for m in miss for g in m.goals)
+        else:
+            # One lost replica: the missing frontier is that replica's branch.
+            assert len(miss) >= 1
+            tables = {m.rule.table for m in miss}
+            assert tables <= {"replicate", "log"}
+        for m in miss:
+            assert m.rule.id.startswith(f"run_{2000 + f}_post_")
+
+
+def test_diff_overlay_visibility(backend):
+    molly = backend.molly
+    _, post_dots, _, _ = backend.pull_pre_post_prov()
+    diff_dots, failed_dots, missing = backend.create_naive_diff_prov(
+        False, molly.failed_runs_iters, post_dots[0]
+    )
+    f = molly.failed_runs_iters[0]
+    diff_dot = diff_dots[0]
+    # Every node is either invisible (copied from the good graph) or revealed.
+    styles = {n.attrs.get("style") for n in diff_dot.nodes if n.name != "graph"}
+    assert styles <= {"invis", "filled, solid", "filled, dashed, bold"}
+    # Missing-frontier nodes are marked mediumvioletred.
+    missing_ids = {m.rule.id for m in missing[0]}
+    for n in diff_dot.nodes:
+        if n.name in missing_ids:
+            assert n.attrs["color"] == "mediumvioletred"
+            assert n.attrs["style"] == "filled, dashed, bold"
+
+
+def test_corrections(backend):
+    recs = backend.generate_corrections()
+    # One pre trigger (acked <- ack on node C), post triggers on b/c: the
+    # differing nodes force ack_log message rounds, a buffer_ack persistence
+    # scheme, and the final rule rewrite.
+    assert any("ack_log(C, ...)@async :- log(b, ...)" in r for r in recs)
+    assert any("ack_log(C, ...)@async :- log(c, ...)" in r for r in recs)
+    assert any("buffer_ack(C, ...)" in r for r in recs)
+    change = [r for r in recs if r.startswith("Change: ")]
+    assert len(change) == 1
+    assert "acked(C, ...) :- ack(C, ...);" in change[0]
+    assert "buffer_ack(C, ...), ack_log(C, sender=b, ...), ack_log(C, sender=c, ...)" in change[0]
+
+
+def test_extensions(backend):
+    all_achieved, exts = backend.generate_extensions()
+    has_unachieving = any(
+        not any(n.cond_holds for n in backend.graphs[(r.iteration, "pre")].goals())
+        for r in backend.molly.runs
+    )
+    assert all_achieved == (not has_unachieving)
+    if not all_achieved:
+        # Network rules below the condition boundary of run 0's antecedent.
+        assert exts == [
+            "<code>ack(node, ...)@async :- ...;</code>",
+            "<code>request(node, ...)@async :- ...;</code>",
+        ]
+
+
+def test_hazard_analysis(backend, corpus_dir):
+    dots = backend.create_hazard_analysis(corpus_dir)
+    assert len(dots) == len(backend.molly.runs)
+    run0 = backend.molly.runs[0]
+    for node in dots[0].nodes:
+        t = node.name.rsplit("_", 1)[-1]
+        if run0.time_post_holds.get(t):
+            assert node.attrs["fillcolor"] == "deepskyblue"
+        elif run0.time_pre_holds.get(t):
+            assert node.attrs["fillcolor"] == "firebrick"
+        else:
+            assert node.attrs["fillcolor"] == "lightgrey"
+
+
+def test_pull_dots_styling(backend):
+    pre, post, pre_clean, post_clean = backend.pull_pre_post_prov()
+    d = pre[0]
+    by_label = {}
+    for n in d.nodes:
+        if n.name != "graph":
+            by_label.setdefault(n.attrs.get("label", ""), n)
+    # Condition-holding pre goals are firebrick ellipses.
+    pre_goal = next(n for label, n in by_label.items() if label.startswith("pre("))
+    assert pre_goal.attrs["fillcolor"] == "firebrick"
+    assert pre_goal.attrs["shape"] == "ellipse"
+    # Async rules are lawngreen bold rects.
+    async_rule = by_label.get("ack") or by_label.get("request")
+    assert async_rule is not None
+    assert async_rule.attrs["color"] == "lawngreen"
+    assert async_rule.attrs["shape"] == "rect"
+    # Clean post dots contain collapsed rules.
+    labels = {n.attrs.get("label", "") for n in post_clean[0].nodes}
+    assert "log_collapsed" in labels
