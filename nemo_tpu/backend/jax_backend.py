@@ -1,0 +1,374 @@
+"""JaxBackend: the batched TPU graph-analytics engine.
+
+All per-run graph analyses run as fixed-shape array kernels over size-bucketed
+run batches (nemo_tpu.ops.*): condition marking, clean-copy + chain
+contraction, prototype bitsets, and differential provenance execute once per
+bucket for the whole batch — the axis the reference loops over sequentially,
+one Bolt round-trip at a time (SURVEY.md §2.3).  Host work is limited to
+packing, report materialization, and the run-0-only trigger queries shared
+with the oracle backend (analysis/queries.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nemo_tpu.analysis.corrections import synthesize_corrections, synthesize_extensions
+from nemo_tpu.analysis.protos import intersect_proto, missing_from, union_proto, wrap_code
+from nemo_tpu.analysis.queries import (
+    extension_candidates,
+    find_post_triggers,
+    find_pre_triggers,
+)
+from nemo_tpu.graphs.packed import (
+    CorpusVocab,
+    PackedBatch,
+    bucketize,
+    pack_batch,
+    pack_graph,
+    rewrite_run_prefix,
+    unpack_to_pgraph,
+)
+from nemo_tpu.graphs.pgraph import PGraph, build_pgraph
+from nemo_tpu.ingest.datatypes import Goal, MissingEvent, Rule
+from nemo_tpu.ingest.molly import MollyOutput
+from nemo_tpu.ops.adjacency import build_adjacency
+from nemo_tpu.ops.condition import mark_condition_holds
+from nemo_tpu.ops.diff import diff_masks
+from nemo_tpu.ops.proto import DEPTH_INF, all_rule_bits, proto_rule_bits
+from nemo_tpu.ops.simplify import clean_masks, collapse_chains
+from nemo_tpu.report.dot import DotGraph
+from nemo_tpu.report.figures import create_diff_dot, create_dot, create_hazard_dot
+
+from .base import GraphBackend
+from .python_ref import CLEAN_OFFSET, DIFF_OFFSET
+
+
+@partial(jax.jit, static_argnames=("v", "cond_tid", "num_tables"))
+def _k_condition(edge_src, edge_dst, edge_mask, is_goal, table_id, node_mask, v, cond_tid, num_tables):
+    adj = build_adjacency(edge_src, edge_dst, edge_mask, v)
+    return mark_condition_holds(adj, is_goal, table_id, node_mask, cond_tid, num_tables)
+
+
+@partial(jax.jit, static_argnames=("v",))
+def _k_simplify(edge_src, edge_dst, edge_mask, is_goal, type_id, node_mask, v):
+    adj = build_adjacency(edge_src, edge_dst, edge_mask, v)
+    adj_clean, alive = clean_masks(adj, is_goal, node_mask)
+    return collapse_chains(adj_clean, is_goal, type_id, alive)
+
+
+@partial(jax.jit, static_argnames=("num_tables", "max_depth"))
+def _k_proto(adj, is_goal, alive, table_id, achieved_pre, num_tables, max_depth):
+    bits, min_depth = proto_rule_bits(
+        adj, is_goal, alive, table_id, achieved_pre, num_tables, max_depth
+    )
+    present = all_rule_bits(is_goal, alive, table_id, num_tables)
+    return bits, min_depth, present
+
+
+@partial(jax.jit, static_argnames=("max_depth",))
+def _k_diff(adj_good, is_goal, node_mask, label_id, fail_bits, max_depth):
+    return diff_masks(adj_good, is_goal, node_mask, label_id, fail_bits, max_depth)
+
+
+class JaxBackend(GraphBackend):
+    def __init__(self, max_batch: int | None = None) -> None:
+        self.max_batch = max_batch
+        self.molly: MollyOutput | None = None
+        self.vocab = CorpusVocab()
+        self.packed: dict[tuple[int, str], object] = {}
+        self.raw: dict[tuple[int, str], PGraph] = {}
+        self.clean: dict[tuple[int, str], PGraph] = {}
+        self.cond_holds: dict[tuple[int, str], np.ndarray] = {}
+        self.achieved_pre: dict[int, bool] = {}
+        # Per condition: list of (batch, adj, alive, type_id) kernel outputs.
+        self.simplified: dict[str, list[tuple[PackedBatch, np.ndarray, np.ndarray, np.ndarray]]] = {}
+        self._batch_cache: dict[tuple[str, tuple[int, ...]], list[PackedBatch]] = {}
+
+    # ------------------------------------------------------------------ setup
+
+    def init_graph_db(self, conn: str, molly: MollyOutput) -> None:
+        self.molly = molly
+        for run in molly.runs:
+            for cond, prov in (("pre", run.pre_prov), ("post", run.post_prov)):
+                self.packed[(run.iteration, cond)] = pack_graph(prov, self.vocab)
+                self.raw[(run.iteration, cond)] = build_pgraph(prov)
+
+    def close_db(self) -> None:
+        self.packed = {}
+        self.simplified = {}
+
+    def _batches(self, cond: str, iters: list[int] | None = None) -> list[PackedBatch]:
+        """Size-bucketed batches for one condition; cached per (cond, runs)."""
+        assert self.molly is not None
+        run_ids = [r.iteration for r in self.molly.runs] if iters is None else list(iters)
+        key = (cond, tuple(run_ids))
+        cached = self._batch_cache.get(key)
+        if cached is None:
+            graphs = [self.packed[(i, cond)] for i in run_ids]
+            cached = bucketize(run_ids, graphs, self.max_batch)
+            self._batch_cache[key] = cached
+        return cached
+
+    # ------------------------------------------------------------------- load
+
+    def load_raw_provenance(self) -> None:
+        assert self.molly is not None
+        for cond in ("pre", "post"):
+            cond_tid = self.vocab.tables.lookup(cond)
+            for batch in self._batches(cond):
+                holds = np.asarray(
+                    _k_condition(
+                        jnp.asarray(batch.edge_src),
+                        jnp.asarray(batch.edge_dst),
+                        jnp.asarray(batch.edge_mask),
+                        jnp.asarray(batch.is_goal),
+                        jnp.asarray(batch.table_id),
+                        jnp.asarray(batch.node_mask),
+                        batch.v,
+                        cond_tid,
+                        len(self.vocab.tables),
+                    )
+                )
+                for row, rid in enumerate(batch.run_ids):
+                    n = batch.graphs[row].n_nodes
+                    self.cond_holds[(rid, cond)] = holds[row, :n]
+                    # Mirror onto the host graph for DOT styling and the
+                    # shared run-0 trigger queries.
+                    g = self.raw[(rid, cond)]
+                    for slot in range(batch.graphs[row].n_goals):
+                        g.nodes[batch.graphs[row].node_ids[slot]].cond_holds = bool(
+                            holds[row, slot]
+                        )
+        for run in self.molly.runs:
+            self.achieved_pre[run.iteration] = bool(
+                self.cond_holds[(run.iteration, "pre")].any()
+            )
+
+    # --------------------------------------------------------------- simplify
+
+    def simplify_prov(self, iters: list[int]) -> None:
+        for cond in ("pre", "post"):
+            outs = []
+            for batch in self._batches(cond, iters):
+                adj, alive, type_new = _k_simplify(
+                    jnp.asarray(batch.edge_src),
+                    jnp.asarray(batch.edge_dst),
+                    jnp.asarray(batch.edge_mask),
+                    jnp.asarray(batch.is_goal),
+                    jnp.asarray(batch.type_id),
+                    jnp.asarray(batch.node_mask),
+                    batch.v,
+                )
+                adj, alive, type_new = np.asarray(adj), np.asarray(alive), np.asarray(type_new)
+                outs.append((batch, adj, alive, type_new))
+                for row, rid in enumerate(batch.run_ids):
+                    holds = self.cond_holds[(rid, cond)]
+                    n = batch.graphs[row].n_nodes
+                    padded_holds = np.zeros(batch.v, dtype=bool)
+                    padded_holds[:n] = holds
+                    self.clean[(CLEAN_OFFSET + rid, cond)] = unpack_to_pgraph(
+                        batch,
+                        row,
+                        self.vocab,
+                        alive[row],
+                        adj[row],
+                        type_new[row],
+                        padded_holds,
+                        id_prefix=f"run_{CLEAN_OFFSET + rid}_{cond}_",
+                    )
+            self.simplified[cond] = outs
+
+    # ----------------------------------------------------------------- hazard
+
+    def create_hazard_analysis(self, fault_inj_out: str) -> list[DotGraph]:
+        assert self.molly is not None
+        dots = []
+        for run in self.molly.runs:
+            with open(self.molly.spacetime_dot_path(run.iteration), "r", encoding="utf-8") as f:
+                text = f.read()
+            dots.append(create_hazard_dot(text, run.time_pre_holds, run.time_post_holds))
+        return dots
+
+    # ------------------------------------------------------------- prototypes
+
+    def _proto_tables_by_run(self) -> tuple[dict[int, list[str]], dict[int, set[str]]]:
+        """Run the prototype kernels over every post bucket; returns
+        (ordered qualifying tables per run, all present rule tables per run)."""
+        num_tables = len(self.vocab.tables)
+        ordered: dict[int, list[str]] = {}
+        present: dict[int, set[str]] = {}
+        for batch, adj, alive, _ in self.simplified["post"]:
+            ach = np.asarray([self.achieved_pre[rid] for rid in batch.run_ids], dtype=bool)
+            bits, min_depth, present_bits = _k_proto(
+                jnp.asarray(adj),
+                jnp.asarray(batch.is_goal),
+                jnp.asarray(alive),
+                jnp.asarray(batch.table_id),
+                jnp.asarray(ach),
+                num_tables,
+                batch.v,
+            )
+            bits, min_depth, present_bits = (
+                np.asarray(bits),
+                np.asarray(min_depth),
+                np.asarray(present_bits),
+            )
+            for row, rid in enumerate(batch.run_ids):
+                tabs = [
+                    (int(min_depth[row, t]), self.vocab.tables[t])
+                    for t in np.nonzero(bits[row])[0]
+                    if min_depth[row, t] < DEPTH_INF
+                ]
+                ordered[rid] = [name for _, name in sorted(tabs)]
+                present[rid] = {self.vocab.tables[t] for t in np.nonzero(present_bits[row])[0]}
+        return ordered, present
+
+    def create_prototypes(
+        self, success_iters: list[int], failed_iters: list[int]
+    ) -> tuple[list[str], list[list[str]], list[str], list[list[str]]]:
+        ordered, present = self._proto_tables_by_run()
+        per_run = [ordered.get(i, []) for i in success_iters]
+        inter = intersect_proto(per_run, "post")
+        union = union_proto(per_run, "post")
+        inter_miss = [missing_from(inter, present.get(f, set())) for f in failed_iters]
+        union_miss = [missing_from(union, present.get(f, set())) for f in failed_iters]
+        return wrap_code(inter), inter_miss, wrap_code(union), union_miss
+
+    # ------------------------------------------------------------------- pull
+
+    def pull_pre_post_prov(
+        self,
+    ) -> tuple[list[DotGraph], list[DotGraph], list[DotGraph], list[DotGraph]]:
+        assert self.molly is not None
+        pre, post, pre_clean, post_clean = [], [], [], []
+        for run in self.molly.runs:
+            i = run.iteration
+            pre.append(create_dot(self.raw[(i, "pre")], "pre"))
+            post.append(create_dot(self.raw[(i, "post")], "post"))
+            pre_clean.append(create_dot(self.clean[(CLEAN_OFFSET + i, "pre")], "pre"))
+            post_clean.append(create_dot(self.clean[(CLEAN_OFFSET + i, "post")], "post"))
+        return pre, post, pre_clean, post_clean
+
+    # ------------------------------------------------------------------- diff
+
+    def create_naive_diff_prov(
+        self, symmetric: bool, failed_iters: list[int], success_post_dot: DotGraph
+    ) -> tuple[list[DotGraph], list[DotGraph], list[list[MissingEvent]]]:
+        assert self.molly is not None
+        good = self.packed[(0, "post")]
+        num_labels = max(1, len(self.vocab.labels))
+        # Pad the single good graph to its own bucket.
+        gb = pack_batch([0], [good])
+        adj_good = np.asarray(
+            build_adjacency(
+                jnp.asarray(gb.edge_src), jnp.asarray(gb.edge_dst), jnp.asarray(gb.edge_mask), gb.v
+            )
+        )[0]
+
+        bits = np.zeros((max(1, len(failed_iters)), num_labels), dtype=bool)
+        for j, f in enumerate(failed_iters):
+            pg = self.packed[(f, "post")]
+            goal_labels = pg.label_id[: pg.n_goals]
+            bits[j, goal_labels] = True
+
+        if failed_iters:
+            node_keep, edge_keep, frontier_rule, missing_goal = (
+                np.asarray(x)
+                for x in _k_diff(
+                    jnp.asarray(adj_good),
+                    jnp.asarray(gb.is_goal[0]),
+                    jnp.asarray(gb.node_mask[0]),
+                    jnp.asarray(gb.label_id[0]),
+                    jnp.asarray(bits),
+                    gb.v,
+                )
+            )
+        diff_dots, failed_dots, missing_events = [], [], []
+        for j, f in enumerate(failed_iters):
+            prefix = f"run_{DIFF_OFFSET + f}_post_"
+            holds = np.zeros(gb.v, dtype=bool)
+            n = good.n_nodes
+            holds[:n] = self.cond_holds[(0, "post")]
+            diff_graph = unpack_to_pgraph(
+                gb,
+                0,
+                self.vocab,
+                node_keep[j],
+                edge_keep[j],
+                gb.type_id[0],
+                holds,
+                id_prefix=prefix,
+            )
+            missing = self._missing_events(gb, frontier_rule[j], missing_goal[j], edge_keep[j], prefix, holds)
+            diff_dot, failed_dot = create_diff_dot(
+                DIFF_OFFSET + f, diff_graph, self.raw[(f, "post")], 0, success_post_dot, missing
+            )
+            diff_dots.append(diff_dot)
+            failed_dots.append(failed_dot)
+            missing_events.append(missing)
+        return diff_dots, failed_dots, missing_events
+
+    def _missing_events(
+        self,
+        gb: PackedBatch,
+        frontier_rule: np.ndarray,
+        missing_goal: np.ndarray,
+        edge_keep: np.ndarray,
+        prefix: str,
+        holds: np.ndarray,
+    ) -> list[MissingEvent]:
+        good = gb.graphs[0]
+
+        def rename(slot: int) -> str:
+            return rewrite_run_prefix(good.node_ids[slot], prefix)
+
+        out = []
+        for r in sorted(np.nonzero(frontier_rule)[0].tolist(), key=rename):
+            rule = Rule(
+                id=rename(r),
+                label=self.vocab.labels[int(good.label_id[r])],
+                table=self.vocab.tables[int(good.table_id[r])],
+                type={0: "", 1: "async", 2: "next", 3: "collapsed"}[int(good.type_id[r])],
+            )
+            goals = []
+            for gslot in sorted(
+                np.nonzero(edge_keep[r] & missing_goal)[0].tolist(), key=rename
+            ):
+                goals.append(
+                    Goal(
+                        id=rename(gslot),
+                        label=self.vocab.labels[int(good.label_id[gslot])],
+                        table=self.vocab.tables[int(good.table_id[gslot])],
+                        time=self.vocab.times[int(good.time_id[gslot])],
+                        cond_holds=bool(holds[gslot]),
+                    )
+                )
+            out.append(MissingEvent(rule=rule, goals=goals))
+        return out
+
+    # ------------------------------------------------------------ corrections
+
+    def generate_corrections(self) -> list[str]:
+        return synthesize_corrections(
+            find_pre_triggers(self.raw[(0, "pre")]), find_post_triggers(self.raw[(0, "post")])
+        )
+
+    # ------------------------------------------------------------- extensions
+
+    def generate_extensions(self) -> tuple[bool, list[str]]:
+        assert self.molly is not None
+        pre_tid = self.vocab.tables.lookup("pre")
+        achieved = 0
+        for run in self.molly.runs:
+            pg = self.packed[(run.iteration, "pre")]
+            holds = self.cond_holds[(run.iteration, "pre")]
+            achieved += int(np.sum(holds[: pg.n_goals] & (pg.table_id[: pg.n_goals] == pre_tid)))
+        all_achieved = achieved >= len(self.molly.runs)
+        if all_achieved:
+            return True, []
+        return False, synthesize_extensions(extension_candidates(self.raw[(0, "pre")]))

@@ -19,11 +19,15 @@ import dataclasses
 from nemo_tpu.analysis.corrections import (
     PostTrigger,
     PreTrigger,
-    parse_receiver,
     synthesize_corrections,
     synthesize_extensions,
 )
 from nemo_tpu.analysis.protos import intersect_proto, missing_from, union_proto, wrap_code
+from nemo_tpu.analysis.queries import (
+    extension_candidates,
+    find_post_triggers,
+    find_pre_triggers,
+)
 from nemo_tpu.graphs.pgraph import PGraph, PNode, build_pgraph
 from nemo_tpu.ingest.datatypes import Goal, MissingEvent, Rule
 from nemo_tpu.ingest.molly import MollyOutput
@@ -113,8 +117,9 @@ class PythonBackend(GraphBackend):
                 has_out = bool(g.out[node.id])
                 if has_in and has_out:
                     keep.add(node.id)
-        for nid in keep:
-            out.add_node(dataclasses.replace(g.nodes[nid], id=rename(nid)))
+        for nid in g.nodes:  # original insertion order (deterministic)
+            if nid in keep:
+                out.add_node(dataclasses.replace(g.nodes[nid], id=rename(nid)))
         for src, dst in g.edge_order:
             if src in keep and dst in keep:
                 out.add_edge(rename(src), rename(dst))
@@ -156,7 +161,11 @@ class PythonBackend(GraphBackend):
                         stack.append(w)
             components.append(comp)
 
-        k = 0
+        # Deterministic component order: by the insertion index of each
+        # component's first head rule (matches the packed-array kernel, which
+        # numbers collapsed rules by representative slot order).
+        node_index = {nid: i for i, nid in enumerate(g.nodes)}
+        ordered: list[tuple[int, list[str], list[str], list[str]]] = []
         for comp in components:
             comp_set = set(comp)
             comp_rules = [v for v in comp if v in next_rules]
@@ -165,8 +174,18 @@ class PythonBackend(GraphBackend):
 
             # Head rules: no predecessor chain goal within the component;
             # tail rules: no successor chain goal within the component.
-            heads = [r for r in comp_rules if not any(p in comp_set for p in g.inn[r])]
+            heads = sorted(
+                (r for r in comp_rules if not any(p in comp_set for p in g.inn[r])),
+                key=lambda r: node_index[r],
+            )
             tails = [r for r in comp_rules if not any(s in comp_set for s in g.out[r])]
+            rep_index = node_index[(heads or sorted(comp_rules, key=lambda r: node_index[r]))[0]]
+            ordered.append((rep_index, comp, heads, tails))
+
+        k = 0
+        for _, comp, heads, tails in sorted(ordered):
+            comp_set = set(comp)
+            comp_rules = [v for v in comp if v in next_rules]
             # Preds/succs outside the component (preprocessing.go:146-245).
             preds: list[str] = []
             for r in heads:
@@ -344,7 +363,15 @@ class PythonBackend(GraphBackend):
                 continue
             for child in diff.out[nid]:
                 cnode = diff.nodes[child]
-                if cnode.is_goal and not diff.out[child] and dist[child] > -(10**9):
+                # The rule must itself lie on the maximal path: its own longest
+                # root distance plus the final hop equals the leaf's distance
+                # (length(path) = maxLen, differential-provenance.go:89-91).
+                if (
+                    cnode.is_goal
+                    and not diff.out[child]
+                    and dist[child] >= 1
+                    and dist[nid] + 1 == dist[child]
+                ):
                     frontier_rules.setdefault(dist[child], [])
                     if nid not in frontier_rules[dist[child]]:
                         frontier_rules[dist[child]].append(nid)
@@ -354,11 +381,14 @@ class PythonBackend(GraphBackend):
         missing = []
         for rid in sorted(frontier_rules[best]):
             rule = diff.nodes[rid]
-            goals = [
-                diff.nodes[c]
-                for c in diff.out[rid]
-                if diff.nodes[c].is_goal  # all goal children, not only leaves (:94)
-            ]
+            goals = sorted(
+                (
+                    diff.nodes[c]
+                    for c in diff.out[rid]
+                    if diff.nodes[c].is_goal  # all goal children, not only leaves (:94)
+                ),
+                key=lambda n: n.id,
+            )
             missing.append(
                 MissingEvent(
                     rule=Rule(id=rule.id, label=rule.label, table=rule.table, type=rule.type),
@@ -395,74 +425,10 @@ class PythonBackend(GraphBackend):
     # ------------------------------------------------------------ corrections
 
     def find_pre_triggers(self, run: int) -> list[PreTrigger]:
-        """(a:Rule)->(g:Goal !holds)->(r:Rule) with a holding goal above a
-        (corrections.go:30-34), in edge order."""
-        g = self.graphs[(run, "pre")]
-        out = []
-        for a in g.nodes.values():
-            if a.is_goal:
-                continue
-            if not any(g.nodes[p].is_goal and g.nodes[p].cond_holds for p in g.inn[a.id]):
-                continue
-            for gid in g.out[a.id]:
-                goal = g.nodes[gid]
-                if not goal.is_goal or goal.cond_holds:
-                    continue
-                for rid in g.out[gid]:
-                    rule = g.nodes[rid]
-                    if rule.is_goal:
-                        continue
-                    out.append(
-                        PreTrigger(
-                            agg=Rule(id=a.id, label=a.label, table=a.table, type=a.type),
-                            goal=Goal(
-                                id=goal.id,
-                                label=goal.label,
-                                table=goal.table,
-                                time=goal.time,
-                                cond_holds=goal.cond_holds,
-                                receiver=parse_receiver(goal.label, goal.table),
-                            ),
-                            rule=Rule(id=rule.id, label=rule.label, table=rule.table, type=rule.type),
-                        )
-                    )
-        return out
+        return find_pre_triggers(self.graphs[(run, "pre")])
 
     def find_post_triggers(self, run: int) -> list[PostTrigger]:
-        """(g:Goal holds)->(r:Rule) with a rule above g and a non-holding goal
-        below r that itself has a rule below (corrections.go:121-125)."""
-        g = self.graphs[(run, "post")]
-        out = []
-        for goal in g.nodes.values():
-            if not goal.is_goal or not goal.cond_holds:
-                continue
-            if not any(not g.nodes[p].is_goal for p in g.inn[goal.id]):
-                continue
-            for rid in g.out[goal.id]:
-                rule = g.nodes[rid]
-                if rule.is_goal:
-                    continue
-                qualifies = any(
-                    g.nodes[c].is_goal
-                    and not g.nodes[c].cond_holds
-                    and any(not g.nodes[cr].is_goal for cr in g.out[c])
-                    for c in g.out[rid]
-                )
-                if qualifies:
-                    out.append(
-                        PostTrigger(
-                            goal=Goal(
-                                id=goal.id,
-                                label=goal.label,
-                                table=goal.table,
-                                time=goal.time,
-                                cond_holds=goal.cond_holds,
-                                receiver=parse_receiver(goal.label, goal.table),
-                            ),
-                            rule=Rule(id=rule.id, label=rule.label, table=rule.table, type=rule.type),
-                        )
-                    )
-        return out
+        return find_post_triggers(self.graphs[(run, "post")])
 
     def generate_corrections(self) -> list[str]:
         return synthesize_corrections(self.find_pre_triggers(0), self.find_post_triggers(0))
@@ -482,25 +448,5 @@ class PythonBackend(GraphBackend):
         all_achieved = achieved >= len(self.molly.runs)
         if all_achieved:
             return True, []
-
-        g = self.graphs[(0, "pre")]
-        candidates = []
-        for r in g.nodes.values():
-            if r.is_goal or r.type != "async":
-                continue
-            # (holding goal)->r->(non-holding goal)->(rule)  OR
-            # (non-holding goal)->r   (extensions.go:63-67).
-            cond_a = any(
-                g.nodes[p].is_goal and g.nodes[p].cond_holds for p in g.inn[r.id]
-            ) and any(
-                g.nodes[c].is_goal
-                and not g.nodes[c].cond_holds
-                and any(not g.nodes[cr].is_goal for cr in g.out[c])
-                for c in g.out[r.id]
-            )
-            cond_b = any(
-                g.nodes[p].is_goal and not g.nodes[p].cond_holds for p in g.inn[r.id]
-            )
-            if cond_a or cond_b:
-                candidates.append(r.table)
+        candidates = extension_candidates(self.graphs[(0, "pre")])
         return False, synthesize_extensions(candidates)

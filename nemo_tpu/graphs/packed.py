@@ -1,0 +1,238 @@
+"""Packed-array graph batches: the device-side representation.
+
+Each (run, condition) provenance graph becomes fixed-shape integer/boolean
+arrays; runs of similar size share a bucket (padded to the bucket's V/E) so
+kernels vmap over the run axis without ragged shapes (SURVEY.md §7 hard
+part 2).  Bucketing-by-size is this framework's expert-parallelism analog:
+same-shaped work groups per compiled program (SURVEY.md §2.3).
+
+Node slot convention: goals first (in ProvData order), then rules; slot ids
+are local to the graph.  Type ids: 0 none, 1 async, 2 next, 3 collapsed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from nemo_tpu.graphs.pgraph import PGraph
+from nemo_tpu.ingest.datatypes import ProvData
+
+from .vocab import Vocab
+
+TYPE_NONE, TYPE_ASYNC, TYPE_NEXT, TYPE_COLLAPSED = 0, 1, 2, 3
+_TYPE_IDS = {"": TYPE_NONE, "async": TYPE_ASYNC, "next": TYPE_NEXT, "collapsed": TYPE_COLLAPSED}
+TYPE_NAMES = {v: k for k, v in _TYPE_IDS.items()}
+
+
+@dataclass
+class CorpusVocab:
+    """Corpus-wide interning of tables and labels (shared by all runs)."""
+
+    tables: Vocab = field(default_factory=Vocab)
+    labels: Vocab = field(default_factory=Vocab)
+    times: Vocab = field(default_factory=Vocab)
+
+
+@dataclass
+class PackedGraph:
+    """One graph in packed form (host-side numpy; unpadded)."""
+
+    n_goals: int
+    n_nodes: int
+    node_ids: list[str]  # slot -> original id string (host-side only)
+    table_id: np.ndarray  # [n_nodes] int32
+    label_id: np.ndarray  # [n_nodes] int32
+    time_id: np.ndarray  # [n_nodes] int32
+    type_id: np.ndarray  # [n_nodes] int32
+    edges: np.ndarray  # [n_edges, 2] int32 (src slot, dst slot)
+
+
+def pack_graph(prov: ProvData, vocab: CorpusVocab) -> PackedGraph:
+    slot: dict[str, int] = {}
+    node_ids: list[str] = []
+    tables, labels, times, types = [], [], [], []
+    for g in prov.goals:
+        slot[g.id] = len(node_ids)
+        node_ids.append(g.id)
+        tables.append(vocab.tables.intern(g.table))
+        labels.append(vocab.labels.intern(g.label))
+        times.append(vocab.times.intern(g.time))
+        types.append(TYPE_NONE)
+    for r in prov.rules:
+        slot[r.id] = len(node_ids)
+        node_ids.append(r.id)
+        tables.append(vocab.tables.intern(r.table))
+        labels.append(vocab.labels.intern(r.label))
+        times.append(vocab.times.intern(""))
+        types.append(_TYPE_IDS.get(r.type, TYPE_NONE))
+    edges = np.array(
+        [[slot[e.src], slot[e.dst]] for e in prov.edges], dtype=np.int32
+    ).reshape(-1, 2)
+    return PackedGraph(
+        n_goals=len(prov.goals),
+        n_nodes=len(node_ids),
+        node_ids=node_ids,
+        table_id=np.asarray(tables, dtype=np.int32),
+        label_id=np.asarray(labels, dtype=np.int32),
+        time_id=np.asarray(times, dtype=np.int32),
+        type_id=np.asarray(types, dtype=np.int32),
+        edges=edges,
+    )
+
+
+def bucket_size(n: int, minimum: int = 16) -> int:
+    """Next power of two >= n (>= minimum)."""
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclass
+class PackedBatch:
+    """A batch of same-bucket graphs, padded to [B, V] / [B, E] (numpy)."""
+
+    run_ids: list[int]  # batch row -> run iteration
+    graphs: list[PackedGraph]  # batch row -> unpadded graph (host-side)
+    v: int
+    e: int
+    n_nodes: np.ndarray  # [B] int32
+    n_goals: np.ndarray  # [B] int32
+    is_goal: np.ndarray  # [B, V] bool
+    node_mask: np.ndarray  # [B, V] bool
+    table_id: np.ndarray  # [B, V] int32 (-1 pad)
+    label_id: np.ndarray  # [B, V] int32 (-1 pad)
+    type_id: np.ndarray  # [B, V] int32
+    edge_src: np.ndarray  # [B, E] int32 (0 pad)
+    edge_dst: np.ndarray  # [B, E] int32 (0 pad)
+    edge_mask: np.ndarray  # [B, E] bool
+
+
+def pack_batch(
+    run_ids: list[int], graphs: list[PackedGraph], v: int | None = None, e: int | None = None
+) -> PackedBatch:
+    b = len(graphs)
+    v = v or bucket_size(max((g.n_nodes for g in graphs), default=1))
+    e = e or bucket_size(max((len(g.edges) for g in graphs), default=1))
+    n_nodes = np.array([g.n_nodes for g in graphs], dtype=np.int32)
+    n_goals = np.array([g.n_goals for g in graphs], dtype=np.int32)
+    is_goal = np.zeros((b, v), dtype=bool)
+    node_mask = np.zeros((b, v), dtype=bool)
+    table_id = np.full((b, v), -1, dtype=np.int32)
+    label_id = np.full((b, v), -1, dtype=np.int32)
+    type_id = np.zeros((b, v), dtype=np.int32)
+    edge_src = np.zeros((b, e), dtype=np.int32)
+    edge_dst = np.zeros((b, e), dtype=np.int32)
+    edge_mask = np.zeros((b, e), dtype=bool)
+    for i, g in enumerate(graphs):
+        n = g.n_nodes
+        if n > v or len(g.edges) > e:
+            raise ValueError(f"graph {i} exceeds bucket (V={v}, E={e}): n={n}, e={len(g.edges)}")
+        is_goal[i, : g.n_goals] = True
+        node_mask[i, :n] = True
+        table_id[i, :n] = g.table_id
+        label_id[i, :n] = g.label_id
+        type_id[i, :n] = g.type_id
+        ne = len(g.edges)
+        if ne:
+            edge_src[i, :ne] = g.edges[:, 0]
+            edge_dst[i, :ne] = g.edges[:, 1]
+            edge_mask[i, :ne] = True
+    return PackedBatch(
+        run_ids=list(run_ids),
+        graphs=list(graphs),
+        v=v,
+        e=e,
+        n_nodes=n_nodes,
+        n_goals=n_goals,
+        is_goal=is_goal,
+        node_mask=node_mask,
+        table_id=table_id,
+        label_id=label_id,
+        type_id=type_id,
+        edge_src=edge_src,
+        edge_dst=edge_dst,
+        edge_mask=edge_mask,
+    )
+
+
+def bucketize(
+    run_ids: list[int], graphs: list[PackedGraph], max_batch: int | None = None
+) -> list[PackedBatch]:
+    """Group graphs into same-(V,E)-bucket batches, preserving run order
+    within each bucket."""
+    groups: dict[tuple[int, int], tuple[list[int], list[PackedGraph]]] = {}
+    for rid, g in zip(run_ids, graphs):
+        key = (bucket_size(g.n_nodes), bucket_size(max(1, len(g.edges))))
+        groups.setdefault(key, ([], []))
+        groups[key][0].append(rid)
+        groups[key][1].append(g)
+    batches = []
+    for (v, e), (rids, gs) in sorted(groups.items()):
+        step = max_batch or len(gs)
+        for s in range(0, len(gs), step):
+            batches.append(pack_batch(rids[s : s + step], gs[s : s + step], v, e))
+    return batches
+
+
+def rewrite_run_prefix(orig_id: str, new_prefix: str) -> str:
+    """Replace the run_<i>_<cond>_ namespace of an ingested node id
+    (ingest/molly.py prefixing, reference molly.go:92) with a shadow-run
+    prefix, mirroring the reference's sed rewrites (preprocessing.go:33-54)."""
+    return new_prefix + orig_id.split("_", 3)[-1] if orig_id.count("_") >= 3 else new_prefix + orig_id
+
+
+def unpack_to_pgraph(
+    batch: PackedBatch,
+    row: int,
+    vocab: CorpusVocab,
+    alive: np.ndarray,
+    adj: np.ndarray,
+    type_id: np.ndarray,
+    cond_holds: np.ndarray,
+    id_prefix: str,
+    collapsed_label_suffix: str = "_collapsed",
+) -> PGraph:
+    """Materialize one (possibly kernel-rewritten) graph row back into a
+    PGraph for DOT rendering.  `alive`/`adj`/`type_id`/`cond_holds` are kernel
+    outputs for this row; collapsed rules (slots whose type became
+    TYPE_COLLAPSED) get fresh ids/labels per preprocessing.go:251-252."""
+    from nemo_tpu.graphs.pgraph import PNode
+
+    g = batch.graphs[row]
+    out = PGraph()
+    n_coll = 0
+    names: dict[int, str] = {}
+    for slot in range(g.n_nodes):
+        if not alive[slot]:
+            continue
+        is_goal = slot < g.n_goals
+        table = vocab.tables[int(batch.table_id[row, slot])]
+        if not is_goal and int(type_id[slot]) == TYPE_COLLAPSED and int(
+            batch.type_id[row, slot]
+        ) != TYPE_COLLAPSED:
+            label = f"{table}{collapsed_label_suffix}"
+            nid = f"{id_prefix}{label}_{n_coll}"
+            n_coll += 1
+        else:
+            label = vocab.labels[int(batch.label_id[row, slot])]
+            nid = rewrite_run_prefix(g.node_ids[slot], id_prefix)
+        names[slot] = nid
+        out.add_node(
+            PNode(
+                id=nid,
+                is_goal=is_goal,
+                label=label,
+                table=table,
+                time=vocab.times[int(g.time_id[slot])] if is_goal else "",
+                type="" if is_goal else TYPE_NAMES.get(int(type_id[slot]), ""),
+                cond_holds=bool(cond_holds[slot]) if is_goal else False,
+            )
+        )
+    srcs, dsts = np.nonzero(adj)
+    for s, d in zip(srcs.tolist(), dsts.tolist()):
+        if s in names and d in names:
+            out.add_edge(names[s], names[d])
+    return out

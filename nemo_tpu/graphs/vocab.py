@@ -1,0 +1,36 @@
+"""String interning shared across a corpus.
+
+All Cypher matching in the reference compares table/label strings
+(e.g. prototype intersection at prototype.go:93, diff-by-label at
+differential-provenance.go:23-28).  On device, strings become stable integer
+ids interned host-side once per corpus (SURVEY.md §7 hard part 4); the same
+vocab must be shared by every run so cross-run bitset reductions line up.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Vocab:
+    strings: list[str] = field(default_factory=list)
+    ids: dict[str, int] = field(default_factory=dict)
+
+    def intern(self, s: str) -> int:
+        i = self.ids.get(s)
+        if i is None:
+            i = len(self.strings)
+            self.strings.append(s)
+            self.ids[s] = i
+        return i
+
+    def lookup(self, s: str) -> int:
+        """Id of s, or -1 if never interned."""
+        return self.ids.get(s, -1)
+
+    def __len__(self) -> int:
+        return len(self.strings)
+
+    def __getitem__(self, i: int) -> str:
+        return self.strings[i]

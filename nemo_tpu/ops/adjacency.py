@@ -1,0 +1,103 @@
+"""Shared dense-adjacency primitives for the batched graph kernels.
+
+TPU-first design: per-run provenance graphs are small (tens to a few hundred
+nodes), so reachability is cheapest as *batched dense boolean matmuls on the
+MXU* — frontier steps are [B,V]x[B,V,V] einsums and transitive closure is
+log2(V) squarings of [B,V,V] bf16 matrices — rather than as the pointer-chasing
+BFS a CPU graph store performs (the Cypher `-[*0..]->` matches of
+preprocessing.go:18, prototype.go:12, differential-provenance.go:26).  The
+run axis B is the data-parallel axis sharded across the TPU mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def build_adjacency(
+    edge_src: jax.Array, edge_dst: jax.Array, edge_mask: jax.Array, v: int
+) -> jax.Array:
+    """Edge lists [B,E] -> dense boolean adjacency [B,V,V]."""
+    b = edge_src.shape[0]
+    adj = jnp.zeros((b, v, v), dtype=bool)
+    b_idx = jnp.arange(b)[:, None]
+    return adj.at[b_idx, edge_src, edge_dst].max(edge_mask)
+
+
+def bool_matmul(x: jax.Array, y: jax.Array) -> jax.Array:
+    """Boolean matrix product on the MXU: bf16 multiply, f32 accumulate,
+    threshold.  Exact because entries are 0/1 and accumulation is f32."""
+    prod = jnp.einsum(
+        "...ik,...kj->...ij",
+        x.astype(jnp.bfloat16),
+        y.astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    )
+    return prod > 0.5
+
+
+def step_forward(frontier: jax.Array, adj: jax.Array) -> jax.Array:
+    """One BFS hop: nodes with an in-edge from the frontier.  [B,V]x[B,V,V]."""
+    prod = jnp.einsum(
+        "...v,...vw->...w",
+        frontier.astype(jnp.bfloat16),
+        adj.astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    )
+    return prod > 0.5
+
+
+def step_backward(frontier: jax.Array, adj: jax.Array) -> jax.Array:
+    """One reverse hop: nodes with an out-edge into the frontier."""
+    prod = jnp.einsum(
+        "...w,...vw->...v",
+        frontier.astype(jnp.bfloat16),
+        adj.astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    )
+    return prod > 0.5
+
+
+def closure(adj: jax.Array) -> jax.Array:
+    """Reflexive-transitive closure (>=0 hops) by log2(V) squarings."""
+    v = adj.shape[-1]
+    eye = jnp.eye(v, dtype=bool)
+    r = adj | eye
+    n_steps = max(1, (v - 1).bit_length())
+    for _ in range(n_steps):
+        r = bool_matmul(r, r)
+    return r
+
+
+def reach_ge1(adj: jax.Array, clo: jax.Array) -> jax.Array:
+    """>=1-hop reachability from the >=0-hop closure: adj @ closure."""
+    return bool_matmul(adj, clo)
+
+
+def in_degree_any(adj: jax.Array) -> jax.Array:
+    """[B,V] bool: node has any incoming edge."""
+    return adj.any(axis=-2)
+
+
+def out_degree_any(adj: jax.Array) -> jax.Array:
+    """[B,V] bool: node has any outgoing edge."""
+    return adj.any(axis=-1)
+
+
+def table_bitset(mask: jax.Array, table_id: jax.Array, num_tables: int) -> jax.Array:
+    """[B,V] node mask -> [B,T] per-table any-bitset (table_id -1 = padding)."""
+    tid = jnp.clip(table_id, 0, num_tables - 1)
+    one_hot = jax.nn.one_hot(tid, num_tables, dtype=bool) & (table_id >= 0)[..., None]
+    return jnp.any(one_hot & mask[..., None], axis=-2)
+
+
+def table_min(
+    values: jax.Array, mask: jax.Array, table_id: jax.Array, num_tables: int, fill: int
+) -> jax.Array:
+    """[B,V] int values -> [B,T] per-table min over masked nodes (else fill)."""
+    tid = jnp.clip(table_id, 0, num_tables - 1)
+    one_hot = jax.nn.one_hot(tid, num_tables, dtype=bool) & (table_id >= 0)[..., None]
+    sel = one_hot & mask[..., None]
+    vals = jnp.where(sel, values[..., None], fill)
+    return jnp.min(vals, axis=-2)

@@ -1,0 +1,35 @@
+"""Condition-holds marking kernel.
+
+Array form of the reference's markConditionHolds Cypher
+(graphing/pre-post-prov.go:218-244): find the root goal of the condition's
+table (no incoming edge), its child rules of the same table, and THEIR child
+goals g; if any such g exists, set condition_holds on every goal whose table
+is the condition's or any g's.  Two masked BFS hops plus a table scatter,
+vmapped over the run batch.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .adjacency import in_degree_any, step_forward, table_bitset
+
+
+def mark_condition_holds(
+    adj: jax.Array,  # [B,V,V] bool
+    is_goal: jax.Array,  # [B,V] bool
+    table_id: jax.Array,  # [B,V] int32
+    node_mask: jax.Array,  # [B,V] bool
+    cond_tid: int,
+    num_tables: int,
+) -> jax.Array:
+    """Returns cond_holds [B,V] bool."""
+    root = is_goal & node_mask & (table_id == cond_tid) & ~in_degree_any(adj)
+    rule = step_forward(root, adj) & ~is_goal & node_mask & (table_id == cond_tid)
+    trig = step_forward(rule, adj) & is_goal & node_mask
+    any_trig = trig.any(axis=-1, keepdims=True)
+    trig_tables = table_bitset(trig, table_id, num_tables)  # [B,T]
+    tid = jnp.clip(table_id, 0, num_tables - 1)
+    in_trig_table = jnp.take_along_axis(trig_tables, tid, axis=-1) & (table_id >= 0)
+    return is_goal & node_mask & any_trig & ((table_id == cond_tid) | in_trig_table)

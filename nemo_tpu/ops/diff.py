@@ -1,0 +1,73 @@
+"""Differential-provenance kernels.
+
+Array form of the reference's CreateNaiveDiffProv
+(graphing/differential-provenance.go:18-243; semantics per backend/base.py):
+the diff graph keeps nodes/edges of the good run's consequent provenance that
+lie on a path between two goals whose labels are absent from the failed run
+(endpoint-filtered: forward-reachable from an ok goal AND backward-reachable
+to one); the missing-event frontier is the terminal rule of the longest
+root->leaf paths plus all its goal children.  The failed-run label set enters
+as a label-vocab bitset; everything vmaps over the failed-run axis against a
+single shared good graph.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .adjacency import closure, in_degree_any, out_degree_any
+
+NEG_INF = -(1 << 20)
+
+
+def longest_depths(adj: jax.Array, start: jax.Array, max_depth: int) -> jax.Array:
+    """Longest path length (in edges) from start nodes; NEG_INF if unreachable.
+    Bounded max-plus iteration; exact when max_depth >= graph depth."""
+    d = jnp.where(start, 0, NEG_INF)
+
+    def body(_, dist):
+        stepped = jnp.max(jnp.where(adj, dist[..., None], NEG_INF), axis=-2) + 1
+        return jnp.maximum(dist, stepped)
+
+    return lax.fori_loop(0, max_depth, body, d)
+
+
+def diff_masks(
+    adj_good: jax.Array,  # [V,V] good run's raw consequent adjacency
+    is_goal: jax.Array,  # [V]
+    node_mask: jax.Array,  # [V]
+    label_id: jax.Array,  # [V]
+    fail_bits: jax.Array,  # [B,L] one bitset per failed run
+    max_depth: int,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Returns (node_keep [B,V], edge_keep [B,V,V], frontier_rule [B,V],
+    missing_goal [B,V])."""
+    num_labels = fail_bits.shape[-1]
+    lid = jnp.clip(label_id, 0, num_labels - 1)
+    clo = closure(adj_good)  # [V,V], shared across failed runs
+
+    def per_run(bits: jax.Array):
+        in_failed = bits[lid] & (label_id >= 0)
+        ok = is_goal & node_mask & ~in_failed
+        fwd = (clo & ok[:, None]).any(axis=0)  # >=0 hops from an ok goal
+        bwd = (clo & ok[None, :]).any(axis=1)  # >=0 hops to an ok goal
+        node_keep = fwd & bwd & node_mask
+        edge_keep = adj_good & fwd[:, None] & bwd[None, :]
+
+        root = is_goal & node_keep & ~in_degree_any(edge_keep)
+        leaf = is_goal & node_keep & ~out_degree_any(edge_keep)
+        dist = longest_depths(edge_keep, root, max_depth)
+        leaf_dist = jnp.where(leaf & (dist >= 1), dist, NEG_INF)
+        max_len = jnp.max(leaf_dist)
+        frontier_rule = (
+            ~is_goal
+            & node_keep
+            & (dist + 1 == max_len)
+            & (edge_keep & (leaf & (dist == max_len))[None, :]).any(axis=1)
+        )
+        missing_goal = is_goal & node_keep & (edge_keep & frontier_rule[:, None]).any(axis=0)
+        return node_keep, edge_keep, frontier_rule, missing_goal
+
+    return jax.vmap(per_run)(fail_bits)

@@ -1,0 +1,86 @@
+"""Success-prototype kernels.
+
+Array form of the reference's extractProtos/missingFrom Cypher
+(graphing/prototype.go:11-24, :143-147; corrected semantics per SURVEY.md §7):
+per run, the rule tables on paths root-[1]->rule-[*1..]->rule from in-degree-0
+goals of the simplified consequent graph — i.e. rules reachable from a root
+that have a rule descendant or a reachable rule ancestor — gated on the run
+having achieved the antecedent.  Cross-run intersection/union are AND/OR
+reductions over the run axis (jnp.all/any; under a sharded mesh XLA lowers
+them to all-reduces over ICI).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .adjacency import (
+    closure,
+    in_degree_any,
+    reach_ge1,
+    step_backward,
+    step_forward,
+    table_bitset,
+    table_min,
+)
+
+DEPTH_INF = 1 << 20
+
+
+def hop_depths(adj: jax.Array, start: jax.Array, max_depth: int) -> jax.Array:
+    """Shortest hop distance [B,V] from start nodes, DEPTH_INF if unreachable.
+    Bounded iteration (static trip count) per XLA's fixed-shape model."""
+    depth = jnp.where(start, 0, DEPTH_INF)
+
+    def body(_, d):
+        stepped = jnp.min(jnp.where(adj, d[..., None], DEPTH_INF), axis=-2) + 1
+        return jnp.minimum(d, stepped)
+
+    return lax.fori_loop(0, max_depth, body, depth)
+
+
+def proto_rule_bits(
+    adj: jax.Array,  # [B,V,V] simplified consequent adjacency
+    is_goal: jax.Array,  # [B,V]
+    alive: jax.Array,  # [B,V]
+    table_id: jax.Array,  # [B,V]
+    achieved_pre: jax.Array,  # [B] bool
+    num_tables: int,
+    max_depth: int,
+) -> tuple[jax.Array, jax.Array]:
+    """Returns (bits [B,T] bool, min_rule_depth [B,T] int32)."""
+    a = adj & alive[..., None] & alive[..., None, :]
+    root = is_goal & alive & ~in_degree_any(a)
+    clo = closure(a)
+    d1 = reach_ge1(a, clo)  # >=1-hop reachability
+    reach = step_forward(root, d1) | jnp.zeros_like(root)  # nodes >=1 hop below a root
+    is_rule = ~is_goal & alive
+    rule_desc = step_backward(is_rule, d1)  # has a rule strictly below
+    rule_anc = step_forward(is_rule & reach, d1)  # has a reachable rule strictly above
+    qualify = is_rule & reach & (rule_desc | rule_anc) & achieved_pre[..., None]
+
+    depth = hop_depths(a, root, max_depth)
+    rule_depth = (depth + 1) // 2  # hops alternate goal/rule
+
+    bits = table_bitset(qualify, table_id, num_tables)
+    min_depth = table_min(rule_depth, qualify, table_id, num_tables, DEPTH_INF)
+    return bits, min_depth
+
+
+def all_rule_bits(
+    is_goal: jax.Array, alive: jax.Array, table_id: jax.Array, num_tables: int
+) -> jax.Array:
+    """[B,T]: distinct rule tables present in each simplified graph
+    (missingFrom's MATCH (r:Rule), prototype.go:143-147)."""
+    return table_bitset(~is_goal & alive, table_id, num_tables)
+
+
+def reduce_protos(bits: jax.Array, achieved: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """(intersection [T], union [T]) over achieving runs.  Under a mesh with
+    the run axis sharded, jnp.all/any lower to cross-device all-reduces."""
+    masked = bits & achieved[..., None]
+    inter = jnp.all(masked | ~achieved[..., None], axis=0) & jnp.any(achieved)
+    union = jnp.any(masked, axis=0)
+    return inter, union

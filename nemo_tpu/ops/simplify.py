@@ -1,0 +1,106 @@
+"""Graph simplification kernels: clean-copy restriction and @next chain
+contraction.
+
+Array form of the reference's SimplifyProv pass
+(graphing/preprocessing.go:351-387; semantics per backend/base.py):
+
+  * clean_masks: keep all goals; keep rules with both an incoming and an
+    outgoing goal edge; keep edge g->r iff r has an out-goal, r->g iff r has
+    an in-goal (the Goal-[*0..]->Goal path restriction of
+    preprocessing.go:17-27, expressed as degree masks on the bipartite graph).
+
+  * collapse_chains: contract each connected component (with >=2 next rules)
+    of the {type==next rules + goals strictly between next rules} subgraph
+    into a single collapsed rule occupying the slot of the component's
+    minimum-index head rule; external goal predecessors of head rules and
+    goal successors of tail rules rewire to it; everything else in the
+    component dies (preprocessing.go:66-348).  Component labeling runs as a
+    transitive closure on the MXU; edge rewiring is two boolean matmuls that
+    move columns/rows onto the representative slot.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from nemo_tpu.graphs.packed import TYPE_COLLAPSED, TYPE_NEXT
+
+from .adjacency import bool_matmul, closure, step_backward, step_forward
+
+
+def clean_masks(
+    adj: jax.Array, is_goal: jax.Array, node_mask: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """Returns (adj_clean [B,V,V], alive [B,V])."""
+    goal = is_goal & node_mask
+    has_in_goal = step_forward(goal, adj)  # rule has an incoming goal edge
+    has_out_goal = step_backward(goal, adj)  # rule has an outgoing goal edge
+    is_rule = ~is_goal & node_mask
+    alive = goal | (is_rule & has_in_goal & has_out_goal)
+    # Edge u->v: from a goal, keep iff rule v has an out-goal; from a rule u,
+    # keep iff u has an in-goal.
+    keep = jnp.where(goal[..., None], has_out_goal[..., None, :], has_in_goal[..., None])
+    adj_clean = adj & keep & alive[..., None] & alive[..., None, :]
+    return adj_clean, alive
+
+
+def collapse_chains(
+    adj: jax.Array,  # [B,V,V] clean adjacency
+    is_goal: jax.Array,  # [B,V]
+    type_id: jax.Array,  # [B,V]
+    alive: jax.Array,  # [B,V]
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Returns (adj_new, alive_new, type_new)."""
+    v = adj.shape[-1]
+    idx = jnp.arange(v)
+
+    a = adj & alive[..., None] & alive[..., None, :]
+    next_rule = ~is_goal & alive & (type_id == TYPE_NEXT)
+    in_from_next = step_forward(next_rule, a)
+    out_to_next = step_backward(next_rule, a)
+    chain_goal = is_goal & alive & in_from_next & out_to_next
+    member = next_rule | chain_goal
+
+    # Component labels = min member index reachable in the undirected member
+    # subgraph (closure on the MXU; log2(V) squarings).
+    und = (a | jnp.swapaxes(a, -1, -2)) & member[..., None] & member[..., None, :]
+    comp_reach = closure(und)  # includes identity
+    lab = jnp.min(
+        jnp.where(comp_reach & member[..., None], idx[None, :, None], v), axis=-2
+    )  # [B,V]; == v for non-members
+    lab_c = jnp.clip(lab, 0, v - 1)
+
+    in_from_member = step_forward(member, a)
+    out_to_member = step_backward(member, a)
+    head = next_rule & ~in_from_member
+    tail = next_rule & ~out_to_member
+
+    one_hot_lab = (lab[..., None] == idx) & member[..., None]  # [B,V,C]
+    rep_per_comp = jnp.min(
+        jnp.where(one_hot_lab & head[..., None], idx[:, None], v), axis=-2
+    )  # [B,C] min head index, v if no head
+    n_rules_per_comp = jnp.sum(one_hot_lab & next_rule[..., None], axis=-2)
+    collapsible_comp = (n_rules_per_comp >= 2) & (rep_per_comp < v)
+
+    node_collapsible = member & jnp.take_along_axis(collapsible_comp, lab_c, axis=-1)
+    rep_of_node = jnp.where(
+        node_collapsible, jnp.take_along_axis(rep_per_comp, lab_c, axis=-1), idx
+    )
+    is_rep = node_collapsible & (idx == rep_of_node)
+    dies = node_collapsible & ~is_rep
+
+    # Column/row moves onto the representative slot.
+    ext_goal = is_goal & alive & ~member
+    head_map = (rep_of_node[..., None] == idx) & head[..., None] & node_collapsible[..., None]
+    tail_map = (rep_of_node[..., None] == idx) & tail[..., None] & node_collapsible[..., None]
+    pred_edges = bool_matmul(a & ext_goal[..., None], head_map)  # goal -> rep
+    succ_edges = bool_matmul(
+        jnp.swapaxes(tail_map, -1, -2), a & ext_goal[..., None, :]
+    )  # rep -> goal
+
+    kill = node_collapsible
+    adj_new = (a & ~kill[..., None] & ~kill[..., None, :]) | pred_edges | succ_edges
+    alive_new = alive & ~dies
+    type_new = jnp.where(is_rep, TYPE_COLLAPSED, type_id)
+    return adj_new, alive_new, type_new

@@ -49,7 +49,7 @@ def create_dot(graph: PGraph, graph_type: str) -> DotGraph:
     """Provenance graph -> DOT, one statement pair per edge
     (reference: graphing/diagrams.go:15-130 'createDOT')."""
     dot = DotGraph(name="dataflow")
-    dot.add_node("graph", {"bgcolor": "transparent"})
+    dot.graph_attrs["bgcolor"] = "transparent"
     for src, dst in graph.edge_order:
         dot.add_node(src, _node_attrs(graph.nodes[src], graph_type))
         dot.add_node(dst, _node_attrs(graph.nodes[dst], graph_type))
@@ -84,15 +84,13 @@ def create_diff_dot(
 
     diff_dot = DotGraph(name="dataflow")
     failed_dot = DotGraph(name="dataflow")
-    diff_dot.add_node("graph", {"bgcolor": "transparent"})
-    failed_dot.add_node("graph", {"bgcolor": "transparent"})
+    diff_dot.graph_attrs["bgcolor"] = "transparent"
+    failed_dot.graph_attrs["bgcolor"] = "transparent"
 
     old, new = f"run_{success_run_id}", f"run_{diff_run_id}"
 
     # Copy the good graph with every node/edge hidden (diagrams.go:185-234).
     for node in success_post_dot.nodes:
-        if node.name == "graph":
-            continue
         attrs = dict(node.attrs)
         attrs["style"] = INVIS_STYLE
         name = node.name.replace(old, new)

@@ -27,7 +27,7 @@ def _node_size(label: str) -> tuple[float, float]:
 
 
 def render_svg(g: DotGraph) -> str:
-    nodes = [n for n in g.nodes if n.name != "graph"]
+    nodes = list(g.nodes)
     names = {n.name for n in nodes}
     edges = [e for e in g.edges if e.src in names and e.dst in names]
 

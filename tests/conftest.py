@@ -1,17 +1,30 @@
 """Test configuration.
 
-Force JAX onto a virtual 8-device CPU platform so mesh/sharding code is
-exercised without TPU hardware (SURVEY.md §4d).  Must run before jax imports.
+Run JAX on a virtual 8-device CPU platform so mesh/sharding code is exercised
+without TPU hardware (SURVEY.md §4d).  This environment presets
+JAX_PLATFORMS=axon (a tunnel to one real TPU chip), which would put the whole
+suite on a single slow-compiling device — so the suite defaults to cpu; set
+NEMO_TEST_PLATFORM=tpu (or any platform name) to run the kernels on real
+hardware instead.  Must run before jax imports.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_platform = os.environ.get("NEMO_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+# The environment's TPU-tunnel plugin (sitecustomize) force-sets
+# jax_platforms at interpreter start, overriding the env var; set it back so
+# the suite never blocks on tunnel health unless a platform was explicitly
+# requested via NEMO_TEST_PLATFORM.
+jax.config.update("jax_platforms", _platform)
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,100 @@
+"""Backend-differential tests: the JAX kernels must reproduce the Python
+oracle exactly (SURVEY.md §4b — the per-query parity oracle)."""
+
+import json
+
+import pytest
+
+from nemo_tpu.backend.jax_backend import JaxBackend
+from nemo_tpu.backend.python_ref import CLEAN_OFFSET, PythonBackend
+from nemo_tpu.ingest.molly import load_molly_output
+
+
+@pytest.fixture(scope="module")
+def molly(corpus_dir):
+    return load_molly_output(corpus_dir)
+
+
+@pytest.fixture(scope="module")
+def oracle(corpus_dir):
+    m = load_molly_output(corpus_dir)
+    b = PythonBackend()
+    b.init_graph_db("", m)
+    b.load_raw_provenance()
+    b.simplify_prov(m.runs_iters)
+    return b
+
+
+@pytest.fixture(scope="module")
+def jaxed(corpus_dir):
+    m = load_molly_output(corpus_dir)
+    b = JaxBackend()
+    b.init_graph_db("", m)
+    b.load_raw_provenance()
+    b.simplify_prov(m.runs_iters)
+    return b
+
+
+def graph_signature(g):
+    nodes = {
+        (n.id, n.is_goal, n.label, n.table, n.type, n.cond_holds) for n in g.nodes.values()
+    }
+    edges = set(g.edge_order)
+    return nodes, edges
+
+
+def test_condition_holds_parity(oracle, jaxed, molly):
+    for run in molly.runs:
+        for cond in ("pre", "post"):
+            o = oracle.graphs[(run.iteration, cond)]
+            j = jaxed.raw[(run.iteration, cond)]
+            o_holds = {n.id: n.cond_holds for n in o.goals()}
+            j_holds = {n.id: n.cond_holds for n in j.goals()}
+            assert o_holds == j_holds, (run.iteration, cond)
+
+
+def test_simplified_graph_parity(oracle, jaxed, molly):
+    for run in molly.runs:
+        for cond in ("pre", "post"):
+            o = oracle.graphs[(CLEAN_OFFSET + run.iteration, cond)]
+            j = jaxed.clean[(CLEAN_OFFSET + run.iteration, cond)]
+            assert graph_signature(o) == graph_signature(j), (run.iteration, cond)
+
+
+def test_prototype_parity(oracle, jaxed, molly):
+    s, f = molly.success_runs_iters, molly.failed_runs_iters
+    assert oracle.create_prototypes(s, f) == jaxed.create_prototypes(s, f)
+
+
+def test_diff_parity(oracle, jaxed, molly):
+    _, post_dots, _, _ = oracle.pull_pre_post_prov()
+    o_diff, o_failed, o_missing = oracle.create_naive_diff_prov(
+        False, molly.failed_runs_iters, post_dots[0]
+    )
+    j_diff, j_failed, j_missing = jaxed.create_naive_diff_prov(
+        False, molly.failed_runs_iters, post_dots[0]
+    )
+    for om, jm in zip(o_missing, j_missing):
+        assert [m.to_json() for m in om] == [m.to_json() for m in jm]
+    # Diff overlays: same visible node/edge sets.
+    for od, jd in zip(o_diff, j_diff):
+        o_vis = {(n.name, n.attrs.get("style")) for n in od.nodes}
+        j_vis = {(n.name, n.attrs.get("style")) for n in jd.nodes}
+        assert o_vis == j_vis
+
+
+def test_corrections_extensions_parity(oracle, jaxed):
+    assert oracle.generate_corrections() == jaxed.generate_corrections()
+    assert oracle.generate_extensions() == jaxed.generate_extensions()
+
+
+def test_full_pipeline_parity(corpus_dir, tmp_path):
+    """The whole debugging.json must be byte-identical across backends."""
+    from nemo_tpu.analysis.pipeline import run_debug
+
+    r1 = run_debug(corpus_dir, str(tmp_path / "py"), PythonBackend())
+    r2 = run_debug(corpus_dir, str(tmp_path / "jax"), JaxBackend())
+    with open(f"{r1.report_dir}/debugging.json") as f1, open(
+        f"{r2.report_dir}/debugging.json"
+    ) as f2:
+        assert json.load(f1) == json.load(f2)

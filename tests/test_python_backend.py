@@ -163,7 +163,7 @@ def test_diff_overlay_visibility(backend):
     f = molly.failed_runs_iters[0]
     diff_dot = diff_dots[0]
     # Every node is either invisible (copied from the good graph) or revealed.
-    styles = {n.attrs.get("style") for n in diff_dot.nodes if n.name != "graph"}
+    styles = {n.attrs.get("style") for n in diff_dot.nodes}
     assert styles <= {"invis", "filled, solid", "filled, dashed, bold"}
     # Missing-frontier nodes are marked mediumvioletred.
     missing_ids = {m.rule.id for m in missing[0]}
@@ -221,8 +221,7 @@ def test_pull_dots_styling(backend):
     d = pre[0]
     by_label = {}
     for n in d.nodes:
-        if n.name != "graph":
-            by_label.setdefault(n.attrs.get("label", ""), n)
+        by_label.setdefault(n.attrs.get("label", ""), n)
     # Condition-holding pre goals are firebrick ellipses.
     pre_goal = next(n for label, n in by_label.items() if label.startswith("pre("))
     assert pre_goal.attrs["fillcolor"] == "firebrick"
