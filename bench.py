@@ -1,0 +1,119 @@
+"""Benchmark: provenance-graphs/sec of the batched TPU analysis pipeline.
+
+Times the flagship fused analysis_step (condition marking + simplification +
+prototypes + differential provenance — the per-run Cypher pipeline of the
+reference, main.go:106-180) over a large synthetic run batch, and compares
+against the sequential Python oracle backend running the same analyses —
+the stand-in for the reference's one-run-at-a-time Neo4j path (BASELINE.md;
+the oracle is strictly faster than Neo4j since it skips all Bolt round-trips).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Env knobs: NEMO_BENCH_RUNS (default 4096), NEMO_BENCH_BASE_RUNS (default 64),
+NEMO_BENCH_PLATFORM (force a jax platform, e.g. cpu).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    platform = os.environ.get("NEMO_BENCH_PLATFORM")
+    if platform:
+        os.environ["JAX_PLATFORMS"] = platform
+    import jax
+
+    if platform:
+        jax.config.update("jax_platforms", platform)
+
+    import jax.numpy as jnp
+
+    from nemo_tpu.backend.python_ref import PythonBackend
+    from nemo_tpu.ingest.molly import load_molly_output
+    from nemo_tpu.models.pipeline_model import (
+        BatchArrays,
+        analysis_step,
+        pack_molly_for_step,
+    )
+    from nemo_tpu.models.synth import SynthSpec, write_corpus
+
+    n_runs = int(os.environ.get("NEMO_BENCH_RUNS", "4096"))
+    base_runs = int(os.environ.get("NEMO_BENCH_BASE_RUNS", "64"))
+    log(f"device: {jax.devices()[0].platform} x{len(jax.devices())}")
+
+    # Base corpus: base_runs distinct runs; tile the packed batch to n_runs
+    # (per-run work is identical, so tiling is timing-representative while
+    # keeping host-side generation cheap).
+    corpus = write_corpus(SynthSpec(n_runs=base_runs, seed=11, eot=7), tempfile.mkdtemp())
+    molly = load_molly_output(corpus)
+    pre, post, static = pack_molly_for_step(molly)
+    reps = max(1, (n_runs + base_runs - 1) // base_runs)
+
+    def tile(arrays: BatchArrays) -> BatchArrays:
+        return jax.tree_util.tree_map(
+            lambda x: jnp.asarray(np.tile(np.asarray(x), (reps,) + (1,) * (x.ndim - 1))),
+            arrays,
+        )
+
+    pre_t, post_t = tile(pre), tile(post)
+    batch = pre_t.is_goal.shape[0]
+    graphs = 2 * batch  # pre + post provenance per run
+    log(f"batch: {batch} runs ({graphs} graphs), bucket V={static['v']}")
+
+    # Warm up (compile), then time steady-state iterations.
+    out = analysis_step(pre_t, post_t, **static)
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        out = analysis_step(pre_t, post_t, **static)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    t_step = float(np.median(times))
+    value = graphs / t_step
+    log(f"analysis_step: {t_step * 1e3:.1f} ms median -> {value:,.0f} graphs/s")
+
+    # Baseline: the sequential oracle over the base corpus (same analyses).
+    # init_graph_db is excluded from the timed region the same way the JAX
+    # side's packing is — both sides time analysis only.
+    oracle = PythonBackend()
+    oracle.init_graph_db("", molly)
+    t0 = time.perf_counter()
+    oracle.load_raw_provenance()
+    oracle.simplify_prov(molly.runs_iters)
+    for i in molly.success_runs_iters:
+        oracle.proto_rule_tables(i, "post")
+    for f in molly.failed_runs_iters:
+        oracle.clean_rule_tables(f, "post")
+        diff = oracle.diff_graph(f)
+        oracle._diff_missing(diff)
+    t_base = time.perf_counter() - t0
+    base_graphs_per_sec = (2 * base_runs) / t_base
+    log(f"python oracle: {t_base * 1e3:.1f} ms for {2 * base_runs} graphs "
+        f"-> {base_graphs_per_sec:,.0f} graphs/s")
+
+    print(
+        json.dumps(
+            {
+                "metric": "provenance-graphs/sec, full analysis pipeline "
+                f"({batch} fault-injection runs, batched)",
+                "value": round(value, 1),
+                "unit": "graphs/s",
+                "vs_baseline": round(value / base_graphs_per_sec, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

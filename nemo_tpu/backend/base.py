@@ -75,10 +75,22 @@ class GraphBackend(abc.ABC):
             actually take.)
         """
 
-    @abc.abstractmethod
+    molly: MollyOutput | None
+
     def create_hazard_analysis(self, fault_inj_out: str) -> list[DotGraph]:
         """Recolored space-time diagram per run
-        (reference: CreateHazardAnalysis, graphing/hazard-analysis.go:16-88)."""
+        (reference: CreateHazardAnalysis, graphing/hazard-analysis.go:16-88).
+        Purely host-side (reads Molly's DOT files + the holds maps), so it is
+        shared by all backends."""
+        from nemo_tpu.report.figures import create_hazard_dot
+
+        assert self.molly is not None
+        dots = []
+        for run in self.molly.runs:
+            with open(self.molly.spacetime_dot_path(run.iteration), "r", encoding="utf-8") as f:
+                text = f.read()
+            dots.append(create_hazard_dot(text, run.time_pre_holds, run.time_post_holds))
+        return dots
 
     @abc.abstractmethod
     def create_prototypes(

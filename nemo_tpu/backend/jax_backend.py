@@ -42,7 +42,7 @@ from nemo_tpu.ops.diff import diff_masks
 from nemo_tpu.ops.proto import DEPTH_INF, all_rule_bits, proto_rule_bits
 from nemo_tpu.ops.simplify import clean_masks, collapse_chains
 from nemo_tpu.report.dot import DotGraph
-from nemo_tpu.report.figures import create_diff_dot, create_dot, create_hazard_dot
+from nemo_tpu.report.figures import create_diff_dot, create_dot
 
 from .base import GraphBackend
 from .python_ref import CLEAN_OFFSET, DIFF_OFFSET
@@ -92,7 +92,16 @@ class JaxBackend(GraphBackend):
     # ------------------------------------------------------------------ setup
 
     def init_graph_db(self, conn: str, molly: MollyOutput) -> None:
+        # Full state reset: a backend instance may be reused across corpora.
         self.molly = molly
+        self.vocab = CorpusVocab()
+        self.packed = {}
+        self.raw = {}
+        self.clean = {}
+        self.cond_holds = {}
+        self.achieved_pre = {}
+        self.simplified = {}
+        self._batch_cache = {}
         for run in molly.runs:
             for cond, prov in (("pre", run.pre_prov), ("post", run.post_prov)):
                 self.packed[(run.iteration, cond)] = pack_graph(prov, self.vocab)
@@ -101,6 +110,8 @@ class JaxBackend(GraphBackend):
     def close_db(self) -> None:
         self.packed = {}
         self.simplified = {}
+        self._batch_cache = {}
+        self.cond_holds = {}
 
     def _batches(self, cond: str, iters: list[int] | None = None) -> list[PackedBatch]:
         """Size-bucketed batches for one condition; cached per (cond, runs)."""
@@ -183,16 +194,7 @@ class JaxBackend(GraphBackend):
                     )
             self.simplified[cond] = outs
 
-    # ----------------------------------------------------------------- hazard
-
-    def create_hazard_analysis(self, fault_inj_out: str) -> list[DotGraph]:
-        assert self.molly is not None
-        dots = []
-        for run in self.molly.runs:
-            with open(self.molly.spacetime_dot_path(run.iteration), "r", encoding="utf-8") as f:
-                text = f.read()
-            dots.append(create_hazard_dot(text, run.time_pre_holds, run.time_post_holds))
-        return dots
+    # (create_hazard_analysis is inherited from GraphBackend — host-side only.)
 
     # ------------------------------------------------------------- prototypes
 

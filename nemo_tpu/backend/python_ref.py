@@ -32,7 +32,7 @@ from nemo_tpu.graphs.pgraph import PGraph, PNode, build_pgraph
 from nemo_tpu.ingest.datatypes import Goal, MissingEvent, Rule
 from nemo_tpu.ingest.molly import MollyOutput
 from nemo_tpu.report.dot import DotGraph
-from nemo_tpu.report.figures import create_diff_dot, create_dot, create_hazard_dot
+from nemo_tpu.report.figures import create_diff_dot, create_dot
 
 from .base import GraphBackend
 
@@ -209,16 +209,7 @@ class PythonBackend(GraphBackend):
             for v in comp:
                 g.remove_node(v)
 
-    # ----------------------------------------------------------------- hazard
-
-    def create_hazard_analysis(self, fault_inj_out: str) -> list[DotGraph]:
-        assert self.molly is not None
-        dots = []
-        for run in self.molly.runs:
-            with open(self.molly.spacetime_dot_path(run.iteration), "r", encoding="utf-8") as f:
-                text = f.read()
-            dots.append(create_hazard_dot(text, run.time_pre_holds, run.time_post_holds))
-        return dots
+    # (create_hazard_analysis is inherited from GraphBackend — host-side only.)
 
     # ------------------------------------------------------------- prototypes
 

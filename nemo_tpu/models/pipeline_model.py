@@ -1,0 +1,187 @@
+"""The flagship model: one fused, jittable analysis step over a run batch.
+
+This is the framework's equivalent of a model's training step — the unit the
+benchmark times and the driver compile-checks.  Given the packed pre/post
+provenance batches of B fault-injection runs (both padded to one bucket), a
+single jit region computes everything the per-run Cypher pipeline of the
+reference produces (main.go:106-180): condition marking for both conditions,
+clean-copy + @next chain contraction, prototype bitsets with cross-run
+intersection/union reductions, and differential provenance of every run
+against the successful run in row 0.  Under a sharded mesh the run axis is
+data-parallel and the cross-run reductions become ICI all-reduces.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nemo_tpu.graphs.packed import CorpusVocab, pack_batch, pack_graph
+from nemo_tpu.ingest.molly import MollyOutput
+from nemo_tpu.ops.adjacency import build_adjacency
+from nemo_tpu.ops.condition import mark_condition_holds
+from nemo_tpu.ops.diff import diff_masks
+from nemo_tpu.ops.proto import all_rule_bits, proto_rule_bits, reduce_protos
+from nemo_tpu.ops.simplify import clean_masks, collapse_chains
+
+
+@dataclass
+class BatchArrays:
+    """Device-ready arrays for one condition's run batch."""
+
+    edge_src: jnp.ndarray
+    edge_dst: jnp.ndarray
+    edge_mask: jnp.ndarray
+    is_goal: jnp.ndarray
+    table_id: jnp.ndarray
+    label_id: jnp.ndarray
+    type_id: jnp.ndarray
+    node_mask: jnp.ndarray
+
+    @classmethod
+    def from_packed(cls, batch) -> "BatchArrays":
+        return cls(
+            edge_src=jnp.asarray(batch.edge_src),
+            edge_dst=jnp.asarray(batch.edge_dst),
+            edge_mask=jnp.asarray(batch.edge_mask),
+            is_goal=jnp.asarray(batch.is_goal),
+            table_id=jnp.asarray(batch.table_id),
+            label_id=jnp.asarray(batch.label_id),
+            type_id=jnp.asarray(batch.type_id),
+            node_mask=jnp.asarray(batch.node_mask),
+        )
+
+
+jax.tree_util.register_dataclass(
+    BatchArrays,
+    data_fields=[
+        "edge_src",
+        "edge_dst",
+        "edge_mask",
+        "is_goal",
+        "table_id",
+        "label_id",
+        "type_id",
+        "node_mask",
+    ],
+    meta_fields=[],
+)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("v", "pre_tid", "post_tid", "num_tables", "num_labels", "max_depth"),
+)
+def analysis_step(
+    pre: BatchArrays,
+    post: BatchArrays,
+    v: int,
+    pre_tid: int,
+    post_tid: int,
+    num_tables: int,
+    num_labels: int,
+    max_depth: int,
+) -> dict[str, jnp.ndarray]:
+    """The full fused pipeline for one run batch.  Returns per-run and
+    corpus-level results; everything stays on device."""
+    adj_pre = build_adjacency(pre.edge_src, pre.edge_dst, pre.edge_mask, v)
+    adj_post = build_adjacency(post.edge_src, post.edge_dst, post.edge_mask, v)
+
+    # Condition marking (pre-post-prov.go:218-244).
+    pre_holds = mark_condition_holds(
+        adj_pre, pre.is_goal, pre.table_id, pre.node_mask, pre_tid, num_tables
+    )
+    post_holds = mark_condition_holds(
+        adj_post, post.is_goal, post.table_id, post.node_mask, post_tid, num_tables
+    )
+    achieved_pre = pre_holds.any(axis=-1)
+
+    # Simplification of both conditions (preprocessing.go:351-387).
+    pre_clean, pre_alive = clean_masks(adj_pre, pre.is_goal, pre.node_mask)
+    pre_adj2, pre_alive2, pre_type2 = collapse_chains(
+        pre_clean, pre.is_goal, pre.type_id, pre_alive
+    )
+    post_clean, post_alive = clean_masks(adj_post, post.is_goal, post.node_mask)
+    post_adj2, post_alive2, post_type2 = collapse_chains(
+        post_clean, post.is_goal, post.type_id, post_alive
+    )
+
+    # Prototypes over the simplified consequent (prototype.go:11-130).
+    bits, min_depth = proto_rule_bits(
+        post_adj2, post.is_goal, post_alive2, post.table_id, achieved_pre, num_tables, max_depth
+    )
+    present = all_rule_bits(post.is_goal, post_alive2, post.table_id, num_tables)
+    inter, union = reduce_protos(bits, achieved_pre)
+
+    # Differential provenance of every run vs the successful run in row 0
+    # (differential-provenance.go:18-243).  Label bitsets per run.
+    lid = jnp.clip(post.label_id, 0, num_labels - 1)
+    sel = post.is_goal & post.node_mask & (post.label_id >= 0)
+    run_bits = jnp.zeros((post.label_id.shape[0], num_labels), dtype=bool)
+    run_bits = jax.vmap(lambda b, l, m: b.at[l].max(m))(run_bits, lid, sel)
+    node_keep, edge_keep, frontier_rule, missing_goal = diff_masks(
+        adj_post[0], post.is_goal[0], post.node_mask[0], post.label_id[0], run_bits, max_depth
+    )
+
+    return {
+        "pre_holds": pre_holds,
+        "post_holds": post_holds,
+        "achieved_pre": achieved_pre,
+        "pre_adj_clean": pre_adj2,
+        "pre_alive": pre_alive2,
+        "pre_type": pre_type2,
+        "post_adj_clean": post_adj2,
+        "post_alive": post_alive2,
+        "post_type": post_type2,
+        "proto_bits": bits,
+        "proto_min_depth": min_depth,
+        "proto_present": present,
+        "proto_inter": inter,
+        "proto_union": union,
+        "diff_node_keep": node_keep,
+        "diff_frontier_rule": frontier_rule,
+        "diff_missing_goal": missing_goal,
+    }
+
+
+def pack_molly_for_step(
+    molly: MollyOutput, vocab: CorpusVocab | None = None
+) -> tuple[BatchArrays, BatchArrays, dict]:
+    """Pack a whole corpus into one common-bucket pre batch + post batch,
+    returning (pre, post, static_kwargs) ready for analysis_step."""
+    vocab = vocab or CorpusVocab()
+    run_ids = [r.iteration for r in molly.runs]
+    pre_graphs = [pack_graph(r.pre_prov, vocab) for r in molly.runs]
+    post_graphs = [pack_graph(r.post_prov, vocab) for r in molly.runs]
+    from nemo_tpu.graphs.packed import bucket_size
+
+    v = bucket_size(max(g.n_nodes for g in pre_graphs + post_graphs))
+    e = bucket_size(max(max(len(g.edges) for g in pre_graphs + post_graphs), 1))
+    pre_b = pack_batch(run_ids, pre_graphs, v, e)
+    post_b = pack_batch(run_ids, post_graphs, v, e)
+    static = dict(
+        v=v,
+        pre_tid=vocab.tables.lookup("pre"),
+        post_tid=vocab.tables.lookup("post"),
+        num_tables=len(vocab.tables),
+        num_labels=max(1, len(vocab.labels)),
+        max_depth=v,
+    )
+    return BatchArrays.from_packed(pre_b), BatchArrays.from_packed(post_b), static
+
+
+def synth_batch_arrays(
+    n_runs: int, seed: int = 0, eot: int = 6
+) -> tuple[BatchArrays, BatchArrays, dict]:
+    """Synthetic corpus -> step inputs, for benchmarks and compile checks."""
+    import tempfile
+
+    from nemo_tpu.ingest.molly import load_molly_output
+    from nemo_tpu.models.synth import SynthSpec, write_corpus
+
+    d = write_corpus(SynthSpec(n_runs=n_runs, seed=seed, eot=eot), tempfile.mkdtemp())
+    return pack_molly_for_step(load_molly_output(d))

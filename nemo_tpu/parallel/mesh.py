@@ -1,0 +1,80 @@
+"""Device-mesh scaling of the analysis pipeline.
+
+The run axis is the framework's data-parallel axis (SURVEY.md §2.3): the
+reference analyzes runs in a sequential host loop; here the packed run batch
+is sharded over a 1-D `jax.sharding.Mesh` and the same jitted analysis_step
+runs SPMD, with the cross-run prototype reductions (jnp.all/any over the run
+axis) lowered by XLA to all-reduces over ICI.  Multi-host scale-out uses the
+same code path — jax.distributed + a larger mesh — with DCN only between
+hosts, never inside the per-run kernels.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from nemo_tpu.models.pipeline_model import BatchArrays, analysis_step
+
+RUN_AXIS = "run"
+NODE_AXIS = "node"
+
+
+def make_run_mesh(n_devices: int | None = None) -> Mesh:
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    return Mesh(np.asarray(devices[:n]).reshape(n), (RUN_AXIS,))
+
+
+def pad_batch_rows(arrays: BatchArrays, multiple: int) -> tuple[BatchArrays, int]:
+    """Pad the run axis to a multiple of the mesh size (padding rows are
+    empty graphs: node_mask/edge_mask all False).  Returns (padded, n_real)."""
+    b = arrays.is_goal.shape[0]
+    target = ((b + multiple - 1) // multiple) * multiple
+    if target == b:
+        return arrays, b
+    pad = target - b
+
+    def pad_rows(x):
+        widths = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
+        return np.pad(np.asarray(x), widths)
+
+    padded = BatchArrays(
+        edge_src=pad_rows(arrays.edge_src),
+        edge_dst=pad_rows(arrays.edge_dst),
+        edge_mask=pad_rows(arrays.edge_mask),
+        is_goal=pad_rows(arrays.is_goal),
+        table_id=pad_rows(arrays.table_id),
+        label_id=pad_rows(arrays.label_id),
+        type_id=pad_rows(arrays.type_id),
+        node_mask=pad_rows(arrays.node_mask),
+    )
+    return padded, b
+
+
+def shard_arrays(mesh: Mesh, arrays: BatchArrays) -> BatchArrays:
+    """Place each [B, ...] array with the run axis sharded over the mesh."""
+    sharding = NamedSharding(mesh, P(RUN_AXIS))
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), arrays)
+
+
+def analysis_step_sharded(
+    mesh: Mesh, pre: BatchArrays, post: BatchArrays, static: dict
+) -> dict:
+    """Run the flagship step with the run batch sharded across the mesh.
+
+    Row 0 (the successful run every failed run diffs against,
+    differential-provenance.go:26) is needed by all shards; XLA inserts the
+    broadcast of that slice plus the all-reduces for the prototype
+    intersection/union automatically from the sharding annotations.
+    """
+    pre_s, n_real = pad_batch_rows(pre, mesh.devices.size)
+    post_s, _ = pad_batch_rows(post, mesh.devices.size)
+    pre_s = shard_arrays(mesh, pre_s)
+    post_s = shard_arrays(mesh, post_s)
+    out = analysis_step(pre_s, post_s, **static)
+    # Un-pad only the outputs whose leading axis is the run axis; corpus-level
+    # outputs (proto_inter/proto_union over the table axis) pass through.
+    corpus_level = {"proto_inter", "proto_union"}
+    return {k: v if k in corpus_level else v[:n_real] for k, v in out.items()}

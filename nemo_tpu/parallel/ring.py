@@ -1,0 +1,93 @@
+"""Ring-scheduled frontier propagation for node-sharded giant graphs.
+
+This is the framework's long-context / sequence-parallel analog (SURVEY.md
+§5): the reference's only 'long' dimension is deep @next chains, which it
+contracts; but a provenance graph too large for one chip's HBM needs its
+node dimension sharded.  ring_reach shards the adjacency by column blocks
+(each device owns the in-edges of its node block) and the frontier by row
+blocks; each of the K ring steps multiplies the local frontier chunk against
+the matching row-block of the local adjacency shard and ppermutes the chunk
+to the next device — the same stationary-weights / moving-activations
+schedule as ring attention, riding ICI neighbor links with no all-gather of
+the full frontier.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import NODE_AXIS
+
+
+def make_node_mesh(n_devices: int | None = None) -> Mesh:
+    import numpy as np
+
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    return Mesh(np.asarray(devices[:n]).reshape(n), (NODE_AXIS,))
+
+
+def _ring_step_body(frontier_chunk, adj_shard, axis_name):
+    """One full ring rotation: accumulate new-frontier contributions for this
+    device's node block from every frontier chunk passing by."""
+    n_dev = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    chunk = frontier_chunk  # [Vb] bool, row-block (axis_index) of the frontier
+    # Mark the accumulator as device-varying so the ring loop's carry type is
+    # stable under shard_map's varying-axes checks.
+    acc = lax.pcast(
+        jnp.zeros((adj_shard.shape[1],), dtype=jnp.float32), (axis_name,), to="varying"
+    )
+
+    def body(i, carry):
+        chunk, acc = carry
+        # The chunk currently held started at device (my + i) mod n_dev, so it
+        # covers that row block of the global frontier; multiply against the
+        # matching row block of our column shard.
+        src_block = (my + i) % n_dev
+        vb = chunk.shape[0]
+        rows = lax.dynamic_slice_in_dim(adj_shard, src_block * vb, vb, axis=0)
+        acc = acc + chunk.astype(jnp.bfloat16) @ rows.astype(jnp.bfloat16)
+        # Pass our chunk around the ring (receive from the next device).
+        chunk = lax.ppermute(
+            chunk, axis_name, [(j, (j - 1) % n_dev) for j in range(n_dev)]
+        )
+        return chunk, acc
+
+    chunk, acc = lax.fori_loop(0, n_dev, body, (chunk, acc))
+    return acc > 0.5
+
+
+def ring_reach(mesh: Mesh, adjacency: jnp.ndarray, start: jnp.ndarray, steps: int) -> jnp.ndarray:
+    """BFS reachability (>=0 hops) over a node-sharded graph.
+
+    adjacency: [V, V] (will be column-sharded over the mesh);
+    start: [V] bool (row-sharded).  V must divide evenly by mesh size.
+    Returns the reachable-set mask [V].
+    """
+    v = adjacency.shape[0]
+    n_dev = mesh.devices.size
+    if v % n_dev:
+        raise ValueError(f"V={v} not divisible by mesh size {n_dev}")
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(None, NODE_AXIS), P(NODE_AXIS)),
+        out_specs=P(NODE_AXIS),
+    )
+    def run(adj_shard, start_chunk):  # adj [V, Vb], start [Vb]
+        def body(_, reach_chunk):
+            new = _ring_step_body(reach_chunk, adj_shard, NODE_AXIS)
+            return reach_chunk | new
+
+        return lax.fori_loop(0, steps, body, start_chunk)
+
+    adj_sharded = jax.device_put(adjacency, NamedSharding(mesh, P(None, NODE_AXIS)))
+    start_sharded = jax.device_put(start, NamedSharding(mesh, P(NODE_AXIS)))
+    return run(adj_sharded, start_sharded)

@@ -1,0 +1,20 @@
+"""Driver contract tests: entry() compiles and dryrun_multichip executes."""
+
+import jax
+import pytest
+
+
+def test_entry_compiles():
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    out = jax.jit(fn)(*args)
+    jax.block_until_ready(out)
+    assert "proto_inter" in out and "diff_frontier_rule" in out
+
+
+@pytest.mark.skipif(len(jax.devices()) < 4, reason="needs the multi-device CPU platform")
+def test_dryrun_multichip_small():
+    import __graft_entry__ as g
+
+    g.dryrun_multichip(4)
