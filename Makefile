@@ -1,0 +1,24 @@
+# nemo-tpu build/test/bench entry points (reference: Makefile:1-21).
+
+NATIVE_SRC := native/nemo_native.cpp
+NATIVE_LIB := native/build/libnemo_native.so
+
+.PHONY: all native test bench clean
+
+all: native
+
+native: $(NATIVE_LIB)
+
+# Single source of truth for compile flags lives in ingest/native.py.
+$(NATIVE_LIB): $(NATIVE_SRC)
+	python -c "from nemo_tpu.ingest.native import build_native; print(build_native(force=True))"
+
+test:
+	python -m pytest tests/ -x -q
+
+bench:
+	python bench.py
+
+clean:
+	rm -rf native/build results .pytest_cache
+	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -54,9 +54,10 @@ def main() -> None:
     # Base corpus: base_runs distinct runs; tile the packed batch to n_runs
     # (per-run work is identical, so tiling is timing-representative while
     # keeping host-side generation cheap).
-    corpus = write_corpus(SynthSpec(n_runs=base_runs, seed=11, eot=7), tempfile.mkdtemp())
-    molly = load_molly_output(corpus)
-    pre, post, static = pack_molly_for_step(molly)
+    with tempfile.TemporaryDirectory() as tmp:
+        corpus = write_corpus(SynthSpec(n_runs=base_runs, seed=11, eot=7), tmp)
+        molly = load_molly_output(corpus)
+        pre, post, static = pack_molly_for_step(molly)
     reps = max(1, (n_runs + base_runs - 1) // base_runs)
 
     def tile(arrays: BatchArrays) -> BatchArrays:

@@ -1,0 +1,563 @@
+// nemo_native.cpp — native ingestion/ETL engine: Molly JSON -> packed batches.
+//
+// The reference's ingestion is compiled-native Go (faultinjectors/molly.go:15-163,
+// faultinjectors/data-types.go:6-98); this is its TPU-era equivalent: one C++
+// pass that parses runs.json plus every run's pre/post provenance JSON, applies
+// the ingestion invariants —
+//   * clock-goal time extraction via the two patterns
+//     ", (\d+), __WILDCARD__)" and ", (\d+), (\d+))" with two-number-wins
+//     (molly.go:76-89, :124-137);
+//   * run namespacing run_<iter>_{pre,post}_<origID> (molly.go:92-107);
+//   * success partition on the exact status string "success" (molly.go:53);
+// — interns table/label/time strings into a corpus-wide vocabulary (the
+// device-side analog of Cypher string matching, SURVEY.md §7 hard part 4), and
+// emits padded [B,V]/[B,E] int32/bool batches in the exact layout of
+// nemo_tpu.graphs.packed.pack_batch, ready for jax.device_put.
+//
+// Exposed as a C ABI consumed via ctypes (nemo_tpu/ingest/native.py); no
+// external dependencies (self-contained minimal JSON parser below).
+
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Minimal JSON DOM parser (objects, arrays, strings with escapes, numbers,
+// bools, null).  Numbers keep their raw token so integer times round-trip as
+// the same string the Python path produces via str(int).
+// ---------------------------------------------------------------------------
+
+struct JVal {
+  enum Type { NUL, BOOL, NUM, STR, ARR, OBJ } type = NUL;
+  bool b = false;
+  std::string s;  // STR: decoded string; NUM: raw token
+  std::vector<JVal> arr;
+  std::vector<std::pair<std::string, JVal>> obj;
+
+  const JVal* get(const std::string& key) const {
+    for (const auto& kv : obj)
+      if (kv.first == key) return &kv.second;
+    return nullptr;
+  }
+  std::string get_str(const std::string& key, const std::string& dflt = "") const {
+    const JVal* v = get(key);
+    if (!v) return dflt;
+    if (v->type == STR) return v->s;
+    if (v->type == NUM) return v->s;  // str(number): raw token
+    return dflt;
+  }
+  long get_int(const std::string& key, long dflt = 0) const {
+    const JVal* v = get(key);
+    if (!v || v->type != NUM) return dflt;
+    return std::strtol(v->s.c_str(), nullptr, 10);
+  }
+};
+
+class JsonParser {
+ public:
+  explicit JsonParser(const std::string& text) : t_(text) {}
+
+  JVal parse() {
+    JVal v = value();
+    ws();
+    if (p_ != t_.size()) fail("trailing content");
+    return v;
+  }
+
+ private:
+  const std::string& t_;
+  size_t p_ = 0;
+
+  [[noreturn]] void fail(const char* msg) {
+    throw std::runtime_error("JSON parse error at byte " + std::to_string(p_) + ": " + msg);
+  }
+  void ws() {
+    while (p_ < t_.size() &&
+           (t_[p_] == ' ' || t_[p_] == '\t' || t_[p_] == '\n' || t_[p_] == '\r'))
+      ++p_;
+  }
+  char peek() {
+    if (p_ >= t_.size()) fail("unexpected end");
+    return t_[p_];
+  }
+  void expect(char c) {
+    if (p_ >= t_.size() || t_[p_] != c) fail("unexpected character");
+    ++p_;
+  }
+
+  JVal value() {
+    ws();
+    switch (peek()) {
+      case '{': return object();
+      case '[': return array();
+      case '"': {
+        JVal v;
+        v.type = JVal::STR;
+        v.s = string();
+        return v;
+      }
+      case 't': literal("true"); { JVal v; v.type = JVal::BOOL; v.b = true; return v; }
+      case 'f': literal("false"); { JVal v; v.type = JVal::BOOL; v.b = false; return v; }
+      case 'n': literal("null"); return JVal{};
+      default: return number();
+    }
+  }
+
+  void literal(const char* lit) {
+    size_t n = std::strlen(lit);
+    if (t_.compare(p_, n, lit) != 0) fail("bad literal");
+    p_ += n;
+  }
+
+  JVal number() {
+    size_t start = p_;
+    if (peek() == '-') ++p_;
+    while (p_ < t_.size() && (std::isdigit((unsigned char)t_[p_]) || t_[p_] == '.' ||
+                              t_[p_] == 'e' || t_[p_] == 'E' || t_[p_] == '+' || t_[p_] == '-'))
+      ++p_;
+    if (p_ == start) fail("bad number");
+    JVal v;
+    v.type = JVal::NUM;
+    v.s = t_.substr(start, p_ - start);
+    return v;
+  }
+
+  std::string string() {
+    expect('"');
+    std::string out;
+    while (true) {
+      if (p_ >= t_.size()) fail("unterminated string");
+      char c = t_[p_++];
+      if (c == '"') break;
+      if (c == '\\') {
+        if (p_ >= t_.size()) fail("bad escape");
+        char e = t_[p_++];
+        switch (e) {
+          case '"': out += '"'; break;
+          case '\\': out += '\\'; break;
+          case '/': out += '/'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'n': out += '\n'; break;
+          case 'r': out += '\r'; break;
+          case 't': out += '\t'; break;
+          case 'u': {
+            if (p_ + 4 > t_.size()) fail("bad \\u escape");
+            unsigned cp = (unsigned)std::strtoul(t_.substr(p_, 4).c_str(), nullptr, 16);
+            p_ += 4;
+            // Surrogate pair.
+            if (cp >= 0xD800 && cp <= 0xDBFF && p_ + 6 <= t_.size() && t_[p_] == '\\' &&
+                t_[p_ + 1] == 'u') {
+              unsigned lo = (unsigned)std::strtoul(t_.substr(p_ + 2, 4).c_str(), nullptr, 16);
+              if (lo >= 0xDC00 && lo <= 0xDFFF) {
+                cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+                p_ += 6;
+              }
+            }
+            // UTF-8 encode.
+            if (cp < 0x80) {
+              out += (char)cp;
+            } else if (cp < 0x800) {
+              out += (char)(0xC0 | (cp >> 6));
+              out += (char)(0x80 | (cp & 0x3F));
+            } else if (cp < 0x10000) {
+              out += (char)(0xE0 | (cp >> 12));
+              out += (char)(0x80 | ((cp >> 6) & 0x3F));
+              out += (char)(0x80 | (cp & 0x3F));
+            } else {
+              out += (char)(0xF0 | (cp >> 18));
+              out += (char)(0x80 | ((cp >> 12) & 0x3F));
+              out += (char)(0x80 | ((cp >> 6) & 0x3F));
+              out += (char)(0x80 | (cp & 0x3F));
+            }
+            break;
+          }
+          default: fail("bad escape");
+        }
+      } else {
+        out += c;
+      }
+    }
+    return out;
+  }
+
+  JVal object() {
+    expect('{');
+    JVal v;
+    v.type = JVal::OBJ;
+    ws();
+    if (peek() == '}') { ++p_; return v; }
+    while (true) {
+      ws();
+      std::string key = string();
+      ws();
+      expect(':');
+      v.obj.emplace_back(std::move(key), value());
+      ws();
+      if (peek() == ',') { ++p_; continue; }
+      expect('}');
+      break;
+    }
+    return v;
+  }
+
+  JVal array() {
+    expect('[');
+    JVal v;
+    v.type = JVal::ARR;
+    ws();
+    if (peek() == ']') { ++p_; return v; }
+    while (true) {
+      v.arr.push_back(value());
+      ws();
+      if (peek() == ',') { ++p_; continue; }
+      expect(']');
+      break;
+    }
+    return v;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Clock-time extraction (molly.go:76-89): leftmost match of each pattern;
+// the two-number pattern, applied second, wins when both match.
+// ---------------------------------------------------------------------------
+
+bool scan_digits(const std::string& s, size_t& p, std::string& out) {
+  size_t start = p;
+  while (p < s.size() && std::isdigit((unsigned char)s[p])) ++p;
+  if (p == start) return false;
+  out = s.substr(start, p - start);
+  return true;
+}
+
+// ", (\d+), __WILDCARD__\)"
+bool match_clock_wild(const std::string& s, std::string& time_out) {
+  for (size_t i = 0; i + 1 < s.size(); ++i) {
+    if (s[i] != ',' || s[i + 1] != ' ') continue;
+    size_t p = i + 2;
+    std::string digits;
+    if (!scan_digits(s, p, digits)) continue;
+    static const char* kTail = ", __WILDCARD__)";
+    if (s.compare(p, std::strlen(kTail), kTail) == 0) {
+      time_out = digits;
+      return true;
+    }
+  }
+  return false;
+}
+
+// ", (\d+), (\d+)\)" — first capture group.
+bool match_clock_two(const std::string& s, std::string& time_out) {
+  for (size_t i = 0; i + 1 < s.size(); ++i) {
+    if (s[i] != ',' || s[i + 1] != ' ') continue;
+    size_t p = i + 2;
+    std::string d1, d2;
+    if (!scan_digits(s, p, d1)) continue;
+    if (p + 1 < s.size() && s[p] == ',' && s[p + 1] == ' ') {
+      size_t q = p + 2;
+      if (scan_digits(s, q, d2) && q < s.size() && s[q] == ')') {
+        time_out = d1;
+        return true;
+      }
+    }
+  }
+  return false;
+}
+
+// ---------------------------------------------------------------------------
+// Corpus model
+// ---------------------------------------------------------------------------
+
+struct Vocab {
+  std::vector<std::string> strings;
+  std::unordered_map<std::string, int32_t> ids;
+  int32_t intern(const std::string& s) {
+    auto it = ids.find(s);
+    if (it != ids.end()) return it->second;
+    int32_t id = (int32_t)strings.size();
+    strings.push_back(s);
+    ids.emplace(s, id);
+    return id;
+  }
+  int32_t lookup(const std::string& s) const {
+    auto it = ids.find(s);
+    return it == ids.end() ? -1 : it->second;
+  }
+};
+
+// One provenance graph after parsing + namespacing, before interning.
+struct RawGraph {
+  int32_t n_goals = 0;
+  std::vector<std::string> ids;     // slot -> namespaced id (goals then rules)
+  std::vector<std::string> tables;  // per slot
+  std::vector<std::string> labels;
+  std::vector<std::string> times;   // goals only meaningful; rules ""
+  std::vector<int32_t> types;       // 0 none, 1 async, 2 next, 3 collapsed
+  std::vector<int32_t> esrc, edst;  // slot indices
+};
+
+int32_t type_id_of(const std::string& t) {
+  if (t == "async") return 1;
+  if (t == "next") return 2;
+  if (t == "collapsed") return 3;
+  return 0;
+}
+
+std::string read_file(const std::string& path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) throw std::runtime_error("cannot open " + path);
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+RawGraph parse_prov(const std::string& path, long iteration, const char* cond) {
+  JVal doc = JsonParser(read_file(path)).parse();
+  if (doc.type != JVal::OBJ) throw std::runtime_error(path + ": provenance root not an object");
+  RawGraph g;
+  std::string prefix = "run_" + std::to_string(iteration) + "_" + cond + "_";
+  std::unordered_map<std::string, int32_t> slot;  // original (un-namespaced) id -> slot
+
+  const JVal* goals = doc.get("goals");
+  const JVal* rules = doc.get("rules");
+  const JVal* edges = doc.get("edges");
+
+  if (goals && goals->type == JVal::ARR) {
+    for (const JVal& jg : goals->arr) {
+      std::string id = jg.get_str("id");
+      std::string table = jg.get_str("table");
+      std::string label = jg.get_str("label");
+      std::string time = jg.get_str("time");
+      if (table == "clock") {  // molly.go:76-89: wild first, two-number wins
+        std::string t;
+        if (match_clock_wild(label, t)) time = t;
+        if (match_clock_two(label, t)) time = t;
+      }
+      slot[id] = (int32_t)g.ids.size();  // last occurrence wins (packed.py pack_graph)
+      g.ids.push_back(prefix + id);
+      g.tables.push_back(table);
+      g.labels.push_back(label);
+      g.times.push_back(time);
+      g.types.push_back(0);
+    }
+  }
+  g.n_goals = (int32_t)g.ids.size();
+  if (rules && rules->type == JVal::ARR) {
+    for (const JVal& jr : rules->arr) {
+      std::string id = jr.get_str("id");
+      slot[id] = (int32_t)g.ids.size();  // last occurrence wins (packed.py pack_graph)
+      g.ids.push_back(prefix + id);
+      g.tables.push_back(jr.get_str("table"));
+      g.labels.push_back(jr.get_str("label"));
+      g.times.push_back("");
+      g.types.push_back(type_id_of(jr.get_str("type")));
+    }
+  }
+  if (edges && edges->type == JVal::ARR) {
+    for (const JVal& je : edges->arr) {
+      auto si = slot.find(je.get_str("from"));
+      auto di = slot.find(je.get_str("to"));
+      if (si == slot.end() || di == slot.end())
+        throw std::runtime_error(path + ": edge endpoint not a known goal/rule id");
+      g.esrc.push_back(si->second);
+      g.edst.push_back(di->second);
+    }
+  }
+  return g;
+}
+
+int32_t bucket_size(int32_t n, int32_t minimum = 16) {
+  int32_t b = minimum;
+  while (b < n) b *= 2;
+  return b;
+}
+
+// Packed arrays for one condition's batch (layout of graphs/packed.py).
+struct PackedCond {
+  std::vector<int32_t> table_id, label_id, time_id, type_id;  // [B*V]
+  std::vector<uint8_t> is_goal, node_mask;                    // [B*V]
+  std::vector<int32_t> edge_src, edge_dst;                    // [B*E]
+  std::vector<uint8_t> edge_mask;                             // [B*E]
+  std::vector<int32_t> n_nodes, n_goals;                      // [B]
+  std::vector<std::string> node_ids_joined;                   // per run, '\n'-joined
+};
+
+struct Corpus {
+  int64_t n_runs = 0, v = 0, e = 0;
+  Vocab tables, labels, times;
+  PackedCond cond[2];  // 0 = pre, 1 = post
+  std::vector<int32_t> iteration;
+  std::vector<uint8_t> success;
+  std::string error;  // empty on success
+};
+
+void pack_cond(const std::vector<RawGraph>& graphs, int64_t v, int64_t e, Corpus& c,
+               PackedCond& out) {
+  int64_t b = (int64_t)graphs.size();
+  out.table_id.assign(b * v, -1);
+  out.label_id.assign(b * v, -1);
+  out.time_id.assign(b * v, -1);
+  out.type_id.assign(b * v, 0);
+  out.is_goal.assign(b * v, 0);
+  out.node_mask.assign(b * v, 0);
+  out.edge_src.assign(b * e, 0);
+  out.edge_dst.assign(b * e, 0);
+  out.edge_mask.assign(b * e, 0);
+  out.n_nodes.resize(b);
+  out.n_goals.resize(b);
+  out.node_ids_joined.resize(b);
+  for (int64_t i = 0; i < b; ++i) {
+    const RawGraph& g = graphs[i];
+    int32_t n = (int32_t)g.ids.size();
+    out.n_nodes[i] = n;
+    out.n_goals[i] = g.n_goals;
+    std::string joined;
+    for (int32_t s = 0; s < n; ++s) {
+      out.table_id[i * v + s] = c.tables.intern(g.tables[s]);
+      out.label_id[i * v + s] = c.labels.intern(g.labels[s]);
+      out.time_id[i * v + s] = c.times.intern(s < g.n_goals ? g.times[s] : "");
+      out.type_id[i * v + s] = g.types[s];
+      out.is_goal[i * v + s] = s < g.n_goals;
+      out.node_mask[i * v + s] = 1;
+      if (s) joined += '\n';
+      joined += g.ids[s];
+    }
+    out.node_ids_joined[i] = std::move(joined);
+    for (size_t k = 0; k < g.esrc.size(); ++k) {
+      out.edge_src[i * e + (int64_t)k] = g.esrc[k];
+      out.edge_dst[i * e + (int64_t)k] = g.edst[k];
+      out.edge_mask[i * e + (int64_t)k] = 1;
+    }
+  }
+}
+
+Corpus* ingest(const std::string& dir) {
+  auto c = std::make_unique<Corpus>();
+  JVal runs = JsonParser(read_file(dir + "/runs.json")).parse();
+  if (runs.type != JVal::ARR) throw std::runtime_error("runs.json: root not an array");
+  c->n_runs = (int64_t)runs.arr.size();
+
+  std::vector<RawGraph> pre_graphs, post_graphs;
+  pre_graphs.reserve(c->n_runs);
+  post_graphs.reserve(c->n_runs);
+  for (int64_t i = 0; i < c->n_runs; ++i) {
+    const JVal& r = runs.arr[i];
+    long iter = r.get_int("iteration");
+    c->iteration.push_back((int32_t)iter);
+    c->success.push_back(r.get_str("status") == "success");  // molly.go:53
+    // Provenance files are indexed by position i, not iteration (molly.go:59-60).
+    pre_graphs.push_back(
+        parse_prov(dir + "/run_" + std::to_string(i) + "_pre_provenance.json", iter, "pre"));
+    post_graphs.push_back(
+        parse_prov(dir + "/run_" + std::to_string(i) + "_post_provenance.json", iter, "post"));
+  }
+
+  int32_t max_n = 1, max_e = 1;
+  for (const auto* gs : {&pre_graphs, &post_graphs})
+    for (const RawGraph& g : *gs) {
+      max_n = std::max(max_n, (int32_t)g.ids.size());
+      max_e = std::max(max_e, (int32_t)g.esrc.size());
+    }
+  c->v = bucket_size(max_n);
+  c->e = bucket_size(max_e);
+
+  // Interning order matches the Python path (pack_molly_for_step): all pre
+  // graphs in run order, then all post graphs — so ids are bit-identical.
+  pack_cond(pre_graphs, c->v, c->e, *c, c->cond[0]);
+  pack_cond(post_graphs, c->v, c->e, *c, c->cond[1]);
+  return c.release();
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+// Returns an opaque handle, or nullptr with a message in err[0..errlen).
+void* nemo_ingest(const char* dir, char* err, int errlen) {
+  try {
+    return ingest(dir);
+  } catch (const std::exception& ex) {
+    if (err && errlen > 0) {
+      std::strncpy(err, ex.what(), (size_t)errlen - 1);
+      err[errlen - 1] = '\0';
+    }
+    return nullptr;
+  }
+}
+
+// dims: [n_runs, v, e, n_tables, n_labels, n_times, pre_tid, post_tid]
+void nemo_dims(void* h, int64_t* out) {
+  auto* c = (Corpus*)h;
+  out[0] = c->n_runs;
+  out[1] = c->v;
+  out[2] = c->e;
+  out[3] = (int64_t)c->tables.strings.size();
+  out[4] = (int64_t)c->labels.strings.size();
+  out[5] = (int64_t)c->times.strings.size();
+  out[6] = c->tables.lookup("pre");
+  out[7] = c->tables.lookup("post");
+}
+
+// Copy one condition's packed arrays into caller-allocated buffers
+// (cond: 0 = pre, 1 = post).  Sizes: node arrays B*V, edge arrays B*E,
+// n_nodes/n_goals B.
+void nemo_copy(void* h, int cond, int32_t* table_id, int32_t* label_id, int32_t* time_id,
+               int32_t* type_id, uint8_t* is_goal, uint8_t* node_mask, int32_t* edge_src,
+               int32_t* edge_dst, uint8_t* edge_mask, int32_t* n_nodes, int32_t* n_goals) {
+  auto* c = (Corpus*)h;
+  const PackedCond& p = c->cond[cond];
+  std::memcpy(table_id, p.table_id.data(), p.table_id.size() * sizeof(int32_t));
+  std::memcpy(label_id, p.label_id.data(), p.label_id.size() * sizeof(int32_t));
+  std::memcpy(time_id, p.time_id.data(), p.time_id.size() * sizeof(int32_t));
+  std::memcpy(type_id, p.type_id.data(), p.type_id.size() * sizeof(int32_t));
+  std::memcpy(is_goal, p.is_goal.data(), p.is_goal.size());
+  std::memcpy(node_mask, p.node_mask.data(), p.node_mask.size());
+  std::memcpy(edge_src, p.edge_src.data(), p.edge_src.size() * sizeof(int32_t));
+  std::memcpy(edge_dst, p.edge_dst.data(), p.edge_dst.size() * sizeof(int32_t));
+  std::memcpy(edge_mask, p.edge_mask.data(), p.edge_mask.size());
+  std::memcpy(n_nodes, p.n_nodes.data(), p.n_nodes.size() * sizeof(int32_t));
+  std::memcpy(n_goals, p.n_goals.data(), p.n_goals.size() * sizeof(int32_t));
+}
+
+// Run metadata: iteration numbers and success flags ([B] each).
+void nemo_runs(void* h, int32_t* iteration, uint8_t* success) {
+  auto* c = (Corpus*)h;
+  std::memcpy(iteration, c->iteration.data(), c->iteration.size() * sizeof(int32_t));
+  std::memcpy(success, c->success.data(), c->success.size());
+}
+
+// Vocabulary string (which: 0 tables, 1 labels, 2 times); valid until free.
+const char* nemo_vocab(void* h, int which, int idx) {
+  auto* c = (Corpus*)h;
+  const Vocab& v = which == 0 ? c->tables : which == 1 ? c->labels : c->times;
+  if (idx < 0 || (size_t)idx >= v.strings.size()) return "";
+  return v.strings[(size_t)idx].c_str();
+}
+
+// '\n'-joined namespaced node ids of one run's graph (cond 0/1).
+const char* nemo_node_ids(void* h, int cond, int run) {
+  auto* c = (Corpus*)h;
+  const PackedCond& p = c->cond[cond];
+  if (run < 0 || (size_t)run >= p.node_ids_joined.size()) return "";
+  return p.node_ids_joined[(size_t)run].c_str();
+}
+
+void nemo_free(void* h) { delete (Corpus*)h; }
+
+// ABI version for the ctypes wrapper to sanity-check.
+int nemo_abi_version() { return 1; }
+
+}  // extern "C"

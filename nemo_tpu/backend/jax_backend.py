@@ -108,10 +108,17 @@ class JaxBackend(GraphBackend):
                 self.raw[(run.iteration, cond)] = build_pgraph(prov)
 
     def close_db(self) -> None:
+        # Release everything init_graph_db allocates (reference: CloseDB,
+        # graphing/helpers.go:58-86); the backend stays reusable.
+        self.molly = None
+        self.vocab = None
         self.packed = {}
+        self.raw = {}
+        self.clean = {}
+        self.cond_holds = {}
+        self.achieved_pre = {}
         self.simplified = {}
         self._batch_cache = {}
-        self.cond_holds = {}
 
     def _batches(self, cond: str, iters: list[int] | None = None) -> list[PackedBatch]:
         """Size-bucketed batches for one condition; cached per (cond, runs)."""

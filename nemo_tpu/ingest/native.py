@@ -1,0 +1,240 @@
+"""ctypes bindings for the native C++ ingestion engine (native/nemo_native.cpp).
+
+The reference's ETL is compiled-native (Go, faultinjectors/molly.go); here the
+hot path — Molly JSON -> packed device-ready batches — is a C++ shared library
+loaded via ctypes, with the pure-Python path (ingest/molly.py +
+graphs/packed.py) kept as the portable fallback and parity oracle.  The native
+path produces bit-identical arrays/vocabularies to the Python path (enforced
+by tests/test_native.py).
+
+The library is compiled on demand with g++ (cached next to the source, rebuilt
+when the source is newer); environments without a toolchain simply fall back.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from dataclasses import dataclass
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "..", "..", "native", "nemo_native.cpp")
+_LIB = os.path.join(os.path.dirname(__file__), "..", "..", "native", "build", "libnemo_native.so")
+
+_lib = None
+_lib_error: str | None = None
+
+
+def build_native(force: bool = False) -> str:
+    """Compile the shared library if missing/stale; returns its path."""
+    src = os.path.abspath(_SRC)
+    lib = os.path.abspath(_LIB)
+    if not os.path.exists(src):
+        raise FileNotFoundError(src)
+    if not force and os.path.exists(lib) and os.path.getmtime(lib) >= os.path.getmtime(src):
+        return lib
+    os.makedirs(os.path.dirname(lib), exist_ok=True)
+    # Build to a temp name then rename: atomic under concurrent test workers.
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=os.path.dirname(lib))
+    os.close(fd)
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp, src]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except subprocess.CalledProcessError as ex:
+        os.unlink(tmp)
+        raise RuntimeError(f"native build failed: {ex.stderr}") from ex
+    os.replace(tmp, lib)
+    return lib
+
+
+def _load():
+    global _lib, _lib_error
+    if _lib is not None or _lib_error is not None:
+        return _lib
+    try:
+        path = build_native()
+        lib = ctypes.CDLL(path)
+    except Exception as ex:  # toolchain missing, build failure, ...
+        _lib_error = str(ex)
+        return None
+    lib.nemo_ingest.restype = ctypes.c_void_p
+    lib.nemo_ingest.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+    lib.nemo_dims.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64)]
+    lib.nemo_copy.argtypes = [ctypes.c_void_p, ctypes.c_int] + [ctypes.c_void_p] * 11
+    lib.nemo_runs.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+    lib.nemo_vocab.restype = ctypes.c_char_p
+    lib.nemo_vocab.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
+    lib.nemo_node_ids.restype = ctypes.c_char_p
+    lib.nemo_node_ids.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
+    lib.nemo_free.argtypes = [ctypes.c_void_p]
+    lib.nemo_abi_version.restype = ctypes.c_int
+    if lib.nemo_abi_version() != 1:
+        _lib_error = "ABI version mismatch"
+        return None
+    _lib = lib
+    return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def native_error() -> str | None:
+    _load()
+    return _lib_error
+
+
+@dataclass
+class NativeCondBatch:
+    """One condition's packed batch in the pack_batch layout ([B,V]/[B,E])."""
+
+    table_id: np.ndarray
+    label_id: np.ndarray
+    time_id: np.ndarray
+    type_id: np.ndarray
+    is_goal: np.ndarray
+    node_mask: np.ndarray
+    edge_src: np.ndarray
+    edge_dst: np.ndarray
+    edge_mask: np.ndarray
+    n_nodes: np.ndarray
+    n_goals: np.ndarray
+
+
+@dataclass
+class NativeCorpus:
+    """Full output of the native ETL for one Molly directory."""
+
+    n_runs: int
+    v: int
+    e: int
+    tables: list[str]
+    labels: list[str]
+    times: list[str]
+    pre_tid: int
+    post_tid: int
+    iteration: np.ndarray  # [B] int32
+    success: np.ndarray  # [B] bool
+    pre: NativeCondBatch
+    post: NativeCondBatch
+    node_ids_pre: list[list[str]]
+    node_ids_post: list[list[str]]
+
+    @property
+    def static_kwargs(self) -> dict:
+        """Static kwargs for models.pipeline_model.analysis_step, identical to
+        pack_molly_for_step's."""
+        return dict(
+            v=self.v,
+            pre_tid=self.pre_tid,
+            post_tid=self.post_tid,
+            num_tables=len(self.tables),
+            num_labels=max(1, len(self.labels)),
+            max_depth=self.v,
+        )
+
+
+def _copy_cond(lib, handle, cond: int, b: int, v: int, e: int) -> NativeCondBatch:
+    i32, u8 = np.int32, np.uint8
+    arrs = dict(
+        table_id=np.empty((b, v), i32),
+        label_id=np.empty((b, v), i32),
+        time_id=np.empty((b, v), i32),
+        type_id=np.empty((b, v), i32),
+        is_goal=np.empty((b, v), u8),
+        node_mask=np.empty((b, v), u8),
+        edge_src=np.empty((b, e), i32),
+        edge_dst=np.empty((b, e), i32),
+        edge_mask=np.empty((b, e), u8),
+        n_nodes=np.empty((b,), i32),
+        n_goals=np.empty((b,), i32),
+    )
+    lib.nemo_copy(
+        handle,
+        cond,
+        *(a.ctypes.data_as(ctypes.c_void_p) for a in arrs.values()),
+    )
+    for k in ("is_goal", "node_mask", "edge_mask"):
+        arrs[k] = arrs[k].astype(bool)
+    return NativeCondBatch(**arrs)
+
+
+def ingest_native(output_dir: str, with_node_ids: bool = True) -> NativeCorpus:
+    """Parse + pack a Molly output directory entirely in C++.
+
+    Raises RuntimeError when the native library is unavailable (callers that
+    want the fallback use `native_available()` first or catch this).
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native ingestion unavailable: {_lib_error}")
+    err = ctypes.create_string_buffer(1024)
+    handle = lib.nemo_ingest(os.fsencode(output_dir), err, len(err))
+    if not handle:
+        raise RuntimeError(f"native ingestion failed: {err.value.decode()}")
+    try:
+        dims = (ctypes.c_int64 * 8)()
+        lib.nemo_dims(handle, dims)
+        b, v, e, n_tables, n_labels, n_times, pre_tid, post_tid = (int(x) for x in dims)
+        iteration = np.empty((b,), np.int32)
+        success = np.empty((b,), np.uint8)
+        lib.nemo_runs(
+            handle,
+            iteration.ctypes.data_as(ctypes.c_void_p),
+            success.ctypes.data_as(ctypes.c_void_p),
+        )
+        tables = [lib.nemo_vocab(handle, 0, i).decode() for i in range(n_tables)]
+        labels = [lib.nemo_vocab(handle, 1, i).decode() for i in range(n_labels)]
+        times = [lib.nemo_vocab(handle, 2, i).decode() for i in range(n_times)]
+        pre = _copy_cond(lib, handle, 0, b, v, e)
+        post = _copy_cond(lib, handle, 1, b, v, e)
+        ids_pre: list[list[str]] = []
+        ids_post: list[list[str]] = []
+        if with_node_ids:
+            for i in range(b):
+                joined_pre = lib.nemo_node_ids(handle, 0, i).decode()
+                joined_post = lib.nemo_node_ids(handle, 1, i).decode()
+                ids_pre.append(joined_pre.split("\n") if joined_pre else [])
+                ids_post.append(joined_post.split("\n") if joined_post else [])
+        return NativeCorpus(
+            n_runs=b,
+            v=v,
+            e=e,
+            tables=tables,
+            labels=labels,
+            times=times,
+            pre_tid=pre_tid,
+            post_tid=post_tid,
+            iteration=iteration,
+            success=success.astype(bool),
+            pre=pre,
+            post=post,
+            node_ids_pre=ids_pre,
+            node_ids_post=ids_post,
+        )
+    finally:
+        lib.nemo_free(handle)
+
+
+def pack_molly_dir(output_dir: str):
+    """Directory -> (pre BatchArrays, post BatchArrays, static kwargs) for
+    models.pipeline_model.analysis_step, via the native engine when available
+    and the Python path otherwise."""
+    if native_available():
+        c = ingest_native(output_dir, with_node_ids=False)
+        from nemo_tpu.models.pipeline_model import BatchArrays
+
+        # NativeCondBatch exposes the same field names as PackedBatch, so the
+        # shared constructor applies.
+        return (
+            BatchArrays.from_packed(c.pre),
+            BatchArrays.from_packed(c.post),
+            c.static_kwargs,
+        )
+    from nemo_tpu.ingest.molly import load_molly_output
+    from nemo_tpu.models.pipeline_model import pack_molly_for_step
+
+    return pack_molly_for_step(load_molly_output(output_dir))

@@ -183,5 +183,6 @@ def synth_batch_arrays(
     from nemo_tpu.ingest.molly import load_molly_output
     from nemo_tpu.models.synth import SynthSpec, write_corpus
 
-    d = write_corpus(SynthSpec(n_runs=n_runs, seed=seed, eot=eot), tempfile.mkdtemp())
-    return pack_molly_for_step(load_molly_output(d))
+    with tempfile.TemporaryDirectory() as d:
+        corpus = write_corpus(SynthSpec(n_runs=n_runs, seed=seed, eot=eot), d)
+        return pack_molly_for_step(load_molly_output(corpus))
