@@ -3,7 +3,7 @@
 NATIVE_SRC := native/nemo_native.cpp
 NATIVE_LIB := native/build/libnemo_native.so
 
-.PHONY: all native test bench clean
+.PHONY: all native test bench clean proto
 
 all: native
 
@@ -18,6 +18,10 @@ test:
 
 bench:
 	python bench.py
+
+# Regenerate protobuf message code for the sidecar wire protocol.
+proto:
+	protoc --python_out=nemo_tpu/service proto/nemo_service.proto
 
 clean:
 	rm -rf native/build results .pytest_cache

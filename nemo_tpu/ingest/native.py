@@ -46,6 +46,9 @@ def build_native(force: bool = False) -> str:
     except subprocess.CalledProcessError as ex:
         os.unlink(tmp)
         raise RuntimeError(f"native build failed: {ex.stderr}") from ex
+    except OSError as ex:  # g++ missing entirely
+        os.unlink(tmp)
+        raise RuntimeError(f"native build failed: {ex}") from ex
     os.replace(tmp, lib)
     return lib
 

@@ -72,6 +72,13 @@ jax.tree_util.register_dataclass(
 )
 
 
+# analysis_step outputs that are reductions over the run axis, not per-run
+# rows, and how to re-combine them across chunked batches (used by the
+# sidecar client's chunk merge).  Keep in sync with the return dict below:
+# any new cross-run reduction output MUST be added here.
+CORPUS_REDUCTIONS = {"proto_inter": "and", "proto_union": "or"}
+
+
 @partial(
     jax.jit,
     static_argnames=("v", "pre_tid", "post_tid", "num_tables", "num_labels", "max_depth"),

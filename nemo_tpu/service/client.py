@@ -1,0 +1,202 @@
+"""Client for the TPU sidecar: packs locally (natively when possible),
+analyzes remotely.
+
+Failure handling (SURVEY.md §5 — the reference has none; everything is
+log.Fatalf): health-gated connect with deadline, bounded retries with
+exponential backoff on UNAVAILABLE, and chunk ordinals verified on receipt.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import grpc
+import numpy as np
+
+from nemo_tpu.service import codec
+from nemo_tpu.service.proto import nemo_service_pb2 as pb
+from nemo_tpu.service.server import SERVICE
+
+
+class SidecarError(RuntimeError):
+    pass
+
+
+@dataclass
+class RemoteAnalyzer:
+    """Thin, retrying client over the NemoAnalysis service."""
+
+    target: str = "127.0.0.1:50051"
+    timeout: float = 300.0
+    retries: int = 3
+
+    def __post_init__(self):
+        self._channel = grpc.insecure_channel(
+            self.target,
+            options=[
+                ("grpc.max_receive_message_length", 1 << 30),
+                ("grpc.max_send_message_length", 1 << 30),
+            ],
+        )
+        self._health = self._channel.unary_unary(
+            f"/{SERVICE}/Health",
+            request_serializer=pb.HealthRequest.SerializeToString,
+            response_deserializer=pb.HealthResponse.FromString,
+        )
+        self._analyze = self._channel.unary_unary(
+            f"/{SERVICE}/Analyze",
+            request_serializer=pb.AnalyzeRequest.SerializeToString,
+            response_deserializer=pb.AnalyzeResponse.FromString,
+        )
+        self._analyze_stream = self._channel.stream_stream(
+            f"/{SERVICE}/AnalyzeStream",
+            request_serializer=pb.AnalyzeRequest.SerializeToString,
+            response_deserializer=pb.AnalyzeResponse.FromString,
+        )
+
+    def close(self) -> None:
+        self._channel.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ------------------------------------------------------------- health
+
+    def health(self, timeout: float = 10.0) -> dict:
+        resp = self._call(self._health, pb.HealthRequest(), timeout)
+        return {
+            "platform": resp.platform,
+            "device_count": resp.device_count,
+            "version": resp.version,
+        }
+
+    def wait_ready(self, deadline: float = 30.0) -> dict:
+        """Poll Health until the sidecar answers (startup gate).  Single
+        attempt per poll — retry policy here is the loop itself, not _call."""
+        end = time.monotonic() + deadline
+        last: Exception | None = None
+        while time.monotonic() < end:
+            try:
+                resp = self._health(pb.HealthRequest(), timeout=2.0)
+                return {
+                    "platform": resp.platform,
+                    "device_count": resp.device_count,
+                    "version": resp.version,
+                }
+            except grpc.RpcError as ex:
+                last = ex
+                time.sleep(0.2)
+        raise SidecarError(f"sidecar not ready after {deadline}s: {last}")
+
+    def _call(self, method, request, timeout: float | None = None):
+        delay = 0.2
+        for attempt in range(self.retries):
+            try:
+                return method(request, timeout=timeout or self.timeout)
+            except grpc.RpcError as ex:
+                if ex.code() != grpc.StatusCode.UNAVAILABLE or attempt == self.retries - 1:
+                    raise
+                time.sleep(delay)
+                delay *= 2
+        raise SidecarError("unreachable")
+
+    # ------------------------------------------------------------ analyze
+
+    def analyze(self, pre, post, static: dict) -> dict[str, np.ndarray]:
+        """One fused analysis step on the sidecar's device."""
+        req = pb.AnalyzeRequest(
+            pre=codec.batch_arrays_to_pb(pre),
+            post=codec.batch_arrays_to_pb(post),
+        )
+        req.static.CopyFrom(codec.static_to_pb(static))
+        return codec.outputs_from_pb(self._call(self._analyze, req))
+
+    def analyze_chunks(
+        self, chunks: list[tuple[object, object, dict]]
+    ) -> list[dict[str, np.ndarray]]:
+        """Stream chunks through the bidi RPC; returns per-chunk outputs in
+        submission order (ordinals are verified)."""
+
+        def requests():
+            for i, (pre, post, static) in enumerate(chunks):
+                req = pb.AnalyzeRequest(
+                    pre=codec.batch_arrays_to_pb(pre),
+                    post=codec.batch_arrays_to_pb(post),
+                    chunk=i,
+                )
+                req.static.CopyFrom(codec.static_to_pb(static))
+                yield req
+
+        out: list[dict[str, np.ndarray] | None] = [None] * len(chunks)
+        for resp in self._analyze_stream(requests(), timeout=self.timeout):
+            if not 0 <= resp.chunk < len(chunks):
+                raise SidecarError(f"bad chunk ordinal {resp.chunk}")
+            out[resp.chunk] = codec.outputs_from_pb(resp)
+        missing = [i for i, o in enumerate(out) if o is None]
+        if missing:
+            raise SidecarError(f"missing responses for chunks {missing}")
+        return out  # type: ignore[return-value]
+
+
+def analyze_dir(target: str, molly_dir: str, chunk_runs: int = 0) -> dict[str, np.ndarray]:
+    """Native-pack a Molly directory and analyze it remotely, optionally
+    streamed in chunks of chunk_runs runs.
+
+    Chunked results are merged to be equivalent to one unchunked call: every
+    chunk gets the corpus's good run (row 0) prepended so the differential
+    provenance baseline (analysis_step diffs against its batch's row 0) and
+    the prototype reductions see it; the duplicate row is dropped from
+    per-run outputs and the cross-chunk reductions are re-combined.
+    """
+    import jax
+
+    from nemo_tpu.ingest.native import pack_molly_dir
+
+    pre, post, static = pack_molly_dir(molly_dir)
+    b = int(np.asarray(pre.is_goal).shape[0])
+    with RemoteAnalyzer(target=target) as client:
+        client.wait_ready()
+        if not chunk_runs or chunk_runs >= b:
+            return client.analyze(pre, post, static)
+
+        def rows(arrays, s, e, with_good: bool):
+            if with_good:
+                return jax.tree_util.tree_map(
+                    lambda x: np.concatenate([np.asarray(x[:1]), np.asarray(x[s:e])]), arrays
+                )
+            return jax.tree_util.tree_map(lambda x: x[s:e], arrays)
+
+        spans = [(s, min(s + chunk_runs, b)) for s in range(0, b, chunk_runs)]
+        chunks = [
+            (rows(pre, s, e, s > 0), rows(post, s, e, s > 0), static) for s, e in spans
+        ]
+        results = client.analyze_chunks(chunks)
+
+    from nemo_tpu.models.pipeline_model import CORPUS_REDUCTIONS
+
+    merged: dict[str, np.ndarray] = {}
+    for key in results[0]:
+        how = CORPUS_REDUCTIONS.get(key)
+        if how == "and":
+            merged[key] = np.logical_and.reduce([r[key] for r in results])
+        elif how == "or":
+            merged[key] = np.logical_or.reduce([r[key] for r in results])
+        else:
+            # Per-run rows: drop the prepended good-run row of chunks > 0.
+            # Guard against an unregistered reduction output silently being
+            # concatenated as if it were per-run (CORPUS_REDUCTIONS contract).
+            for (s, e), r in zip(spans, results):
+                expected = (e - s) + (1 if s > 0 else 0)
+                if r[key].shape[0] != expected:
+                    raise SidecarError(
+                        f"output {key!r} is not per-run shaped "
+                        f"(got leading dim {r[key].shape[0]}, batch {expected}); "
+                        "register it in models.pipeline_model.CORPUS_REDUCTIONS"
+                    )
+            parts = [results[0][key]] + [r[key][1:] for r in results[1:]]
+            merged[key] = np.concatenate(parts, axis=0)
+    return merged

@@ -1,0 +1,68 @@
+"""numpy/jax <-> protobuf conversion for the sidecar wire protocol."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from nemo_tpu.service.proto import nemo_service_pb2 as pb
+
+_COND_FIELDS = (
+    "table_id",
+    "label_id",
+    "type_id",
+    "is_goal",
+    "node_mask",
+    "edge_src",
+    "edge_dst",
+    "edge_mask",
+)
+
+
+def ndarray_to_pb(a) -> pb.NdArray:
+    a = np.ascontiguousarray(np.asarray(a))
+    return pb.NdArray(dtype=str(a.dtype), shape=list(a.shape), data=a.tobytes())
+
+
+def ndarray_from_pb(m: pb.NdArray, copy: bool = False) -> np.ndarray:
+    """Decode to numpy; zero-copy (read-only view) by default — the
+    device-bound path hands this straight to jnp.asarray."""
+    a = np.frombuffer(m.data, dtype=np.dtype(m.dtype)).reshape(tuple(m.shape))
+    return a.copy() if copy else a
+
+
+def batch_arrays_to_pb(arrays) -> pb.CondBatch:
+    """BatchArrays (or any object with the 8 packed fields) -> CondBatch."""
+    return pb.CondBatch(**{f: ndarray_to_pb(getattr(arrays, f)) for f in _COND_FIELDS})
+
+
+def batch_arrays_from_pb(m: pb.CondBatch):
+    from nemo_tpu.models.pipeline_model import BatchArrays
+    import jax.numpy as jnp
+
+    return BatchArrays(**{f: jnp.asarray(ndarray_from_pb(getattr(m, f))) for f in _COND_FIELDS})
+
+
+def static_to_pb(static: dict) -> pb.StaticParams:
+    return pb.StaticParams(**{k: int(v) for k, v in static.items()})
+
+
+def static_from_pb(m: pb.StaticParams) -> dict:
+    return dict(
+        v=int(m.v),
+        pre_tid=int(m.pre_tid),
+        post_tid=int(m.post_tid),
+        num_tables=int(m.num_tables),
+        num_labels=int(m.num_labels),
+        max_depth=int(m.max_depth),
+    )
+
+
+def outputs_to_pb(outputs: dict, chunk: int, step_seconds: float) -> pb.AnalyzeResponse:
+    resp = pb.AnalyzeResponse(chunk=chunk, step_seconds=step_seconds)
+    for k, v in outputs.items():
+        resp.outputs[k].CopyFrom(ndarray_to_pb(v))
+    return resp
+
+
+def outputs_from_pb(m: pb.AnalyzeResponse) -> dict[str, np.ndarray]:
+    return {k: ndarray_from_pb(v) for k, v in m.outputs.items()}

@@ -1,0 +1,116 @@
+"""The TPU sidecar: a gRPC service running the fused analysis step on device.
+
+Architecture per SURVEY.md §7: the CLI/ETL process packs provenance into
+integer arrays (natively, ingest/native.py) and streams them here; this
+process owns the accelerator, jits the fused pipeline once per
+(shapes, statics) signature, and streams results back.  Replaces the
+reference's per-node/edge Bolt round-trips to Neo4j (SURVEY.md §3.1 hot
+loop #1) with one RPC per chunk of thousands of runs.
+
+grpcio is present in this environment but its codegen plugin is not, so the
+service is registered through grpc's generic-handler API with the
+protoc-generated message classes doing (de)serialization.
+
+Run:  python -m nemo_tpu.service.server --port 50051
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import time
+from concurrent import futures
+
+import grpc
+
+from nemo_tpu.service import codec
+from nemo_tpu.service.proto import nemo_service_pb2 as pb
+
+SERVICE = "nemo.NemoAnalysis"
+VERSION = "1"
+
+log = logging.getLogger("nemo.sidecar")
+
+
+class _Impl:
+    """Method implementations; one fused-step jit cache per process."""
+
+    def health(self, request: pb.HealthRequest, context) -> pb.HealthResponse:
+        import jax
+
+        devs = jax.devices()
+        return pb.HealthResponse(
+            platform=devs[0].platform, device_count=len(devs), version=VERSION
+        )
+
+    def _analyze_one(self, request: pb.AnalyzeRequest) -> pb.AnalyzeResponse:
+        import jax
+
+        from nemo_tpu.models.pipeline_model import analysis_step
+
+        pre = codec.batch_arrays_from_pb(request.pre)
+        post = codec.batch_arrays_from_pb(request.post)
+        static = codec.static_from_pb(request.static)
+        t0 = time.perf_counter()
+        out = analysis_step(pre, post, **static)
+        out = jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        return codec.outputs_to_pb(out, chunk=request.chunk, step_seconds=dt)
+
+    def analyze(self, request: pb.AnalyzeRequest, context) -> pb.AnalyzeResponse:
+        return self._analyze_one(request)
+
+    def analyze_stream(self, request_iterator, context):
+        # Sequential device dispatch preserves chunk arrival order; gRPC's
+        # flow control provides the backpressure (SURVEY.md §7 hard part 6).
+        for request in request_iterator:
+            yield self._analyze_one(request)
+
+
+def make_server(port: int = 0, max_workers: int = 4) -> tuple[grpc.Server, int]:
+    """Build (but don't start) the sidecar server; returns (server, port)."""
+    impl = _Impl()
+    handlers = {
+        "Health": grpc.unary_unary_rpc_method_handler(
+            impl.health,
+            request_deserializer=pb.HealthRequest.FromString,
+            response_serializer=pb.HealthResponse.SerializeToString,
+        ),
+        "Analyze": grpc.unary_unary_rpc_method_handler(
+            impl.analyze,
+            request_deserializer=pb.AnalyzeRequest.FromString,
+            response_serializer=pb.AnalyzeResponse.SerializeToString,
+        ),
+        "AnalyzeStream": grpc.stream_stream_rpc_method_handler(
+            impl.analyze_stream,
+            request_deserializer=pb.AnalyzeRequest.FromString,
+            response_serializer=pb.AnalyzeResponse.SerializeToString,
+        ),
+    }
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=[
+            ("grpc.max_receive_message_length", 1 << 30),
+            ("grpc.max_send_message_length", 1 << 30),
+        ],
+    )
+    server.add_generic_rpc_handlers((grpc.method_handlers_generic_handler(SERVICE, handlers),))
+    bound = server.add_insecure_port(f"127.0.0.1:{port}")
+    return server, bound
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="nemo-tpu-sidecar")
+    parser.add_argument("--port", type=int, default=50051)
+    parser.add_argument("--max-workers", type=int, default=4)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    server, port = make_server(args.port, args.max_workers)
+    server.start()
+    log.info("sidecar listening on 127.0.0.1:%d", port)
+    server.wait_for_termination()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

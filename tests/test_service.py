@@ -1,0 +1,60 @@
+"""gRPC sidecar: in-process server/client round-trip and chunked-stream
+equivalence with a local fused step."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+from nemo_tpu.ingest.molly import load_molly_output  # noqa: E402
+from nemo_tpu.models.pipeline_model import analysis_step, pack_molly_for_step  # noqa: E402
+from nemo_tpu.service.client import RemoteAnalyzer, SidecarError, analyze_dir  # noqa: E402
+from nemo_tpu.service.server import make_server  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def sidecar():
+    server, port = make_server(port=0)
+    server.start()
+    yield f"127.0.0.1:{port}"
+    server.stop(grace=None)
+
+
+@pytest.fixture(scope="module")
+def packed(corpus_dir):
+    return pack_molly_for_step(load_molly_output(corpus_dir))
+
+
+def test_health(sidecar):
+    with RemoteAnalyzer(target=sidecar) as client:
+        h = client.wait_ready()
+    assert h["device_count"] >= 1
+    assert h["version"] == "1"
+
+
+def test_unary_analyze_matches_local(sidecar, packed):
+    pre, post, static = packed
+    local = analysis_step(pre, post, **static)
+    with RemoteAnalyzer(target=sidecar) as client:
+        client.wait_ready()
+        remote = client.analyze(pre, post, static)
+    assert set(remote) == set(local)
+    for k in local:
+        np.testing.assert_array_equal(remote[k], np.asarray(local[k]), err_msg=k)
+
+
+def test_streamed_chunks_match_unchunked(sidecar, corpus_dir, packed):
+    pre, post, static = packed
+    local = analysis_step(pre, post, **static)
+    merged = analyze_dir(sidecar, corpus_dir, chunk_runs=3)
+    assert set(merged) == set(local)
+    for k in local:
+        np.testing.assert_array_equal(merged[k], np.asarray(local[k]), err_msg=k)
+
+
+def test_unavailable_target_raises():
+    with RemoteAnalyzer(target="127.0.0.1:1", retries=2, timeout=2.0) as client:
+        with pytest.raises((grpc.RpcError, SidecarError)):
+            client.health(timeout=0.5)
