@@ -1,14 +1,19 @@
-"""Benchmark: provenance-graphs/sec of the batched TPU analysis pipeline.
+"""Benchmark: the north-star stress — the full 6-case-study corpus, >=10k
+fault-injection runs, through the fused TPU analysis pipeline.
 
-Times the flagship fused analysis_step (condition marking + simplification +
-prototypes + differential provenance — the per-run Cypher pipeline of the
-reference, main.go:106-180) over a large synthetic run batch, and compares
-against the sequential Python oracle backend running the same analyses —
-the stand-in for the reference's one-run-at-a-time Neo4j path (BASELINE.md;
-the oracle is strictly faster than Neo4j since it skips all Bolt round-trips).
+For each of the six case-study protocol families (models/case_studies.py,
+mirroring reference case-studies/*.ded), a base corpus is generated and
+packed (natively when the C++ engine is available), tiled along the run axis
+to n_total/6 runs, and pushed through the fused analysis_step (condition
+marking + simplification + prototypes + differential provenance — the per-run
+Cypher pipeline of the reference, main.go:106-180).  The baseline is the
+sequential Python oracle backend running the same analyses — the stand-in for
+the reference's one-run-at-a-time Neo4j path (BASELINE.md; the oracle is
+strictly faster than Neo4j since it skips all Bolt round-trips).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-Env knobs: NEMO_BENCH_RUNS (default 4096), NEMO_BENCH_BASE_RUNS (default 64),
+Env knobs: NEMO_BENCH_RUNS (total runs across families, default 10200),
+NEMO_BENCH_BASE_RUNS (distinct runs per family, default 32),
 NEMO_BENCH_PLATFORM (force a jax platform, e.g. cpu).
 """
 
@@ -40,74 +45,95 @@ def main() -> None:
 
     from nemo_tpu.backend.python_ref import PythonBackend
     from nemo_tpu.ingest.molly import load_molly_output
-    from nemo_tpu.models.pipeline_model import (
-        BatchArrays,
-        analysis_step,
-        pack_molly_for_step,
-    )
-    from nemo_tpu.models.synth import SynthSpec, write_corpus
+    from nemo_tpu.ingest.native import pack_molly_dir
+    from nemo_tpu.models.case_studies import CASE_STUDIES, write_case_study
+    from nemo_tpu.models.pipeline_model import BatchArrays, analysis_step
 
-    n_runs = int(os.environ.get("NEMO_BENCH_RUNS", "4096"))
-    base_runs = int(os.environ.get("NEMO_BENCH_BASE_RUNS", "64"))
+    n_total = int(os.environ.get("NEMO_BENCH_RUNS", "10200"))
+    base_runs = int(os.environ.get("NEMO_BENCH_BASE_RUNS", "32"))
+    per_family = max(base_runs, (n_total + len(CASE_STUDIES) - 1) // len(CASE_STUDIES))
     log(f"device: {jax.devices()[0].platform} x{len(jax.devices())}")
 
-    # Base corpus: base_runs distinct runs; tile the packed batch to n_runs
-    # (per-run work is identical, so tiling is timing-representative while
-    # keeping host-side generation cheap).
-    with tempfile.TemporaryDirectory() as tmp:
-        corpus = write_corpus(SynthSpec(n_runs=base_runs, seed=11, eot=7), tmp)
-        molly = load_molly_output(corpus)
-        pre, post, static = pack_molly_for_step(molly)
-    reps = max(1, (n_runs + base_runs - 1) // base_runs)
-
-    def tile(arrays: BatchArrays) -> BatchArrays:
+    def tile(arrays: BatchArrays, reps: int) -> BatchArrays:
         return jax.tree_util.tree_map(
             lambda x: jnp.asarray(np.tile(np.asarray(x), (reps,) + (1,) * (x.ndim - 1))),
             arrays,
         )
 
-    pre_t, post_t = tile(pre), tile(post)
-    batch = pre_t.is_goal.shape[0]
-    graphs = 2 * batch  # pre + post provenance per run
-    log(f"batch: {batch} runs ({graphs} graphs), bucket V={static['v']}")
+    # Pack each family's base corpus and tile to per_family runs.  Tiling is
+    # timing-representative (per-run work is shape-identical) while keeping
+    # host-side generation cheap.
+    family_batches = []
+    mollys = []
+    total_runs = 0
+    with tempfile.TemporaryDirectory() as tmp:
+        for name in sorted(CASE_STUDIES):
+            corpus = write_case_study(name, n_runs=base_runs, seed=11, out_dir=tmp)
+            molly = load_molly_output(corpus)
+            mollys.append(molly)
+            # Native C++ ETL when available, Python fallback otherwise.
+            pre, post, static = pack_molly_dir(corpus)
+            reps = (per_family + base_runs - 1) // base_runs
+            pre_t, post_t = tile(pre, reps), tile(post, reps)
+            b = int(pre_t.is_goal.shape[0])
+            total_runs += b
+            family_batches.append((name, pre_t, post_t, static))
+            log(f"  {name}: {b} runs, bucket V={static['v']}")
 
-    # Warm up (compile), then time steady-state iterations.
-    out = analysis_step(pre_t, post_t, **static)
-    jax.block_until_ready(out)
+    graphs = 2 * total_runs  # pre + post provenance per run
+    log(f"stress corpus: {len(family_batches)} families, {total_runs} runs, {graphs} graphs")
+
+    # Warm up (one compile per family's shape signature), then time the full
+    # six-family sweep end to end.
+    for _, pre_t, post_t, static in family_batches:
+        jax.block_until_ready(analysis_step(pre_t, post_t, **static))
     times = []
     for _ in range(5):
         t0 = time.perf_counter()
-        out = analysis_step(pre_t, post_t, **static)
-        jax.block_until_ready(out)
+        outs = [
+            analysis_step(pre_t, post_t, **static)
+            for _, pre_t, post_t, static in family_batches
+        ]
+        jax.block_until_ready(outs)
         times.append(time.perf_counter() - t0)
     t_step = float(np.median(times))
     value = graphs / t_step
-    log(f"analysis_step: {t_step * 1e3:.1f} ms median -> {value:,.0f} graphs/s")
+    log(
+        f"fused sweep: {t_step * 1e3:.1f} ms median for {total_runs} runs "
+        f"-> {value:,.0f} graphs/s"
+    )
 
-    # Baseline: the sequential oracle over the base corpus (same analyses).
+    # Baseline: the sequential oracle over the base corpora (same analyses).
     # init_graph_db is excluded from the timed region the same way the JAX
     # side's packing is — both sides time analysis only.
-    oracle = PythonBackend()
-    oracle.init_graph_db("", molly)
-    t0 = time.perf_counter()
-    oracle.load_raw_provenance()
-    oracle.simplify_prov(molly.runs_iters)
-    for i in molly.success_runs_iters:
-        oracle.proto_rule_tables(i, "post")
-    for f in molly.failed_runs_iters:
-        oracle.clean_rule_tables(f, "post")
-        diff = oracle.diff_graph(f)
-        oracle._diff_missing(diff)
-    t_base = time.perf_counter() - t0
-    base_graphs_per_sec = (2 * base_runs) / t_base
-    log(f"python oracle: {t_base * 1e3:.1f} ms for {2 * base_runs} graphs "
-        f"-> {base_graphs_per_sec:,.0f} graphs/s")
+    t_base_total = 0.0
+    base_graphs = 0
+    for molly in mollys:
+        oracle = PythonBackend()
+        oracle.init_graph_db("", molly)
+        t0 = time.perf_counter()
+        oracle.load_raw_provenance()
+        oracle.simplify_prov(molly.runs_iters)
+        for i in molly.success_runs_iters:
+            oracle.proto_rule_tables(i, "post")
+        for f in molly.failed_runs_iters:
+            oracle.clean_rule_tables(f, "post")
+            diff = oracle.diff_graph(f)
+            oracle._diff_missing(diff)
+        t_base_total += time.perf_counter() - t0
+        base_graphs += 2 * len(molly.runs)
+    base_graphs_per_sec = base_graphs / t_base_total
+    log(
+        f"python oracle: {t_base_total * 1e3:.1f} ms for {base_graphs} graphs "
+        f"-> {base_graphs_per_sec:,.0f} graphs/s"
+    )
 
     print(
         json.dumps(
             {
-                "metric": "provenance-graphs/sec, full analysis pipeline "
-                f"({batch} fault-injection runs, batched)",
+                "metric": "provenance-graphs/sec, full analysis pipeline, "
+                f"{len(family_batches)} case-study families x "
+                f"{total_runs // len(family_batches)} fault-injection runs",
                 "value": round(value, 1),
                 "unit": "graphs/s",
                 "vs_baseline": round(value / base_graphs_per_sec, 2),

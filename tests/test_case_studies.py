@@ -1,0 +1,99 @@
+"""Case-study corpus families: generation invariants, full-pipeline runs on
+every family, and oracle-vs-JAX parity spot checks."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from nemo_tpu.analysis.pipeline import run_debug
+from nemo_tpu.backend.python_ref import PythonBackend
+from nemo_tpu.ingest.molly import load_molly_output
+from nemo_tpu.models.case_studies import (
+    CASE_STUDIES,
+    generate_case_study,
+    write_case_study,
+)
+
+ALL = sorted(CASE_STUDIES)
+
+
+def test_registry_shape():
+    assert len(CASE_STUDIES) == 6
+    for spec in CASE_STUDIES.values():
+        # Molly invocation bounds from the reference case-study headers
+        # (SURVEY.md §2: EOT 6-8, EFF 3-5, <=1 crash, 2-4 nodes).
+        assert 6 <= spec.eot <= 8
+        assert 3 <= spec.eff <= 5
+        assert spec.max_crashes <= 1
+        n_nodes = 2 + len(spec.targets)  # client + coordinator + targets
+        assert 2 <= n_nodes <= 4
+        assert spec.ref.startswith("case-studies/")
+
+
+def test_generation_deterministic():
+    spec = CASE_STUDIES["MR-3858-hadoop"]
+    a = generate_case_study(spec, n_runs=5, seed=3)
+    b = generate_case_study(spec, n_runs=5, seed=3)
+    assert json.dumps(a, sort_keys=True, default=str) == json.dumps(
+        b, sort_keys=True, default=str
+    )
+
+
+def test_families_have_distinct_vocabularies():
+    tables = {}
+    for name, spec in CASE_STUDIES.items():
+        key = (spec.propagate_table, spec.persist_table, spec.ack_table)
+        assert key not in tables.values(), f"{name} duplicates another family's vocabulary"
+        tables[name] = key
+
+
+@pytest.mark.parametrize("name", ALL)
+def test_full_pipeline_each_family(name, tmp_path):
+    corpus = write_case_study(name, n_runs=6, seed=1, out_dir=str(tmp_path))
+    result = run_debug(corpus, str(tmp_path / "results"), PythonBackend())
+    runs = json.load(open(f"{result.report_dir}/debugging.json"))
+    assert len(runs) == 6
+    assert runs[0]["status"] == "success"
+    spec = CASE_STUDIES[name]
+    # The intersection prototype must speak this family's vocabulary.
+    proto = " ".join(runs[0].get("interProto", []))
+    assert spec.persist_table in proto and spec.propagate_table in proto, proto
+    # Crash-fault families inject crashes, omission families inject omissions.
+    failed = [r for r in runs if r["status"] != "success"]
+    if failed and spec.crash_faults:
+        assert any(r["failureSpec"]["crashes"] for r in failed)
+
+
+@pytest.mark.parametrize("name", ["ZK-1270-racing-sent-flag", "CA-2083-hinted-handoff"])
+def test_jax_parity_on_families(name, tmp_path):
+    """Backend-differential spot check on the two most structurally distinct
+    families (racing flag chain; crash faults)."""
+    from nemo_tpu.backend.jax_backend import JaxBackend
+    from nemo_tpu.backend.python_ref import CLEAN_OFFSET
+
+    corpus = write_case_study(name, n_runs=4, seed=2, out_dir=str(tmp_path))
+    m = load_molly_output(corpus)
+
+    oracle, jaxed = PythonBackend(), JaxBackend()
+    for b in (oracle, jaxed):
+        b.init_graph_db("", m)
+        b.load_raw_provenance()
+        b.simplify_prov(m.runs_iters)
+
+    for run in m.runs:
+        for cond in ("pre", "post"):
+            o = oracle.graphs[(run.iteration, cond)]
+            j = jaxed.raw[(run.iteration, cond)]
+            assert {n.id: n.cond_holds for n in o.goals()} == {
+                n.id: n.cond_holds for n in j.goals()
+            }, (run.iteration, cond)
+            oc = oracle.graphs[(CLEAN_OFFSET + run.iteration, cond)]
+            jc = jaxed.clean[(CLEAN_OFFSET + run.iteration, cond)]
+            assert {n.id for n in oc.nodes.values()} == {n.id for n in jc.nodes.values()}
+            assert set(oc.edge_order) == set(jc.edge_order)
+
+    o_protos = oracle.create_prototypes(m.success_runs_iters, m.failed_runs_iters)
+    j_protos = jaxed.create_prototypes(m.success_runs_iters, m.failed_runs_iters)
+    assert o_protos == j_protos
