@@ -71,8 +71,16 @@ def main() -> None:
             corpus = write_case_study(name, n_runs=base_runs, seed=11, out_dir=tmp)
             molly = load_molly_output(corpus)
             mollys.append(molly)
-            # Native C++ ETL when available, Python fallback otherwise.
-            pre, post, static = pack_molly_dir(corpus)
+            # Native C++ ETL when available; the fallback reuses the molly
+            # object already parsed for the oracle baseline.
+            from nemo_tpu.ingest.native import native_available
+
+            if native_available():
+                pre, post, static = pack_molly_dir(corpus)
+            else:
+                from nemo_tpu.models.pipeline_model import pack_molly_for_step
+
+                pre, post, static = pack_molly_for_step(molly)
             reps = (per_family + base_runs - 1) // base_runs
             pre_t, post_t = tile(pre, reps), tile(post, reps)
             b = int(pre_t.is_goal.shape[0])

@@ -45,11 +45,17 @@ def run_debug(
     backend: GraphBackend,
     conn: str = "",
     reporter: Reporter | None = None,
+    save_corpus_path: str | None = None,
 ) -> DebugResult:
     timer = PhaseTimer()
 
     with timer.phase("ingest"):
         molly = load_molly_output(fault_inj_out)
+    if save_corpus_path:
+        from nemo_tpu.graphs.corpus import pack_corpus, save_corpus
+
+        with timer.phase("save_corpus"):
+            save_corpus(pack_corpus(molly), save_corpus_path)
     iters = molly.get_runs_iters()
     failed_iters = molly.get_failed_runs_iters()
 

@@ -66,6 +66,14 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--timings", action="store_true", help="print per-phase wall-clock timings"
     )
+    parser.add_argument(
+        "--save-corpus",
+        metavar="PATH",
+        default=None,
+        help="after ingestion, persist the packed-array corpus as a .npz "
+        "bundle (graphs/corpus.py) so analysis can be re-run without "
+        "re-parsing the Molly output",
+    )
     args = parser.parse_args(argv)
 
     if not os.path.isdir(args.fault_inj_out):
@@ -73,7 +81,11 @@ def main(argv: list[str] | None = None) -> int:
 
     backend = make_backend(args.graph_backend)
     result = run_debug(
-        args.fault_inj_out, args.results_dir, backend, conn=args.graph_db_conn
+        args.fault_inj_out,
+        args.results_dir,
+        backend,
+        conn=args.graph_db_conn,
+        save_corpus_path=args.save_corpus,
     )
 
     if args.timings:

@@ -244,7 +244,10 @@ def generate_case_study(spec: FamilySpec, n_runs: int, seed: int = 0) -> dict[st
             )
 
         ack_time = rng.randint(3, max(3, spec.eot - 2))
-        persist_time = rng.randint(3, max(3, spec.eot - 1))
+        # Faults fire at persist_time - 1, and Molly only injects faults at
+        # times <= EFF (the failure window in the .ded headers) — keep the
+        # generated failureSpec self-consistent by bounding the draw.
+        persist_time = rng.randint(3, max(3, min(spec.eot - 1, spec.eff + 1)))
         omissions: list[dict[str, Any]] = []
         crashes: list[dict[str, Any]] = []
 

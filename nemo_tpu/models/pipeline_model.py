@@ -155,15 +155,11 @@ def analysis_step(
     }
 
 
-def pack_molly_for_step(
-    molly: MollyOutput, vocab: CorpusVocab | None = None
+def graphs_to_step(
+    run_ids: list[int], pre_graphs: list, post_graphs: list, vocab: CorpusVocab
 ) -> tuple[BatchArrays, BatchArrays, dict]:
-    """Pack a whole corpus into one common-bucket pre batch + post batch,
-    returning (pre, post, static_kwargs) ready for analysis_step."""
-    vocab = vocab or CorpusVocab()
-    run_ids = [r.iteration for r in molly.runs]
-    pre_graphs = [pack_graph(r.pre_prov, vocab) for r in molly.runs]
-    post_graphs = [pack_graph(r.post_prov, vocab) for r in molly.runs]
+    """Common tail of every pack path: one shared (V, E) bucket over both
+    conditions, two packed batches, and analysis_step's static kwargs."""
     from nemo_tpu.graphs.packed import bucket_size
 
     v = bucket_size(max(g.n_nodes for g in pre_graphs + post_graphs))
@@ -179,6 +175,28 @@ def pack_molly_for_step(
         max_depth=v,
     )
     return BatchArrays.from_packed(pre_b), BatchArrays.from_packed(post_b), static
+
+
+def pack_molly_for_step(
+    molly: MollyOutput, vocab: CorpusVocab | None = None
+) -> tuple[BatchArrays, BatchArrays, dict]:
+    """Pack a whole corpus into one common-bucket pre batch + post batch,
+    returning (pre, post, static_kwargs) ready for analysis_step."""
+    vocab = vocab or CorpusVocab()
+    run_ids = [r.iteration for r in molly.runs]
+    pre_graphs = [pack_graph(r.pre_prov, vocab) for r in molly.runs]
+    post_graphs = [pack_graph(r.post_prov, vocab) for r in molly.runs]
+    return graphs_to_step(run_ids, pre_graphs, post_graphs, vocab)
+
+
+def pack_corpus_for_step(corpus) -> tuple[BatchArrays, BatchArrays, dict]:
+    """Packed-corpus bundle (graphs/corpus.py) -> step inputs, without
+    touching the original Molly directory — the resume path: ingest once,
+    save_corpus, then benchmark/analyze from the bundle alone."""
+    run_ids = list(corpus.run_ids)
+    pre_graphs = [corpus.graphs[(i, "pre")] for i in run_ids]
+    post_graphs = [corpus.graphs[(i, "post")] for i in run_ids]
+    return graphs_to_step(run_ids, pre_graphs, post_graphs, corpus.vocab)
 
 
 def synth_batch_arrays(

@@ -1,0 +1,70 @@
+"""Packed-corpus persistence tests: save/load round-trip is bit-identical and
+feeds the fused analysis step without the original Molly directory
+(checkpoint/resume subsystem, SURVEY.md §5)."""
+
+import numpy as np
+
+from nemo_tpu.graphs.corpus import load_corpus, pack_corpus, save_corpus
+from nemo_tpu.ingest.molly import load_molly_output
+from nemo_tpu.models.pipeline_model import pack_corpus_for_step, pack_molly_for_step
+
+
+def test_corpus_roundtrip_bit_identical(corpus_dir, tmp_path):
+    molly = load_molly_output(corpus_dir)
+    corpus = pack_corpus(molly)
+    path = str(tmp_path / "corpus.npz")
+    save_corpus(corpus, path)
+    loaded = load_corpus(path)
+
+    assert loaded.run_name == corpus.run_name
+    assert loaded.run_ids == corpus.run_ids
+    assert loaded.statuses == corpus.statuses
+    assert loaded.success_runs_iters == molly.success_runs_iters
+    assert loaded.failed_runs_iters == molly.failed_runs_iters
+    for vocab in ("tables", "labels", "times"):
+        assert getattr(loaded.vocab, vocab).strings == getattr(corpus.vocab, vocab).strings
+        assert getattr(loaded.vocab, vocab).ids == getattr(corpus.vocab, vocab).ids
+
+    assert set(loaded.graphs) == set(corpus.graphs)
+    for key, g in corpus.graphs.items():
+        lg = loaded.graphs[key]
+        assert lg.n_goals == g.n_goals
+        assert lg.n_nodes == g.n_nodes
+        assert lg.node_ids == g.node_ids
+        for col in ("table_id", "label_id", "time_id", "type_id", "edges"):
+            got, want = getattr(lg, col), getattr(g, col)
+            assert got.dtype == want.dtype
+            np.testing.assert_array_equal(got, want)
+
+
+def test_corpus_feeds_analysis_step(corpus_dir, tmp_path):
+    """Arrays packed from a reloaded bundle match arrays packed from Molly."""
+    molly = load_molly_output(corpus_dir)
+    path = str(tmp_path / "corpus.npz")
+    save_corpus(pack_corpus(molly), path)
+
+    pre_m, post_m, static_m = pack_molly_for_step(molly)
+    pre_c, post_c, static_c = pack_corpus_for_step(load_corpus(path))
+    assert static_m == static_c
+    for a, b in ((pre_m, pre_c), (post_m, post_c)):
+        for fld in vars(a):
+            np.testing.assert_array_equal(np.asarray(getattr(a, fld)), np.asarray(getattr(b, fld)))
+
+
+def test_cli_save_corpus_flag(corpus_dir, tmp_path):
+    from nemo_tpu.cli import main
+
+    path = str(tmp_path / "bundle.npz")
+    rc = main(
+        [
+            "-faultInjOut",
+            corpus_dir,
+            "--results-dir",
+            str(tmp_path / "results"),
+            "--save-corpus",
+            path,
+        ]
+    )
+    assert rc == 0
+    loaded = load_corpus(path)
+    assert loaded.graphs
