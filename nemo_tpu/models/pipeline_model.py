@@ -81,7 +81,15 @@ CORPUS_REDUCTIONS = {"proto_inter": "and", "proto_union": "or"}
 
 @partial(
     jax.jit,
-    static_argnames=("v", "pre_tid", "post_tid", "num_tables", "num_labels", "max_depth"),
+    static_argnames=(
+        "v",
+        "pre_tid",
+        "post_tid",
+        "num_tables",
+        "num_labels",
+        "max_depth",
+        "closure_impl",
+    ),
 )
 def analysis_step(
     pre: BatchArrays,
@@ -92,6 +100,7 @@ def analysis_step(
     num_tables: int,
     num_labels: int,
     max_depth: int,
+    closure_impl: str = "auto",
 ) -> dict[str, jnp.ndarray]:
     """The full fused pipeline for one run batch.  Returns per-run and
     corpus-level results; everything stays on device."""
@@ -110,16 +119,23 @@ def analysis_step(
     # Simplification of both conditions (preprocessing.go:351-387).
     pre_clean, pre_alive = clean_masks(adj_pre, pre.is_goal, pre.node_mask)
     pre_adj2, pre_alive2, pre_type2 = collapse_chains(
-        pre_clean, pre.is_goal, pre.type_id, pre_alive
+        pre_clean, pre.is_goal, pre.type_id, pre_alive, closure_impl=closure_impl
     )
     post_clean, post_alive = clean_masks(adj_post, post.is_goal, post.node_mask)
     post_adj2, post_alive2, post_type2 = collapse_chains(
-        post_clean, post.is_goal, post.type_id, post_alive
+        post_clean, post.is_goal, post.type_id, post_alive, closure_impl=closure_impl
     )
 
     # Prototypes over the simplified consequent (prototype.go:11-130).
     bits, min_depth = proto_rule_bits(
-        post_adj2, post.is_goal, post_alive2, post.table_id, achieved_pre, num_tables, max_depth
+        post_adj2,
+        post.is_goal,
+        post_alive2,
+        post.table_id,
+        achieved_pre,
+        num_tables,
+        max_depth,
+        closure_impl=closure_impl,
     )
     present = all_rule_bits(post.is_goal, post_alive2, post.table_id, num_tables)
     inter, union = reduce_protos(bits, achieved_pre)
@@ -131,7 +147,13 @@ def analysis_step(
     run_bits = jnp.zeros((post.label_id.shape[0], num_labels), dtype=bool)
     run_bits = jax.vmap(lambda b, l, m: b.at[l].max(m))(run_bits, lid, sel)
     node_keep, edge_keep, frontier_rule, missing_goal = diff_masks(
-        adj_post[0], post.is_goal[0], post.node_mask[0], post.label_id[0], run_bits, max_depth
+        adj_post[0],
+        post.is_goal[0],
+        post.node_mask[0],
+        post.label_id[0],
+        run_bits,
+        max_depth,
+        closure_impl=closure_impl,
     )
 
     return {

@@ -11,6 +11,8 @@ run axis B is the data-parallel axis sharded across the TPU mesh.
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 
@@ -59,8 +61,23 @@ def step_backward(frontier: jax.Array, adj: jax.Array) -> jax.Array:
     return prod > 0.5
 
 
-def closure(adj: jax.Array) -> jax.Array:
-    """Reflexive-transitive closure (>=0 hops) by log2(V) squarings."""
+def closure(adj: jax.Array, impl: str | None = None) -> jax.Array:
+    """Reflexive-transitive closure (>=0 hops) by log2(V) squarings.
+
+    impl: "xla" (einsum chain, one HBM round-trip per squaring; GSPMD can
+    partition it, so it is the only legal choice under a sharded jit),
+    "pallas" (fused VMEM-resident chain, ops/pallas_kernels.py; interpreter
+    mode off-TPU), or "auto"/None (NEMO_CLOSURE_IMPL env, defaulting to
+    pallas on TPU backends)."""
+    impl = impl or os.environ.get("NEMO_CLOSURE_IMPL", "auto")
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl not in ("xla", "pallas"):
+        raise ValueError(f"unknown closure impl {impl!r} (expected auto, xla, or pallas)")
+    if impl == "pallas":
+        from nemo_tpu.ops.pallas_kernels import closure_pallas
+
+        return closure_pallas(adj, interpret=jax.default_backend() != "tpu")
     v = adj.shape[-1]
     eye = jnp.eye(v, dtype=bool)
     r = adj | eye

@@ -41,12 +41,13 @@ def diff_masks(
     label_id: jax.Array,  # [V]
     fail_bits: jax.Array,  # [B,L] one bitset per failed run
     max_depth: int,
+    closure_impl: str = "auto",
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Returns (node_keep [B,V], edge_keep [B,V,V], frontier_rule [B,V],
     missing_goal [B,V])."""
     num_labels = fail_bits.shape[-1]
     lid = jnp.clip(label_id, 0, num_labels - 1)
-    clo = closure(adj_good)  # [V,V], shared across failed runs
+    clo = closure(adj_good, impl=closure_impl)  # [V,V], shared across failed runs
 
     def per_run(bits: jax.Array):
         in_failed = bits[lid] & (label_id >= 0)

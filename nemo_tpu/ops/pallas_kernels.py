@@ -1,0 +1,80 @@
+"""Pallas TPU kernels for the hot graph ops.
+
+The hottest op in the fused analysis step is transitive closure
+(ops/adjacency.py:closure): log2(V) squarings of [B,V,V] boolean matrices.
+Under plain XLA each squaring is a separate MXU matmul whose input and output
+round-trip HBM — 2·log2(V)·B·V² of traffic for a compute-light 0/1 matmul
+chain, i.e. HBM-bandwidth-bound at the corpus sizes the stress bench runs
+(V 32–128, B in the thousands).  The Pallas kernel fuses the whole squaring
+chain: each grid instance DMAs a block of graphs into VMEM once, runs every
+squaring on the MXU from VMEM, and writes the finished closure back once —
+HBM traffic drops to read+write of the block regardless of log2(V).
+
+Boolean exactness: entries are 0/1 (exact in bf16), products accumulate in
+f32 (exact up to V ≤ 2^24), thresholded at 0.5 each squaring.
+
+Used via ops.adjacency.closure's impl dispatch (NEMO_CLOSURE_IMPL =
+auto|xla|pallas; auto picks pallas on TPU backends).  CPU tests run the same
+kernel in interpreter mode (tests/test_pallas.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _closure_kernel(adj_ref, out_ref, *, n_steps: int, block_b: int, v: int):
+    row = jax.lax.broadcasted_iota(jnp.int32, (v, v), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (v, v), 1)
+    eye = (row == col).astype(jnp.bfloat16)
+    # Static unroll over the graphs of this block: Mosaic's dot lowering is
+    # 2-D, and block_b is small (VMEM-bounded), so unrolling beats a loop.
+    for i in range(block_b):
+        r = jnp.maximum(adj_ref[i], eye)
+        for _ in range(n_steps):
+            p = jnp.dot(r, r, preferred_element_type=jnp.float32)
+            r = (p > 0.5).astype(jnp.bfloat16)
+        out_ref[i] = r
+
+
+def default_block_b(v: int) -> int:
+    """Graphs per grid instance, sized so ~3 live [block_b,V,V] bf16 buffers
+    stay well under VMEM (~16 MB/core)."""
+    if v <= 128:
+        return 8
+    if v <= 256:
+        return 4
+    if v <= 512:
+        return 2
+    return 1
+
+
+def closure_pallas(
+    adj: jax.Array, block_b: int | None = None, interpret: bool = False
+) -> jax.Array:
+    """Reflexive-transitive closure of [B,V,V] (or [V,V]) boolean adjacency,
+    fused squaring chain in VMEM.  Bit-identical to adjacency.closure."""
+    squeeze = adj.ndim == 2
+    if squeeze:
+        adj = adj[None]
+    b, v, _ = adj.shape
+    n_steps = max(1, (v - 1).bit_length())
+    bb = min(block_b or default_block_b(v), b)
+    x = adj.astype(jnp.bfloat16)
+    pad = (-b) % bb
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0), (0, 0)))
+    out = pl.pallas_call(
+        functools.partial(_closure_kernel, n_steps=n_steps, block_b=bb, v=v),
+        out_shape=jax.ShapeDtypeStruct(x.shape, jnp.bfloat16),
+        grid=(x.shape[0] // bb,),
+        in_specs=[pl.BlockSpec((bb, v, v), lambda i: (i, 0, 0))],
+        out_specs=pl.BlockSpec((bb, v, v), lambda i: (i, 0, 0)),
+        interpret=interpret,
+    )(x)
+    res = out[:b] > 0.5
+    return res[0] if squeeze else res

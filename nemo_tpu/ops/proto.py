@@ -49,11 +49,12 @@ def proto_rule_bits(
     achieved_pre: jax.Array,  # [B] bool
     num_tables: int,
     max_depth: int,
+    closure_impl: str = "auto",
 ) -> tuple[jax.Array, jax.Array]:
     """Returns (bits [B,T] bool, min_rule_depth [B,T] int32)."""
     a = adj & alive[..., None] & alive[..., None, :]
     root = is_goal & alive & ~in_degree_any(a)
-    clo = closure(a)
+    clo = closure(a, impl=closure_impl)
     d1 = reach_ge1(a, clo)  # >=1-hop reachability
     reach = step_forward(root, d1) | jnp.zeros_like(root)  # nodes >=1 hop below a root
     is_rule = ~is_goal & alive

@@ -50,6 +50,7 @@ def collapse_chains(
     is_goal: jax.Array,  # [B,V]
     type_id: jax.Array,  # [B,V]
     alive: jax.Array,  # [B,V]
+    closure_impl: str = "auto",
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Returns (adj_new, alive_new, type_new)."""
     v = adj.shape[-1]
@@ -65,7 +66,7 @@ def collapse_chains(
     # Component labels = min member index reachable in the undirected member
     # subgraph (closure on the MXU; log2(V) squarings).
     und = (a | jnp.swapaxes(a, -1, -2)) & member[..., None] & member[..., None, :]
-    comp_reach = closure(und)  # includes identity
+    comp_reach = closure(und, impl=closure_impl)  # includes identity
     lab = jnp.min(
         jnp.where(comp_reach & member[..., None], idx[None, :, None], v), axis=-2
     )  # [B,V]; == v for non-members

@@ -73,7 +73,10 @@ def analysis_step_sharded(
     post_s, _ = pad_batch_rows(post, mesh.devices.size)
     pre_s = shard_arrays(mesh, pre_s)
     post_s = shard_arrays(mesh, post_s)
-    out = analysis_step(pre_s, post_s, **static)
+    # closure_impl is pinned to the partitionable XLA einsum chain: GSPMD
+    # cannot shard through a Mosaic pallas_call, so the fused pallas closure
+    # is single-device-only (ops/adjacency.py:closure).
+    out = analysis_step(pre_s, post_s, **{**static, "closure_impl": "xla"})
     # Un-pad only the outputs whose leading axis is the run axis; corpus-level
     # outputs (proto_inter/proto_union over the table axis) pass through.
     corpus_level = {"proto_inter", "proto_union"}
