@@ -96,11 +96,15 @@ class PythonBackend(GraphBackend):
                 self.graphs[(CLEAN_OFFSET + i, cond)] = clean
 
     @staticmethod
-    def _clean_copy(g: PGraph, iteration: int, cond: str) -> PGraph:
+    def _clean_copy(
+        g: PGraph, iteration: int, cond: str, kept_rule_ids: set[str] | None = None
+    ) -> PGraph:
         """Goal-[*0..]->Goal path restriction (preprocessing.go:17-27; see
         base.py for the degree-mask formulation).  Node IDs are rewritten from
         run_<i>_ to run_<1000+i>_ exactly as the reference's sed pass does
-        (preprocessing.go:33-54)."""
+        (preprocessing.go:33-54).  `kept_rule_ids` lets a backend supply the
+        kept-rule selection from its own store (Neo4jBackend's Cypher degree
+        query) instead of the local degree check."""
         old_prefix = f"run_{iteration}_"
         new_prefix = f"run_{CLEAN_OFFSET + iteration}_"
 
@@ -112,6 +116,9 @@ class PythonBackend(GraphBackend):
         for node in g.nodes.values():
             if node.is_goal:
                 keep.add(node.id)
+            elif kept_rule_ids is not None:
+                if node.id in kept_rule_ids:
+                    keep.add(node.id)
             else:
                 has_in = bool(g.inn[node.id])
                 has_out = bool(g.out[node.id])

@@ -29,7 +29,11 @@ def make_backend(name: str):
         from nemo_tpu.backend.jax_backend import JaxBackend
 
         return JaxBackend()
-    raise SystemExit(f"unknown graph backend: {name!r} (expected python or jax)")
+    if name == "neo4j":
+        from nemo_tpu.backend.neo4j_backend import Neo4jBackend
+
+        return Neo4jBackend()
+    raise SystemExit(f"unknown graph backend: {name!r} (expected python, jax, or neo4j)")
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -54,9 +58,10 @@ def main(argv: list[str] | None = None) -> int:
     )
     parser.add_argument(
         "--graph-backend",
-        choices=("python", "jax"),
+        choices=("python", "jax", "neo4j"),
         default="python",
-        help="graph analytics engine: in-process Python oracle or batched JAX/TPU",
+        help="graph analytics engine: in-process Python oracle, batched "
+        "JAX/TPU, or a Neo4j server at -graphDBConn (the reference's backend)",
     )
     parser.add_argument(
         "--results-dir",

@@ -13,6 +13,7 @@ data-parallel and the cross-run reductions become ICI all-reduces.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from functools import partial
 
@@ -79,6 +80,38 @@ jax.tree_util.register_dataclass(
 CORPUS_REDUCTIONS = {"proto_inter": "and", "proto_union": "or"}
 
 
+def analysis_step(
+    pre: BatchArrays,
+    post: BatchArrays,
+    v: int,
+    pre_tid: int,
+    post_tid: int,
+    num_tables: int,
+    num_labels: int,
+    max_depth: int,
+    closure_impl: str = "auto",
+) -> dict[str, jnp.ndarray]:
+    """Jit-cached wrapper that resolves closure_impl="auto" (env + backend)
+    BEFORE entering jit, so the resolved impl is part of the static cache key
+    — changing NEMO_CLOSURE_IMPL between calls takes effect instead of
+    silently hitting the stale trace."""
+    if closure_impl == "auto":
+        closure_impl = os.environ.get("NEMO_CLOSURE_IMPL", "auto")
+        if closure_impl == "auto":
+            closure_impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    return _analysis_step_jit(
+        pre,
+        post,
+        v=v,
+        pre_tid=pre_tid,
+        post_tid=post_tid,
+        num_tables=num_tables,
+        num_labels=num_labels,
+        max_depth=max_depth,
+        closure_impl=closure_impl,
+    )
+
+
 @partial(
     jax.jit,
     static_argnames=(
@@ -91,7 +124,7 @@ CORPUS_REDUCTIONS = {"proto_inter": "and", "proto_union": "or"}
         "closure_impl",
     ),
 )
-def analysis_step(
+def _analysis_step_jit(
     pre: BatchArrays,
     post: BatchArrays,
     v: int,

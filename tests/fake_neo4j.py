@@ -1,0 +1,430 @@
+"""In-process fake Neo4j: a real Bolt v1 TCP server over an in-memory store.
+
+Speaks the genuine wire protocol (handshake, chunked PackStream framing,
+INIT/RUN/PULL_ALL), so the backend's client stack is exercised end to end;
+query execution dispatches on the `// nemo:<verb>` marker each backend
+statement carries and implements that verb's documented semantics against a
+dict store.  This substitutes for the unavailable Neo4j container the same
+way the virtual CPU mesh substitutes for a TPU pod (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Any
+
+from nemo_tpu.backend.bolt.client import (
+    BOLT_MAGIC,
+    BOLT_VERSION,
+    MSG_FAILURE,
+    MSG_IGNORED,
+    MSG_INIT,
+    MSG_PULL_ALL,
+    MSG_RECORD,
+    MSG_RESET,
+    MSG_RUN,
+    MSG_SUCCESS,
+)
+from nemo_tpu.backend.bolt.packstream import Structure, pack, unpack_all
+
+
+class FakeStore:
+    """Property-graph store executing the backend's marked statements."""
+
+    def __init__(self) -> None:
+        self.nodes: dict[str, dict[str, Any]] = {}  # id -> props (+kind)
+        self.edges: dict[tuple[str, str], int] = {}  # (src, dst) -> seq
+
+    # -- helpers ----------------------------------------------------------
+
+    def _nodes_of(self, run: int, cond: str) -> list[dict[str, Any]]:
+        return [
+            n
+            for n in self.nodes.values()
+            if n["run"] == run and n["condition"] == cond
+        ]
+
+    def _out(self, nid: str) -> list[str]:
+        return [d for (s, d) in self.edges if s == nid]
+
+    def _inn(self, nid: str) -> list[str]:
+        return [s for (s, d) in self.edges if d == nid]
+
+    # -- dispatch ---------------------------------------------------------
+
+    def run(self, statement: str, params: dict[str, Any]) -> tuple[list[str], list[list[Any]]]:
+        marker = statement.split("\n", 1)[0].removeprefix("// nemo:").strip()
+        handler = getattr(self, "q_" + marker, None)
+        if handler is None:
+            raise KeyError(f"Neo.ClientError.Statement.SyntaxError: no handler for {marker!r}")
+        records = handler(params)
+        return [f"c{i}" for i in range(len(records[0]))] if records else [], records
+
+    # -- verbs ------------------------------------------------------------
+
+    def q_wipe(self, p):
+        self.nodes.clear()
+        self.edges.clear()
+        return []
+
+    def q_constraint_goal(self, p):
+        return []
+
+    q_constraint_rule = q_constraint_goal
+    q_index_goal_run = q_constraint_goal
+    q_index_rule_run = q_constraint_goal
+
+    def _load(self, p, kind: str, extra_keys: tuple[str, ...]) -> list:
+        for row in p["rows"]:
+            if row["id"] in self.nodes:
+                raise KeyError("Neo.ClientError.Schema.ConstraintValidationFailed: dup id")
+            self.nodes[row["id"]] = {
+                "id": row["id"],
+                "kind": kind,
+                "run": p["run"],
+                "condition": p["condition"],
+                "label": row["label"],
+                "table": row["table"],
+                "seq": row["seq"],
+                **{k: row[k] for k in extra_keys},
+            }
+        return []
+
+    def q_load_goals(self, p):
+        return self._load(p, "Goal", ("time", "condition_holds"))
+
+    def q_load_rules(self, p):
+        return self._load(p, "Rule", ("type",))
+
+    def _load_edges(self, p, src_kind: str, dst_kind: str) -> list:
+        for row in p["rows"]:
+            src, dst = self.nodes.get(row["src"]), self.nodes.get(row["dst"])
+            if src is None or dst is None:
+                raise KeyError("Neo.ClientError.Statement.EntityNotFound: edge endpoint")
+            if src["kind"] != src_kind or dst["kind"] != dst_kind:
+                raise KeyError("Neo.ClientError.Statement.EntityNotFound: label mismatch")
+            self.edges[(row["src"], row["dst"])] = row["seq"]  # MERGE + SET seq
+        return []
+
+    def q_load_edges_gr(self, p):
+        return self._load_edges(p, "Goal", "Rule")
+
+    def q_load_edges_rg(self, p):
+        return self._load_edges(p, "Rule", "Goal")
+
+    def _count_kind(self, p, kind: str) -> list:
+        n = sum(1 for x in self._nodes_of(p["run"], p["condition"]) if x["kind"] == kind)
+        return [[n]]
+
+    def q_count_goals(self, p):
+        return self._count_kind(p, "Goal")
+
+    def q_count_rules(self, p):
+        return self._count_kind(p, "Rule")
+
+    def q_count_edges(self, p):
+        # UNION ALL of the Goal-source and Rule-source counts: two rows.
+        counts = {"Goal": 0, "Rule": 0}
+        for (s, _d) in self.edges:
+            n = self.nodes[s]
+            if n["run"] == p["run"] and n["condition"] == p["condition"]:
+                counts[n["kind"]] += 1
+        return [[counts["Goal"]], [counts["Rule"]]]
+
+    def q_mark_condition(self, p):
+        run, cond = p["run"], p["condition"]
+        tables: set[str] = set()
+        found_grandchild = False
+        for root in self._nodes_of(run, cond):
+            if root["kind"] != "Goal" or root["table"] != cond or self._inn(root["id"]):
+                continue
+            for rid in self._out(root["id"]):
+                r = self.nodes[rid]
+                if r["kind"] != "Rule" or r["table"] != cond:
+                    continue
+                if r["run"] != run or r["condition"] != cond:
+                    continue
+                for gid in self._out(rid):
+                    g = self.nodes[gid]
+                    if g["kind"] == "Goal" and g["run"] == run and g["condition"] == cond:
+                        tables.add(g["table"])
+                        found_grandchild = True
+        if not found_grandchild:
+            return []
+        tables.add(cond)
+        for n in self._nodes_of(run, cond):
+            if n["kind"] == "Goal" and n["table"] in tables:
+                n["condition_holds"] = True
+        return []
+
+    def q_pull_nodes(self, p):
+        # UNION of label-scoped matches: goals first, then rules, each in
+        # arbitrary server order (the backend re-sorts by the seq column).
+        rows = self._nodes_of(p["run"], p["condition"])
+        rows = [n for n in rows if n["kind"] == "Goal"] + [
+            n for n in rows if n["kind"] == "Rule"
+        ]
+        return [
+            [
+                n["id"],
+                n["kind"],
+                n["label"],
+                n["table"],
+                n.get("time"),
+                n.get("type"),
+                n.get("condition_holds", False),
+                n["seq"],
+            ]
+            for n in rows
+        ]
+
+    def q_pull_edges(self, p):
+        rows = [
+            (s, d, seq)
+            for (s, d), seq in self.edges.items()
+            if self.nodes[s]["run"] == p["run"]
+            and self.nodes[s]["condition"] == p["condition"]
+        ]
+        # Goal-source rows first (UNION order), arbitrary within each arm.
+        return [
+            [s, d, seq]
+            for s, d, seq in sorted(rows, key=lambda r: self.nodes[r[0]]["kind"] != "Goal")
+        ]
+
+    def q_clean_kept_rules(self, p):
+        rows = [
+            n
+            for n in self._nodes_of(p["run"], p["condition"])
+            if n["kind"] == "Rule" and self._inn(n["id"]) and self._out(n["id"])
+        ]
+        return [[n["id"]] for n in sorted(rows, key=lambda n: n["seq"])]
+
+    def q_achieved_pre(self, p):
+        n = sum(
+            1
+            for x in self._nodes_of(p["run"], "pre")
+            if x["kind"] == "Goal" and x.get("condition_holds")
+        )
+        return [[n]]
+
+    def q_proto_tables(self, p):
+        run, cond = p["run"], p["condition"]
+        ids = {n["id"] for n in self._nodes_of(run, cond)}
+        out = {nid: [d for d in self._out(nid) if d in ids] for nid in ids}
+        inn = {nid: [s for s in self._inn(nid) if s in ids] for nid in ids}
+        roots = [
+            n["id"]
+            for n in self._nodes_of(run, cond)
+            if n["kind"] == "Goal" and not inn[n["id"]]
+        ]
+        # Min hop distance from any root (BFS).
+        dist: dict[str, int] = {r: 0 for r in roots}
+        frontier = list(roots)
+        while frontier:
+            nxt = []
+            for v in frontier:
+                for w in out[v]:
+                    if w not in dist:
+                        dist[w] = dist[v] + 1
+                        nxt.append(w)
+            frontier = nxt
+
+        def descendants(nid: str) -> set[str]:
+            seen: set[str] = set()
+            stack = [nid]
+            while stack:
+                v = stack.pop()
+                for w in out[v]:
+                    if w not in seen:
+                        seen.add(w)
+                        stack.append(w)
+            return seen
+
+        by_table: dict[str, int] = {}
+        for nid in ids:
+            n = self.nodes[nid]
+            if n["kind"] != "Rule" or nid not in dist or dist[nid] < 1:
+                continue
+            has_rule_desc = any(self.nodes[d]["kind"] == "Rule" for d in descendants(nid))
+            has_rule_anc = any(
+                self.nodes[a]["kind"] == "Rule" and a in dist and a != nid
+                for a in self._ancestors_within(nid, ids, inn)
+            )
+            if has_rule_desc or has_rule_anc:
+                prev = by_table.get(n["table"])
+                if prev is None or dist[nid] < prev:
+                    by_table[n["table"]] = dist[nid]
+        return [[t, d] for t, d in by_table.items()]
+
+    def _ancestors_within(self, nid: str, ids: set[str], inn) -> set[str]:
+        seen: set[str] = set()
+        stack = [nid]
+        while stack:
+            v = stack.pop()
+            for w in inn[v]:
+                if w not in seen:
+                    seen.add(w)
+                    stack.append(w)
+        return seen
+
+    def q_clean_rule_tables(self, p):
+        tables = {
+            n["table"]
+            for n in self._nodes_of(p["run"], p["condition"])
+            if n["kind"] == "Rule"
+        }
+        return [[t] for t in sorted(tables)]
+
+    def q_count_pre_holds(self, p):
+        n = sum(
+            1
+            for x in self.nodes.values()
+            if x["kind"] == "Goal"
+            and x["condition"] == "pre"
+            and x["table"] == "pre"
+            and x.get("condition_holds")
+            and x["run"] < 1000
+        )
+        return [[n]]
+
+
+class FakeNeo4jServer:
+    """Threaded Bolt v1 server over a FakeStore.  Use as a context manager;
+    `uri` gives the bolt:// address to hand to Neo4jBackend."""
+
+    def __init__(self) -> None:
+        self.store = FakeStore()
+        self.statements: list[str] = []  # marker log, for assertions
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("127.0.0.1", 0))
+        self._listener.listen(8)
+        self.port = self._listener.getsockname()[1]
+        self.uri = f"bolt://127.0.0.1:{self.port}"
+        self._threads: list[threading.Thread] = []
+        self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._running = True
+        self._accept_thread.start()
+
+    # -- lifecycle --------------------------------------------------------
+
+    def __enter__(self) -> "FakeNeo4jServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def close(self) -> None:
+        self._running = False
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+    # -- protocol ---------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                conn, _addr = self._listener.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            buf = b""
+
+            def recv_exact(n: int) -> bytes:
+                nonlocal buf
+                while len(buf) < n:
+                    data = conn.recv(65536)
+                    if not data:
+                        raise ConnectionError
+                    buf += data
+                out, rest = buf[:n], buf[n:]
+                buf = rest
+                return out
+
+            # Handshake.
+            magic = recv_exact(4)
+            assert magic == BOLT_MAGIC, magic
+            versions = struct.unpack(">IIII", recv_exact(16))
+            agreed = BOLT_VERSION if BOLT_VERSION in versions else 0
+            conn.sendall(struct.pack(">I", agreed))
+            if agreed == 0:
+                return
+
+            def recv_message() -> Structure:
+                payload = bytearray()
+                while True:
+                    size = struct.unpack(">H", recv_exact(2))[0]
+                    if size == 0:
+                        if payload:
+                            break
+                        continue
+                    payload += recv_exact(size)
+                return unpack_all(bytes(payload))
+
+            def send_message(msg: Structure) -> None:
+                payload = pack(msg)
+                out = bytearray()
+                for ofs in range(0, len(payload), 0xFFFF):
+                    chunk = payload[ofs : ofs + 0xFFFF]
+                    out += struct.pack(">H", len(chunk)) + chunk
+                out += b"\x00\x00"
+                conn.sendall(bytes(out))
+
+            # Bolt server state machine: after FAILURE, every request except
+            # ACK_FAILURE/RESET is answered IGNORED.
+            pending: tuple[list[str], list[list[Any]]] | None = None
+            failed = False
+            while True:
+                msg = recv_message()
+                if msg.signature == MSG_INIT:
+                    send_message(Structure(MSG_SUCCESS, [{"server": "FakeNeo4j/3.3"}]))
+                elif msg.signature == MSG_RESET:
+                    pending, failed = None, False
+                    send_message(Structure(MSG_SUCCESS, [{}]))
+                elif failed and msg.signature in (MSG_RUN, MSG_PULL_ALL):
+                    send_message(Structure(MSG_IGNORED, []))
+                elif msg.signature == MSG_RUN:
+                    statement, params = msg.fields[0], msg.fields[1]
+                    self.statements.append(statement.split("\n", 1)[0])
+                    try:
+                        fields, records = self.store.run(statement, params)
+                        pending = (fields, records)
+                        send_message(Structure(MSG_SUCCESS, [{"fields": fields}]))
+                    except Exception as ex:  # noqa: BLE001 - surfaced as FAILURE
+                        pending, failed = None, True
+                        send_message(
+                            Structure(
+                                MSG_FAILURE,
+                                [{"code": "Neo.ClientError", "message": str(ex)}],
+                            )
+                        )
+                elif msg.signature == MSG_PULL_ALL:
+                    if pending is not None:
+                        for rec in pending[1]:
+                            send_message(Structure(MSG_RECORD, [rec]))
+                        send_message(Structure(MSG_SUCCESS, [{}]))
+                        pending = None
+                    else:
+                        failed = True
+                        send_message(
+                            Structure(MSG_FAILURE, [{"code": "Neo.ClientError", "message": "no result"}])
+                        )
+                else:  # ACK_FAILURE and anything else
+                    failed = False
+                    send_message(Structure(MSG_SUCCESS, [{}]))
+        except (ConnectionError, AssertionError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass

@@ -1,0 +1,164 @@
+"""Bolt wire-layer tests: PackStream codec and the client against the
+in-process fake server (real TCP, real framing)."""
+
+import pytest
+
+from fake_neo4j import FakeNeo4jServer
+from nemo_tpu.backend.bolt import BoltConnection, BoltError
+from nemo_tpu.backend.bolt.packstream import (
+    Node,
+    Path,
+    Relationship,
+    Structure,
+    pack,
+    unpack_all,
+)
+
+
+# ----------------------------------------------------------------- packstream
+
+
+@pytest.mark.parametrize(
+    "value",
+    [
+        None,
+        True,
+        False,
+        0,
+        1,
+        -1,
+        -16,
+        -17,
+        127,
+        128,
+        -128,
+        -129,
+        32767,
+        32768,
+        -32768,
+        -32769,
+        2**31 - 1,
+        2**31,
+        -(2**31),
+        -(2**31) - 1,
+        2**63 - 1,
+        -(2**63),
+        3.5,
+        -0.0,
+        "",
+        "abc",
+        "π∞☺",
+        "x" * 15,
+        "x" * 16,
+        "x" * 255,
+        "x" * 256,
+        "x" * 65535,
+        "x" * 65536,
+        [],
+        [1, "two", [3.0, None]],
+        list(range(20)),
+        {},
+        {"k": "v", "n": {"nested": [1, 2]}},
+        {f"k{i}": i for i in range(20)},
+        b"\x00\x01\xff",
+        b"y" * 300,
+    ],
+)
+def test_packstream_roundtrip(value):
+    assert unpack_all(pack(value)) == value
+
+
+def test_packstream_golden_bytes():
+    # Spot-check the marker layout against the public PackStream v1 spec.
+    assert pack(None) == b"\xc0"
+    assert pack(True) == b"\xc3"
+    assert pack(42) == b"\x2a"
+    assert pack(-16) == b"\xf0"
+    assert pack(-17) == b"\xc8\xef"
+    assert pack(128) == b"\xc9\x00\x80"
+    assert pack("abc") == b"\x83abc"
+    assert pack([1, 2]) == b"\x92\x01\x02"
+    assert pack({"a": 1}) == b"\xa1\x81a\x01"
+    assert pack(Structure(0x10, ["q", {}])) == b"\xb2\x10\x81q\xa0"
+
+
+def test_packstream_graph_structures():
+    node_bytes = pack(Structure(0x4E, [7, ["Goal"], {"id": "g1"}]))
+    node = unpack_all(node_bytes)
+    assert node == Node(identity=7, labels=["Goal"], properties={"id": "g1"})
+
+    rel = unpack_all(pack(Structure(0x52, [1, 7, 8, "DUETO", {}])))
+    assert rel == Relationship(identity=1, start=7, end=8, type="DUETO", properties={})
+
+    path = unpack_all(pack(Structure(0x50, [[], [], []])))
+    assert path == Path(nodes=[], relationships=[], sequence=[])
+
+
+def test_packstream_truncated_and_trailing():
+    with pytest.raises(ValueError):
+        unpack_all(pack("abcdef")[:-1])
+    with pytest.raises(ValueError):
+        unpack_all(pack(1) + b"\x01")
+
+
+# --------------------------------------------------------------- client/server
+
+
+def test_client_handshake_and_run():
+    with FakeNeo4jServer() as srv:
+        with BoltConnection(srv.uri) as conn:
+            conn.exec("// nemo:wipe\nMATCH (n) DETACH DELETE n")
+            conn.exec(
+                "// nemo:load_goals\nUNWIND ...",
+                {
+                    "run": 0,
+                    "condition": "pre",
+                    "rows": [
+                        {
+                            "id": "g0",
+                            "label": "l",
+                            "table": "t",
+                            "time": "1",
+                            "condition_holds": False,
+                            "seq": 0,
+                        }
+                    ],
+                },
+            )
+            rows = conn.exec("// nemo:count_goals\n...", {"run": 0, "condition": "pre"})
+            assert rows == [[1]]
+
+
+def test_client_failure_recovery():
+    with FakeNeo4jServer() as srv:
+        with BoltConnection(srv.uri) as conn:
+            with pytest.raises(BoltError, match="no handler"):
+                conn.exec("// nemo:definitely_not_a_verb\nRETURN 1")
+            # The connection recovered via ACK_FAILURE and stays usable.
+            assert conn.exec("// nemo:count_pre_holds\n...") == [[0]]
+
+
+def test_client_large_message_chunking():
+    # >64 KiB payloads must split into multiple chunks both ways.
+    big = "z" * 200_000
+    with FakeNeo4jServer() as srv:
+        with BoltConnection(srv.uri) as conn:
+            conn.exec(
+                "// nemo:load_goals\n...",
+                {
+                    "run": 1,
+                    "condition": "post",
+                    "rows": [
+                        {
+                            "id": "gbig",
+                            "label": big,
+                            "table": "t",
+                            "time": "",
+                            "condition_holds": False,
+                            "seq": 0,
+                        }
+                    ],
+                },
+            )
+            rows = conn.exec("// nemo:pull_nodes\n...", {"run": 1, "condition": "post"})
+            assert rows[0][2] == big
