@@ -2,16 +2,22 @@
 
 NATIVE_SRC := native/nemo_native.cpp
 NATIVE_LIB := native/build/libnemo_native.so
+REPORT_SRC := native/nemo_report.cpp
+REPORT_LIB := native/build/libnemo_report.so
 
 .PHONY: all native test bench clean proto
 
 all: native
 
-native: $(NATIVE_LIB)
+native: $(NATIVE_LIB) $(REPORT_LIB)
 
-# Single source of truth for compile flags lives in ingest/native.py.
+# Single source of truth for compile flags lives in ingest/native.py and
+# report/native.py respectively.
 $(NATIVE_LIB): $(NATIVE_SRC)
 	python -c "from nemo_tpu.ingest.native import build_native; print(build_native(force=True))"
+
+$(REPORT_LIB): $(REPORT_SRC)
+	python -c "from nemo_tpu.report.native import build_native; print(build_native(force=True))"
 
 test:
 	python -m pytest tests/ -x -q

@@ -22,7 +22,7 @@ from __future__ import annotations
 import socket
 import struct
 from typing import Any
-from urllib.parse import urlparse
+from urllib.parse import unquote, urlparse
 
 from nemo_tpu.backend.bolt.packstream import Structure, pack, unpack_all
 
@@ -70,7 +70,9 @@ class BoltConnection:
         host = parsed.hostname or "127.0.0.1"
         port = parsed.port or 7687
         if auth is None and parsed.username:
-            auth = (parsed.username, parsed.password or "")
+            # urlparse leaves userinfo percent-encoded; decode so passwords
+            # with special characters (p%40ss -> p@ss) authenticate.
+            auth = (unquote(parsed.username), unquote(parsed.password or ""))
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._buf = b""
         try:

@@ -111,12 +111,18 @@ WITH collect(DISTINCT g.table) + [$condition] AS tables, $run AS run, $condition
 MATCH (x:Goal {run: run, condition: cond}) WHERE x.table IN tables
 SET x.condition_holds = true"""
 
+# Neo4j requires identical column names across UNION arms; alias the
+# kind-literal column ('Goal' vs 'Rule') explicitly.
 Q_PULL_NODES = """// nemo:pull_nodes
 MATCH (n:Goal {run: $run, condition: $condition})
-RETURN n.id, 'Goal', n.label, n.table, n.time, n.type, n.condition_holds, n.seq
+RETURN n.id AS id, 'Goal' AS kind, n.label AS label, n.table AS table,
+       n.time AS time, n.type AS type, n.condition_holds AS condition_holds,
+       n.seq AS seq
 UNION ALL
 MATCH (n:Rule {run: $run, condition: $condition})
-RETURN n.id, 'Rule', n.label, n.table, n.time, n.type, n.condition_holds, n.seq"""
+RETURN n.id AS id, 'Rule' AS kind, n.label AS label, n.table AS table,
+       n.time AS time, n.type AS type, n.condition_holds AS condition_holds,
+       n.seq AS seq"""
 
 Q_PULL_EDGES = """// nemo:pull_edges
 MATCH (a:Goal {run: $run, condition: $condition})-[e:DUETO]->(b)

@@ -15,54 +15,17 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
-import tempfile
 from dataclasses import dataclass
 
 import numpy as np
 
+from nemo_tpu.utils.cbuild import NativeLib
+
 _SRC = os.path.join(os.path.dirname(__file__), "..", "..", "native", "nemo_native.cpp")
 _LIB = os.path.join(os.path.dirname(__file__), "..", "..", "native", "build", "libnemo_native.so")
 
-_lib = None
-_lib_error: str | None = None
 
-
-def build_native(force: bool = False) -> str:
-    """Compile the shared library if missing/stale; returns its path."""
-    src = os.path.abspath(_SRC)
-    lib = os.path.abspath(_LIB)
-    if not os.path.exists(src):
-        raise FileNotFoundError(src)
-    if not force and os.path.exists(lib) and os.path.getmtime(lib) >= os.path.getmtime(src):
-        return lib
-    os.makedirs(os.path.dirname(lib), exist_ok=True)
-    # Build to a temp name then rename: atomic under concurrent test workers.
-    fd, tmp = tempfile.mkstemp(suffix=".so", dir=os.path.dirname(lib))
-    os.close(fd)
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp, src]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, text=True)
-    except subprocess.CalledProcessError as ex:
-        os.unlink(tmp)
-        raise RuntimeError(f"native build failed: {ex.stderr}") from ex
-    except OSError as ex:  # g++ missing entirely
-        os.unlink(tmp)
-        raise RuntimeError(f"native build failed: {ex}") from ex
-    os.replace(tmp, lib)
-    return lib
-
-
-def _load():
-    global _lib, _lib_error
-    if _lib is not None or _lib_error is not None:
-        return _lib
-    try:
-        path = build_native()
-        lib = ctypes.CDLL(path)
-    except Exception as ex:  # toolchain missing, build failure, ...
-        _lib_error = str(ex)
-        return None
+def _bind(lib: ctypes.CDLL) -> None:
     lib.nemo_ingest.restype = ctypes.c_void_p
     lib.nemo_ingest.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
     lib.nemo_dims.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64)]
@@ -73,21 +36,26 @@ def _load():
     lib.nemo_node_ids.restype = ctypes.c_char_p
     lib.nemo_node_ids.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
     lib.nemo_free.argtypes = [ctypes.c_void_p]
-    lib.nemo_abi_version.restype = ctypes.c_int
-    if lib.nemo_abi_version() != 1:
-        _lib_error = "ABI version mismatch"
-        return None
-    _lib = lib
-    return _lib
+
+
+_native = NativeLib(_SRC, _LIB, _bind, "nemo_abi_version", 1)
+
+
+def build_native(force: bool = False) -> str:
+    """Compile the shared library if missing/stale; returns its path."""
+    return _native.build(force=force)
+
+
+def _load():
+    return _native.load()
 
 
 def native_available() -> bool:
-    return _load() is not None
+    return _native.available
 
 
 def native_error() -> str | None:
-    _load()
-    return _lib_error
+    return _native.error
 
 
 @dataclass
@@ -173,7 +141,7 @@ def ingest_native(output_dir: str, with_node_ids: bool = True) -> NativeCorpus:
     """
     lib = _load()
     if lib is None:
-        raise RuntimeError(f"native ingestion unavailable: {_lib_error}")
+        raise RuntimeError(f"native ingestion unavailable: {_native.error}")
     err = ctypes.create_string_buffer(1024)
     handle = lib.nemo_ingest(os.fsencode(output_dir), err, len(err))
     if not handle:

@@ -1,0 +1,137 @@
+"""ctypes bindings for the native figure-rendering engine (native/nemo_report.cpp).
+
+The reference's figure rendering is a native C binary (graphviz `dot -Tsvg`,
+report/webpage.go:65); here it is an in-tree C++ layout engine producing SVG
+byte-identical to the portable Python renderer (report/svg.py), which stays as
+the parity oracle and fallback.  Attribute resolution (DOT attrs -> labels,
+shapes, style flags, colors) happens host-side in this module so the C++ core
+is a pure layout + string-builder; selection between the engines lives in
+render_svg_auto (env NEMO_SVG_IMPL={auto,native,python}).
+
+Compiled on demand with g++ like the ingestion engine (ingest/native.py);
+environments without a toolchain fall back to Python silently.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+from nemo_tpu.utils.cbuild import NativeLib
+
+from .dot import DotGraph
+from .svg import render_svg as render_svg_python
+
+_SRC = os.path.join(os.path.dirname(__file__), "..", "..", "native", "nemo_report.cpp")
+_LIB = os.path.join(os.path.dirname(__file__), "..", "..", "native", "build", "libnemo_report.so")
+
+_INVIS, _DASHED, _BOLD = 1, 2, 4
+
+
+def _bind(lib: ctypes.CDLL) -> None:
+    lib.nemo_render_svg.restype = ctypes.c_void_p  # owned char*, freed below
+    lib.nemo_render_svg.argtypes = [
+        ctypes.c_int,  # n_nodes
+        ctypes.POINTER(ctypes.c_char_p),  # labels
+        ctypes.POINTER(ctypes.c_int32),  # label char counts
+        ctypes.POINTER(ctypes.c_ubyte),  # shape_rect
+        ctypes.POINTER(ctypes.c_ubyte),  # node flags
+        ctypes.POINTER(ctypes.c_char_p),  # fill
+        ctypes.POINTER(ctypes.c_char_p),  # stroke
+        ctypes.POINTER(ctypes.c_char_p),  # fontcolor
+        ctypes.c_int,  # n_edges
+        ctypes.POINTER(ctypes.c_int32),  # esrc
+        ctypes.POINTER(ctypes.c_int32),  # edst
+        ctypes.POINTER(ctypes.c_char_p),  # edge color
+        ctypes.POINTER(ctypes.c_ubyte),  # edge flags
+    ]
+    lib.nemo_report_free.argtypes = [ctypes.c_void_p]
+
+
+_native = NativeLib(_SRC, _LIB, _bind, "nemo_report_abi_version", 1)
+
+
+def build_native(force: bool = False) -> str:
+    """Compile the shared library if missing/stale; returns its path."""
+    return _native.build(force=force)
+
+
+def native_available() -> bool:
+    return _native.available
+
+
+def native_error() -> str | None:
+    return _native.error
+
+
+def _style_flags(attrs: dict[str, str]) -> int:
+    style = attrs.get("style", "")
+    flags = 0
+    if "invis" in style:
+        flags |= _INVIS
+    if "dashed" in style:
+        flags |= _DASHED
+    if "bold" in style:
+        flags |= _BOLD
+    return flags
+
+
+def render_svg_native(g: DotGraph) -> str:
+    """Render via the C++ engine.  Raises RuntimeError if it is unavailable."""
+    lib = _native.load()
+    if lib is None:
+        raise RuntimeError(f"native report engine unavailable: {_native.error}")
+
+    nodes = list(g.nodes)
+    index = {n.name: i for i, n in enumerate(nodes)}
+    edges = [e for e in g.edges if e.src in index and e.dst in index]
+
+    n = len(nodes)
+    labels = [node.attrs.get("label", node.name) for node in nodes]
+    c_labels = (ctypes.c_char_p * n)(*[lb.encode("utf-8") for lb in labels])
+    c_label_chars = (ctypes.c_int32 * n)(*[len(lb) for lb in labels])
+    c_shape = (ctypes.c_ubyte * n)(
+        *[1 if node.attrs.get("shape", "ellipse") == "rect" else 0 for node in nodes]
+    )
+    c_nflags = (ctypes.c_ubyte * n)(*[_style_flags(node.attrs) for node in nodes])
+    c_fill = (ctypes.c_char_p * n)(
+        *[node.attrs.get("fillcolor", "white").encode("utf-8") for node in nodes]
+    )
+    c_stroke = (ctypes.c_char_p * n)(
+        *[node.attrs.get("color", "black").encode("utf-8") for node in nodes]
+    )
+    c_fontcolor = (ctypes.c_char_p * n)(
+        *[node.attrs.get("fontcolor", "black").encode("utf-8") for node in nodes]
+    )
+
+    m = len(edges)
+    c_esrc = (ctypes.c_int32 * m)(*[index[e.src] for e in edges])
+    c_edst = (ctypes.c_int32 * m)(*[index[e.dst] for e in edges])
+    c_ecolor = (ctypes.c_char_p * m)(
+        *[e.attrs.get("color", "#444").encode("utf-8") for e in edges]
+    )
+    c_eflags = (ctypes.c_ubyte * m)(*[_style_flags(e.attrs) for e in edges])
+
+    ptr = lib.nemo_render_svg(
+        n, c_labels, c_label_chars, c_shape, c_nflags, c_fill, c_stroke, c_fontcolor,
+        m, c_esrc, c_edst, c_ecolor, c_eflags,
+    )
+    if not ptr:
+        raise RuntimeError("native report engine returned NULL")
+    try:
+        return ctypes.string_at(ptr).decode("utf-8")
+    finally:
+        lib.nemo_report_free(ptr)
+
+
+def render_svg_auto(g: DotGraph) -> str:
+    """Engine dispatch: NEMO_SVG_IMPL=native|python forces one; the default
+    (auto) uses the native engine when it builds, Python otherwise."""
+    impl = os.environ.get("NEMO_SVG_IMPL", "auto")
+    if impl == "python":
+        return render_svg_python(g)
+    if impl == "native":
+        return render_svg_native(g)
+    if native_available():
+        return render_svg_native(g)
+    return render_svg_python(g)

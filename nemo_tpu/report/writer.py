@@ -4,7 +4,9 @@ Reference: report/webpage.go (Prepare copies the assets template into
 results/<runName>/ and creates figures/, webpage.go:26-50; GenerateFigure
 writes <name>.dot and renders <name>.svg, webpage.go:53-76; GenerateFigures
 names files run_<iter>_<name>, webpage.go:79-99).  Rendering uses the built-in
-SVG layout engine instead of shelling out to graphviz.
+SVG layout engine instead of shelling out to graphviz: the native C++ engine
+(native/nemo_report.cpp) when available, the Python renderer otherwise —
+report/native.py:render_svg_auto dispatches.
 """
 
 from __future__ import annotations
@@ -13,7 +15,7 @@ import os
 import shutil
 
 from .dot import DotGraph
-from .svg import render_svg
+from .native import render_svg_auto as render_svg
 
 ASSETS_DIR = os.path.join(os.path.dirname(__file__), "assets")
 

@@ -1,0 +1,93 @@
+"""Shared scaffolding for on-demand-compiled C++ shared libraries.
+
+Both native engines (ingestion, native/nemo_native.cpp via ingest/native.py;
+figure rendering, native/nemo_report.cpp via report/native.py) follow the same
+lifecycle: compile with g++ when missing or stale, load via ctypes, bind
+symbols, check an ABI version, and degrade gracefully (Python fallback) when
+the toolchain is absent.  That lifecycle lives here once.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from typing import Callable
+
+
+def build_shared_lib(src: str, lib: str, force: bool = False) -> str:
+    """Compile src -> lib if missing/stale; returns lib's absolute path.
+
+    Builds to a temp name then renames: atomic under concurrent test workers.
+    """
+    src = os.path.abspath(src)
+    lib = os.path.abspath(lib)
+    if not os.path.exists(src):
+        raise FileNotFoundError(src)
+    if not force and os.path.exists(lib) and os.path.getmtime(lib) >= os.path.getmtime(src):
+        return lib
+    os.makedirs(os.path.dirname(lib), exist_ok=True)
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=os.path.dirname(lib))
+    os.close(fd)
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp, src]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except subprocess.CalledProcessError as ex:
+        os.unlink(tmp)
+        raise RuntimeError(f"native build failed: {ex.stderr}") from ex
+    except OSError as ex:  # g++ missing entirely
+        os.unlink(tmp)
+        raise RuntimeError(f"native build failed: {ex}") from ex
+    os.replace(tmp, lib)
+    return lib
+
+
+class NativeLib:
+    """Lazy ctypes loader: build, bind, ABI-check once; cache lib or error."""
+
+    def __init__(
+        self,
+        src: str,
+        lib_path: str,
+        bind: Callable[[ctypes.CDLL], None],
+        abi_symbol: str,
+        abi_version: int,
+    ) -> None:
+        self._src = src
+        self._lib_path = lib_path
+        self._bind = bind
+        self._abi_symbol = abi_symbol
+        self._abi_version = abi_version
+        self._lib: ctypes.CDLL | None = None
+        self._error: str | None = None
+
+    def build(self, force: bool = False) -> str:
+        return build_shared_lib(self._src, self._lib_path, force=force)
+
+    def load(self) -> ctypes.CDLL | None:
+        if self._lib is not None or self._error is not None:
+            return self._lib
+        try:
+            path = self.build()
+            lib = ctypes.CDLL(path)
+        except Exception as ex:  # toolchain missing, build failure, ...
+            self._error = str(ex)
+            return None
+        abi = getattr(lib, self._abi_symbol)
+        abi.restype = ctypes.c_int
+        if abi() != self._abi_version:
+            self._error = "ABI version mismatch"
+            return None
+        self._bind(lib)
+        self._lib = lib
+        return self._lib
+
+    @property
+    def available(self) -> bool:
+        return self.load() is not None
+
+    @property
+    def error(self) -> str | None:
+        self.load()
+        return self._error

@@ -1,0 +1,123 @@
+"""Native C++ SVG engine vs the Python renderer: byte parity.
+
+The C++ engine (native/nemo_report.cpp) implements the same layout algorithm
+as report/svg.py; these tests assert byte-identical output on the real figure
+families produced by the full pipeline and on adversarial synthetic graphs
+(cycles, self-loops, invisible layers, every style combination).
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from nemo_tpu.report.dot import DotGraph
+from nemo_tpu.report.native import native_available, native_error, render_svg_native
+from nemo_tpu.report.svg import render_svg
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason=f"native report engine unavailable: {native_error()}"
+)
+
+
+def assert_parity(g: DotGraph) -> None:
+    py = render_svg(g)
+    cc = render_svg_native(g)
+    assert cc == py
+
+
+def test_empty_graph():
+    assert_parity(DotGraph())
+
+
+def test_single_node_defaults():
+    g = DotGraph()
+    g.add_node("a")
+    assert_parity(g)
+
+
+def test_styles_and_shapes():
+    g = DotGraph()
+    g.add_node("r1", {"label": "agg_rule", "shape": "rect", "style": "bold", "color": "lawngreen"})
+    g.add_node("g1", {"label": "goal(a, 1)", "shape": "ellipse", "style": "filled",
+                      "fillcolor": "firebrick", "fontcolor": "white"})
+    g.add_node("hidden", {"style": "invis"})
+    g.add_node("d", {"style": "dashed,bold", "color": "mediumvioletred"})
+    g.add_edge("r1", "g1", {"color": "gold"})
+    g.add_edge("g1", "d", {"style": "dashed"})
+    g.add_edge("r1", "hidden", {"style": "invis"})
+    assert_parity(g)
+
+
+def test_self_loop_and_cycle():
+    g = DotGraph()
+    g.add_edge("a", "a")
+    g.add_edge("b", "c")
+    g.add_edge("c", "d")
+    g.add_edge("d", "b")  # cycle: all fall to layer 0
+    assert_parity(g)
+
+
+def test_label_escaping():
+    g = DotGraph()
+    g.add_node("x", {"label": 'pre(a) :- b<c & d>"e" \'f\''})
+    g.add_node("y", {"label": ""})
+    g.add_edge("x", "y")
+    assert_parity(g)
+
+
+def test_random_dags():
+    rng = random.Random(7)
+    for trial in range(20):
+        g = DotGraph()
+        n = rng.randrange(2, 40)
+        for i in range(n):
+            attrs = {}
+            if rng.random() < 0.5:
+                attrs["label"] = f"tbl_{rng.randrange(8)}({rng.randrange(4)}, {i})"
+            if rng.random() < 0.3:
+                attrs["shape"] = rng.choice(["rect", "ellipse"])
+            if rng.random() < 0.3:
+                attrs["style"] = rng.choice(["bold", "dashed", "invis", "dashed,bold"])
+            if rng.random() < 0.3:
+                attrs["fillcolor"] = rng.choice(["firebrick", "deepskyblue", "lightgrey"])
+            g.add_node(f"n{i}", attrs)
+        for _ in range(rng.randrange(1, 3 * n)):
+            a, b = rng.randrange(n), rng.randrange(n)
+            attrs = {}
+            if rng.random() < 0.3:
+                attrs["color"] = "#888"
+            if rng.random() < 0.2:
+                attrs["style"] = rng.choice(["dashed", "invis"])
+            # Mix DAG-respecting and arbitrary (possibly cyclic) edges.
+            if rng.random() < 0.8 and a != b:
+                g.add_edge(f"n{min(a, b)}", f"n{max(a, b)}", attrs)
+            else:
+                g.add_edge(f"n{a}", f"n{b}", attrs)
+        assert_parity(g)
+
+
+def test_pipeline_figures_parity(tmp_path):
+    """Every figure family from a real end-to-end run renders identically."""
+    from nemo_tpu.analysis.pipeline import run_debug
+    from nemo_tpu.backend.python_ref import PythonBackend
+    from nemo_tpu.models.synth import SynthSpec, write_corpus
+    from nemo_tpu.report.writer import Reporter
+
+    corpus = write_corpus(SynthSpec(n_runs=3, seed=5), str(tmp_path / "molly"))
+
+    class CapturingReporter(Reporter):
+        def __init__(self):
+            super().__init__()
+            self.dots = []
+
+        def generate_figure(self, file_name, dot):
+            self.dots.append(dot)
+            super().generate_figure(file_name, dot)
+
+    rep = CapturingReporter()
+    run_debug(corpus, str(tmp_path / "results"), PythonBackend(), reporter=rep)
+    assert rep.dots
+    for dot in rep.dots:
+        assert_parity(dot)
