@@ -11,8 +11,7 @@ all: native
 
 native: $(NATIVE_LIB) $(REPORT_LIB)
 
-# Single source of truth for compile flags lives in ingest/native.py and
-# report/native.py respectively.
+# Single source of truth for compile flags lives in nemo_tpu/utils/cbuild.py.
 $(NATIVE_LIB): $(NATIVE_SRC)
 	python -c "from nemo_tpu.ingest.native import build_native; print(build_native(force=True))"
 

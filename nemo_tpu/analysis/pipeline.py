@@ -46,7 +46,19 @@ def run_debug(
     conn: str = "",
     reporter: Reporter | None = None,
     save_corpus_path: str | None = None,
+    profile_dir: str | None = None,
 ) -> DebugResult:
+    """Full debug pipeline.  With profile_dir set, the analysis phases run
+    under jax.profiler.trace — open the directory with TensorBoard or
+    xprof to see per-kernel device timelines (SURVEY.md §5: the rebuild's
+    tracing story)."""
+    import contextlib
+
+    trace_ctx: contextlib.AbstractContextManager = contextlib.nullcontext()
+    if profile_dir:
+        import jax
+
+        trace_ctx = jax.profiler.trace(profile_dir)
     timer = PhaseTimer()
 
     with timer.phase("ingest"):
@@ -62,29 +74,32 @@ def run_debug(
     with timer.phase("init"):
         backend.init_graph_db(conn, molly)
     try:
-        with timer.phase("load_raw_provenance"):
-            backend.load_raw_provenance()
-        with timer.phase("simplify"):
-            backend.simplify_prov(iters)
-        with timer.phase("hazard"):
-            hazard_dots = backend.create_hazard_analysis(fault_inj_out)
-        with timer.phase("prototypes"):
-            inter, inter_miss, union, union_miss = backend.create_prototypes(
-                molly.get_success_runs_iters(), failed_iters
-            )
-        with timer.phase("pull_prov"):
-            pre_dots, post_dots, pre_clean_dots, post_clean_dots = backend.pull_pre_post_prov()
-        with timer.phase("diff_prov"):
-            diff_dots, failed_dots, missing_events = backend.create_naive_diff_prov(
-                False, failed_iters, post_dots[0]
-            )
+        with trace_ctx:
+            with timer.phase("load_raw_provenance"):
+                backend.load_raw_provenance()
+            with timer.phase("simplify"):
+                backend.simplify_prov(iters)
+            with timer.phase("hazard"):
+                hazard_dots = backend.create_hazard_analysis(fault_inj_out)
+            with timer.phase("prototypes"):
+                inter, inter_miss, union, union_miss = backend.create_prototypes(
+                    molly.get_success_runs_iters(), failed_iters
+                )
+            with timer.phase("pull_prov"):
+                pre_dots, post_dots, pre_clean_dots, post_clean_dots = (
+                    backend.pull_pre_post_prov()
+                )
+            with timer.phase("diff_prov"):
+                diff_dots, failed_dots, missing_events = backend.create_naive_diff_prov(
+                    False, failed_iters, post_dots[0]
+                )
 
-        corrections: list[str] = []
-        if failed_iters:
-            with timer.phase("corrections"):
-                corrections = backend.generate_corrections()
-        with timer.phase("extensions"):
-            all_achieved_pre, extensions = backend.generate_extensions()
+            corrections: list[str] = []
+            if failed_iters:
+                with timer.phase("corrections"):
+                    corrections = backend.generate_corrections()
+            with timer.phase("extensions"):
+                all_achieved_pre, extensions = backend.generate_extensions()
     finally:
         backend.close_db()
 

@@ -72,6 +72,13 @@ def main(argv: list[str] | None = None) -> int:
         "--timings", action="store_true", help="print per-phase wall-clock timings"
     )
     parser.add_argument(
+        "--profile",
+        metavar="DIR",
+        default=None,
+        help="capture a jax.profiler trace of the analysis phases into DIR "
+        "(view with TensorBoard/xprof)",
+    )
+    parser.add_argument(
         "--save-corpus",
         metavar="PATH",
         default=None,
@@ -91,6 +98,7 @@ def main(argv: list[str] | None = None) -> int:
         backend,
         conn=args.graph_db_conn,
         save_corpus_path=args.save_corpus,
+        profile_dir=args.profile,
     )
 
     if args.timings:

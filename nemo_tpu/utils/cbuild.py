@@ -71,15 +71,17 @@ class NativeLib:
         try:
             path = self.build()
             lib = ctypes.CDLL(path)
+            # ABI check and symbol binding stay inside the try: a stale .so
+            # missing symbols must degrade to the Python fallback, not raise.
+            abi = getattr(lib, self._abi_symbol)
+            abi.restype = ctypes.c_int
+            if abi() != self._abi_version:
+                self._error = "ABI version mismatch"
+                return None
+            self._bind(lib)
         except Exception as ex:  # toolchain missing, build failure, ...
             self._error = str(ex)
             return None
-        abi = getattr(lib, self._abi_symbol)
-        abi.restype = ctypes.c_int
-        if abi() != self._abi_version:
-            self._error = "ABI version mismatch"
-            return None
-        self._bind(lib)
         self._lib = lib
         return self._lib
 
