@@ -1,0 +1,128 @@
+"""Multi-host scale-out: jax.distributed init + hybrid DCN x ICI meshes.
+
+The reference has no multi-node story at all (SURVEY.md §2.3: one Go process,
+one Neo4j container).  Here scale-out is the standard JAX SPMD recipe: every
+host runs the same program, `jax.distributed.initialize` wires the processes
+into one runtime, and the run batch is sharded over a 2-D (dcn, ici) mesh —
+the outer axis spans hosts over the data-center network, the inner axis spans
+each host's chips over ICI.  XLA derives the collective topology from the
+device assignment, so the cross-run prototype reductions become hierarchical
+all-reduces (intra-host rings over ICI first, then one small DCN exchange),
+and per-run kernels never communicate at all — the layout the scaling
+playbook prescribes for pure data parallelism.
+
+Single-process environments (tests, the virtual-device harness) get the same
+code path: the hybrid mesh is just a reshape of the local devices.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from nemo_tpu.models.pipeline_model import BatchArrays
+from nemo_tpu.parallel.mesh import run_step_sharded
+
+DCN_AXIS = "dcn"
+ICI_AXIS = "ici"
+
+
+def init_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Initialize the multi-process JAX runtime when configured; returns
+    whether a multi-process runtime is active.
+
+    Configuration comes from the arguments or the standard environment
+    (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID, or a
+    supported cluster environment that jax.distributed auto-detects).  A
+    plain single-process run is left untouched — calling this is always safe.
+    """
+    if jax.distributed.is_initialized():
+        return jax.process_count() > 1
+    coordinator_address = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    env_procs = os.environ.get("JAX_NUM_PROCESSES")
+    num_processes = num_processes if num_processes is not None else (
+        int(env_procs) if env_procs else None
+    )
+    env_pid = os.environ.get("JAX_PROCESS_ID")
+    process_id = process_id if process_id is not None else (
+        int(env_pid) if env_pid else None
+    )
+    if coordinator_address is None and num_processes is None:
+        return False  # single-process: nothing to initialize
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return jax.process_count() > 1
+
+
+def make_hybrid_mesh(
+    dcn_size: int | None = None, ici_size: int | None = None
+) -> Mesh:
+    """A 2-D (dcn, ici) mesh: outer axis across hosts, inner across each
+    host's chips.  In a single process the axes are a reshape of the local
+    devices (dcn_size defaults to 1); in a multi-process runtime the outer
+    axis defaults to the process count so each host owns one DCN row.
+    """
+    devices = jax.devices()
+    n_proc = jax.process_count()
+    if dcn_size is None:
+        dcn_size = n_proc if n_proc > 1 else 1
+    if ici_size is None:
+        if len(devices) % dcn_size:
+            raise ValueError(
+                f"{len(devices)} devices not divisible by dcn axis {dcn_size}"
+            )
+        ici_size = len(devices) // dcn_size
+    if dcn_size * ici_size > len(devices):
+        raise ValueError(
+            f"mesh {dcn_size}x{ici_size} needs {dcn_size * ici_size} devices, "
+            f"have {len(devices)}"
+        )
+    if n_proc > 1:
+        # Group devices so each DCN row is one process's chips: collectives
+        # inside an ici row then ride ICI only.  The requested factorization
+        # must match the process layout exactly — a silently truncated or
+        # ragged grid would drop devices.
+        by_proc: dict[int, list] = {}
+        for d in devices:
+            by_proc.setdefault(d.process_index, []).append(d)
+        if len(by_proc) != dcn_size:
+            raise ValueError(
+                f"dcn axis {dcn_size} != process count {len(by_proc)}; one DCN "
+                "row per process is required in multi-process mode"
+            )
+        rows = []
+        for pid, ds in sorted(by_proc.items()):
+            if len(ds) != ici_size:
+                raise ValueError(
+                    f"process {pid} has {len(ds)} devices, ici axis needs {ici_size}"
+                )
+            rows.append(sorted(ds, key=lambda d: d.id))
+        grid = np.asarray(rows)
+    else:
+        grid = np.asarray(devices[: dcn_size * ici_size]).reshape(dcn_size, ici_size)
+    assert grid.shape == (dcn_size, ici_size)
+    return Mesh(grid, (DCN_AXIS, ICI_AXIS))
+
+
+def analysis_step_hybrid(
+    mesh: Mesh, pre: BatchArrays, post: BatchArrays, static: dict
+) -> dict:
+    """The flagship analysis step with the run batch data-parallel over BOTH
+    mesh axes (runs split across hosts, then across each host's chips).
+
+    Same semantics as parallel/mesh.py:analysis_step_sharded; the only
+    difference is the 2-D device layout, which makes XLA lower the prototype
+    intersection/union reductions hierarchically (ICI ring + DCN exchange)
+    and broadcast the row-0 good graph the same way.
+    """
+    return run_step_sharded(mesh, P((DCN_AXIS, ICI_AXIS)), pre, post, static)

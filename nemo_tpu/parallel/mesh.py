@@ -59,10 +59,11 @@ def shard_arrays(mesh: Mesh, arrays: BatchArrays) -> BatchArrays:
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), arrays)
 
 
-def analysis_step_sharded(
-    mesh: Mesh, pre: BatchArrays, post: BatchArrays, static: dict
+def run_step_sharded(
+    mesh: Mesh, spec: P, pre: BatchArrays, post: BatchArrays, static: dict
 ) -> dict:
-    """Run the flagship step with the run batch sharded across the mesh.
+    """Pad the run axis to the mesh size, shard it per `spec`, run the
+    flagship step, and un-pad the per-run outputs.
 
     Row 0 (the successful run every failed run diffs against,
     differential-provenance.go:26) is needed by all shards; XLA inserts the
@@ -71,8 +72,9 @@ def analysis_step_sharded(
     """
     pre_s, n_real = pad_batch_rows(pre, mesh.devices.size)
     post_s, _ = pad_batch_rows(post, mesh.devices.size)
-    pre_s = shard_arrays(mesh, pre_s)
-    post_s = shard_arrays(mesh, post_s)
+    sharding = NamedSharding(mesh, spec)
+    pre_s = jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), pre_s)
+    post_s = jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), post_s)
     # closure_impl is pinned to the partitionable XLA einsum chain: GSPMD
     # cannot shard through a Mosaic pallas_call, so the fused pallas closure
     # is single-device-only (ops/adjacency.py:closure).
@@ -81,3 +83,10 @@ def analysis_step_sharded(
     # outputs (proto_inter/proto_union over the table axis) pass through.
     corpus_level = {"proto_inter", "proto_union"}
     return {k: v if k in corpus_level else v[:n_real] for k, v in out.items()}
+
+
+def analysis_step_sharded(
+    mesh: Mesh, pre: BatchArrays, post: BatchArrays, static: dict
+) -> dict:
+    """The flagship step with the run batch data-parallel over a 1-D mesh."""
+    return run_step_sharded(mesh, P(RUN_AXIS), pre, post, static)
