@@ -56,6 +56,17 @@ def init_distributed(
     )
     if coordinator_address is None and num_processes is None:
         return False  # single-process: nothing to initialize
+    if coordinator_address is not None and num_processes is None:
+        # A stray coordinator address without a process count (e.g. a shared
+        # env file) must not crash a plain single-process run.
+        import warnings
+
+        warnings.warn(
+            "JAX_COORDINATOR_ADDRESS set without JAX_NUM_PROCESSES; ignoring "
+            "and staying single-process",
+            stacklevel=2,
+        )
+        return False
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

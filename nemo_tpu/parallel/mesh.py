@@ -53,9 +53,10 @@ def pad_batch_rows(arrays: BatchArrays, multiple: int) -> tuple[BatchArrays, int
     return padded, b
 
 
-def shard_arrays(mesh: Mesh, arrays: BatchArrays) -> BatchArrays:
-    """Place each [B, ...] array with the run axis sharded over the mesh."""
-    sharding = NamedSharding(mesh, P(RUN_AXIS))
+def shard_arrays(mesh: Mesh, arrays: BatchArrays, spec: P | None = None) -> BatchArrays:
+    """Place each [B, ...] array with the run axis sharded over the mesh
+    (per `spec`; default: the 1-D run axis)."""
+    sharding = NamedSharding(mesh, spec if spec is not None else P(RUN_AXIS))
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), arrays)
 
 
@@ -72,9 +73,8 @@ def run_step_sharded(
     """
     pre_s, n_real = pad_batch_rows(pre, mesh.devices.size)
     post_s, _ = pad_batch_rows(post, mesh.devices.size)
-    sharding = NamedSharding(mesh, spec)
-    pre_s = jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), pre_s)
-    post_s = jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), post_s)
+    pre_s = shard_arrays(mesh, pre_s, spec)
+    post_s = shard_arrays(mesh, post_s, spec)
     # closure_impl is pinned to the partitionable XLA einsum chain: GSPMD
     # cannot shard through a Mosaic pallas_call, so the fused pallas closure
     # is single-device-only (ops/adjacency.py:closure).

@@ -1,0 +1,84 @@
+"""Cross-backend differential sweep: python oracle vs JAX vs Neo4j.
+
+Broadens the fixed-fixture parity tests (test_jax_parity.py,
+test_neo4j_backend.py) with (a) a randomized-seed property sweep — varied
+corpus shapes, byte-identical debugging.json between the oracle and the JAX
+backend, plus backend-independent invariants — and (b) a three-way
+full-pipeline equality check on the case-study families not already covered
+by test_case_studies.py's two-family spot check.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from fake_neo4j import FakeNeo4jServer
+from nemo_tpu.analysis.pipeline import run_debug
+from nemo_tpu.backend.jax_backend import JaxBackend
+from nemo_tpu.backend.neo4j_backend import Neo4jBackend
+from nemo_tpu.backend.python_ref import PythonBackend
+from nemo_tpu.models.case_studies import write_case_study
+from nemo_tpu.models.synth import SynthSpec, write_corpus
+
+
+def _report_json(result) -> list[dict]:
+    with open(os.path.join(result.report_dir, "debugging.json")) as f:
+        return json.load(f)
+
+
+SWEEP = [
+    # Varied corpus shapes: run counts, horizon depths, failure mixes.
+    SynthSpec(n_runs=5, seed=101, eot=4, eff=2),
+    SynthSpec(n_runs=9, seed=202, eot=8, eff=5, fail_fraction=0.6),
+    SynthSpec(n_runs=7, seed=303, eot=6, eff=3, vacuous_fraction=0.5),
+    SynthSpec(n_runs=6, seed=404, eot=7, eff=4, fail_all_fraction=0.5),
+    SynthSpec(n_runs=12, seed=505, eot=5, eff=3),
+]
+
+
+@pytest.mark.parametrize("spec", SWEEP, ids=lambda s: f"seed{s.seed}")
+def test_randomized_sweep_jax_matches_oracle(spec, tmp_path):
+    corpus = write_corpus(spec, str(tmp_path))
+    py = run_debug(corpus, str(tmp_path / "py"), PythonBackend())
+    jx = run_debug(corpus, str(tmp_path / "jax"), JaxBackend())
+    want, got = _report_json(py), _report_json(jx)
+    assert got == want
+
+    # Backend-independent invariants of the analysis itself.
+    for run in want:
+        inter = run.get("interProto") or []
+        union = run.get("unionProto") or []
+        # Intersection prototype tables all occur in the union prototype.
+        assert set(inter) <= set(union)
+        rec = run.get("recommendation") or []
+        assert rec, "every run gets a recommendation"
+        if run["status"] != "success":
+            # Missing-from-prototype lists only name tables from the
+            # respective prototype.
+            assert set(run.get("interProtoMissing") or []) <= set(inter)
+            assert set(run.get("unionProtoMissing") or []) <= set(union)
+
+
+# The two families omitted here get the same treatment (plus per-verb
+# checks) in test_case_studies.py::test_jax_parity_on_families.
+THREE_WAY_FAMILIES = [
+    "pb_asynchronous",
+    "CA-2434-bootstrap-synchronization",
+    "MR-2995-failed-after-expiry",
+    "MR-3858-hadoop",
+]
+
+
+@pytest.mark.parametrize("name", THREE_WAY_FAMILIES)
+def test_three_way_family_parity(name, tmp_path):
+    corpus = write_case_study(name, n_runs=4, seed=6, out_dir=str(tmp_path))
+    py = run_debug(corpus, str(tmp_path / "py"), PythonBackend())
+    jx = run_debug(corpus, str(tmp_path / "jax"), JaxBackend())
+    with FakeNeo4jServer() as srv:
+        neo = run_debug(corpus, str(tmp_path / "neo"), Neo4jBackend(), conn=srv.uri)
+    want = _report_json(py)
+    assert _report_json(jx) == want
+    assert _report_json(neo) == want

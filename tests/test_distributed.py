@@ -37,12 +37,16 @@ def test_init_distributed_single_process_noop(monkeypatch):
 
 @pytest.mark.parametrize("dcn,ici", [(2, 4), (4, 2), (1, 8), (8, 1)])
 def test_hybrid_mesh_shapes(dcn, ici):
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
     mesh = make_hybrid_mesh(dcn, ici)
     assert mesh.axis_names == (DCN_AXIS, ICI_AXIS)
     assert mesh.devices.shape == (dcn, ici)
 
 
 def test_hybrid_mesh_rejects_bad_factorization():
+    if len(jax.devices()) != 8:
+        pytest.skip("assertions assume the 8-virtual-device harness")
     with pytest.raises(ValueError):
         make_hybrid_mesh(3)  # 8 devices don't divide by 3
     with pytest.raises(ValueError):
