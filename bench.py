@@ -92,16 +92,32 @@ def main() -> None:
     log(f"stress corpus: {len(family_batches)} families, {total_runs} runs, {graphs} graphs")
 
     # Warm up (one compile per family's shape signature), then time the full
-    # six-family sweep end to end.
+    # six-family sweep end to end.  Every timed dispatch gets DISTINCT input
+    # bytes (a poke in a masked padding slot — results unchanged): the device
+    # tunnel serves byte-identical dispatches from cache, which would
+    # overstate throughput.
+    import dataclasses
+
+    def poke(arrays: BatchArrays, k: int) -> BatchArrays:
+        """Distinct bytes, identical results: bump label_id in a PADDING slot
+        (node_mask False -> the value never reaches any kernel output)."""
+        pad = np.argwhere(~np.asarray(arrays.node_mask))
+        if len(pad) == 0:  # every slot of every run occupied: accept the risk
+            return arrays
+        r, s = (int(x) for x in pad[0])
+        return dataclasses.replace(arrays, label_id=arrays.label_id.at[r, s].set(k))
+
     for _, pre_t, post_t, static in family_batches:
         jax.block_until_ready(analysis_step(pre_t, post_t, **static))
     times = []
-    for _ in range(5):
-        t0 = time.perf_counter()
-        outs = [
-            analysis_step(pre_t, post_t, **static)
+    for rep in range(5):
+        sweep = [
+            (poke(pre_t, 1 + rep), post_t, static)
             for _, pre_t, post_t, static in family_batches
         ]
+        jax.block_until_ready([p.label_id for p, _, _ in sweep])
+        t0 = time.perf_counter()
+        outs = [analysis_step(p, q, **static) for p, q, static in sweep]
         jax.block_until_ready(outs)
         times.append(time.perf_counter() - t0)
     t_step = float(np.median(times))

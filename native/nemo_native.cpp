@@ -381,6 +381,36 @@ int32_t bucket_size(int32_t n, int32_t minimum = 16) {
   return b;
 }
 
+// Longest path (in edges) of one graph's DAG via topological relaxation;
+// returns node count on a cycle (mirror of graphs/packed.py:longest_path_len
+// — the tight static trip count for the depth-relaxation kernels).
+int32_t longest_path_len(const RawGraph& g) {
+  int32_t n = (int32_t)g.ids.size();
+  if (n == 0 || g.esrc.empty()) return 0;
+  std::vector<int32_t> indeg(n, 0);
+  std::vector<std::vector<int32_t>> out(n);
+  for (size_t k = 0; k < g.esrc.size(); ++k) {
+    out[g.esrc[k]].push_back(g.edst[k]);
+    indeg[g.edst[k]]++;
+  }
+  std::vector<int32_t> dist(n, 0), stack;
+  for (int32_t i = 0; i < n; ++i)
+    if (indeg[i] == 0) stack.push_back(i);
+  int32_t seen = 0, best = 0;
+  while (!stack.empty()) {
+    int32_t u = stack.back();
+    stack.pop_back();
+    seen++;
+    for (int32_t w : out[u]) {
+      if (dist[u] + 1 > dist[w]) dist[w] = dist[u] + 1;
+      if (--indeg[w] == 0) stack.push_back(w);
+    }
+    best = std::max(best, dist[u]);
+  }
+  if (seen < n) return n;  // cycle: conservative bound
+  return best;
+}
+
 // Packed arrays for one condition's batch (layout of graphs/packed.py).
 struct PackedCond {
   std::vector<int32_t> table_id, label_id, time_id, type_id;  // [B*V]
@@ -392,7 +422,7 @@ struct PackedCond {
 };
 
 struct Corpus {
-  int64_t n_runs = 0, v = 0, e = 0;
+  int64_t n_runs = 0, v = 0, e = 0, max_depth = 1;
   Vocab tables, labels, times;
   PackedCond cond[2];  // 0 = pre, 1 = post
   std::vector<int32_t> iteration;
@@ -461,14 +491,16 @@ Corpus* ingest(const std::string& dir) {
         parse_prov(dir + "/run_" + std::to_string(i) + "_post_provenance.json", iter, "post"));
   }
 
-  int32_t max_n = 1, max_e = 1;
+  int32_t max_n = 1, max_e = 1, max_lp = 0;
   for (const auto* gs : {&pre_graphs, &post_graphs})
     for (const RawGraph& g : *gs) {
       max_n = std::max(max_n, (int32_t)g.ids.size());
       max_e = std::max(max_e, (int32_t)g.esrc.size());
+      max_lp = std::max(max_lp, longest_path_len(g));
     }
   c->v = bucket_size(max_n);
   c->e = bucket_size(max_e);
+  c->max_depth = std::min<int64_t>(c->v, std::max(1, max_lp + 1));
 
   // Interning order matches the Python path (pack_molly_for_step): all pre
   // graphs in run order, then all post graphs — so ids are bit-identical.
@@ -498,7 +530,8 @@ void* nemo_ingest(const char* dir, char* err, int errlen) {
   }
 }
 
-// dims: [n_runs, v, e, n_tables, n_labels, n_times, pre_tid, post_tid]
+// dims: [n_runs, v, e, n_tables, n_labels, n_times, pre_tid, post_tid,
+//        max_depth]
 void nemo_dims(void* h, int64_t* out) {
   auto* c = (Corpus*)h;
   out[0] = c->n_runs;
@@ -509,6 +542,7 @@ void nemo_dims(void* h, int64_t* out) {
   out[5] = (int64_t)c->times.strings.size();
   out[6] = c->tables.lookup("pre");
   out[7] = c->tables.lookup("post");
+  out[8] = c->max_depth;
 }
 
 // Copy one condition's packed arrays into caller-allocated buffers
@@ -558,6 +592,6 @@ const char* nemo_node_ids(void* h, int cond, int run) {
 void nemo_free(void* h) { delete (Corpus*)h; }
 
 // ABI version for the ctypes wrapper to sanity-check.
-int nemo_abi_version() { return 1; }
+int nemo_abi_version() { return 2; }
 
 }  // extern "C"

@@ -220,7 +220,7 @@ class JaxBackend(GraphBackend):
                 jnp.asarray(batch.table_id),
                 jnp.asarray(ach),
                 num_tables,
-                batch.v,
+                batch.max_depth,
             )
             bits, min_depth, present_bits = (
                 np.asarray(bits),
@@ -294,7 +294,7 @@ class JaxBackend(GraphBackend):
                     jnp.asarray(gb.node_mask[0]),
                     jnp.asarray(gb.label_id[0]),
                     jnp.asarray(bits),
-                    gb.v,
+                    gb.max_depth,
                 )
             )
         diff_dots, failed_dots, missing_events = [], [], []

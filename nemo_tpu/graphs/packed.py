@@ -90,6 +90,40 @@ def bucket_size(n: int, minimum: int = 16) -> int:
     return b
 
 
+def longest_path_len(n_nodes: int, edges: np.ndarray) -> int:
+    """Longest path (in edges) of a DAG via topological relaxation; returns
+    n_nodes if a cycle is present (the conservative trip-count fallback).
+
+    The bounded-iteration kernels (ops/proto.py:hop_depths,
+    ops/diff.py:longest_depths) only need trip counts >= this, not >= V —
+    provenance DAGs are shallow (diameter ~ EOT x rule depth, SURVEY.md §5),
+    so a tight static bound cuts the dominant sequential loops several-fold.
+    """
+    if n_nodes == 0 or len(edges) == 0:
+        return 0
+    indeg = np.zeros(n_nodes, dtype=np.int64)
+    np.add.at(indeg, edges[:, 1], 1)
+    out: list[list[int]] = [[] for _ in range(n_nodes)]
+    for s, d in edges:
+        out[s].append(d)
+    dist = np.zeros(n_nodes, dtype=np.int64)
+    stack = [i for i in range(n_nodes) if indeg[i] == 0]
+    seen = 0
+    while stack:
+        u = stack.pop()
+        seen += 1
+        du = dist[u]
+        for w in out[u]:
+            if du + 1 > dist[w]:
+                dist[w] = du + 1
+            indeg[w] -= 1
+            if indeg[w] == 0:
+                stack.append(w)
+    if seen < n_nodes:  # cycle: fall back to the safe bound
+        return n_nodes
+    return int(dist.max())
+
+
 @dataclass
 class PackedBatch:
     """A batch of same-bucket graphs, padded to [B, V] / [B, E] (numpy)."""
@@ -108,6 +142,9 @@ class PackedBatch:
     edge_src: np.ndarray  # [B, E] int32 (0 pad)
     edge_dst: np.ndarray  # [B, E] int32 (0 pad)
     edge_mask: np.ndarray  # [B, E] bool
+    # Tight static trip count for the depth-relaxation kernels: the batch's
+    # longest DAG path (+1), capped at v.
+    max_depth: int = 0
 
 
 def pack_batch(
@@ -140,11 +177,13 @@ def pack_batch(
             edge_src[i, :ne] = g.edges[:, 0]
             edge_dst[i, :ne] = g.edges[:, 1]
             edge_mask[i, :ne] = True
+    depth = max((longest_path_len(g.n_nodes, g.edges) for g in graphs), default=0)
     return PackedBatch(
         run_ids=list(run_ids),
         graphs=list(graphs),
         v=v,
         e=e,
+        max_depth=min(v, max(1, depth + 1)),
         n_nodes=n_nodes,
         n_goals=n_goals,
         is_goal=is_goal,

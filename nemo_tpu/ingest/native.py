@@ -38,7 +38,7 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.nemo_free.argtypes = [ctypes.c_void_p]
 
 
-_native = NativeLib(_SRC, _LIB, _bind, "nemo_abi_version", 1)
+_native = NativeLib(_SRC, _LIB, _bind, "nemo_abi_version", 2)
 
 
 def build_native(force: bool = False) -> str:
@@ -87,6 +87,7 @@ class NativeCorpus:
     times: list[str]
     pre_tid: int
     post_tid: int
+    max_depth: int  # corpus-wide longest DAG path bound (+1), capped at v
     iteration: np.ndarray  # [B] int32
     success: np.ndarray  # [B] bool
     pre: NativeCondBatch
@@ -104,7 +105,7 @@ class NativeCorpus:
             post_tid=self.post_tid,
             num_tables=len(self.tables),
             num_labels=max(1, len(self.labels)),
-            max_depth=self.v,
+            max_depth=self.max_depth,
         )
 
 
@@ -147,9 +148,11 @@ def ingest_native(output_dir: str, with_node_ids: bool = True) -> NativeCorpus:
     if not handle:
         raise RuntimeError(f"native ingestion failed: {err.value.decode()}")
     try:
-        dims = (ctypes.c_int64 * 8)()
+        dims = (ctypes.c_int64 * 9)()
         lib.nemo_dims(handle, dims)
-        b, v, e, n_tables, n_labels, n_times, pre_tid, post_tid = (int(x) for x in dims)
+        (b, v, e, n_tables, n_labels, n_times, pre_tid, post_tid, max_depth) = (
+            int(x) for x in dims
+        )
         iteration = np.empty((b,), np.int32)
         success = np.empty((b,), np.uint8)
         lib.nemo_runs(
@@ -179,6 +182,7 @@ def ingest_native(output_dir: str, with_node_ids: bool = True) -> NativeCorpus:
             times=times,
             pre_tid=pre_tid,
             post_tid=post_tid,
+            max_depth=max_depth,
             iteration=iteration,
             success=success.astype(bool),
             pre=pre,

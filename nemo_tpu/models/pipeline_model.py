@@ -227,7 +227,10 @@ def graphs_to_step(
         post_tid=vocab.tables.lookup("post"),
         num_tables=len(vocab.tables),
         num_labels=max(1, len(vocab.labels)),
-        max_depth=v,
+        # Tight static trip count for the depth-relaxation loops: the corpus'
+        # longest DAG path (+1 margin), not V — several-fold fewer sequential
+        # steps on shallow provenance graphs (packed.py:longest_path_len).
+        max_depth=max(pre_b.max_depth, post_b.max_depth),
     )
     return BatchArrays.from_packed(pre_b), BatchArrays.from_packed(post_b), static
 

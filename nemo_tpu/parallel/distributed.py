@@ -56,14 +56,15 @@ def init_distributed(
     )
     if coordinator_address is None and num_processes is None:
         return False  # single-process: nothing to initialize
-    if coordinator_address is not None and num_processes is None:
-        # A stray coordinator address without a process count (e.g. a shared
-        # env file) must not crash a plain single-process run.
+    if coordinator_address is None or num_processes is None:
+        # A stray half-configuration (e.g. a shared env file exporting only
+        # one of the two) must not crash a plain single-process run.
         import warnings
 
+        have = "JAX_COORDINATOR_ADDRESS" if coordinator_address else "JAX_NUM_PROCESSES"
+        need = "JAX_NUM_PROCESSES" if coordinator_address else "JAX_COORDINATOR_ADDRESS"
         warnings.warn(
-            "JAX_COORDINATOR_ADDRESS set without JAX_NUM_PROCESSES; ignoring "
-            "and staying single-process",
+            f"{have} set without {need}; ignoring and staying single-process",
             stacklevel=2,
         )
         return False
