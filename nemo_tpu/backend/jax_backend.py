@@ -70,14 +70,67 @@ def _k_proto(adj, is_goal, alive, table_id, achieved_pre, num_tables, max_depth)
     return bits, min_depth, present
 
 
-@partial(jax.jit, static_argnames=("max_depth",))
-def _k_diff(adj_good, is_goal, node_mask, label_id, fail_bits, max_depth):
+@partial(jax.jit, static_argnames=("v", "max_depth"))
+def _k_diff(edge_src, edge_dst, edge_mask, is_goal, node_mask, label_id, fail_bits, v, max_depth):
+    adj_good = build_adjacency(edge_src, edge_dst, edge_mask, v)[0]
     return diff_masks(adj_good, is_goal, node_mask, label_id, fail_bits, max_depth)
 
 
+class LocalExecutor:
+    """The backend's device boundary: four named kernels over named numpy
+    arrays and static int params.  run() is the whole contract — the remote
+    executor (service/client.py:RemoteExecutor) sends the same (verb, arrays,
+    params) triple over the sidecar's Kernel RPC, and the sidecar dispatches
+    right back into this class, so local and two-process deployments execute
+    identical device code.
+    """
+
+    VERBS = {
+        "condition": (
+            _k_condition,
+            ("edge_src", "edge_dst", "edge_mask", "is_goal", "table_id", "node_mask"),
+            ("v", "cond_tid", "num_tables"),
+            ("holds",),
+        ),
+        "simplify": (
+            _k_simplify,
+            ("edge_src", "edge_dst", "edge_mask", "is_goal", "type_id", "node_mask"),
+            ("v",),
+            ("adj", "alive", "type_id"),
+        ),
+        "proto": (
+            _k_proto,
+            ("adj", "is_goal", "alive", "table_id", "achieved_pre"),
+            ("num_tables", "max_depth"),
+            ("bits", "min_depth", "present"),
+        ),
+        "diff": (
+            _k_diff,
+            ("edge_src", "edge_dst", "edge_mask", "is_goal", "node_mask", "label_id", "fail_bits"),
+            ("v", "max_depth"),
+            ("node_keep", "edge_keep", "frontier_rule", "missing_goal"),
+        ),
+    }
+
+    def run(self, verb: str, arrays: dict, params: dict) -> dict[str, np.ndarray]:
+        if verb not in self.VERBS:
+            raise ValueError(f"unknown kernel verb {verb!r}")
+        fn, array_names, param_names, out_names = self.VERBS[verb]
+        args = [jnp.asarray(arrays[n]) for n in array_names]
+        statics = [int(params[n]) for n in param_names]
+        out = fn(*args, *statics)
+        if not isinstance(out, tuple):
+            out = (out,)
+        return {n: np.asarray(o) for n, o in zip(out_names, out)}
+
+
 class JaxBackend(GraphBackend):
-    def __init__(self, max_batch: int | None = None) -> None:
+    def __init__(self, max_batch: int | None = None, executor=None) -> None:
         self.max_batch = max_batch
+        # The device boundary.  LocalExecutor runs kernels in-process; the
+        # ServiceBackend passes a RemoteExecutor that sends each call to the
+        # gRPC sidecar instead (north-star two-process architecture).
+        self.executor = executor or LocalExecutor()
         self.molly: MollyOutput | None = None
         self.vocab = CorpusVocab()
         self.packed: dict[tuple[int, str], object] = {}
@@ -139,19 +192,18 @@ class JaxBackend(GraphBackend):
         for cond in ("pre", "post"):
             cond_tid = self.vocab.tables.lookup(cond)
             for batch in self._batches(cond):
-                holds = np.asarray(
-                    _k_condition(
-                        jnp.asarray(batch.edge_src),
-                        jnp.asarray(batch.edge_dst),
-                        jnp.asarray(batch.edge_mask),
-                        jnp.asarray(batch.is_goal),
-                        jnp.asarray(batch.table_id),
-                        jnp.asarray(batch.node_mask),
-                        batch.v,
-                        cond_tid,
-                        len(self.vocab.tables),
-                    )
-                )
+                holds = self.executor.run(
+                    "condition",
+                    {
+                        "edge_src": batch.edge_src,
+                        "edge_dst": batch.edge_dst,
+                        "edge_mask": batch.edge_mask,
+                        "is_goal": batch.is_goal,
+                        "table_id": batch.table_id,
+                        "node_mask": batch.node_mask,
+                    },
+                    {"v": batch.v, "cond_tid": cond_tid, "num_tables": len(self.vocab.tables)},
+                )["holds"]
                 for row, rid in enumerate(batch.run_ids):
                     n = batch.graphs[row].n_nodes
                     self.cond_holds[(rid, cond)] = holds[row, :n]
@@ -173,16 +225,19 @@ class JaxBackend(GraphBackend):
         for cond in ("pre", "post"):
             outs = []
             for batch in self._batches(cond, iters):
-                adj, alive, type_new = _k_simplify(
-                    jnp.asarray(batch.edge_src),
-                    jnp.asarray(batch.edge_dst),
-                    jnp.asarray(batch.edge_mask),
-                    jnp.asarray(batch.is_goal),
-                    jnp.asarray(batch.type_id),
-                    jnp.asarray(batch.node_mask),
-                    batch.v,
+                out = self.executor.run(
+                    "simplify",
+                    {
+                        "edge_src": batch.edge_src,
+                        "edge_dst": batch.edge_dst,
+                        "edge_mask": batch.edge_mask,
+                        "is_goal": batch.is_goal,
+                        "type_id": batch.type_id,
+                        "node_mask": batch.node_mask,
+                    },
+                    {"v": batch.v},
                 )
-                adj, alive, type_new = np.asarray(adj), np.asarray(alive), np.asarray(type_new)
+                adj, alive, type_new = out["adj"], out["alive"], out["type_id"]
                 outs.append((batch, adj, alive, type_new))
                 for row, rid in enumerate(batch.run_ids):
                     holds = self.cond_holds[(rid, cond)]
@@ -213,20 +268,18 @@ class JaxBackend(GraphBackend):
         present: dict[int, set[str]] = {}
         for batch, adj, alive, _ in self.simplified["post"]:
             ach = np.asarray([self.achieved_pre[rid] for rid in batch.run_ids], dtype=bool)
-            bits, min_depth, present_bits = _k_proto(
-                jnp.asarray(adj),
-                jnp.asarray(batch.is_goal),
-                jnp.asarray(alive),
-                jnp.asarray(batch.table_id),
-                jnp.asarray(ach),
-                num_tables,
-                batch.max_depth,
+            out = self.executor.run(
+                "proto",
+                {
+                    "adj": adj,
+                    "is_goal": batch.is_goal,
+                    "alive": alive,
+                    "table_id": batch.table_id,
+                    "achieved_pre": ach,
+                },
+                {"num_tables": num_tables, "max_depth": batch.max_depth},
             )
-            bits, min_depth, present_bits = (
-                np.asarray(bits),
-                np.asarray(min_depth),
-                np.asarray(present_bits),
-            )
+            bits, min_depth, present_bits = out["bits"], out["min_depth"], out["present"]
             for row, rid in enumerate(batch.run_ids):
                 tabs = [
                     (int(min_depth[row, t]), self.vocab.tables[t])
@@ -273,11 +326,6 @@ class JaxBackend(GraphBackend):
         num_labels = max(1, len(self.vocab.labels))
         # Pad the single good graph to its own bucket.
         gb = pack_batch([0], [good])
-        adj_good = np.asarray(
-            build_adjacency(
-                jnp.asarray(gb.edge_src), jnp.asarray(gb.edge_dst), jnp.asarray(gb.edge_mask), gb.v
-            )
-        )[0]
 
         bits = np.zeros((max(1, len(failed_iters)), num_labels), dtype=bool)
         for j, f in enumerate(failed_iters):
@@ -286,16 +334,24 @@ class JaxBackend(GraphBackend):
             bits[j, goal_labels] = True
 
         if failed_iters:
+            out = self.executor.run(
+                "diff",
+                {
+                    "edge_src": gb.edge_src,
+                    "edge_dst": gb.edge_dst,
+                    "edge_mask": gb.edge_mask,
+                    "is_goal": gb.is_goal[0],
+                    "node_mask": gb.node_mask[0],
+                    "label_id": gb.label_id[0],
+                    "fail_bits": bits,
+                },
+                {"v": gb.v, "max_depth": gb.max_depth},
+            )
             node_keep, edge_keep, frontier_rule, missing_goal = (
-                np.asarray(x)
-                for x in _k_diff(
-                    jnp.asarray(adj_good),
-                    jnp.asarray(gb.is_goal[0]),
-                    jnp.asarray(gb.node_mask[0]),
-                    jnp.asarray(gb.label_id[0]),
-                    jnp.asarray(bits),
-                    gb.max_depth,
-                )
+                out["node_keep"],
+                out["edge_keep"],
+                out["frontier_rule"],
+                out["missing_goal"],
             )
         diff_dots, failed_dots, missing_events = [], [], []
         for j, f in enumerate(failed_iters):

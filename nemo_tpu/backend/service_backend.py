@@ -1,0 +1,58 @@
+"""ServiceBackend: the JaxBackend with its device kernels on the gRPC sidecar.
+
+The north-star two-process architecture (SURVEY.md §7): a thin CLI process
+does ingestion, host assembly, and report writing, while the sidecar owns the
+accelerator.  This backend is exactly the JaxBackend with the device boundary
+swapped — every kernel call (condition marking, simplify, prototypes, diff)
+travels the Kernel RPC as a (verb, named arrays, static params) triple and
+executes in the sidecar through the same LocalExecutor dispatch table, so the
+two deployments are bit-identical by construction (tests/test_service.py).
+
+Select with `--graph-backend=service`; the sidecar address comes from
+`-graphDBConn` (the reference's store-connection flag, retargeted) or the
+constructor.  Start the sidecar with `python -m nemo_tpu.service.server`.
+"""
+
+from __future__ import annotations
+
+from nemo_tpu.backend.jax_backend import JaxBackend
+from nemo_tpu.ingest.molly import MollyOutput
+
+
+class ServiceBackend(JaxBackend):
+    def __init__(self, target: str = "127.0.0.1:50051", max_batch: int | None = None) -> None:
+        self.target = target
+        # The executor (and its channel) is created lazily in init_graph_db —
+        # the reference's InitGraphDB is likewise where the store connection
+        # opens (graphing/helpers.go:38-49) — so the backend is reusable
+        # across corpora after close_db.
+        super().__init__(max_batch=max_batch, executor=_Unconnected())
+
+    def init_graph_db(self, conn: str, molly: MollyOutput) -> None:
+        from nemo_tpu.service.client import RemoteExecutor
+
+        if conn and not conn.startswith("bolt://"):
+            self.target = conn
+        # Reconnect when unconnected OR re-initialized with a different
+        # sidecar address (JaxBackend supports reuse without close_db, so a
+        # stale connection here would silently route kernels to the old host).
+        if isinstance(self.executor, _Unconnected):
+            self.executor = RemoteExecutor(target=self.target)
+        elif self.executor.target != self.target:
+            self.executor.close()
+            self.executor = _Unconnected()
+            self.executor = RemoteExecutor(target=self.target)
+        super().init_graph_db(conn, molly)
+
+    def close_db(self) -> None:
+        super().close_db()
+        if not isinstance(self.executor, _Unconnected):
+            self.executor.close()
+            self.executor = _Unconnected()
+
+
+class _Unconnected:
+    """Placeholder executor before init_graph_db / after close_db."""
+
+    def run(self, verb, arrays, params):
+        raise RuntimeError("ServiceBackend is not connected; call init_graph_db first")

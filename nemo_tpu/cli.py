@@ -33,7 +33,13 @@ def make_backend(name: str):
         from nemo_tpu.backend.neo4j_backend import Neo4jBackend
 
         return Neo4jBackend()
-    raise SystemExit(f"unknown graph backend: {name!r} (expected python, jax, or neo4j)")
+    if name == "service":
+        from nemo_tpu.backend.service_backend import ServiceBackend
+
+        return ServiceBackend()
+    raise SystemExit(
+        f"unknown graph backend: {name!r} (expected python, jax, neo4j, or service)"
+    )
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -58,10 +64,12 @@ def main(argv: list[str] | None = None) -> int:
     )
     parser.add_argument(
         "--graph-backend",
-        choices=("python", "jax", "neo4j"),
+        choices=("python", "jax", "neo4j", "service"),
         default="python",
         help="graph analytics engine: in-process Python oracle, batched "
-        "JAX/TPU, or a Neo4j server at -graphDBConn (the reference's backend)",
+        "JAX/TPU, a Neo4j server at -graphDBConn (the reference's backend), "
+        "or the gRPC TPU sidecar at -graphDBConn (host:port; start it with "
+        "python -m nemo_tpu.service.server)",
     )
     parser.add_argument(
         "--results-dir",

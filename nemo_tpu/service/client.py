@@ -54,6 +54,11 @@ class RemoteAnalyzer:
             request_serializer=pb.AnalyzeRequest.SerializeToString,
             response_deserializer=pb.AnalyzeResponse.FromString,
         )
+        self._kernel = self._channel.unary_unary(
+            f"/{SERVICE}/Kernel",
+            request_serializer=pb.KernelRequest.SerializeToString,
+            response_deserializer=pb.KernelResponse.FromString,
+        )
 
     def close(self) -> None:
         self._channel.close()
@@ -104,6 +109,13 @@ class RemoteAnalyzer:
                 delay *= 2
         raise SidecarError("unreachable")
 
+    # ------------------------------------------------------------- kernel
+
+    def kernel(self, verb: str, arrays: dict, params: dict) -> dict[str, np.ndarray]:
+        """One named device-kernel call on the sidecar (ServiceBackend path)."""
+        req = codec.kernel_request_to_pb(verb, arrays, params)
+        return codec.kernel_response_from_pb(self._call(self._kernel, req))
+
     # ------------------------------------------------------------ analyze
 
     def analyze(self, pre, post, static: dict) -> dict[str, np.ndarray]:
@@ -140,6 +152,32 @@ class RemoteAnalyzer:
         if missing:
             raise SidecarError(f"missing responses for chunks {missing}")
         return out  # type: ignore[return-value]
+
+
+@dataclass
+class RemoteExecutor:
+    """Drop-in for backend.jax_backend.LocalExecutor that runs every kernel
+    on the sidecar: same (verb, arrays, params) contract, carried over the
+    Kernel RPC.  Owns its RemoteAnalyzer; close() releases the channel."""
+
+    target: str = "127.0.0.1:50051"
+    ready_deadline: float = 30.0
+
+    def __post_init__(self):
+        self._client = RemoteAnalyzer(target=self.target)
+        try:
+            self._client.wait_ready(self.ready_deadline)
+        except BaseException:
+            # Don't leak the channel (and its worker threads) when the
+            # sidecar is unreachable.
+            self._client.close()
+            raise
+
+    def run(self, verb: str, arrays: dict, params: dict) -> dict[str, np.ndarray]:
+        return self._client.kernel(verb, arrays, params)
+
+    def close(self) -> None:
+        self._client.close()
 
 
 def analyze_dir(target: str, molly_dir: str, chunk_runs: int = 0) -> dict[str, np.ndarray]:

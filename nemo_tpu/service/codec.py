@@ -57,6 +57,32 @@ def static_from_pb(m: pb.StaticParams) -> dict:
     )
 
 
+def kernel_request_to_pb(verb: str, arrays: dict, params: dict) -> pb.KernelRequest:
+    req = pb.KernelRequest(verb=verb)
+    for k, v in arrays.items():
+        req.arrays[k].CopyFrom(ndarray_to_pb(v))
+    for k, v in params.items():
+        req.params[k] = int(v)
+    return req
+
+
+def kernel_request_from_pb(m: pb.KernelRequest) -> tuple[str, dict, dict]:
+    arrays = {k: ndarray_from_pb(v) for k, v in m.arrays.items()}
+    params = {k: int(v) for k, v in m.params.items()}
+    return m.verb, arrays, params
+
+
+def kernel_response_to_pb(outputs: dict, step_seconds: float) -> pb.KernelResponse:
+    resp = pb.KernelResponse(step_seconds=step_seconds)
+    for k, v in outputs.items():
+        resp.outputs[k].CopyFrom(ndarray_to_pb(v))
+    return resp
+
+
+def kernel_response_from_pb(m: pb.KernelResponse) -> dict[str, np.ndarray]:
+    return {k: ndarray_from_pb(v) for k, v in m.outputs.items()}
+
+
 def outputs_to_pb(outputs: dict, chunk: int, step_seconds: float) -> pb.AnalyzeResponse:
     resp = pb.AnalyzeResponse(chunk=chunk, step_seconds=step_seconds)
     for k, v in outputs.items():

@@ -35,6 +35,9 @@ log = logging.getLogger("nemo.sidecar")
 class _Impl:
     """Method implementations; one fused-step jit cache per process."""
 
+    def __init__(self) -> None:
+        self._kernel_executor = None  # lazy: created on first Kernel RPC
+
     def health(self, request: pb.HealthRequest, context) -> pb.HealthResponse:
         import jax
 
@@ -66,6 +69,25 @@ class _Impl:
         for request in request_iterator:
             yield self._analyze_one(request)
 
+    def kernel(self, request: pb.KernelRequest, context) -> pb.KernelResponse:
+        """Named device-kernel dispatch for the ServiceBackend: the request's
+        (verb, arrays, params) triple runs through the same LocalExecutor the
+        in-process JaxBackend uses, so both deployments execute identical
+        device code."""
+        from nemo_tpu.backend.jax_backend import LocalExecutor
+
+        verb, arrays, params = codec.kernel_request_from_pb(request)
+        if verb not in LocalExecutor.VERBS:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"unknown kernel verb {verb!r}")
+        if self._kernel_executor is None:
+            self._kernel_executor = LocalExecutor()
+        t0 = time.perf_counter()
+        try:
+            out = self._kernel_executor.run(verb, arrays, params)
+        except KeyError as ex:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"missing kernel input: {ex}")
+        return codec.kernel_response_to_pb(out, step_seconds=time.perf_counter() - t0)
+
 
 def make_server(port: int = 0, max_workers: int = 4) -> tuple[grpc.Server, int]:
     """Build (but don't start) the sidecar server; returns (server, port)."""
@@ -85,6 +107,11 @@ def make_server(port: int = 0, max_workers: int = 4) -> tuple[grpc.Server, int]:
             impl.analyze_stream,
             request_deserializer=pb.AnalyzeRequest.FromString,
             response_serializer=pb.AnalyzeResponse.SerializeToString,
+        ),
+        "Kernel": grpc.unary_unary_rpc_method_handler(
+            impl.kernel,
+            request_deserializer=pb.KernelRequest.FromString,
+            response_serializer=pb.KernelResponse.SerializeToString,
         ),
     }
     server = grpc.server(

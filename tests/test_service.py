@@ -58,3 +58,54 @@ def test_unavailable_target_raises():
     with RemoteAnalyzer(target="127.0.0.1:1", retries=2, timeout=2.0) as client:
         with pytest.raises((grpc.RpcError, SidecarError)):
             client.health(timeout=0.5)
+
+
+def test_kernel_rpc_matches_local_executor(sidecar, packed):
+    """The Kernel RPC must execute the same dispatch table as in-process."""
+    from nemo_tpu.backend.jax_backend import LocalExecutor
+
+    pre, post, static = packed
+    arrays = {
+        "edge_src": np.asarray(post.edge_src),
+        "edge_dst": np.asarray(post.edge_dst),
+        "edge_mask": np.asarray(post.edge_mask),
+        "is_goal": np.asarray(post.is_goal),
+        "table_id": np.asarray(post.table_id),
+        "node_mask": np.asarray(post.node_mask),
+    }
+    params = {
+        "v": static["v"],
+        "cond_tid": static["post_tid"],
+        "num_tables": static["num_tables"],
+    }
+    local = LocalExecutor().run("condition", arrays, params)
+    with RemoteAnalyzer(target=sidecar) as client:
+        client.wait_ready()
+        remote = client.kernel("condition", arrays, params)
+        with pytest.raises(grpc.RpcError):
+            client.kernel("no_such_verb", {}, {})
+    assert set(remote) == set(local)
+    np.testing.assert_array_equal(remote["holds"], local["holds"])
+
+
+def test_service_backend_full_pipeline_matches_oracle(sidecar, corpus_dir, tmp_path):
+    """CLI-shaped two-process run: ServiceBackend (kernels on the sidecar)
+    produces a byte-identical report to the in-process oracle."""
+    import json
+    import os
+
+    from nemo_tpu.analysis.pipeline import run_debug
+    from nemo_tpu.backend.python_ref import PythonBackend
+    from nemo_tpu.backend.service_backend import ServiceBackend
+
+    oracle = run_debug(corpus_dir, str(tmp_path / "py"), PythonBackend())
+    svc = ServiceBackend(target=sidecar)
+    remote = run_debug(corpus_dir, str(tmp_path / "svc"), svc)
+    # Reusable after close_db, like the other backends.
+    remote2 = run_debug(corpus_dir, str(tmp_path / "svc2"), svc)
+
+    with open(os.path.join(oracle.report_dir, "debugging.json")) as f:
+        want = json.load(f)
+    for result in (remote, remote2):
+        with open(os.path.join(result.report_dir, "debugging.json")) as f:
+            assert json.load(f) == want
