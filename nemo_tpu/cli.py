@@ -80,6 +80,15 @@ def main(argv: list[str] | None = None) -> int:
         "--timings", action="store_true", help="print per-phase wall-clock timings"
     )
     parser.add_argument(
+        "--serve",
+        metavar="PORT",
+        type=int,
+        default=0,
+        help="after generating the report, serve it on http://127.0.0.1:PORT "
+        "(browsers block fetch() on file:// URLs, so the report's "
+        "debugging.json load needs an HTTP origin)",
+    )
+    parser.add_argument(
         "--profile",
         metavar="DIR",
         default=None,
@@ -114,6 +123,20 @@ def main(argv: list[str] | None = None) -> int:
             print(f"{phase:>22s}  {secs * 1e3:9.1f} ms")
 
     print(f"All done! Find the debug report here: {os.path.join(result.report_dir, 'index.html')}")
+
+    if args.serve:
+        import functools
+        import http.server
+
+        handler = functools.partial(
+            http.server.SimpleHTTPRequestHandler, directory=result.report_dir
+        )
+        with http.server.ThreadingHTTPServer(("127.0.0.1", args.serve), handler) as httpd:
+            print(f"Serving the report at http://127.0.0.1:{httpd.server_address[1]}/ (Ctrl-C to stop)")
+            try:
+                httpd.serve_forever()
+            except KeyboardInterrupt:
+                pass
     return 0
 
 

@@ -63,6 +63,26 @@ def _ring_step_body(frontier_chunk, adj_shard, axis_name):
     return acc > 0.5
 
 
+def closure_sharded(mesh: Mesh, adjacency: jnp.ndarray) -> jnp.ndarray:
+    """Reflexive-transitive closure of ONE giant graph, node-sharded.
+
+    The adjacency's columns are sharded over the mesh and the log2(V)
+    boolean-matmul squarings (ops/adjacency.py:closure's XLA chain) run SPMD:
+    GSPMD partitions each [V,V]x[V,V] product, with the contraction's partial
+    sums riding ICI — the path for a single provenance graph whose dense
+    adjacency exceeds one chip's HBM.  Per-run batched graphs never need
+    this; they shard over the run axis instead (parallel/mesh.py).
+    """
+    from nemo_tpu.ops.adjacency import closure
+
+    v = adjacency.shape[-1]
+    if v % mesh.devices.size:
+        raise ValueError(f"V={v} not divisible by mesh size {mesh.devices.size}")
+    sharded = jax.device_put(adjacency, NamedSharding(mesh, P(None, NODE_AXIS)))
+    fn = jax.jit(partial(closure, impl="xla"))  # pallas closure can't shard
+    return fn(sharded)
+
+
 def ring_reach(mesh: Mesh, adjacency: jnp.ndarray, start: jnp.ndarray, steps: int) -> jnp.ndarray:
     """BFS reachability (>=0 hops) over a node-sharded graph.
 

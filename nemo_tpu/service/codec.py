@@ -18,15 +18,30 @@ _COND_FIELDS = (
 )
 
 
+# Boolean arrays dominate the wire (masks, adjacencies, kernel outputs);
+# packbits cuts their bytes 8x.  The marker is a dtype tag, so mixed-version
+# peers fail loudly on decode rather than misread data.
+_PACKED_BOOL = "packedbool"
+
+
 def ndarray_to_pb(a) -> pb.NdArray:
     a = np.ascontiguousarray(np.asarray(a))
+    if a.dtype == np.bool_:
+        return pb.NdArray(
+            dtype=_PACKED_BOOL, shape=list(a.shape), data=np.packbits(a).tobytes()
+        )
     return pb.NdArray(dtype=str(a.dtype), shape=list(a.shape), data=a.tobytes())
 
 
 def ndarray_from_pb(m: pb.NdArray, copy: bool = False) -> np.ndarray:
     """Decode to numpy; zero-copy (read-only view) by default — the
     device-bound path hands this straight to jnp.asarray."""
-    a = np.frombuffer(m.data, dtype=np.dtype(m.dtype)).reshape(tuple(m.shape))
+    shape = tuple(m.shape)
+    if m.dtype == _PACKED_BOOL:
+        n = int(np.prod(shape, dtype=np.int64))
+        bits = np.unpackbits(np.frombuffer(m.data, dtype=np.uint8), count=n)
+        return bits.astype(bool).reshape(shape)
+    a = np.frombuffer(m.data, dtype=np.dtype(m.dtype)).reshape(shape)
     return a.copy() if copy else a
 
 

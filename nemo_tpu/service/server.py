@@ -35,9 +35,6 @@ log = logging.getLogger("nemo.sidecar")
 class _Impl:
     """Method implementations; one fused-step jit cache per process."""
 
-    def __init__(self) -> None:
-        self._kernel_executor = None  # lazy: created on first Kernel RPC
-
     def health(self, request: pb.HealthRequest, context) -> pb.HealthResponse:
         import jax
 
@@ -79,11 +76,11 @@ class _Impl:
         verb, arrays, params = codec.kernel_request_from_pb(request)
         if verb not in LocalExecutor.VERBS:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"unknown kernel verb {verb!r}")
-        if self._kernel_executor is None:
-            self._kernel_executor = LocalExecutor()
         t0 = time.perf_counter()
         try:
-            out = self._kernel_executor.run(verb, arrays, params)
+            # LocalExecutor is stateless; the jit caches live on the
+            # module-level kernel functions.
+            out = LocalExecutor().run(verb, arrays, params)
         except KeyError as ex:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"missing kernel input: {ex}")
         return codec.kernel_response_to_pb(out, step_seconds=time.perf_counter() - t0)
@@ -130,8 +127,20 @@ def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="nemo-tpu-sidecar")
     parser.add_argument("--port", type=int, default=50051)
     parser.add_argument("--max-workers", type=int, default=4)
+    parser.add_argument(
+        "--profiler-port",
+        type=int,
+        default=0,
+        help="start jax.profiler.start_server on this port so TensorBoard/"
+        "xprof can capture device traces from the running sidecar (0 = off)",
+    )
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
+    if args.profiler_port:
+        import jax
+
+        jax.profiler.start_server(args.profiler_port)
+        log.info("jax profiler server on port %d", args.profiler_port)
     server, port = make_server(args.port, args.max_workers)
     server.start()
     log.info("sidecar listening on 127.0.0.1:%d", port)

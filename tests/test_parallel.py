@@ -39,3 +39,24 @@ def test_ring_reach_matches_dense():
     for _ in range(v):
         want = want | (want @ adj > 0)
     np.testing.assert_array_equal(got, want)
+
+
+def test_closure_sharded_matches_dense():
+    """All-pairs closure of one node-sharded giant graph == single-device."""
+    import jax
+    import numpy as np
+    import jax.numpy as jnp
+
+    from nemo_tpu.ops.adjacency import closure
+    from nemo_tpu.parallel.ring import closure_sharded, make_node_mesh
+
+    if len(jax.devices()) < 8:
+        import pytest
+
+        pytest.skip("needs 8 virtual devices")
+    rng = np.random.default_rng(3)
+    v = 128
+    adj = jnp.asarray(rng.random((v, v)) < 0.05)
+    want = np.asarray(closure(adj, impl="xla"))
+    got = np.asarray(closure_sharded(make_node_mesh(8), adj))
+    np.testing.assert_array_equal(got, want)
