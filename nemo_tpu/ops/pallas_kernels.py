@@ -10,8 +10,10 @@ chain: each grid instance DMAs a block of graphs into VMEM once, runs every
 squaring on the MXU from VMEM, and writes the finished closure back once —
 HBM traffic drops to read+write of the block regardless of log2(V).
 
-Boolean exactness: entries are 0/1 (exact in bf16), products accumulate in
-f32 (exact up to V ≤ 2^24), thresholded at 0.5 each squaring.
+Boolean exactness: entries are 0/1 (exact in bf16 and int8), products
+accumulate in f32 (bf16 path, exact up to V ≤ 2^24) or int32 (int8 path),
+thresholded at > 0 each squaring — sums of 0/1 products are non-negative
+integers, so the threshold is exact in both.
 
 Used via ops.adjacency.closure's impl dispatch (NEMO_CLOSURE_IMPL =
 auto|xla|pallas; auto picks pallas on TPU backends).  CPU tests run the same
@@ -27,54 +29,76 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 
-def _closure_kernel(adj_ref, out_ref, *, n_steps: int, block_b: int, v: int):
+def _closure_kernel(adj_ref, out_ref, *, n_steps: int, block_b: int, v: int, compute_dtype):
+    acc_dtype = jnp.int32 if compute_dtype == jnp.int8 else jnp.float32
     row = jax.lax.broadcasted_iota(jnp.int32, (v, v), 0)
     col = jax.lax.broadcasted_iota(jnp.int32, (v, v), 1)
-    eye = (row == col).astype(jnp.bfloat16)
+    eye = (row == col).astype(compute_dtype)
     # Static unroll over the graphs of this block: Mosaic's dot lowering is
     # 2-D, and block_b is small (VMEM-bounded), so unrolling beats a loop.
     for i in range(block_b):
         r = jnp.maximum(adj_ref[i], eye)
         for _ in range(n_steps):
-            p = jnp.dot(r, r, preferred_element_type=jnp.float32)
-            r = (p > 0.5).astype(jnp.bfloat16)
+            p = jnp.dot(r, r, preferred_element_type=acc_dtype)
+            r = (p > 0).astype(compute_dtype)
         out_ref[i] = r
 
 
-def default_block_b(v: int) -> int:
-    """Graphs per grid instance, sized so ~3 live [block_b,V,V] bf16 buffers
-    stay well under VMEM (~16 MB/core)."""
+def default_block_b(v: int, itemsize: int = 2) -> int:
+    """Graphs per grid instance, sized so ~3 live [block_b,V,V] buffers stay
+    well under VMEM (~16 MB/core); int8 compute fits twice as many as bf16."""
+    scale = max(1, 2 // itemsize)
     if v <= 128:
-        return 8
+        return 8 * scale
     if v <= 256:
-        return 4
+        return 4 * scale
     if v <= 512:
-        return 2
-    return 1
+        return 2 * scale
+    return 1 * scale
+
+
+def _compute_dtype():
+    """bf16 by default; NEMO_PALLAS_DTYPE=int8 switches the squaring chain to
+    int8xint8->int32 MXU matmuls (half the VMEM, higher int throughput on
+    TPUs that support it).  Both are exact for 0/1 entries."""
+    import os
+
+    name = os.environ.get("NEMO_PALLAS_DTYPE", "bfloat16")
+    if name in ("int8", "i8"):
+        return jnp.int8
+    if name in ("bfloat16", "bf16"):
+        return jnp.bfloat16
+    raise ValueError(
+        f"unknown NEMO_PALLAS_DTYPE {name!r} (expected bfloat16/bf16 or int8/i8)"
+    )
 
 
 def closure_pallas(
-    adj: jax.Array, block_b: int | None = None, interpret: bool = False
+    adj: jax.Array,
+    block_b: int | None = None,
+    interpret: bool = False,
+    compute_dtype=None,
 ) -> jax.Array:
     """Reflexive-transitive closure of [B,V,V] (or [V,V]) boolean adjacency,
     fused squaring chain in VMEM.  Bit-identical to adjacency.closure."""
     squeeze = adj.ndim == 2
     if squeeze:
         adj = adj[None]
+    dt = compute_dtype or _compute_dtype()
     b, v, _ = adj.shape
     n_steps = max(1, (v - 1).bit_length())
-    bb = min(block_b or default_block_b(v), b)
-    x = adj.astype(jnp.bfloat16)
+    bb = min(block_b or default_block_b(v, jnp.dtype(dt).itemsize), b)
+    x = adj.astype(dt)
     pad = (-b) % bb
     if pad:
         x = jnp.pad(x, ((0, pad), (0, 0), (0, 0)))
     out = pl.pallas_call(
-        functools.partial(_closure_kernel, n_steps=n_steps, block_b=bb, v=v),
-        out_shape=jax.ShapeDtypeStruct(x.shape, jnp.bfloat16),
+        functools.partial(_closure_kernel, n_steps=n_steps, block_b=bb, v=v, compute_dtype=dt),
+        out_shape=jax.ShapeDtypeStruct(x.shape, dt),
         grid=(x.shape[0] // bb,),
         in_specs=[pl.BlockSpec((bb, v, v), lambda i: (i, 0, 0))],
         out_specs=pl.BlockSpec((bb, v, v), lambda i: (i, 0, 0)),
         interpret=interpret,
     )(x)
-    res = out[:b] > 0.5
+    res = out[:b] > 0
     return res[0] if squeeze else res

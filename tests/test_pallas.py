@@ -74,3 +74,16 @@ def test_closure_pallas_under_jit():
     adj = jnp.asarray(rng.random((4, 16, 16)) < 0.2)
     f = jax.jit(lambda a: closure_pallas(a, interpret=_INTERPRET))
     np.testing.assert_array_equal(np.asarray(f(adj)), np.asarray(closure(adj, impl="xla")))
+
+
+def test_closure_pallas_int8_matches_xla():
+    """The int8 MXU variant is exact for 0/1 matrices too (runs the real
+    Mosaic lowering under NEMO_TEST_PLATFORM=tpu, like the other tests)."""
+    rng = np.random.default_rng(5)
+    for v, b in ((16, 3), (64, 9)):
+        adj = jnp.asarray(rng.random((b, v, v)) < 0.08)
+        want = np.asarray(closure(adj, impl="xla"))
+        got = np.asarray(
+            closure_pallas(adj, interpret=_INTERPRET, compute_dtype=jnp.int8)
+        )
+        np.testing.assert_array_equal(got, want, err_msg=f"V={v}")
