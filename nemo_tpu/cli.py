@@ -18,6 +18,7 @@ import os
 import sys
 
 from nemo_tpu.analysis.pipeline import run_debug
+from nemo_tpu.utils.jax_config import enable_compilation_cache
 
 
 def make_backend(name: str):
@@ -108,6 +109,7 @@ def main(argv: list[str] | None = None) -> int:
     if not os.path.isdir(args.fault_inj_out):
         parser.error(f"fault injector output directory not found: {args.fault_inj_out}")
 
+    enable_compilation_cache()
     backend = make_backend(args.graph_backend)
     result = run_debug(
         args.fault_inj_out,

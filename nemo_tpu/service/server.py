@@ -136,6 +136,9 @@ def main(argv: list[str] | None = None) -> int:
     )
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
+    from nemo_tpu.utils.jax_config import enable_compilation_cache
+
+    enable_compilation_cache()
     if args.profiler_port:
         import jax
 

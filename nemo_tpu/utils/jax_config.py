@@ -1,0 +1,21 @@
+"""Shared JAX runtime configuration for the entry points (CLI, sidecar)."""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_compilation_cache() -> None:
+    """Persist jitted kernels across process invocations (first TPU compile
+    is tens of seconds; repeat invocations then load from disk).  Opt out
+    with NEMO_JAX_CACHE=off; NEMO_JAX_CACHE=<dir> overrides the location."""
+    cache = os.environ.get("NEMO_JAX_CACHE", "")
+    if cache.lower() in ("off", "0", "none"):
+        return
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        cache or os.path.join(os.path.expanduser("~"), ".cache", "nemo_tpu", "jax"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)

@@ -87,3 +87,29 @@ def test_closure_pallas_int8_matches_xla():
             closure_pallas(adj, interpret=_INTERPRET, compute_dtype=jnp.int8)
         )
         np.testing.assert_array_equal(got, want, err_msg=f"V={v}")
+
+
+def test_pallas_dtype_env_dispatch(monkeypatch):
+    """NEMO_PALLAS_DTYPE drives the env path users actually configure:
+    aliases resolve, closure() routes through it, typos raise."""
+    from nemo_tpu.ops.pallas_kernels import _compute_dtype
+
+    for name, want in (
+        ("int8", jnp.int8), ("i8", jnp.int8),
+        ("bfloat16", jnp.bfloat16), ("bf16", jnp.bfloat16),
+    ):
+        monkeypatch.setenv("NEMO_PALLAS_DTYPE", name)
+        assert _compute_dtype() == want, name
+    monkeypatch.delenv("NEMO_PALLAS_DTYPE")
+    assert _compute_dtype() == jnp.bfloat16
+
+    monkeypatch.setenv("NEMO_PALLAS_DTYPE", "int8")
+    rng = np.random.default_rng(8)
+    adj = jnp.asarray(rng.random((4, 32, 32)) < 0.1)
+    want = np.asarray(closure(adj, impl="xla"))
+    got = np.asarray(closure_pallas(adj, interpret=_INTERPRET))  # env-driven
+    np.testing.assert_array_equal(got, want)
+
+    monkeypatch.setenv("NEMO_PALLAS_DTYPE", "itn8")
+    with pytest.raises(ValueError, match="NEMO_PALLAS_DTYPE"):
+        closure_pallas(adj, interpret=_INTERPRET)
