@@ -5,7 +5,7 @@ NATIVE_LIB := native/build/libnemo_native.so
 REPORT_SRC := native/nemo_report.cpp
 REPORT_LIB := native/build/libnemo_report.so
 
-.PHONY: all native test bench clean proto
+.PHONY: all native test bench clean reset proto
 
 all: native
 
@@ -28,6 +28,12 @@ bench:
 proto:
 	protoc --python_out=nemo_tpu/service proto/nemo_service.proto
 
-clean:
-	rm -rf native/build results .pytest_cache
+# Wipe generated reports.  (The reference's `make reset`, Makefile:9-14,
+# also tears down its Neo4j container and tmp/ volume; this repo runs no
+# container — external Neo4j lifecycle is the operator's.)
+reset:
+	rm -rf results
+
+clean: reset
+	rm -rf native/build .pytest_cache
 	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -153,10 +153,15 @@ def parse_dot(text: str) -> DotGraph:
             j += 1  # consume ]
         return attrs, j
 
+    depth = 1  # the graph's own brace, consumed above
     while i < len(tokens):
         tok = tokens[i]
         if tok == "}":
-            break
+            depth -= 1
+            if depth <= 0:
+                break
+            i += 1  # closing a flattened subgraph
+            continue
         if tok == ";":
             i += 1
             continue
@@ -165,21 +170,45 @@ def parse_dot(text: str) -> DotGraph:
             if tok.lower() == "graph":
                 g.graph_attrs.update(attrs)
             continue  # default node/edge attrs are not tracked
-        if tok.lower() == "subgraph" or tok == "{":
-            i += 1  # flatten subgraph contents
+        if tok.lower() == "subgraph":
+            # Flatten subgraph contents: skip the optional name and the
+            # opening brace; the statements inside parse as usual.
+            i += 1
+            if i < len(tokens) and tokens[i] != "{":
+                i += 1
+            if i < len(tokens) and tokens[i] == "{":
+                i += 1
+                depth += 1
+            continue
+        if tok == "{":
+            i += 1  # anonymous subgraph
+            depth += 1
             continue
         name = _unquote(tok)
         if i + 1 < len(tokens) and tokens[i + 1] == "=":
-            g.graph_attrs[name] = _unquote(tokens[i + 2])
+            # Bare `name = value` sets graph attributes — but only at the
+            # top level; a flattened cluster's label/style must not clobber
+            # the enclosing graph's.
+            if depth == 1:
+                g.graph_attrs[name] = _unquote(tokens[i + 2])
             i += 3
             continue
         if i + 1 < len(tokens) and tokens[i + 1] == "->":
             chain = [name]
             j = i + 1
             while j < len(tokens) and tokens[j] == "->":
+                if j + 1 < len(tokens) and tokens[j + 1] == "{":
+                    # Subgraph edge endpoint (`a -> { b c }`): the grouped
+                    # edges are dropped (unused by our inputs) but the
+                    # braced statements still parse as usual — consume the
+                    # dangling arrow and stop the chain.
+                    j += 1
+                    break
                 chain.append(_unquote(tokens[j + 1]))
                 j += 2
             attrs, j = parse_attr_list(j)
+            for n in chain:  # declare even when the chain has no edges left
+                g.add_node(n)
             for a, b in zip(chain, chain[1:]):
                 g.add_edge(a, b, dict(attrs))
             i = j

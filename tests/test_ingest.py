@@ -75,3 +75,49 @@ def test_edge_endpoint_resolution(corpus_dir):
             goal_ids = {g.id for g in prov.goals}
             for e in prov.edges:
                 assert (e.src in goal_ids) != (e.dst in goal_ids)
+
+
+def test_parse_dot_robustness():
+    """The hazard path must survive the DOT dialect variance Molly-style
+    tools emit: strict digraphs, subgraphs/clusters, default-attr statements,
+    edge chains, comments, quoted names with escapes."""
+    from nemo_tpu.report.dot import parse_dot
+
+    text = r'''
+    strict digraph "space time" { // top comment
+      graph [ rankdir=LR, label="st" ];
+      node [ shape=ellipse ];  /* default attrs are skipped */
+      edge [ color=black ];
+      subgraph cluster_a {
+        "a_1" [ label="a@1" ];
+        "a_2";
+      }
+      "a_1" -> "a_2" -> "b_2" [ style=dashed ];
+      "quo\"ted" [ label="x" ];
+      rankdir=TB;
+      # trailing comment
+    }
+    '''
+    g = parse_dot(text)
+    names = {n.name for n in g.nodes}
+    assert {"a_1", "a_2", "b_2", 'quo"ted'} <= names
+    assert g.graph_attrs["rankdir"] == "TB"  # later statement wins
+    chain = [(e.src, e.dst) for e in g.edges]
+    assert ("a_1", "a_2") in chain and ("a_2", "b_2") in chain
+    assert all(e.attrs.get("style") == "dashed" for e in g.edges)
+
+
+def test_parse_dot_cluster_attrs_and_subgraph_endpoints():
+    """Cluster-local attributes must not clobber graph attrs; subgraph edge
+    endpoints must not truncate the parse."""
+    from nemo_tpu.report.dot import parse_dot
+
+    g = parse_dot(
+        'digraph { label="top"; subgraph cluster_a { label="inner"; n1; } '
+        "a -> { b }; c [x=y]; d -> e }"
+    )
+    assert g.graph_attrs["label"] == "top"
+    names = {n.name for n in g.nodes}
+    assert {"n1", "a", "b", "c", "d", "e"} <= names
+    assert "{" not in names
+    assert ("d", "e") in [(e.src, e.dst) for e in g.edges]
