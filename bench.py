@@ -14,7 +14,9 @@ strictly faster than Neo4j since it skips all Bolt round-trips).
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 Env knobs: NEMO_BENCH_RUNS (total runs across families, default 10200),
 NEMO_BENCH_BASE_RUNS (distinct runs per family, default 32),
-NEMO_BENCH_PLATFORM (force a jax platform, e.g. cpu).
+NEMO_BENCH_PLATFORM (force a jax platform, e.g. cpu),
+NEMO_BENCH_FAMILY (restrict to one case-study family — BASELINE.md's
+single-protocol benchmark configs 1-4; default: all six).
 """
 
 from __future__ import annotations
@@ -51,7 +53,15 @@ def main() -> None:
 
     n_total = int(os.environ.get("NEMO_BENCH_RUNS", "10200"))
     base_runs = int(os.environ.get("NEMO_BENCH_BASE_RUNS", "32"))
-    per_family = max(base_runs, (n_total + len(CASE_STUDIES) - 1) // len(CASE_STUDIES))
+    only_family = os.environ.get("NEMO_BENCH_FAMILY", "")
+    families = sorted(CASE_STUDIES)
+    if only_family:
+        if only_family not in CASE_STUDIES:
+            raise SystemExit(
+                f"NEMO_BENCH_FAMILY {only_family!r} unknown; choose from {families}"
+            )
+        families = [only_family]
+    per_family = max(base_runs, (n_total + len(families) - 1) // len(families))
     log(f"device: {jax.devices()[0].platform} x{len(jax.devices())}")
 
     def tile(arrays: BatchArrays, reps: int) -> BatchArrays:
@@ -67,7 +77,7 @@ def main() -> None:
     mollys = []
     total_runs = 0
     with tempfile.TemporaryDirectory() as tmp:
-        for name in sorted(CASE_STUDIES):
+        for name in families:
             corpus = write_case_study(name, n_runs=base_runs, seed=11, out_dir=tmp)
             molly = load_molly_output(corpus)
             mollys.append(molly)

@@ -165,9 +165,16 @@ def parse_dot(text: str) -> DotGraph:
         if tok == ";":
             i += 1
             continue
+        if tok == "->":
+            # Stray arrow (e.g. the continuation of `a -> { b } -> c` after
+            # the flattened subgraph closed): never a node name.
+            i += 1
+            continue
         if tok.lower() in ("graph", "node", "edge") and i + 1 < len(tokens) and tokens[i + 1] == "[":
             attrs, i = parse_attr_list(i + 1)
-            if tok.lower() == "graph":
+            if tok.lower() == "graph" and depth == 1:
+                # Top level only: a cluster's graph [label=...] must not
+                # clobber the enclosing graph's attributes.
                 g.graph_attrs.update(attrs)
             continue  # default node/edge attrs are not tracked
         if tok.lower() == "subgraph":

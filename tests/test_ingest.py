@@ -121,3 +121,17 @@ def test_parse_dot_cluster_attrs_and_subgraph_endpoints():
     assert {"n1", "a", "b", "c", "d", "e"} <= names
     assert "{" not in names
     assert ("d", "e") in [(e.src, e.dst) for e in g.edges]
+
+
+def test_parse_dot_graph_bracket_attrs_and_chain_after_subgraph():
+    from nemo_tpu.report.dot import parse_dot
+
+    g = parse_dot(
+        'digraph { graph [label="top"]; '
+        'subgraph cluster_a { graph [label="inner"]; n1 } '
+        "a -> { b } -> c }"
+    )
+    assert g.graph_attrs["label"] == "top"
+    names = {n.name for n in g.nodes}
+    assert {"n1", "a", "b", "c"} <= names
+    assert "->" not in names and "{" not in names
