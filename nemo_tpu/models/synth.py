@@ -171,20 +171,33 @@ def _build_post_prov(
     return b.build()
 
 
-def _build_spacetime_dot(nodes: list[str], eot: int, messages: list[dict[str, Any]]) -> str:
+def build_spacetime_dot(
+    nodes: list[str],
+    eot: int,
+    messages: list[dict[str, Any]],
+    crashes: dict[str, int] | None = None,
+) -> str:
     """Space-time DOT diagram in the shape hazard analysis parses: node names
-    end in _<timestep> (reference: graphing/hazard-analysis.go:48-54)."""
+    end in _<timestep> (reference: graphing/hazard-analysis.go:48-54).  A
+    crashed process's clock edges stop at its crash time.  Shared by the
+    synthetic generators and the mini-Dedalus fault injector."""
+    crashes = crashes or {}
     lines = ["digraph spacetime {"]
     for n in nodes:
+        last = crashes.get(n, eot)
         for t in range(1, eot + 1):
-            lines.append(f'\t"{n}_{t}" [label="{n}@{t}"];')
-        for t in range(1, eot):
+            label = f"{n}@{t}" + (" CRASHED" if n in crashes and t >= last else "")
+            lines.append(f'\t"{n}_{t}" [label="{label}"];')
+        for t in range(1, min(last, eot)):
             lines.append(f'\t"{n}_{t}" -> "{n}_{t + 1}";')
     for m in messages:
         if m["sendTime"] < eot:
             lines.append(f'\t"{m["from"]}_{m["sendTime"]}" -> "{m["to"]}_{m["receiveTime"]}";')
     lines.append("}")
     return "\n".join(lines)
+
+
+_build_spacetime_dot = build_spacetime_dot  # module-internal callers
 
 
 @dataclass

@@ -1,0 +1,242 @@
+"""Mini-Dedalus: parser, evaluator semantics, fault injection, and the full
+spec -> fault injector -> Molly output -> debug pipeline chain."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from nemo_tpu.dedalus.ast import ASYNC, NEXT
+from nemo_tpu.dedalus.eval import EvalError, Evaluator, stratify
+from nemo_tpu.dedalus.faults import FaultSpec, enumerate_runs, write_molly_output
+from nemo_tpu.dedalus.parser import DedalusSyntaxError, load_program, parse_program
+from nemo_tpu.dedalus.registry import BUNDLED_SPECS, bundled_spec_path
+
+
+def facts_at(result, rel, t):
+    return result.derived[t].facts(rel)
+
+
+# ------------------------------------------------------------------ parser
+
+
+def test_parser_shapes():
+    prog = parse_program(
+        """
+        // facts and every rule kind
+        edge("a", "b")@1;
+        reach(X, Y) :- edge(X, Y);
+        reach(X, Y)@next :- reach(X, Y);
+        ping(Y, X)@async :- edge(X, Y), notin down(Y, Y), X != Y;
+        cnt(X, count<Y>) :- edge(X, Y);
+        tick(X, C+1)@next :- tick(X, C), C < 5;
+        """
+    )
+    assert len(prog.facts) == 1 and prog.facts[0].time == 1
+    kinds = [r.kind for r in prog.rules]
+    assert kinds == ["", NEXT, ASYNC, "", NEXT]
+    ping = prog.rules[2]
+    assert ping.negated[0].rel == "down"
+    assert ping.comparisons[0].op == "!="
+    assert prog.rules[3].is_aggregating
+    assert prog.rules[4].head.args[1].kind == "arith"
+
+
+def test_parser_errors():
+    with pytest.raises(DedalusSyntaxError):
+        parse_program('p(X) :- q(X)')  # missing semicolon
+    with pytest.raises(DedalusSyntaxError):
+        parse_program('p(X)@7 ;')  # fact with a variable
+
+
+# --------------------------------------------------------------- evaluator
+
+
+def test_deduction_and_induction():
+    prog = parse_program(
+        """
+        a("n", "x")@1;
+        b(N, X) :- a(N, X);
+        b(N, X)@next :- b(N, X);
+        """
+    )
+    res = Evaluator(prog, eot=3).run()
+    assert facts_at(res, "b", 1) == [("n", "x")]
+    assert facts_at(res, "b", 3) == [("n", "x")]
+    assert facts_at(res, "a", 2) == []  # not persisted
+
+
+def test_async_delivers_next_step_and_omission_drops():
+    prog = parse_program(
+        """
+        src("s", "m")@1;
+        dst("s", "d")@1;
+        msg(D, M)@async :- src(S, M), dst(S, D);
+        """
+    )
+    res = Evaluator(prog, eot=3).run()
+    assert facts_at(res, "msg", 2) == [("d", "m")]
+    dropped = Evaluator(prog, eot=3, omissions={("s", "d", 1)}).run()
+    assert facts_at(dropped, "msg", 2) == []
+    assert [m.delivered for m in dropped.messages] == [False]
+
+
+def test_crash_stops_sending_receiving_and_next():
+    prog = parse_program(
+        """
+        st("n", "v")@1;
+        st(N, V)@next :- st(N, V);
+        out("n", "peer")@1;
+        out(N, P)@next :- out(N, P);
+        ship(P, V)@async :- st(N, V), out(N, P);
+        """
+    )
+    res = Evaluator(prog, eot=4, crashes={"n": 3}).run()
+    assert facts_at(res, "st", 2) == [("n", "v")]
+    assert facts_at(res, "st", 3) == []  # @next state stops at the crash
+    # Messages sent before the crash deliver; at/after it they are dropped.
+    assert [(m.send_time, m.delivered) for m in res.messages] == [(1, True), (2, True)]
+    # crash(n, n, 3) is visible at every timestep for notin crash(...) guards.
+    assert ("n", "n", "3") in res.derived[1].by_rel["crash"]
+
+
+def test_negation_stratified_and_cycle_rejected():
+    prog = parse_program(
+        """
+        base("n", "x")@1;
+        holds(N, X) :- base(N, X);
+        gap(N, X) :- base(N, X), notin holds(N, X);
+        """
+    )
+    res = Evaluator(prog, eot=1).run()
+    assert facts_at(res, "gap", 1) == []
+    bad = parse_program(
+        """
+        p(X) :- q(X), notin r(X);
+        r(X) :- q(X), notin p(X);
+        """
+    )
+    with pytest.raises(EvalError):
+        stratify(bad.rules)
+
+
+def test_count_aggregation_and_comparisons():
+    prog = parse_program(
+        """
+        vote("ld", "f1")@1;
+        vote("ld", "f2")@1;
+        tally(L, count<F>) :- vote(L, F);
+        quorum(L, L) :- tally(L, N), N >= 2;
+        """
+    )
+    res = Evaluator(prog, eot=1).run()
+    assert facts_at(res, "tally", 1) == [("ld", "2")]
+    assert facts_at(res, "quorum", 1) == [("ld", "ld")]
+
+
+def test_arithmetic_timer_chain():
+    prog = parse_program(
+        """
+        tick("n", 0)@1;
+        tick(N, C+1)@next :- tick(N, C);
+        fired(N, N) :- tick(N, C), C > 2;
+        """
+    )
+    res = Evaluator(prog, eot=5).run()
+    assert facts_at(res, "fired", 3) == []
+    assert facts_at(res, "fired", 4) == [("n", "n")]
+
+
+def test_provenance_structure():
+    """Goal->rule->goal alternation, async rules carry clock goals with the
+    loader's label format (faultinjectors/molly.go:76-89)."""
+    prog = parse_program(
+        """
+        src("s", "m")@1;
+        dst("s", "d")@1;
+        msg(D, M)@async :- src(S, M), dst(S, D);
+        got(D, M) :- msg(D, M);
+        """
+    )
+    res = Evaluator(prog, eot=2).run()
+    prov = res.prov
+    goals = {g["id"]: g for g in prov.goals}
+    clock_labels = {g["label"] for g in prov.goals if g["table"] == "clock"}
+    assert "clock(s, d, 1, __WILDCARD__)" in clock_labels  # the async hop
+    for src_id, dst_id in prov.edges:
+        src_is_goal = src_id in goals
+        assert src_is_goal != (dst_id in goals), "edges must alternate goal/rule"
+
+
+# ---------------------------------------------------- fault space + output
+
+
+@pytest.mark.parametrize("name", sorted(BUNDLED_SPECS))
+def test_bundled_spec_fault_space(name):
+    prog = load_program(bundled_spec_path(name))
+    runs = enumerate_runs(prog, BUNDLED_SPECS[name])
+    # Run 0 is the failure-free run and achieves the antecedent.
+    assert runs[0].result.status == "success" and runs[0].result.pre_rows
+    # Every family's fault space surfaces at least one violation.
+    assert any(r.result.status == "fail" for r in runs), name
+    # Statuses are sound: fail iff pre holds without post at EOT.
+    for r in runs:
+        eot = BUNDLED_SPECS[name].eot
+        final_pre = {tuple(row[:-1]) for row in r.result.pre_rows if row[-1] == str(eot)}
+        final_post = {tuple(row[:-1]) for row in r.result.post_rows if row[-1] == str(eot)}
+        assert (r.result.status == "fail") == bool(final_pre - final_post)
+
+
+def test_molly_output_feeds_pipeline(tmp_path):
+    """spec -> fault injector -> Molly dir -> ingest -> full debug report,
+    identical across the oracle and JAX backends."""
+    from nemo_tpu.analysis.pipeline import run_debug
+    from nemo_tpu.backend.jax_backend import JaxBackend
+    from nemo_tpu.backend.python_ref import PythonBackend
+
+    prog = load_program(bundled_spec_path("pb_asynchronous"))
+    corpus = write_molly_output(
+        prog, BUNDLED_SPECS["pb_asynchronous"], str(tmp_path), "pb_dedalus"
+    )
+    py = run_debug(corpus, str(tmp_path / "py"), PythonBackend())
+    jx = run_debug(corpus, str(tmp_path / "jax"), JaxBackend())
+    with open(f"{py.report_dir}/debugging.json") as f1, open(
+        f"{jx.report_dir}/debugging.json"
+    ) as f2:
+        want, got = json.load(f1), json.load(f2)
+    assert got == want
+    statuses = [r["status"] for r in want]
+    assert statuses[0] == "success" and "fail" in statuses
+    # The failed run got the fault recommendation and diff-based missing events.
+    failed = next(r for r in want if r["status"] != "success")
+    assert want[0]["recommendation"][0].startswith("A fault occurred")
+    assert failed.get("missingEvents")
+
+
+def test_cli_entrypoint(tmp_path):
+    from nemo_tpu.dedalus.__main__ import main
+
+    rc = main(["-spec", "zk_1270_racing_flag", "-o", str(tmp_path)])
+    assert rc == 0
+    runs = json.load(open(tmp_path / "zk_1270_racing_flag" / "runs.json"))
+    assert runs and runs[0]["status"] == "success"
+    assert (tmp_path / "zk_1270_racing_flag" / "run_0_spacetime.dot").exists()
+
+
+def test_async_body_colocation_enforced():
+    prog = parse_program(
+        """
+        cfg("d", "s")@1;
+        src("s", "m")@1;
+        msg(D, M)@async :- cfg(D, S), src(S, M);
+        """
+    )
+    with pytest.raises(EvalError, match="co-located"):
+        Evaluator(prog, eot=2).run()
+
+
+def test_fact_before_time_one_rejected():
+    prog = parse_program('x("n", "v")@0;')
+    with pytest.raises(EvalError, match="time starts at 1"):
+        Evaluator(prog, eot=2).run()
