@@ -11,9 +11,10 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 from dataclasses import dataclass
 
-from nemo_tpu.backend.base import GraphBackend
+from nemo_tpu.backend.base import GraphBackend, NoSuccessfulRunError
 from nemo_tpu.ingest.molly import MollyOutput, load_molly_output
 from nemo_tpu.report.writer import Reporter
 from nemo_tpu.utils.timing import PhaseTimer
@@ -89,13 +90,32 @@ def run_debug(
                 pre_dots, post_dots, pre_clean_dots, post_clean_dots = (
                     backend.pull_pre_post_prov()
                 )
-            with timer.phase("diff_prov"):
-                diff_dots, failed_dots, missing_events = backend.create_naive_diff_prov(
-                    False, failed_iters, post_dots[0]
-                )
-
-            corrections: list[str] = []
+            # Differential provenance and corrections diff failed runs against
+            # a baseline good run.  The reference hard-codes run 0 and
+            # silently emits nonsense when run 0 failed
+            # (differential-provenance.go:22); here the backend's good-run
+            # policy (base.py:good_run_iter) decides, and on an all-failed
+            # corpus both phases are skipped with a warning instead of
+            # raising.
+            good_iter: int | None = None
             if failed_iters:
+                try:
+                    good_iter = backend.good_run_iter()
+                except NoSuccessfulRunError:
+                    print(
+                        "warning: no successful run in corpus; skipping "
+                        "differential provenance and correction synthesis "
+                        "(nothing to diff against)",
+                        file=sys.stderr,
+                    )
+            diff_dots, failed_dots = [], []
+            missing_events: list[list] = [[] for _ in failed_iters]
+            corrections: list[str] = []
+            if good_iter is not None:
+                with timer.phase("diff_prov"):
+                    diff_dots, failed_dots, missing_events = backend.create_naive_diff_prov(
+                        False, failed_iters, post_dots[iters.index(good_iter)]
+                    )
                 with timer.phase("corrections"):
                     corrections = backend.generate_corrections()
             with timer.phase("extensions"):
@@ -113,6 +133,10 @@ def run_debug(
         run = by_iter[i]
         if corrections:
             run.recommendation = [REC_FAULT, *corrections]
+        elif failed_iters and good_iter is None:
+            # Failures exist but there was no good run to synthesize
+            # corrections from; "well done" / "no violation" would be a lie.
+            run.recommendation = [REC_CANT_HELP]
         elif extensions:
             run.recommendation = [REC_EXTEND, *extensions]
         elif not all_achieved_pre:
@@ -143,7 +167,8 @@ def run_debug(
         reporter.generate_figures(iters, "post_prov", post_dots)
         reporter.generate_figures(iters, "pre_prov_clean", pre_clean_dots)
         reporter.generate_figures(iters, "post_prov_clean", post_clean_dots)
-        reporter.generate_figures(failed_iters, "diff_post_prov-diff", diff_dots)
-        reporter.generate_figures(failed_iters, "diff_post_prov-failed", failed_dots)
+        diff_fig_iters = failed_iters if diff_dots else []
+        reporter.generate_figures(diff_fig_iters, "diff_post_prov-diff", diff_dots)
+        reporter.generate_figures(diff_fig_iters, "diff_post_prov-failed", failed_dots)
 
     return DebugResult(molly=molly, report_dir=this_results_dir, timings=timer.as_dict())

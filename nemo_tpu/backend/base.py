@@ -28,8 +28,51 @@ from nemo_tpu.ingest.molly import MollyOutput
 from nemo_tpu.report.dot import DotGraph
 
 
+class NoSuccessfulRunError(RuntimeError):
+    """Raised when an analysis that needs a baseline "good" run (differential
+    provenance, trigger queries) runs on a corpus where no run succeeded.
+    The reference hard-codes run 0 as the good run
+    (differential-provenance.go:22-26, corrections.go:210-216) and silently
+    produces a nonsense diff when run 0 failed; the rebuild raises instead."""
+
+
 class GraphBackend(abc.ABC):
     """Interface over the graph analytics engine (reference: main.go:33-44)."""
+
+    def good_run_iter(self) -> int:
+        """Iteration of the baseline successful run used for differential
+        provenance and the trigger queries.  The first successful run that
+        actually ACHIEVED the consequent — Molly marks vacuous runs (the
+        antecedent never held, so the invariant holds trivially) status
+        "success" too, and a vacuous baseline would make every diff silently
+        near-empty.  Identical to the reference's hard-coded run 0
+        (differential-provenance.go:22, corrections.go:210) in the normal
+        Molly layout where run 0 is the failure-free execution.  Falls back
+        to the first status-success run when no success achieved the
+        consequent; raises NoSuccessfulRunError when no run succeeded."""
+        assert self.molly is not None
+        succ = self.molly.get_success_runs_iters()
+        if not succ:
+            raise NoSuccessfulRunError(
+                "no successful run in this corpus: differential provenance "
+                "and correction synthesis need a good run to diff against"
+            )
+        by_iter = {r.iteration: r for r in self.molly.runs}
+        for i in succ:
+            if by_iter[i].time_post_holds:
+                return i
+        return succ[0]
+
+    def baseline_run_iter(self) -> int:
+        """The good run when one exists, else the first run.  Used where a
+        representative provenance graph is enough (extension candidates read
+        the antecedent provenance's async boundary, which failed runs have
+        too — extensions.go:63-67 uses run 0 unconditionally)."""
+        try:
+            return self.good_run_iter()
+        except NoSuccessfulRunError:
+            assert self.molly is not None
+            return self.molly.runs[0].iteration
 
     @abc.abstractmethod
     def init_graph_db(self, conn: str, molly: MollyOutput) -> None:

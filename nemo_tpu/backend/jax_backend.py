@@ -322,10 +322,13 @@ class JaxBackend(GraphBackend):
         self, symmetric: bool, failed_iters: list[int], success_post_dot: DotGraph
     ) -> tuple[list[DotGraph], list[DotGraph], list[list[MissingEvent]]]:
         assert self.molly is not None
-        good = self.packed[(0, "post")]
+        if not failed_iters:
+            return [], [], []
+        g = self.good_run_iter()
+        good = self.packed[(g, "post")]
         num_labels = max(1, len(self.vocab.labels))
         # Pad the single good graph to its own bucket.
-        gb = pack_batch([0], [good])
+        gb = pack_batch([g], [good])
 
         bits = np.zeros((max(1, len(failed_iters)), num_labels), dtype=bool)
         for j, f in enumerate(failed_iters):
@@ -358,7 +361,7 @@ class JaxBackend(GraphBackend):
             prefix = f"run_{DIFF_OFFSET + f}_post_"
             holds = np.zeros(gb.v, dtype=bool)
             n = good.n_nodes
-            holds[:n] = self.cond_holds[(0, "post")]
+            holds[:n] = self.cond_holds[(g, "post")]
             diff_graph = unpack_to_pgraph(
                 gb,
                 0,
@@ -371,7 +374,7 @@ class JaxBackend(GraphBackend):
             )
             missing = self._missing_events(gb, frontier_rule[j], missing_goal[j], edge_keep[j], prefix, holds)
             diff_dot, failed_dot = create_diff_dot(
-                DIFF_OFFSET + f, diff_graph, self.raw[(f, "post")], 0, success_post_dot, missing
+                DIFF_OFFSET + f, diff_graph, self.raw[(f, "post")], g, success_post_dot, missing
             )
             diff_dots.append(diff_dot)
             failed_dots.append(failed_dot)
@@ -419,8 +422,9 @@ class JaxBackend(GraphBackend):
     # ------------------------------------------------------------ corrections
 
     def generate_corrections(self) -> list[str]:
+        g = self.good_run_iter()
         return synthesize_corrections(
-            find_pre_triggers(self.raw[(0, "pre")]), find_post_triggers(self.raw[(0, "post")])
+            find_pre_triggers(self.raw[(g, "pre")]), find_post_triggers(self.raw[(g, "post")])
         )
 
     # ------------------------------------------------------------- extensions
@@ -436,4 +440,6 @@ class JaxBackend(GraphBackend):
         all_achieved = achieved >= len(self.molly.runs)
         if all_achieved:
             return True, []
-        return False, synthesize_extensions(extension_candidates(self.raw[(0, "pre")]))
+        return False, synthesize_extensions(
+            extension_candidates(self.raw[(self.baseline_run_iter(), "pre")])
+        )

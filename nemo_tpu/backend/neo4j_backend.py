@@ -403,9 +403,13 @@ class Neo4jBackend(GraphBackend):
         shared longest-path pass."""
         from nemo_tpu.backend.python_ref import PythonBackend
 
+        if not failed_iters:
+            return [], [], []
+        g = self.good_run_iter()
         helper = PythonBackend()
+        helper.molly = self.molly
         helper.graphs = {
-            (0, "post"): self._pull_graph(0, "post"),
+            (g, "post"): self._pull_graph(g, "post"),
         }
         diff_dots, failed_dots, missing_events = [], [], []
         for f in failed_iters:
@@ -417,7 +421,7 @@ class Neo4jBackend(GraphBackend):
                 DIFF_OFFSET + f,
                 diff,
                 helper.graphs[(f, "post")],
-                0,
+                g,
                 success_post_dot,
                 missing,
             )
@@ -429,8 +433,9 @@ class Neo4jBackend(GraphBackend):
     # ------------------------------------------------------- corrections etc.
 
     def generate_corrections(self) -> list[str]:
-        pre_triggers = find_pre_triggers(self._pull_graph(0, "pre"))
-        post_triggers = find_post_triggers(self._pull_graph(0, "post"))
+        g = self.good_run_iter()
+        pre_triggers = find_pre_triggers(self._pull_graph(g, "pre"))
+        post_triggers = find_post_triggers(self._pull_graph(g, "post"))
         return synthesize_corrections(pre_triggers, post_triggers)
 
     def generate_extensions(self) -> tuple[bool, list[str]]:
@@ -439,5 +444,5 @@ class Neo4jBackend(GraphBackend):
         all_achieved = achieved >= len(self.molly.runs)
         if all_achieved:
             return True, []
-        candidates = extension_candidates(self._pull_graph(0, "pre"))
+        candidates = extension_candidates(self._pull_graph(self.baseline_run_iter(), "pre"))
         return False, synthesize_extensions(candidates)

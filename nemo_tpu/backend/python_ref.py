@@ -308,14 +308,15 @@ class PythonBackend(GraphBackend):
 
     def diff_graph(self, failed_iter: int) -> PGraph:
         """Good-minus-bad subgraph for one failed run (see base.py spec)."""
-        good = self.graphs[(0, "post")]
+        g = self.good_run_iter()
+        good = self.graphs[(g, "post")]
         bad = self.graphs[(failed_iter, "post")]
         fail_labels = {n.label for n in bad.goals()}
         ok_goals = [n.id for n in good.goals() if n.label not in fail_labels]
         fwd = good.reachable_from(ok_goals)  # >=0 hops from an ok goal
         bwd = good.coreachable_to(ok_goals)  # >=0 hops to an ok goal
 
-        old_prefix = "run_0_"
+        old_prefix = f"run_{g}_"
         new_prefix = f"run_{DIFF_OFFSET + failed_iter}_"
 
         def rename(nid: str) -> str:
@@ -407,13 +408,16 @@ class PythonBackend(GraphBackend):
     def create_naive_diff_prov(
         self, symmetric: bool, failed_iters: list[int], success_post_dot: DotGraph
     ) -> tuple[list[DotGraph], list[DotGraph], list[list[MissingEvent]]]:
+        if not failed_iters:
+            return [], [], []
         diff_dots, failed_dots, missing_events = [], [], []
+        good_iter = self.good_run_iter()
         for f in failed_iters:
             diff = self.diff_graph(f)
             self.graphs[(DIFF_OFFSET + f, "post")] = diff
             missing = self._diff_missing(diff)
             diff_dot, failed_dot = create_diff_dot(
-                DIFF_OFFSET + f, diff, self.graphs[(f, "post")], 0, success_post_dot, missing
+                DIFF_OFFSET + f, diff, self.graphs[(f, "post")], good_iter, success_post_dot, missing
             )
             diff_dots.append(diff_dot)
             failed_dots.append(failed_dot)
@@ -429,7 +433,8 @@ class PythonBackend(GraphBackend):
         return find_post_triggers(self.graphs[(run, "post")])
 
     def generate_corrections(self) -> list[str]:
-        return synthesize_corrections(self.find_pre_triggers(0), self.find_post_triggers(0))
+        g = self.good_run_iter()
+        return synthesize_corrections(self.find_pre_triggers(g), self.find_post_triggers(g))
 
     # ------------------------------------------------------------- extensions
 
@@ -446,5 +451,5 @@ class PythonBackend(GraphBackend):
         all_achieved = achieved >= len(self.molly.runs)
         if all_achieved:
             return True, []
-        candidates = extension_candidates(self.graphs[(0, "pre")])
+        candidates = extension_candidates(self.graphs[(self.baseline_run_iter(), "pre")])
         return False, synthesize_extensions(candidates)

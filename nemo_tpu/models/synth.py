@@ -215,14 +215,19 @@ class SynthSpec:
     # A total replication failure: every replicate message lost, so the failed
     # run's consequent provenance is empty and whole rule tables go missing.
     fail_all_fraction: float = 0.15
+    # Kind forced on run 0.  Molly puts the failure-free execution first, and
+    # the reference relies on that (differential-provenance.go:22); set to
+    # "fail" to exercise the rebuild's good-run selection guard.
+    first_run_kind: str = "success"
 
 
 def generate_corpus(spec: SynthSpec) -> dict[str, Any]:
     """Generate an in-memory corpus: file name -> JSON-serializable content.
 
-    Run 0 always succeeds with full replication — the reference assumes the
-    first run is the successful one everywhere it hardcodes run 0
+    By default run 0 succeeds with full replication — the reference assumes
+    the first run is the successful one everywhere it hardcodes run 0
     (e.g. graphing/corrections.go:210-216, differential-provenance.go:26).
+    Override with spec.first_run_kind to test that assumption's guard.
     """
     rng = random.Random(spec.seed)
     client, primary = "C", "a"
@@ -235,7 +240,7 @@ def generate_corpus(spec: SynthSpec) -> dict[str, Any]:
 
     for i in range(spec.n_runs):
         if i == 0:
-            kind = "success"
+            kind = spec.first_run_kind
         else:
             u = rng.random()
             if u < spec.fail_fraction:

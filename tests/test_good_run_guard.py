@@ -1,0 +1,154 @@
+"""Good-run selection guard (VERDICT r1 item 8).
+
+The reference diffs every failed run against run 0's consequent provenance
+unconditionally (differential-provenance.go:22-26) and reads run 0's trigger
+boundaries for corrections (corrections.go:210-216); when run 0 itself failed
+the output is silently nonsense.  The rebuild selects the first SUCCESSFUL
+run — identical in the normal Molly layout — and raises / skips cleanly on an
+all-failed corpus.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from nemo_tpu.backend.base import NoSuccessfulRunError
+from nemo_tpu.backend.jax_backend import JaxBackend
+from nemo_tpu.backend.python_ref import PythonBackend
+from nemo_tpu.ingest.molly import load_molly_output
+from nemo_tpu.models.synth import SynthSpec, write_corpus
+
+
+@pytest.fixture(scope="module")
+def failed_first_corpus(tmp_path_factory) -> str:
+    """Corpus whose run 0 FAILED; later runs include successes."""
+    root = tmp_path_factory.mktemp("molly_failed_first")
+    return write_corpus(
+        SynthSpec(n_runs=6, seed=5, eot=6, first_run_kind="fail"), str(root)
+    )
+
+
+@pytest.fixture(scope="module")
+def all_failed_corpus(tmp_path_factory) -> str:
+    root = tmp_path_factory.mktemp("molly_all_failed")
+    return write_corpus(
+        SynthSpec(
+            n_runs=3,
+            seed=7,
+            eot=6,
+            first_run_kind="fail",
+            fail_fraction=1.0,
+            vacuous_fraction=0.0,
+            fail_all_fraction=0.0,
+        ),
+        str(root),
+    )
+
+
+def _run_backend(backend, molly):
+    backend.init_graph_db("", molly)
+    backend.load_raw_provenance()
+    backend.simplify_prov(molly.get_runs_iters())
+    return backend
+
+
+def test_good_run_is_first_success(failed_first_corpus):
+    molly = load_molly_output(failed_first_corpus)
+    assert molly.runs[0].status != "success"
+    succ = molly.get_success_runs_iters()
+    assert succ, "fixture must contain a successful run"
+    b = _run_backend(PythonBackend(), molly)
+    assert b.good_run_iter() == succ[0] != 0
+
+
+def test_diff_uses_first_success_python(failed_first_corpus):
+    molly = load_molly_output(failed_first_corpus)
+    succ0 = molly.get_success_runs_iters()[0]
+    b = _run_backend(PythonBackend(), molly)
+    failed = molly.get_failed_runs_iters()
+    f = failed[0]
+    diff = b.diff_graph(f)
+    # The diff graph is carved out of the GOOD run's provenance: node ids are
+    # renamed from run_<succ0>_ to the shadow prefix, and the good run's
+    # labels minus the failed run's labels survive.
+    good_labels = {n.label for n in b.graphs[(succ0, "post")].goals()}
+    for node in diff.goals():
+        assert node.label in good_labels
+    # Diffing against the failed run 0 instead would keep nothing label-wise
+    # identical to run 0's own provenance.
+    assert all(nid.startswith(f"run_{2000 + f}_") for nid in diff.nodes)
+
+
+def test_python_jax_parity_with_failed_run0(failed_first_corpus):
+    """The batched kernels must make the same good-run choice as the oracle."""
+    molly = load_molly_output(failed_first_corpus)
+    failed = molly.get_failed_runs_iters()
+    py = _run_backend(PythonBackend(), molly)
+    jx = _run_backend(JaxBackend(), molly)
+    from nemo_tpu.report.figures import create_dot
+
+    succ0 = molly.get_success_runs_iters()[0]
+    good_dot = create_dot(py.graphs[(succ0, "post")], "post")
+    _, _, miss_py = py.create_naive_diff_prov(False, failed, good_dot)
+    _, _, miss_jx = jx.create_naive_diff_prov(False, failed, good_dot)
+    for mp, mj in zip(miss_py, miss_jx):
+        assert {m.rule.table for m in mp} == {m.rule.table for m in mj}
+        assert {g.label for m in mp for g in m.goals} == {
+            g.label for m in mj for g in m.goals
+        }
+    # Corrections read the good run's trigger boundaries without raising.
+    assert py.generate_corrections() == jx.generate_corrections()
+
+
+def test_all_failed_raises(all_failed_corpus):
+    molly = load_molly_output(all_failed_corpus)
+    assert not molly.get_success_runs_iters()
+    b = _run_backend(PythonBackend(), molly)
+    with pytest.raises(NoSuccessfulRunError):
+        b.good_run_iter()
+    with pytest.raises(NoSuccessfulRunError):
+        b.create_naive_diff_prov(False, molly.get_failed_runs_iters(), None)
+    # baseline_run_iter falls back to the first run for extension candidates.
+    assert b.baseline_run_iter() == molly.runs[0].iteration
+
+
+def test_vacuous_success_not_chosen_as_baseline(failed_first_corpus):
+    """Molly marks vacuous runs (antecedent never held) status 'success';
+    a vacuous baseline would make every diff silently near-empty, so
+    good_run_iter prefers a success that actually achieved the consequent."""
+    molly = load_molly_output(failed_first_corpus)
+    succ = molly.get_success_runs_iters()
+    assert len(succ) >= 2
+    by_iter = {r.iteration: r for r in molly.runs}
+    # Turn the first success vacuous in-place: empty holds maps.
+    by_iter[succ[0]].time_post_holds = {}
+    b = PythonBackend()
+    b.init_graph_db("", molly)
+    assert b.good_run_iter() == succ[1]
+    # If every success is vacuous, fall back to the first one.
+    for i in succ:
+        by_iter[i].time_post_holds = {}
+    assert b.good_run_iter() == succ[0]
+
+
+def test_pipeline_skips_diff_on_all_failed(all_failed_corpus, tmp_path):
+    """run_debug completes on an all-failed corpus: diff + corrections are
+    skipped with a warning, the report still materializes, and the
+    recommendation is 'can't help' — never 'well done'."""
+    import json
+    import os
+
+    from nemo_tpu.analysis.pipeline import REC_CANT_HELP, run_debug
+
+    res = run_debug(all_failed_corpus, str(tmp_path / "results"), PythonBackend())
+    dbg_path = os.path.join(res.report_dir, "debugging.json")
+    with open(dbg_path, "r", encoding="utf-8") as fh:
+        dbg = json.load(fh)
+    for run in dbg:
+        assert run["recommendation"] == [REC_CANT_HELP]
+    # No diff figures were produced.
+    figs = os.listdir(os.path.join(res.report_dir, "figures"))
+    assert not [f for f in figs if "diff_post_prov" in f]
+    # Every failed run still has spacetime + raw/clean provenance figures.
+    assert [f for f in figs if f.startswith("run_0_spacetime")]
