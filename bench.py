@@ -1,55 +1,174 @@
 """Benchmark: the north-star stress — the full 6-case-study corpus, >=10k
-fault-injection runs, through the fused TPU analysis pipeline.
+DISTINCT fault-injection runs, through the fused TPU analysis pipeline.
 
 For each of the six case-study protocol families (models/case_studies.py,
-mirroring reference case-studies/*.ded), a base corpus is generated and
-packed (natively when the C++ engine is available), tiled along the run axis
-to n_total/6 runs, and pushed through the fused analysis_step (condition
-marking + simplification + prototypes + differential provenance — the per-run
-Cypher pipeline of the reference, main.go:106-180).  The baseline is the
-sequential Python oracle backend running the same analyses — the stand-in for
-the reference's one-run-at-a-time Neo4j path (BASELINE.md; the oracle is
-strictly faster than Neo4j since it skips all Bolt round-trips).
+mirroring reference case-studies/*.ded), a corpus of distinct runs is
+generated and packed (natively when the C++ engine is available) and pushed
+through the fused analysis_step (condition marking + simplification +
+prototypes + differential provenance — the per-run Cypher pipeline of the
+reference, main.go:106-180).  The baseline is the sequential Python oracle
+backend running the same analyses — the stand-in for the reference's
+one-run-at-a-time Neo4j path (BASELINE.md; the oracle is strictly faster
+than Neo4j since it skips all Bolt round-trips).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-Env knobs: NEMO_BENCH_RUNS (total runs across families, default 10200),
-NEMO_BENCH_BASE_RUNS (distinct runs per family, default 32),
-NEMO_BENCH_PLATFORM (force a jax platform, e.g. cpu),
-NEMO_BENCH_FAMILY (restrict to one case-study family — BASELINE.md's
-single-protocol benchmark configs 1-4; default: all six).
+Outage-proofing (the TPU here rides a tunnel whose outages make
+jax.devices() HANG rather than error): bench.py is a PARENT process that
+(1) probes device availability in a subprocess under a watchdog with
+retries, and (2) runs the measurement itself in a child process under a
+timeout, falling back to CPU when the device platform is unreachable.  The
+parent ALWAYS prints exactly one JSON result line:
+{"metric", "value", "unit", "vs_baseline", ...extras} — with an "error"
+field instead of numbers only if every attempt (including the CPU fallback)
+failed.
+
+Env knobs:
+  NEMO_BENCH_RUNS          total distinct runs across families (default 10200)
+  NEMO_BENCH_BASE_RUNS     oracle-baseline runs per family (default 32)
+  NEMO_BENCH_PLATFORM      force a jax platform (skips the probe)
+  NEMO_BENCH_FAMILY        restrict to one case-study family
+  NEMO_BENCH_PROBE_TIMEOUT seconds per device probe attempt (default 120)
+  NEMO_BENCH_PROBE_RETRIES probe attempts before CPU fallback (default 3)
+  NEMO_BENCH_CHILD_TIMEOUT seconds for the measurement child (default 1800)
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import tempfile
 import time
 
-import numpy as np
+METRIC = "provenance-graphs/sec, full analysis pipeline, 6 case-study families"
 
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def main() -> None:
-    platform = os.environ.get("NEMO_BENCH_PLATFORM")
-    if platform:
-        os.environ["JAX_PLATFORMS"] = platform
+# --------------------------------------------------------------------- parent
+
+
+def probe_platform(timeout_s: float, retries: int) -> dict | None:
+    """Ask a subprocess what jax's default platform is.  In an axon-tunnel
+    outage jax.devices() hangs forever (observed in round 1), so the probe
+    gets a hard timeout and backoff retries."""
+    code = (
+        "import jax, json;"
+        "d = jax.devices();"
+        "print(json.dumps({'platform': d[0].platform, 'n': len(d)}))"
+    )
+    for attempt in range(retries):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True,
+                text=True,
+                timeout=timeout_s,
+            )
+            if out.returncode == 0 and out.stdout.strip():
+                try:
+                    return json.loads(out.stdout.strip().splitlines()[-1])
+                except json.JSONDecodeError:
+                    log(f"probe attempt {attempt + 1}/{retries}: unparseable stdout")
+                    continue
+            tail = (out.stderr or "").strip().splitlines()[-1:] or ["<no stderr>"]
+            log(f"probe attempt {attempt + 1}/{retries} rc={out.returncode}: {tail[0]}")
+        except subprocess.TimeoutExpired:
+            log(f"probe attempt {attempt + 1}/{retries} timed out after {timeout_s:.0f}s")
+        if attempt + 1 < retries:
+            time.sleep(min(30.0, 5.0 * 2**attempt))
+    return None
+
+
+def parent_main() -> None:
+    probe_timeout = float(os.environ.get("NEMO_BENCH_PROBE_TIMEOUT", "120"))
+    probe_retries = int(os.environ.get("NEMO_BENCH_PROBE_RETRIES", "3"))
+    child_timeout = float(os.environ.get("NEMO_BENCH_CHILD_TIMEOUT", "1800"))
+
+    forced = os.environ.get("NEMO_BENCH_PLATFORM")
+    attempts: list[tuple[str, str]] = []  # (platform, note)
+    if forced:
+        attempts.append((forced, ""))
+    else:
+        info = probe_platform(probe_timeout, probe_retries)
+        if info is not None:
+            log(f"device probe: {info['platform']} x{info['n']}")
+            attempts.append((info["platform"], ""))
+        else:
+            attempts.append(
+                ("cpu", "device platform unreachable (probe timed out); CPU fallback")
+            )
+    if attempts[-1][0] != "cpu":
+        attempts.append(("cpu", "device attempt failed mid-bench; CPU fallback"))
+
+    errors: list[str] = []
+    for platform, note in attempts:
+        env = dict(os.environ)
+        env["NEMO_BENCH_PLATFORM"] = platform
+        if note:
+            env["NEMO_BENCH_NOTE"] = note
+            log(f"note: {note}")
+        try:
+            # Child stderr is inherited so progress streams live; stdout is
+            # captured — its last line is the result JSON.
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--child"],
+                stdout=subprocess.PIPE,
+                text=True,
+                timeout=child_timeout,
+                env=env,
+            )
+        except subprocess.TimeoutExpired:
+            errors.append(f"{platform}: child timed out after {child_timeout:.0f}s")
+            log(errors[-1])
+            continue
+        lines = (out.stdout or "").strip().splitlines()
+        if out.returncode == 0 and lines:
+            try:
+                result = json.loads(lines[-1])
+            except json.JSONDecodeError:
+                errors.append(f"{platform}: child emitted unparseable result")
+                log(errors[-1])
+                continue
+            print(json.dumps(result))
+            return
+        errors.append(f"{platform}: child exited rc={out.returncode}")
+        log(errors[-1])
+
+    print(
+        json.dumps(
+            {
+                "metric": METRIC,
+                "value": None,
+                "unit": "graphs/s",
+                "vs_baseline": None,
+                "error": "; ".join(errors) or "no bench attempt ran",
+            }
+        )
+    )
+
+
+# ---------------------------------------------------------------------- child
+
+
+def child_main() -> None:
+    platform = os.environ["NEMO_BENCH_PLATFORM"]
+    os.environ["JAX_PLATFORMS"] = platform
     import jax
 
-    if platform:
-        jax.config.update("jax_platforms", platform)
+    # The axon sitecustomize force-sets jax_platforms at interpreter start,
+    # overriding the env var — set it back explicitly.
+    jax.config.update("jax_platforms", platform)
 
-    import jax.numpy as jnp
+    import numpy as np
 
     from nemo_tpu.backend.python_ref import PythonBackend
     from nemo_tpu.ingest.molly import load_molly_output
-    from nemo_tpu.ingest.native import pack_molly_dir
+    from nemo_tpu.ingest.native import native_available, pack_molly_dir
     from nemo_tpu.models.case_studies import CASE_STUDIES, write_case_study
-    from nemo_tpu.models.pipeline_model import BatchArrays, analysis_step
+    from nemo_tpu.models.pipeline_model import BatchArrays, analysis_step, pack_molly_for_step
 
     n_total = int(os.environ.get("NEMO_BENCH_RUNS", "10200"))
     base_runs = int(os.environ.get("NEMO_BENCH_BASE_RUNS", "32"))
@@ -64,55 +183,60 @@ def main() -> None:
     per_family = max(base_runs, (n_total + len(families) - 1) // len(families))
     log(f"device: {jax.devices()[0].platform} x{len(jax.devices())}")
 
-    def tile(arrays: BatchArrays, reps: int) -> BatchArrays:
-        return jax.tree_util.tree_map(
-            lambda x: jnp.asarray(np.tile(np.asarray(x), (reps,) + (1,) * (x.ndim - 1))),
-            arrays,
-        )
-
-    # Pack each family's base corpus and tile to per_family runs.  Tiling is
-    # timing-representative (per-run work is shape-identical) while keeping
-    # host-side generation cheap.
+    # Generate DISTINCT runs for the full stress corpus (VERDICT r1: tiling
+    # duplicated data; with the native C++ ETL, distinct generation is cheap)
+    # plus a small base corpus per family for the sequential-oracle baseline.
     family_batches = []
-    mollys = []
+    base_mollys = []
     total_runs = 0
+    t_gen = t_pack = 0.0
     with tempfile.TemporaryDirectory() as tmp:
         for name in families:
-            corpus = write_case_study(name, n_runs=base_runs, seed=11, out_dir=tmp)
-            molly = load_molly_output(corpus)
-            mollys.append(molly)
-            # Native C++ ETL when available; the fallback reuses the molly
-            # object already parsed for the oracle baseline.
-            from nemo_tpu.ingest.native import native_available
-
+            t0 = time.perf_counter()
+            big_dir = write_case_study(
+                name, n_runs=per_family, seed=11, out_dir=os.path.join(tmp, "big")
+            )
+            base_dir = write_case_study(
+                name, n_runs=base_runs, seed=11, out_dir=os.path.join(tmp, "base")
+            )
+            t1 = time.perf_counter()
+            base_mollys.append(load_molly_output(base_dir))
             if native_available():
-                pre, post, static = pack_molly_dir(corpus)
+                pre, post, static = pack_molly_dir(big_dir)
             else:
-                from nemo_tpu.models.pipeline_model import pack_molly_for_step
-
-                pre, post, static = pack_molly_for_step(molly)
-            reps = (per_family + base_runs - 1) // base_runs
-            pre_t, post_t = tile(pre, reps), tile(post, reps)
-            b = int(pre_t.is_goal.shape[0])
+                pre, post, static = pack_molly_for_step(load_molly_output(big_dir))
+            t2 = time.perf_counter()
+            t_gen += t1 - t0
+            t_pack += t2 - t1
+            b = int(pre.is_goal.shape[0])
             total_runs += b
-            family_batches.append((name, pre_t, post_t, static))
-            log(f"  {name}: {b} runs, bucket V={static['v']}")
-
+            family_batches.append((name, pre, post, static))
+            log(f"  {name}: {b} distinct runs, bucket V={static['v']}")
     graphs = 2 * total_runs  # pre + post provenance per run
-    log(f"stress corpus: {len(family_batches)} families, {total_runs} runs, {graphs} graphs")
+    log(
+        f"stress corpus: {len(family_batches)} families, {total_runs} distinct runs, "
+        f"{graphs} graphs (gen {t_gen:.1f}s, pack {t_pack:.1f}s, untimed)"
+    )
 
     # Warm up (one compile per family's shape signature), then time the full
-    # six-family sweep end to end.  Every timed dispatch gets DISTINCT input
-    # bytes (a poke in a masked padding slot — results unchanged): the device
-    # tunnel serves byte-identical dispatches from cache, which would
-    # overstate throughput.
+    # sweep end to end.  Every timed dispatch gets DISTINCT input bytes (a
+    # poke in a masked padding slot — results unchanged): the device tunnel
+    # serves byte-identical dispatches from cache, which would overstate
+    # throughput.
     import dataclasses
 
     def poke(arrays: BatchArrays, k: int) -> BatchArrays:
         """Distinct bytes, identical results: bump label_id in a PADDING slot
         (node_mask False -> the value never reaches any kernel output)."""
         pad = np.argwhere(~np.asarray(arrays.node_mask))
-        if len(pad) == 0:  # every slot of every run occupied: accept the risk
+        if len(pad) == 0:
+            # Every slot of every run occupied: repeated dispatches would be
+            # byte-identical and may be served from the tunnel's cache,
+            # OVERSTATING throughput (ADVICE r1).
+            log(
+                "warning: no padding slot in batch; timed dispatches are "
+                "byte-identical and the reported graphs/s may be cache-inflated"
+            )
             return arrays
         r, s = (int(x) for x in pad[0])
         return dataclasses.replace(arrays, label_id=arrays.label_id.at[r, s].set(k))
@@ -138,15 +262,15 @@ def main() -> None:
     )
 
     # Secondary metric (BASELINE.md): p50 single-run differential-provenance
-    # latency.  Each timed call diffs a DIFFERENT failed run against the good
-    # run (distinct inputs — the device tunnel caches identical dispatches),
-    # so the median is over per-run latencies, matching the oracle side.
+    # latency, population = the first family's failed runs (base corpus, same
+    # population as the oracle side).  Each timed call diffs a DIFFERENT
+    # failed run (distinct inputs — the device tunnel caches identical
+    # dispatches).
     from nemo_tpu.ops.diff import diff_masks
 
-    name0, pre0, post0, static0 = family_batches[0]
-    # Slice the shared good graph (row 0) host-side so each timed call does
-    # only single-run work — building the full tiled batch's adjacency inside
-    # the jit would charge O(total-runs) scatter cost to a "single-run" diff.
+    name0 = family_batches[0][0]
+    molly0 = base_mollys[0]
+    pre0, post0, static0 = pack_molly_for_step(molly0)
     post0_row0 = jax.tree_util.tree_map(lambda x: x[:1], post0)
 
     @jax.jit
@@ -166,19 +290,16 @@ def main() -> None:
             closure_impl="xla",
         )
 
-    # Same population as the oracle side: this family's FAILED runs (their
-    # row indices in the base batch), capped at 32.
+    import jax.numpy as jnp
+
     num_labels = static0["num_labels"]
-    # Only the base (un-tiled) rows are ever indexed below; don't materialize
-    # host-side boolean planes for the whole tiled batch.
-    n_base = len(mollys[0].runs)
-    lid = np.clip(np.asarray(post0.label_id[:n_base]), 0, num_labels - 1)
-    sel = np.asarray(post0.is_goal[:n_base]) & np.asarray(post0.node_mask[:n_base]) & (
-        np.asarray(post0.label_id[:n_base]) >= 0
+    lid = np.clip(np.asarray(post0.label_id), 0, num_labels - 1)
+    sel = np.asarray(post0.is_goal) & np.asarray(post0.node_mask) & (
+        np.asarray(post0.label_id) >= 0
     )
-    failed_set = set(mollys[0].failed_runs_iters)
+    failed_set = set(molly0.failed_runs_iters)
     failed_rows = [
-        idx for idx, r in enumerate(mollys[0].runs) if r.iteration in failed_set
+        idx for idx, r in enumerate(molly0.runs) if r.iteration in failed_set
     ][:32]
     bit_rows = []
     for r in failed_rows:
@@ -188,8 +309,7 @@ def main() -> None:
     p50_tpu = amort_tpu = float("nan")
     n_lat = len(bit_rows)
     if bit_rows:
-        # Warm the compile with different VALUES than any timed call — the
-        # device tunnel serves byte-identical dispatches from cache.
+        # Warm the compile with different VALUES than any timed call.
         jax.block_until_ready(one_diff(post0_row0, ~bit_rows[0]))
         lat = []
         for row in bit_rows:
@@ -199,10 +319,7 @@ def main() -> None:
         p50_tpu = float(np.median(lat)) * 1e3
 
         # Amortized per-run diff latency when all failed runs ride one
-        # dispatch (the deployment shape).  Warm the batch-shape compile with
-        # different VALUES than the timed call — the device tunnel caches
-        # identical dispatches, so timing a repeat of the warmup would be
-        # bogus.
+        # dispatch (the deployment shape).
         all_bits = jnp.concatenate(bit_rows, axis=0)
         jax.block_until_ready(one_diff(post0_row0, ~all_bits))
         t0 = time.perf_counter()
@@ -210,11 +327,11 @@ def main() -> None:
         amort_tpu = (time.perf_counter() - t0) / n_lat * 1e3
 
     oracle0 = PythonBackend()
-    oracle0.init_graph_db("", mollys[0])
+    oracle0.init_graph_db("", molly0)
     oracle0.load_raw_provenance()
-    oracle0.simplify_prov(mollys[0].runs_iters)
+    oracle0.simplify_prov(molly0.runs_iters)
     lat_base = []
-    for f in mollys[0].failed_runs_iters:
+    for f in molly0.failed_runs_iters:
         t0 = time.perf_counter()
         diff = oracle0.diff_graph(f)
         oracle0._diff_missing(diff)
@@ -227,11 +344,9 @@ def main() -> None:
     )
 
     # Baseline: the sequential oracle over the base corpora (same analyses).
-    # init_graph_db is excluded from the timed region the same way the JAX
-    # side's packing is — both sides time analysis only.
     t_base_total = 0.0
     base_graphs = 0
-    for molly in mollys:
+    for molly in base_mollys:
         oracle = PythonBackend()
         oracle.init_graph_db("", molly)
         t0 = time.perf_counter()
@@ -251,19 +366,96 @@ def main() -> None:
         f"-> {base_graphs_per_sec:,.0f} graphs/s"
     )
 
-    print(
-        json.dumps(
-            {
-                "metric": "provenance-graphs/sec, full analysis pipeline, "
-                f"{len(family_batches)} case-study families x "
-                f"{total_runs // len(family_batches)} fault-injection runs",
-                "value": round(value, 1),
-                "unit": "graphs/s",
-                "vs_baseline": round(value / base_graphs_per_sec, 2),
-            }
+    result = {
+        "metric": METRIC
+        if len(family_batches) > 1
+        else f"provenance-graphs/sec, full analysis pipeline, family {name0}",
+        "value": round(value, 1),
+        "unit": "graphs/s",
+        "vs_baseline": round(value / base_graphs_per_sec, 2),
+        "platform": jax.devices()[0].platform,
+        "distinct_runs": total_runs,
+        "sweep_ms": round(t_step * 1e3, 1),
+        "p50_diff_ms": None if np.isnan(p50_tpu) else round(p50_tpu, 3),
+        "p50_diff_ms_amortized": None if np.isnan(amort_tpu) else round(amort_tpu, 4),
+        "p50_diff_ms_oracle": None if np.isnan(p50_base) else round(p50_base, 3),
+        "oracle_graphs_per_sec": round(base_graphs_per_sec, 1),
+    }
+    if jax.default_backend() == "tpu":
+        result["closure_impls"] = closure_microbench(family_batches[0])
+    note = os.environ.get("NEMO_BENCH_NOTE")
+    if note:
+        result["note"] = note
+    print(json.dumps(result))
+
+
+def closure_microbench(family_batch) -> dict:
+    """Pallas fused-VMEM closure vs the XLA einsum chain on one family's
+    post-provenance adjacency, with first-order HBM/MXU estimates.
+
+    Cost model per [B,V,V] closure with S = log2(V) squarings: both impls do
+    2*B*V^3*S MACs; the XLA chain round-trips r through HBM every squaring
+    (~3*B*V^2*S bf16 accesses) while the Pallas kernel keeps the chain
+    VMEM-resident (~2*B*V^2 HBM accesses total).  ops/pallas_kernels.py
+    claims the workload is HBM-bound at small V — these numbers check that
+    on silicon."""
+    import dataclasses  # noqa: F401  (poke pattern not needed: distinct adj per rep)
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from nemo_tpu.ops.adjacency import build_adjacency, closure
+
+    name, pre, post, static = family_batch
+    v = static["v"]
+    b = int(post.is_goal.shape[0])
+    adj = build_adjacency(post.edge_src, post.edge_dst, post.edge_mask, v)
+    s_steps = max(1, (v - 1).bit_length())
+    flops = 2.0 * b * v**3 * s_steps
+    out = {"v": v, "b": b, "squarings": s_steps}
+    for impl in ("xla", "pallas"):
+        fn = jax.jit(lambda a, impl=impl: closure(a, impl=impl))
+        # Distinct bytes per rep: flip one self-loop bit in row 0 (closure is
+        # reflexive, so the result is unchanged but the input bytes differ).
+        jax.block_until_ready(fn(adj))
+        times = []
+        for rep in range(5):
+            a = adj.at[0, rep % v, rep % v].set(True)
+            jax.block_until_ready(a)
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(a))
+            times.append(time.perf_counter() - t0)
+        t = float(np.median(times))
+        hbm_bytes = (
+            3.0 * b * v * v * 2 * s_steps if impl == "xla" else 2.0 * b * v * v * 2
         )
-    )
+        out[impl] = {
+            "ms": round(t * 1e3, 2),
+            "tflops_per_sec": round(flops / t / 1e12, 3),
+            "est_hbm_gb_per_sec": round(hbm_bytes / t / 1e9, 1),
+        }
+    log(f"closure microbench ({name}): {json.dumps(out)}")
+    return out
 
 
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv:
+        child_main()
+    else:
+        try:
+            parent_main()
+        except Exception as exc:  # the parent ALWAYS prints one JSON line
+            log(f"parent crashed: {type(exc).__name__}: {exc}")
+            print(
+                json.dumps(
+                    {
+                        "metric": METRIC,
+                        "value": None,
+                        "unit": "graphs/s",
+                        "vs_baseline": None,
+                        "error": f"{type(exc).__name__}: {exc}",
+                    }
+                )
+            )

@@ -295,25 +295,31 @@ class Evaluator:
         tc = self.crashes.get(node)
         return tc is not None and t >= tc
 
-    def _join(self, rule: Rule, state: StepState) -> list[dict[str, str]]:
-        """All satisfying bindings of the rule's body against one step."""
-        envs: list[dict[str, str]] = [{}]
+    def _join(
+        self, rule: Rule, state: StepState
+    ) -> list[tuple[dict[str, str], list[FactInst]]]:
+        """All satisfying bindings of the rule's body against one step, each
+        with the body fact instances that actually produced it (in body-atom
+        order) — so provenance edges cite the true supporting facts rather
+        than a re-matched first-sorted candidate (which diverges under
+        wildcards)."""
+        envs: list[tuple[dict[str, str], list[FactInst]]] = [({}, [])]
         for atom in rule.body:
-            nxt: list[dict[str, str]] = []
-            for env in envs:
+            nxt: list[tuple[dict[str, str], list[FactInst]]] = []
+            for env, insts in envs:
                 for args in state.facts(atom.rel):
                     new = _match(atom, args, env)
                     if new is not None:
-                        nxt.append(new)
+                        nxt.append((new, [*insts, state.inst(atom.rel, args)]))
             envs = nxt
             if not envs:
                 return []
         out = []
-        for env in envs:
+        for env, insts in envs:
             if any(self._neg_holds(a, state, env) for a in rule.negated):
                 continue
             if all(_cmp_holds(c, env) for c in rule.comparisons):
-                out.append(env)
+                out.append((env, insts))
         return out
 
     def _neg_holds(self, atom: Atom, state: StepState, env: dict[str, str]) -> bool:
@@ -321,19 +327,6 @@ class Evaluator:
             if _match(atom, args, env) is not None:
                 return True
         return False
-
-    def _body_insts(self, rule: Rule, state: StepState, env: dict[str, str]) -> list[FactInst]:
-        insts = []
-        for atom in rule.body:
-            vals = tuple(
-                _subst(t, env) if t.kind != "wild" else None for t in atom.args
-            )
-            # Re-find the matching fact (wildcards: first sorted match).
-            for args in state.facts(atom.rel):
-                if all(v is None or v == a for v, a in zip(vals, args)):
-                    insts.append(state.inst(atom.rel, args))
-                    break
-        return insts
 
     def _head_args(self, rule: Rule, env: dict[str, str]) -> tuple[str, ...] | None:
         vals = []
@@ -380,10 +373,9 @@ class Evaluator:
                         if rule.is_aggregating:
                             changed |= self._fire_aggregate(rule, state, t, prov)
                             continue
-                        for env in self._join(rule, state):
+                        for env, bodies in self._join(rule, state):
                             head = self._head_args(rule, env)
                             inst = FactInst(rule.head.rel, head, t)
-                            bodies = self._body_insts(rule, state, env)
                             if state.add(inst):
                                 changed = True
                             prov.firing(
@@ -395,7 +387,7 @@ class Evaluator:
 
             # @next induction into t+1.
             for rule in self.next_rules:
-                for env in self._join(rule, state):
+                for env, bodies in self._join(rule, state):
                     head = self._head_args(rule, env)
                     node = head[0] if head else ""
                     if self._crashed(node, t + 1):
@@ -407,7 +399,7 @@ class Evaluator:
                         rule.head.rel,
                         f"{rule.head.rel}_next",
                         "next",
-                        self._body_insts(rule, state, env),
+                        bodies,
                     )
 
             # @async messaging delivered at t+1.  The sender is the body's
@@ -415,10 +407,9 @@ class Evaluator:
             # atoms share their first argument) — enforced here because a
             # mis-located body would silently defeat omission/crash faults.
             for rule in self.async_rules:
-                for env in self._join(rule, state):
+                for env, bodies in self._join(rule, state):
                     head = self._head_args(rule, env)
                     dst = head[0] if head else ""
-                    bodies = self._body_insts(rule, state, env)
                     locs = {b.args[0] for b in bodies if b.args}
                     if len(locs) > 1:
                         raise EvalError(
@@ -480,7 +471,7 @@ class Evaluator:
         groups: dict[tuple[str, ...], set[str]] = {}
         contributors: dict[tuple[str, ...], list[FactInst]] = {}
         agg_var = next(term.name for term in rule.head.args if term.kind == "agg")
-        for env in self._join(rule, state):
+        for env, bodies in self._join(rule, state):
             key = tuple(
                 _subst(term, env) or "" for term in rule.head.args if term.kind != "agg"
             )
@@ -488,7 +479,7 @@ class Evaluator:
             if val is None:
                 raise EvalError(f"line {rule.line}: count<{agg_var}> variable unbound")
             groups.setdefault(key, set()).add(val)
-            contributors.setdefault(key, []).extend(self._body_insts(rule, state, env))
+            contributors.setdefault(key, []).extend(bodies)
         changed = False
         for key, vals in sorted(groups.items()):
             head = []

@@ -35,7 +35,7 @@ class FaultSpec:
     eff: int = 4
     max_crashes: int = 0
     nodes: list[str] | None = None
-    max_runs: int = 64  # cap on enumerated fault runs (run 0 excluded)
+    max_runs: int = 256  # cap on enumerated fault runs (run 0 excluded)
 
 
 @dataclass
@@ -105,6 +105,11 @@ def enumerate_runs(program: Program, spec: FaultSpec) -> list[FaultRun]:
     base = Evaluator(program, spec.eot).run()
     runs = [FaultRun(crashes={}, omissions=set(), result=base)]
 
+    # Enumeration order is coverage priority under the max_runs cap: the
+    # linear classes (single omissions, single crashes) come before the
+    # quadratic ones (omission pairs, crash x omission, crash pairs), so a
+    # tight cap still explores every 1-fault execution before any 2-fault
+    # combination displaces it.
     faults: list[tuple[dict[str, int], set[tuple[str, str, int]]]] = []
     singles: list[tuple[str, str, int]] = []
     for m in base.messages:
@@ -112,6 +117,14 @@ def enumerate_runs(program: Program, spec: FaultSpec) -> list[FaultRun]:
         if m.send_time < spec.eff and key not in singles:
             singles.append(key)
             faults.append(({}, {key}))
+    crash_cands: list[tuple[str, int]] = []
+    if spec.max_crashes > 0:
+        nodes = _infer_nodes(program, runs)
+        # Crash times start at 1: a node that is down from the very first
+        # timestep is a reachable (and often the most violating) fault.
+        crash_cands = [(n, tc) for n in nodes for tc in range(1, spec.eff + 1)]
+        for n, tc in crash_cands:
+            faults.append(({n: tc}, set()))
     # Pairs of omissions: protocols with redundancy (e.g. replication to two
     # backups) only fail when every copy is lost — single-fault enumeration
     # would never surface their violation.
@@ -119,15 +132,26 @@ def enumerate_runs(program: Program, spec: FaultSpec) -> list[FaultRun]:
         for j in range(i + 1, len(singles)):
             faults.append(({}, {singles[i], singles[j]}))
     if spec.max_crashes > 0:
-        nodes = _infer_nodes(program, runs)
-        crash_cands = [(n, tc) for n in nodes for tc in range(2, spec.eff + 1)]
-        for n, tc in crash_cands:
-            faults.append(({n: tc}, set()))
         # Crash x omission combinations: losses that redundancy absorbs only
         # become violations when the surviving holder also crashes.
         for n, tc in crash_cands:
             for key in singles:
                 faults.append(({n: tc}, {key}))
+        if spec.max_crashes >= 2:
+            # Pairs of crashes on distinct nodes; violations that need two
+            # replicas down are unreachable through single crashes.
+            for i, (n1, t1) in enumerate(crash_cands):
+                for n2, t2 in crash_cands[i + 1 :]:
+                    if n1 != n2:
+                        faults.append(({n1: t1, n2: t2}, set()))
+        if spec.max_crashes > 2:
+            import sys
+
+            print(
+                f"dedalus: max_crashes={spec.max_crashes} > 2; only single "
+                "crashes and crash pairs are enumerated",
+                file=sys.stderr,
+            )
 
     if len(faults) > spec.max_runs:
         import sys

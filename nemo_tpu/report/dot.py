@@ -201,23 +201,71 @@ def parse_dot(text: str) -> DotGraph:
             i += 3
             continue
         if i + 1 < len(tokens) and tokens[i + 1] == "->":
-            chain = [name]
+            # Edge chain; any endpoint may be a braced node group
+            # (`a -> { b c } -> d` = a->b, a->c, b->d, c->d: the DOT
+            # grammar's subgraph-as-endpoint semantics, where the group
+            # contributes ALL nodes appearing inside it, and inner edge
+            # chains are real edges of the graph).
+            def parse_group(j: int) -> tuple[list[str], int]:
+                """Parse `{ ... }` starting at its opening brace; returns the
+                member node names.  Handles nested groups, inner edge chains
+                (with per-hop edge attrs), and `subgraph [name] { ... }`."""
+                members: list[str] = []
+                j += 1  # consume {
+                prev: list[str] | None = None  # tail of an inner chain
+                while j < len(tokens) and tokens[j] != "}":
+                    t = tokens[j]
+                    if t in (";", ","):
+                        prev = None
+                        j += 1
+                        continue
+                    if t == "->":
+                        src_grp = prev or []
+                        dst_grp, j = parse_endpoint(j + 1)
+                        eattrs, j = parse_attr_list(j)
+                        for a in src_grp:
+                            for b in dst_grp:
+                                g.add_edge(a, b, dict(eattrs))
+                        members.extend(n for n in dst_grp if n not in members)
+                        prev = dst_grp
+                        continue
+                    # Node statement (possibly an inner chain head).
+                    nm = _unquote(t)
+                    node_attrs, j = parse_attr_list(j + 1)
+                    g.add_node(nm, node_attrs)
+                    if nm not in members:
+                        members.append(nm)
+                    prev = [nm]
+                return members, j + 1  # consume }
+
+            def parse_endpoint(j: int) -> tuple[list[str], int]:
+                """One chain endpoint: a braced group, a subgraph block, or a
+                bare name.  A bare name does NOT consume a following attr
+                list — that belongs to the edge chain."""
+                if tokens[j] == "{":
+                    return parse_group(j)
+                if tokens[j].lower() == "subgraph":
+                    j += 1
+                    if j < len(tokens) and tokens[j] != "{":
+                        j += 1  # optional subgraph name
+                    if j < len(tokens) and tokens[j] == "{":
+                        return parse_group(j)
+                    return [], j
+                return [_unquote(tokens[j])], j + 1
+
+            endpoints = [[name]]
             j = i + 1
             while j < len(tokens) and tokens[j] == "->":
-                if j + 1 < len(tokens) and tokens[j + 1] == "{":
-                    # Subgraph edge endpoint (`a -> { b c }`): the grouped
-                    # edges are dropped (unused by our inputs) but the
-                    # braced statements still parse as usual — consume the
-                    # dangling arrow and stop the chain.
-                    j += 1
-                    break
-                chain.append(_unquote(tokens[j + 1]))
-                j += 2
+                ep, j = parse_endpoint(j + 1)
+                endpoints.append(ep)
             attrs, j = parse_attr_list(j)
-            for n in chain:  # declare even when the chain has no edges left
-                g.add_node(n)
-            for a, b in zip(chain, chain[1:]):
-                g.add_edge(a, b, dict(attrs))
+            for ep in endpoints:
+                for n in ep:  # declare even when the chain has no edges left
+                    g.add_node(n)
+            for src_grp, dst_grp in zip(endpoints, endpoints[1:]):
+                for a in src_grp:
+                    for b in dst_grp:
+                        g.add_edge(a, b, dict(attrs))
             i = j
             continue
         attrs, i = parse_attr_list(i + 1)
