@@ -186,32 +186,39 @@ def child_main() -> None:
     # Generate DISTINCT runs for the full stress corpus (VERDICT r1: tiling
     # duplicated data; with the native C++ ETL, distinct generation is cheap)
     # plus a small base corpus per family for the sequential-oracle baseline.
+    import shutil
+
     family_batches = []
+    big_dirs = []
     base_mollys = []
     total_runs = 0
     t_gen = t_pack = 0.0
-    with tempfile.TemporaryDirectory() as tmp:
-        for name in families:
-            t0 = time.perf_counter()
-            big_dir = write_case_study(
-                name, n_runs=per_family, seed=11, out_dir=os.path.join(tmp, "big")
-            )
-            base_dir = write_case_study(
-                name, n_runs=base_runs, seed=11, out_dir=os.path.join(tmp, "base")
-            )
-            t1 = time.perf_counter()
-            base_mollys.append(load_molly_output(base_dir))
-            if native_available():
-                pre, post, static = pack_molly_dir(big_dir)
-            else:
-                pre, post, static = pack_molly_for_step(load_molly_output(big_dir))
-            t2 = time.perf_counter()
-            t_gen += t1 - t0
-            t_pack += t2 - t1
-            b = int(pre.is_goal.shape[0])
-            total_runs += b
-            family_batches.append((name, pre, post, static))
-            log(f"  {name}: {b} distinct runs, bucket V={static['v']}")
+    tmp = tempfile.mkdtemp(prefix="nemo_bench_")
+    import atexit
+
+    atexit.register(shutil.rmtree, tmp, ignore_errors=True)
+    for name in families:
+        t0 = time.perf_counter()
+        big_dir = write_case_study(
+            name, n_runs=per_family, seed=11, out_dir=os.path.join(tmp, "big")
+        )
+        base_dir = write_case_study(
+            name, n_runs=base_runs, seed=11, out_dir=os.path.join(tmp, "base")
+        )
+        t1 = time.perf_counter()
+        base_mollys.append(load_molly_output(base_dir))
+        if native_available():
+            pre, post, static = pack_molly_dir(big_dir)
+        else:
+            pre, post, static = pack_molly_for_step(load_molly_output(big_dir))
+        t2 = time.perf_counter()
+        t_gen += t1 - t0
+        t_pack += t2 - t1
+        b = int(pre.is_goal.shape[0])
+        total_runs += b
+        family_batches.append((name, pre, post, static))
+        big_dirs.append((name, big_dir))
+        log(f"  {name}: {b} distinct runs, bucket V={static['v']}")
     graphs = 2 * total_runs  # pre + post provenance per run
     log(
         f"stress corpus: {len(family_batches)} families, {total_runs} distinct runs, "
@@ -366,6 +373,25 @@ def child_main() -> None:
         f"-> {base_graphs_per_sec:,.0f} graphs/s"
     )
 
+    # End-to-end pipeline at stress scale (VERDICT r1 item 2): the FULL CLI
+    # semantics — ingest -> kernels -> debugging.json + policy-bounded
+    # figures — over every family's distinct-run corpus, via run_debug.
+    from nemo_tpu.analysis.pipeline import run_debug
+    from nemo_tpu.backend.jax_backend import JaxBackend
+
+    e2e_phases: dict[str, float] = {}
+    results_root = os.path.join(tmp, "results")
+    t0 = time.perf_counter()
+    for name, d in big_dirs:
+        res = run_debug(d, results_root, JaxBackend(), figures="sample:8")
+        for k, v in res.timings.items():
+            e2e_phases[k] = e2e_phases.get(k, 0.0) + v
+    e2e_wall = time.perf_counter() - t0
+    log(
+        f"end-to-end pipeline ({total_runs} runs, figures=sample:8): "
+        f"{e2e_wall:.1f}s wall"
+    )
+
     result = {
         "metric": METRIC
         if len(family_batches) > 1
@@ -380,6 +406,12 @@ def child_main() -> None:
         "p50_diff_ms_amortized": None if np.isnan(amort_tpu) else round(amort_tpu, 4),
         "p50_diff_ms_oracle": None if np.isnan(p50_base) else round(p50_base, 3),
         "oracle_graphs_per_sec": round(base_graphs_per_sec, 1),
+        "e2e": {
+            "runs": total_runs,
+            "figures": "sample:8",
+            "wall_s": round(e2e_wall, 2),
+            "phases_s": {k: round(v, 2) for k, v in e2e_phases.items()},
+        },
     }
     if jax.default_backend() == "tpu":
         result["closure_impls"] = closure_microbench(family_batches[0])

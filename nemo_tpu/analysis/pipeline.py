@@ -40,6 +40,45 @@ class DebugResult:
     timings: dict[str, float]
 
 
+def select_figure_iters(
+    policy: str, iters: list[int], failed_iters: list[int], good_iter: int | None
+) -> list[int]:
+    """Figure materialization policy (VERDICT r1: explicit at stress scale).
+
+      all       every run gets figures — the reference behavior
+                (main.go:251-289 renders all 7 families for all runs)
+      failed    failed runs + the good baseline run
+      sample:N  N evenly-spaced failed runs + N evenly-spaced successes +
+                the good run — bounded figure count regardless of corpus
+                size (a 10k-run stress corpus can have thousands of
+                failures; rendering them all is the 'failed' policy)
+      none      debugging.json only, no figures
+
+    debugging.json always covers every run regardless of policy."""
+    if policy in ("", "all"):
+        return list(iters)
+    sel: set[int] = set()
+    if policy == "none":
+        pass
+    elif policy == "failed":
+        sel = set(failed_iters)
+    elif policy.startswith("sample:"):
+        n = int(policy.split(":", 1)[1])
+        failed_set = set(failed_iters)
+        others = [i for i in iters if i not in failed_set]
+        for pool in (list(failed_iters), others):
+            if pool and n > 0:
+                stride = max(1, len(pool) // n)
+                sel.update(pool[::stride][:n])
+    else:
+        raise ValueError(
+            f"unknown figure policy {policy!r} (expected all, failed, sample:N, none)"
+        )
+    if good_iter is not None and sel:
+        sel.add(good_iter)
+    return [i for i in iters if i in sel]
+
+
 def run_debug(
     fault_inj_out: str,
     results_root: str,
@@ -48,11 +87,13 @@ def run_debug(
     reporter: Reporter | None = None,
     save_corpus_path: str | None = None,
     profile_dir: str | None = None,
+    figures: str = "all",
 ) -> DebugResult:
     """Full debug pipeline.  With profile_dir set, the analysis phases run
     under jax.profiler.trace — open the directory with TensorBoard or
     xprof to see per-kernel device timelines (SURVEY.md §5: the rebuild's
-    tracing story)."""
+    tracing story).  `figures` is the figure materialization policy
+    (select_figure_iters)."""
     import contextlib
 
     trace_ctx: contextlib.AbstractContextManager = contextlib.nullcontext()
@@ -75,46 +116,53 @@ def run_debug(
     with timer.phase("init"):
         backend.init_graph_db(conn, molly)
     try:
+        # The baseline good run: the reference hard-codes run 0 and silently
+        # emits nonsense when run 0 failed (differential-provenance.go:22);
+        # here the backend's good-run policy (base.py:good_run_iter) decides,
+        # and on an all-failed corpus diff + corrections are skipped with a
+        # warning instead of raising.
+        good_iter: int | None = None
+        if failed_iters:
+            try:
+                good_iter = backend.good_run_iter()
+            except NoSuccessfulRunError:
+                print(
+                    "warning: no successful run in corpus; skipping "
+                    "differential provenance and correction synthesis "
+                    "(nothing to diff against)",
+                    file=sys.stderr,
+                )
+        fig_iters = select_figure_iters(figures, iters, failed_iters, good_iter)
+        fig_set = set(fig_iters)
+        fig_failed = [f for f in failed_iters if f in fig_set]
+
         with trace_ctx:
             with timer.phase("load_raw_provenance"):
                 backend.load_raw_provenance()
             with timer.phase("simplify"):
                 backend.simplify_prov(iters)
             with timer.phase("hazard"):
-                hazard_dots = backend.create_hazard_analysis(fault_inj_out)
+                hazard_dots = backend.create_hazard_analysis(fault_inj_out, fig_iters)
             with timer.phase("prototypes"):
                 inter, inter_miss, union, union_miss = backend.create_prototypes(
                     molly.get_success_runs_iters(), failed_iters
                 )
             with timer.phase("pull_prov"):
                 pre_dots, post_dots, pre_clean_dots, post_clean_dots = (
-                    backend.pull_pre_post_prov()
+                    backend.pull_pre_post_prov(fig_iters)
                 )
-            # Differential provenance and corrections diff failed runs against
-            # a baseline good run.  The reference hard-codes run 0 and
-            # silently emits nonsense when run 0 failed
-            # (differential-provenance.go:22); here the backend's good-run
-            # policy (base.py:good_run_iter) decides, and on an all-failed
-            # corpus both phases are skipped with a warning instead of
-            # raising.
-            good_iter: int | None = None
-            if failed_iters:
-                try:
-                    good_iter = backend.good_run_iter()
-                except NoSuccessfulRunError:
-                    print(
-                        "warning: no successful run in corpus; skipping "
-                        "differential provenance and correction synthesis "
-                        "(nothing to diff against)",
-                        file=sys.stderr,
-                    )
             diff_dots, failed_dots = [], []
             missing_events: list[list] = [[] for _ in failed_iters]
             corrections: list[str] = []
             if good_iter is not None:
+                success_post_dot = (
+                    post_dots[fig_iters.index(good_iter)]
+                    if good_iter in fig_set
+                    else None
+                )
                 with timer.phase("diff_prov"):
                     diff_dots, failed_dots, missing_events = backend.create_naive_diff_prov(
-                        False, failed_iters, post_dots[iters.index(good_iter)]
+                        False, failed_iters, success_post_dot, dot_iters=fig_failed
                     )
                 with timer.phase("corrections"):
                     corrections = backend.generate_corrections()
@@ -162,12 +210,12 @@ def run_debug(
         with open(os.path.join(this_results_dir, "debugging.json"), "w", encoding="utf-8") as fh:
             json.dump([r.to_json() for r in runs], fh)
 
-        reporter.generate_figures(iters, "spacetime", hazard_dots)
-        reporter.generate_figures(iters, "pre_prov", pre_dots)
-        reporter.generate_figures(iters, "post_prov", post_dots)
-        reporter.generate_figures(iters, "pre_prov_clean", pre_clean_dots)
-        reporter.generate_figures(iters, "post_prov_clean", post_clean_dots)
-        diff_fig_iters = failed_iters if diff_dots else []
+        reporter.generate_figures(fig_iters, "spacetime", hazard_dots)
+        reporter.generate_figures(fig_iters, "pre_prov", pre_dots)
+        reporter.generate_figures(fig_iters, "post_prov", post_dots)
+        reporter.generate_figures(fig_iters, "pre_prov_clean", pre_clean_dots)
+        reporter.generate_figures(fig_iters, "post_prov_clean", post_clean_dots)
+        diff_fig_iters = fig_failed if diff_dots else []
         reporter.generate_figures(diff_fig_iters, "diff_post_prov-diff", diff_dots)
         reporter.generate_figures(diff_fig_iters, "diff_post_prov-failed", failed_dots)
 

@@ -120,16 +120,22 @@ class GraphBackend(abc.ABC):
 
     molly: MollyOutput | None
 
-    def create_hazard_analysis(self, fault_inj_out: str) -> list[DotGraph]:
+    def create_hazard_analysis(
+        self, fault_inj_out: str, iters: list[int] | None = None
+    ) -> list[DotGraph]:
         """Recolored space-time diagram per run
         (reference: CreateHazardAnalysis, graphing/hazard-analysis.go:16-88).
         Purely host-side (reads Molly's DOT files + the holds maps), so it is
-        shared by all backends."""
+        shared by all backends.  `iters` restricts to a subset of runs (the
+        pipeline's figure policy); None = all runs, the reference behavior."""
         from nemo_tpu.report.figures import create_hazard_dot
 
         assert self.molly is not None
+        by_iter = {r.iteration: r for r in self.molly.runs}
+        run_ids = [r.iteration for r in self.molly.runs] if iters is None else list(iters)
         dots = []
-        for run in self.molly.runs:
+        for i in run_ids:
+            run = by_iter[i]
             with open(self.molly.spacetime_dot_path(run.iteration), "r", encoding="utf-8") as f:
                 text = f.read()
             dots.append(create_hazard_dot(text, run.time_pre_holds, run.time_post_holds))
@@ -157,14 +163,19 @@ class GraphBackend(abc.ABC):
 
     @abc.abstractmethod
     def pull_pre_post_prov(
-        self,
+        self, iters: list[int] | None = None
     ) -> tuple[list[DotGraph], list[DotGraph], list[DotGraph], list[DotGraph]]:
-        """Per-run DOT graphs: (pre, post, pre_clean, post_clean)
+        """Per-run DOT graphs: (pre, post, pre_clean, post_clean), aligned
+        with `iters` (None = all runs, the reference behavior)
         (reference: PullPrePostProv, graphing/pre-post-prov.go:288-459)."""
 
     @abc.abstractmethod
     def create_naive_diff_prov(
-        self, symmetric: bool, failed_iters: list[int], success_post_dot: DotGraph
+        self,
+        symmetric: bool,
+        failed_iters: list[int],
+        success_post_dot: DotGraph,
+        dot_iters: list[int] | None = None,
     ) -> tuple[list[DotGraph], list[DotGraph], list[list[MissingEvent]]]:
         """Differential provenance good-minus-bad per failed run
         (reference: CreateNaiveDiffProv, differential-provenance.go:18-243).
@@ -182,6 +193,11 @@ class GraphBackend(abc.ABC):
         failed run after the first against the FIRST failed run's labels
         (differential-provenance.go:43) — each failed run is diffed against
         its own labels.
+
+        Missing events are computed (and returned) for every failed run; the
+        overlay DOTs materialize only for runs in `dot_iters` (None = all
+        failed runs, the reference behavior) — the pipeline's figure policy
+        at stress scale.
         """
 
     @abc.abstractmethod

@@ -377,12 +377,12 @@ class Neo4jBackend(GraphBackend):
     # ------------------------------------------------------------------- pull
 
     def pull_pre_post_prov(
-        self,
+        self, iters: list[int] | None = None
     ) -> tuple[list[DotGraph], list[DotGraph], list[DotGraph], list[DotGraph]]:
         assert self.molly is not None
+        run_ids = [r.iteration for r in self.molly.runs] if iters is None else list(iters)
         pre, post, pre_clean, post_clean = [], [], [], []
-        for run in self.molly.runs:
-            i = run.iteration
+        for i in run_ids:
             pre.append(create_dot(self._pull_graph(i, "pre"), "pre"))
             post.append(create_dot(self._pull_graph(i, "post"), "post"))
             pre_clean.append(create_dot(self._pull_graph(CLEAN_OFFSET + i, "pre"), "pre"))
@@ -394,7 +394,11 @@ class Neo4jBackend(GraphBackend):
     # ------------------------------------------------------------------- diff
 
     def create_naive_diff_prov(
-        self, symmetric: bool, failed_iters: list[int], success_post_dot: DotGraph
+        self,
+        symmetric: bool,
+        failed_iters: list[int],
+        success_post_dot: DotGraph,
+        dot_iters: list[int] | None = None,
     ) -> tuple[list[DotGraph], list[DotGraph], list[list[MissingEvent]]]:
         """Good-minus-bad per failed run (differential-provenance.go:18-243).
         The diff subgraph is computed on the pulled good graph with the shared
@@ -411,12 +415,16 @@ class Neo4jBackend(GraphBackend):
         helper.graphs = {
             (g, "post"): self._pull_graph(g, "post"),
         }
+        dot_set = set(failed_iters if dot_iters is None else dot_iters)
         diff_dots, failed_dots, missing_events = [], [], []
         for f in failed_iters:
             helper.graphs[(f, "post")] = self._pull_graph(f, "post")
             diff = helper.diff_graph(f)
             self._load_graph(DIFF_OFFSET + f, "post", diff)
             missing = helper._diff_missing(diff)
+            missing_events.append(missing)
+            if f not in dot_set:
+                continue
             diff_dot, failed_dot = create_diff_dot(
                 DIFF_OFFSET + f,
                 diff,
@@ -427,7 +435,6 @@ class Neo4jBackend(GraphBackend):
             )
             diff_dots.append(diff_dot)
             failed_dots.append(failed_dot)
-            missing_events.append(missing)
         return diff_dots, failed_dots, missing_events
 
     # ------------------------------------------------------- corrections etc.

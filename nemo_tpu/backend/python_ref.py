@@ -292,12 +292,12 @@ class PythonBackend(GraphBackend):
     # ------------------------------------------------------------------- pull
 
     def pull_pre_post_prov(
-        self,
+        self, iters: list[int] | None = None
     ) -> tuple[list[DotGraph], list[DotGraph], list[DotGraph], list[DotGraph]]:
         assert self.molly is not None
+        run_ids = [r.iteration for r in self.molly.runs] if iters is None else list(iters)
         pre, post, pre_clean, post_clean = [], [], [], []
-        for run in self.molly.runs:
-            i = run.iteration
+        for i in run_ids:
             pre.append(create_dot(self.graphs[(i, "pre")], "pre"))
             post.append(create_dot(self.graphs[(i, "post")], "post"))
             pre_clean.append(create_dot(self.graphs[(CLEAN_OFFSET + i, "pre")], "pre"))
@@ -406,22 +406,29 @@ class PythonBackend(GraphBackend):
         return missing
 
     def create_naive_diff_prov(
-        self, symmetric: bool, failed_iters: list[int], success_post_dot: DotGraph
+        self,
+        symmetric: bool,
+        failed_iters: list[int],
+        success_post_dot: DotGraph,
+        dot_iters: list[int] | None = None,
     ) -> tuple[list[DotGraph], list[DotGraph], list[list[MissingEvent]]]:
         if not failed_iters:
             return [], [], []
+        dot_set = set(failed_iters if dot_iters is None else dot_iters)
         diff_dots, failed_dots, missing_events = [], [], []
         good_iter = self.good_run_iter()
         for f in failed_iters:
             diff = self.diff_graph(f)
             self.graphs[(DIFF_OFFSET + f, "post")] = diff
             missing = self._diff_missing(diff)
+            missing_events.append(missing)
+            if f not in dot_set:
+                continue
             diff_dot, failed_dot = create_diff_dot(
                 DIFF_OFFSET + f, diff, self.graphs[(f, "post")], good_iter, success_post_dot, missing
             )
             diff_dots.append(diff_dot)
             failed_dots.append(failed_dot)
-            missing_events.append(missing)
         return diff_dots, failed_dots, missing_events
 
     # ------------------------------------------------------------ corrections

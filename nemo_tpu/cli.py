@@ -97,6 +97,16 @@ def main(argv: list[str] | None = None) -> int:
         "(view with TensorBoard/xprof)",
     )
     parser.add_argument(
+        "--figures",
+        default="all",
+        metavar="POLICY",
+        help="figure materialization policy: 'all' (reference behavior), "
+        "'failed' (failed runs + the good baseline run), 'sample:N' "
+        "(failed + good + N sampled runs), or 'none'.  debugging.json "
+        "always covers every run; at 10k+ run scale rendering every "
+        "figure dominates wall clock",
+    )
+    parser.add_argument(
         "--save-corpus",
         metavar="PATH",
         default=None,
@@ -118,6 +128,7 @@ def main(argv: list[str] | None = None) -> int:
         conn=args.graph_db_conn,
         save_corpus_path=args.save_corpus,
         profile_dir=args.profile,
+        figures=args.figures,
     )
 
     if args.timings:

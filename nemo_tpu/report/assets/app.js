@@ -24,21 +24,29 @@ function figure(path, title) {
   const wrap = el("div");
   if (title) wrap.append(el("h4", {}, title));
   const scroll = el("div", { class: "figure-scroll" });
-  scroll.append(el("img", { src: path, alt: title || path }));
+  const img = el("img", { src: path, alt: title || path });
+  // Under a restrictive figure policy (--figures=failed/sample:N/none) not
+  // every run has rendered figures; show a note instead of a broken image.
+  img.onerror = () => {
+    scroll.replaceChildren(
+      el("p", { class: "empty-note" }, "figure not rendered (figure policy)")
+    );
+  };
+  scroll.append(img);
   wrap.append(scroll);
   return wrap;
 }
 
-function layerStack(iter) {
+function layerStack(iter, goodIter) {
   // Differential provenance as stacked layers over the good run's graph:
-  // good (run 0 post prov) at the bottom, failed overlay, diff overlay —
-  // mirroring the reference's checkbox-controlled z-ordered layers.
+  // good (the baseline run's post prov) at the bottom, failed overlay, diff
+  // overlay — mirroring the reference's checkbox-controlled z-ordered layers.
   const wrap = el("div");
   wrap.append(el("h4", {}, "Differential provenance (good − bad)"));
   const controls = el("div", { class: "layer-controls" });
   const stack = el("div", { class: "layer-stack" });
   const layers = [
-    ["good", `figures/run_0_post_prov.svg`, true],
+    ["good", `figures/run_${goodIter}_post_prov.svg`, true],
     ["failed", `figures/run_${iter}_diff_post_prov-failed.svg`, true],
     ["diff", `figures/run_${iter}_diff_post_prov-diff.svg`, true],
   ];
@@ -46,6 +54,9 @@ function layerStack(iter) {
     const img = el("img", { src, alt: name });
     if (i > 0) img.classList.add("overlay");
     if (!on) img.style.display = "none";
+    img.onerror = () => {
+      img.remove();
+    };
     stack.append(img);
     const box = el("input", { type: "checkbox" });
     box.checked = on;
@@ -96,7 +107,17 @@ function missingEvents(events) {
   return wrap;
 }
 
-function runSection(run) {
+function goodRunIter(runs) {
+  // Mirror the backend's baseline policy (backend/base.py good_run_iter):
+  // first success that achieved the consequent, else first success, else 0.
+  const succ = runs.filter((r) => r.status === "success");
+  const achieving = succ.find((r) => r.timePostHolds && Object.keys(r.timePostHolds).length);
+  if (achieving) return achieving.iteration;
+  if (succ.length) return succ[0].iteration;
+  return 0;
+}
+
+function runSection(run, goodIter) {
   const failed = run.status !== "success";
   const details = el("details", { class: "run", id: `run-${run.iteration}` });
   details.append(
@@ -112,7 +133,7 @@ function runSection(run) {
     details.append(protoList("Correction suggestions", run.corrections));
   }
   if (failed) {
-    details.append(layerStack(run.iteration));
+    details.append(layerStack(run.iteration, goodIter));
     details.append(missingEvents(run.missingEvents));
     details.append(
       protoList("Missing from intersection prototype", run.interProtoMissing),
@@ -167,8 +188,9 @@ async function main() {
   const recs = (runs[0] && runs[0].recommendation) || [];
   for (const r of recs) recList.append(el("li", { html: r }));
 
+  const goodIter = goodRunIter(runs);
   const runsRoot = document.getElementById("runs");
-  for (const run of runs) runsRoot.append(runSection(run));
+  for (const run of runs) runsRoot.append(runSection(run, goodIter));
 }
 
 main();

@@ -180,6 +180,76 @@ class RemoteExecutor:
         self._client.close()
 
 
+def analyze_dirs(
+    target: str, molly_dirs: list[str], queue_depth: int = 2
+) -> tuple[list[dict[str, np.ndarray]], dict[str, float]]:
+    """Pipelined multi-corpus analysis with TRUE ingest/compute overlap
+    (SURVEY.md §2.3 pipeline-parallel row; VERDICT r1 item 5).
+
+    A producer thread packs each Molly directory (natively when available)
+    and feeds a bounded queue; the bidi AnalyzeStream RPC consumes from the
+    queue, so directory k+1 is parsing/packing on the host WHILE directory
+    k executes on the sidecar's device.  queue_depth bounds host memory
+    (backpressure).  Returns (per-directory outputs, timing dict with
+    pack_s, stream_s, wall_s — overlap win = pack_s + stream_s - wall_s
+    when positive).
+    """
+    import queue
+    import threading
+
+    t_wall0 = time.perf_counter()
+    timings = {"pack_s": 0.0, "stream_s": 0.0, "wall_s": 0.0}
+    q: queue.Queue = queue.Queue(maxsize=max(1, queue_depth))
+    _END = object()
+
+    def producer() -> None:
+        from nemo_tpu.ingest.native import pack_molly_dir
+
+        try:
+            for i, d in enumerate(molly_dirs):
+                t0 = time.perf_counter()
+                packed = pack_molly_dir(d)
+                timings["pack_s"] += time.perf_counter() - t0
+                q.put((i, packed))
+        except BaseException as ex:  # surface in the consumer
+            q.put(ex)
+        finally:
+            q.put(_END)
+
+    threading.Thread(target=producer, daemon=True, name="nemo-pack").start()
+
+    def requests():
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            i, (pre, post, static) = item
+            req = pb.AnalyzeRequest(
+                pre=codec.batch_arrays_to_pb(pre),
+                post=codec.batch_arrays_to_pb(post),
+                chunk=i,
+            )
+            req.static.CopyFrom(codec.static_to_pb(static))
+            yield req
+
+    results: list[dict[str, np.ndarray] | None] = [None] * len(molly_dirs)
+    with RemoteAnalyzer(target=target) as client:
+        client.wait_ready()
+        t0 = time.perf_counter()
+        for resp in client._analyze_stream(requests(), timeout=client.timeout):
+            if not 0 <= resp.chunk < len(molly_dirs):
+                raise SidecarError(f"bad chunk ordinal {resp.chunk}")
+            results[resp.chunk] = codec.outputs_from_pb(resp)
+        timings["stream_s"] = time.perf_counter() - t0
+    missing = [i for i, o in enumerate(results) if o is None]
+    if missing:
+        raise SidecarError(f"missing responses for directories {missing}")
+    timings["wall_s"] = time.perf_counter() - t_wall0
+    return results, timings  # type: ignore[return-value]
+
+
 def analyze_dir(target: str, molly_dir: str, chunk_runs: int = 0) -> dict[str, np.ndarray]:
     """Native-pack a Molly directory and analyze it remotely, optionally
     streamed in chunks of chunk_runs runs.

@@ -1,0 +1,74 @@
+"""Figure materialization policy (VERDICT r1 item 2): debugging.json always
+covers every run; SVG/DOT figures materialize only for the policy-selected
+subset, keeping 10k-run reports out of figure-rendering wall clock."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from nemo_tpu.analysis.pipeline import run_debug, select_figure_iters
+from nemo_tpu.backend.jax_backend import JaxBackend
+from nemo_tpu.ingest.molly import load_molly_output
+
+
+def test_select_all_is_reference_behavior():
+    iters = [0, 1, 2, 3]
+    assert select_figure_iters("all", iters, [1], 0) == iters
+    assert select_figure_iters("", iters, [1], 0) == iters
+
+
+def test_select_none():
+    assert select_figure_iters("none", [0, 1, 2], [1], 0) == []
+
+
+def test_select_failed_includes_good():
+    iters = [0, 1, 2, 3, 4]
+    out = select_figure_iters("failed", iters, [2, 4], 0)
+    assert out == [0, 2, 4]  # good run 0 + failed, in run order
+
+
+def test_select_sample_bounds_both_classes():
+    iters = list(range(100))
+    failed = list(range(1, 100, 2))  # 49 failed
+    out = select_figure_iters("sample:4", iters, failed, 0)
+    n_failed = len([i for i in out if i in set(failed)])
+    n_success = len([i for i in out if i not in set(failed)])
+    assert n_failed <= 4 and n_success <= 5  # + the good run
+    assert 0 in out  # good always present
+    assert out == sorted(out)
+
+
+def test_select_unknown_policy_raises():
+    with pytest.raises(ValueError):
+        select_figure_iters("bogus", [0], [], None)
+
+
+def test_pipeline_failed_policy_end_to_end(corpus_dir, tmp_path):
+    molly = load_molly_output(corpus_dir)
+    res = run_debug(
+        corpus_dir, str(tmp_path / "results"), JaxBackend(), figures="failed"
+    )
+    figs = os.listdir(os.path.join(res.report_dir, "figures"))
+    svg_runs = {
+        int(f.split("_")[1]) for f in figs if f.endswith("_post_prov.svg")
+    }
+    failed = set(molly.get_failed_runs_iters())
+    good = JaxBackend.good_run_iter.__get__(_backend_with(molly))()
+    assert svg_runs == failed | {good}
+    # debugging.json still covers every run, with missing events for every
+    # failed run.
+    with open(os.path.join(res.report_dir, "debugging.json"), encoding="utf-8") as fh:
+        dbg = json.load(fh)
+    assert len(dbg) == len(molly.runs)
+    for r in dbg:
+        if r["status"] != "success":
+            assert "missingEvents" in r
+
+
+def _backend_with(molly):
+    b = JaxBackend()
+    b.molly = molly
+    return b
