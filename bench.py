@@ -51,35 +51,12 @@ def log(msg: str) -> None:
 
 
 def probe_platform(timeout_s: float, retries: int) -> dict | None:
-    """Ask a subprocess what jax's default platform is.  In an axon-tunnel
-    outage jax.devices() hangs forever (observed in round 1), so the probe
-    gets a hard timeout and backoff retries."""
-    code = (
-        "import jax, json;"
-        "d = jax.devices();"
-        "print(json.dumps({'platform': d[0].platform, 'n': len(d)}))"
-    )
-    for attempt in range(retries):
-        try:
-            out = subprocess.run(
-                [sys.executable, "-c", code],
-                capture_output=True,
-                text=True,
-                timeout=timeout_s,
-            )
-            if out.returncode == 0 and out.stdout.strip():
-                try:
-                    return json.loads(out.stdout.strip().splitlines()[-1])
-                except json.JSONDecodeError:
-                    log(f"probe attempt {attempt + 1}/{retries}: unparseable stdout")
-                    continue
-            tail = (out.stderr or "").strip().splitlines()[-1:] or ["<no stderr>"]
-            log(f"probe attempt {attempt + 1}/{retries} rc={out.returncode}: {tail[0]}")
-        except subprocess.TimeoutExpired:
-            log(f"probe attempt {attempt + 1}/{retries} timed out after {timeout_s:.0f}s")
-        if attempt + 1 < retries:
-            time.sleep(min(30.0, 5.0 * 2**attempt))
-    return None
+    """Watchdog device probe (utils/jax_config.py): in an axon-tunnel outage
+    jax.devices() hangs forever, so the probe runs out-of-process with a
+    hard timeout and backoff retries."""
+    from nemo_tpu.utils.jax_config import probe_default_platform
+
+    return probe_default_platform(timeout_s, retries, log=log)
 
 
 def parent_main() -> None:
@@ -155,12 +132,18 @@ def parent_main() -> None:
 
 def child_main() -> None:
     platform = os.environ["NEMO_BENCH_PLATFORM"]
-    os.environ["JAX_PLATFORMS"] = platform
     import jax
 
-    # The axon sitecustomize force-sets jax_platforms at interpreter start,
-    # overriding the env var — set it back explicitly.
-    jax.config.update("jax_platforms", platform)
+    if platform not in ("tpu", "axon", "auto", ""):
+        # Pin an explicit local platform (the axon sitecustomize force-sets
+        # jax_platforms at interpreter start, overriding the env var).
+        # The tunnel TPU is ONLY reachable through the default selection:
+        # forcing JAX_PLATFORMS=tpu makes jax try a local libtpu client and
+        # fail ("No jellyfish device found"), so the tpu/axon/auto cases
+        # leave the selection alone.
+        from nemo_tpu.utils.jax_config import pin_platform
+
+        pin_platform(platform)
 
     import numpy as np
 

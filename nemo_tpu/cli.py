@@ -18,7 +18,7 @@ import os
 import sys
 
 from nemo_tpu.analysis.pipeline import run_debug
-from nemo_tpu.utils.jax_config import enable_compilation_cache
+from nemo_tpu.utils.jax_config import enable_compilation_cache, ensure_platform, pin_platform
 
 
 def make_backend(name: str):
@@ -107,6 +107,15 @@ def main(argv: list[str] | None = None) -> int:
         "figure dominates wall clock",
     )
     parser.add_argument(
+        "--platform",
+        default=None,
+        metavar="NAME",
+        help="jax platform: 'auto' (probe the device under a watchdog, fall "
+        "back to CPU if unreachable — the environment's TPU tunnel HANGS "
+        "device discovery during outages), 'cpu', 'tpu', or a concrete "
+        "platform name (default: $NEMO_PLATFORM or auto)",
+    )
+    parser.add_argument(
         "--save-corpus",
         metavar="PATH",
         default=None,
@@ -119,6 +128,18 @@ def main(argv: list[str] | None = None) -> int:
     if not os.path.isdir(args.fault_inj_out):
         parser.error(f"fault injector output directory not found: {args.fault_inj_out}")
 
+    if args.graph_backend == "jax":
+        # The only backend that touches the accelerator in-process; resolve
+        # the platform under a watchdog so a tunnel outage degrades to CPU
+        # instead of hanging (the reference CLI always terminates,
+        # main.go:65-292 — every error is log.Fatalf).
+        platform = ensure_platform(args.platform)
+        print(f"jax platform: {platform}", file=sys.stderr)
+    else:
+        # python/neo4j run no device code; the service backend's device
+        # lives in the sidecar process.  Pin CPU unless the user explicitly
+        # asked otherwise, so stray jax imports can't block on tunnel health.
+        pin_platform(args.platform if args.platform not in (None, "", "auto") else "cpu")
     enable_compilation_cache()
     backend = make_backend(args.graph_backend)
     result = run_debug(

@@ -11,7 +11,6 @@ hardware instead.  Must run before jax imports.
 import os
 
 _platform = os.environ.get("NEMO_TEST_PLATFORM", "cpu")
-os.environ["JAX_PLATFORMS"] = _platform
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
@@ -21,10 +20,16 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 import jax  # noqa: E402
 
 # The environment's TPU-tunnel plugin (sitecustomize) force-sets
-# jax_platforms at interpreter start, overriding the env var; set it back so
-# the suite never blocks on tunnel health unless a platform was explicitly
-# requested via NEMO_TEST_PLATFORM.
-jax.config.update("jax_platforms", _platform)
+# jax_platforms at interpreter start, overriding the env var; pin it back so
+# the suite never blocks on tunnel health unless a device platform was
+# explicitly requested via NEMO_TEST_PLATFORM.  The tunnel device is only
+# reachable through the default selection (forcing JAX_PLATFORMS=tpu fails
+# with "No jellyfish device found"), so tpu/axon leave the selection alone
+# (utils/jax_config.py).
+if _platform not in ("tpu", "axon", "auto"):
+    from nemo_tpu.utils.jax_config import pin_platform
+
+    pin_platform(_platform)
 
 import pytest  # noqa: E402
 
