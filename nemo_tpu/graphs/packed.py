@@ -216,6 +216,40 @@ def bucketize(
     return batches
 
 
+def bucketize_pairs(
+    run_ids: list[int],
+    pre_graphs: list[PackedGraph],
+    post_graphs: list[PackedGraph],
+    max_batch: int | None = None,
+) -> list[tuple[PackedBatch, PackedBatch]]:
+    """Joint size-bucketing over (pre, post) graph pairs: both conditions of
+    a run share one bucket, padded to the pair's common (V, E) — the shape
+    contract of the fused analysis step (models/pipeline_model.py), which
+    takes the pre and post batches of the same runs in one dispatch.
+    Preserves run order within each bucket."""
+    groups: dict[tuple[int, int], tuple[list[int], list[PackedGraph], list[PackedGraph]]] = {}
+    for rid, gpre, gpost in zip(run_ids, pre_graphs, post_graphs):
+        key = (
+            bucket_size(max(gpre.n_nodes, gpost.n_nodes)),
+            bucket_size(max(1, len(gpre.edges), len(gpost.edges))),
+        )
+        groups.setdefault(key, ([], [], []))
+        groups[key][0].append(rid)
+        groups[key][1].append(gpre)
+        groups[key][2].append(gpost)
+    batches = []
+    for (v, e), (rids, pres, posts) in sorted(groups.items()):
+        step = max_batch or len(rids)
+        for s in range(0, len(rids), step):
+            batches.append(
+                (
+                    pack_batch(rids[s : s + step], pres[s : s + step], v, e),
+                    pack_batch(rids[s : s + step], posts[s : s + step], v, e),
+                )
+            )
+    return batches
+
+
 def rewrite_run_prefix(orig_id: str, new_prefix: str) -> str:
     """Replace the run_<i>_<cond>_ namespace of an ingested node id
     (ingest/molly.py prefixing, reference molly.go:92) with a shadow-run

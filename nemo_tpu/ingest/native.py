@@ -98,14 +98,17 @@ class NativeCorpus:
     @property
     def static_kwargs(self) -> dict:
         """Static kwargs for models.pipeline_model.analysis_step, identical to
-        pack_molly_for_step's."""
+        pack_molly_for_step's (power-of-two rounding included — see
+        graphs_to_step: compiled-program sharing across corpora)."""
+        from nemo_tpu.graphs.packed import bucket_size
+
         return dict(
             v=self.v,
             pre_tid=self.pre_tid,
             post_tid=self.post_tid,
-            num_tables=len(self.tables),
-            num_labels=max(1, len(self.labels)),
-            max_depth=self.max_depth,
+            num_tables=bucket_size(len(self.tables), 8),
+            num_labels=bucket_size(max(1, len(self.labels)), 8),
+            max_depth=bucket_size(self.max_depth, 4),
         )
 
 

@@ -112,12 +112,14 @@ def analysis_step(
     )
 
 
+# pre_tid/post_tid are traced scalars, NOT statics: they only feed
+# elementwise comparisons (ops/condition.py), and keeping them out of the
+# cache key lets corpora with different vocab interning orders share one
+# compiled program — fewer (slow) TPU compiles per multi-family sweep.
 @partial(
     jax.jit,
     static_argnames=(
         "v",
-        "pre_tid",
-        "post_tid",
         "num_tables",
         "num_labels",
         "max_depth",
@@ -221,16 +223,20 @@ def graphs_to_step(
     e = bucket_size(max(max(len(g.edges) for g in pre_graphs + post_graphs), 1))
     pre_b = pack_batch(run_ids, pre_graphs, v, e)
     post_b = pack_batch(run_ids, post_graphs, v, e)
+    # Static dims round up to powers of two so corpora with nearby vocab
+    # sizes / diameters share one compiled program (vocab-dependent extra
+    # table/label columns are never set, so results are unchanged;
+    # max_depth only needs to be >= the true longest path).
     static = dict(
         v=v,
         pre_tid=vocab.tables.lookup("pre"),
         post_tid=vocab.tables.lookup("post"),
-        num_tables=len(vocab.tables),
-        num_labels=max(1, len(vocab.labels)),
+        num_tables=bucket_size(len(vocab.tables), 8),
+        num_labels=bucket_size(max(1, len(vocab.labels)), 8),
         # Tight static trip count for the depth-relaxation loops: the corpus'
         # longest DAG path (+1 margin), not V — several-fold fewer sequential
         # steps on shallow provenance graphs (packed.py:longest_path_len).
-        max_depth=max(pre_b.max_depth, post_b.max_depth),
+        max_depth=bucket_size(max(pre_b.max_depth, post_b.max_depth), 4),
     )
     return BatchArrays.from_packed(pre_b), BatchArrays.from_packed(post_b), static
 
