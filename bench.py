@@ -152,6 +152,12 @@ def child_main() -> None:
     from nemo_tpu.ingest.native import native_available, pack_molly_dir
     from nemo_tpu.models.case_studies import CASE_STUDIES, write_case_study
     from nemo_tpu.models.pipeline_model import BatchArrays, analysis_step, pack_molly_for_step
+    from nemo_tpu.utils.jax_config import enable_compilation_cache
+
+    # Persistent compilation cache: repeat invocations (and the warm e2e
+    # pass below) load compiled programs from disk instead of recompiling —
+    # the cold-vs-warm split quantifies how much of the e2e wall is compile.
+    enable_compilation_cache()
 
     n_total = int(os.environ.get("NEMO_BENCH_RUNS", "10200"))
     base_runs = int(os.environ.get("NEMO_BENCH_BASE_RUNS", "32"))
@@ -263,6 +269,12 @@ def child_main() -> None:
     pre0, post0, static0 = pack_molly_for_step(molly0)
     post0_row0 = jax.tree_util.tree_map(lambda x: x[:1], post0)
 
+    # Measure the deployment path: closure_impl resolves like production
+    # ("auto" -> pallas on TPU, xla elsewhere; VERDICT r2 item 3c).
+    from nemo_tpu.ops.adjacency import resolve_closure_impl
+
+    diff_impl = resolve_closure_impl()
+
     @jax.jit
     def one_diff(post_row, fail_bits):
         from nemo_tpu.ops.adjacency import build_adjacency
@@ -277,7 +289,7 @@ def child_main() -> None:
             post_row.label_id[0],
             fail_bits,
             static0["max_depth"],
-            closure_impl="xla",
+            closure_impl=diff_impl,
         )
 
     import jax.numpy as jnp
@@ -362,18 +374,26 @@ def child_main() -> None:
     from nemo_tpu.analysis.pipeline import run_debug
     from nemo_tpu.backend.jax_backend import JaxBackend
 
-    e2e_phases: dict[str, float] = {}
-    results_root = os.path.join(tmp, "results")
-    t0 = time.perf_counter()
-    for name, d in big_dirs:
-        res = run_debug(d, results_root, JaxBackend(), figures="sample:8")
-        for k, v in res.timings.items():
-            e2e_phases[k] = e2e_phases.get(k, 0.0) + v
-    e2e_wall = time.perf_counter() - t0
-    log(
-        f"end-to-end pipeline ({total_runs} runs, figures=sample:8): "
-        f"{e2e_wall:.1f}s wall"
-    )
+    # Two passes over the same corpora: the cold pass pays every jit
+    # compile; the warm pass reuses the in-process jit caches (plus the
+    # persistent on-disk cache), so cold - warm isolates compile cost from
+    # execute cost (VERDICT r2 weak #8).
+    e2e = {}
+    for label in ("cold", "warm"):
+        phases: dict[str, float] = {}
+        results_root = os.path.join(tmp, f"results_{label}")
+        t0 = time.perf_counter()
+        for name, d in big_dirs:
+            res = run_debug(d, results_root, JaxBackend(), figures="sample:8")
+            for k, v in res.timings.items():
+                phases[k] = phases.get(k, 0.0) + v
+        wall = time.perf_counter() - t0
+        e2e[label] = {"wall_s": round(wall, 2), "phases_s": {k: round(v, 2) for k, v in phases.items()}}
+        log(
+            f"end-to-end pipeline [{label}] ({total_runs} runs, figures=sample:8): "
+            f"{wall:.1f}s wall"
+        )
+    e2e_wall = e2e["cold"]["wall_s"]
 
     result = {
         "metric": METRIC
@@ -389,11 +409,13 @@ def child_main() -> None:
         "p50_diff_ms_amortized": None if np.isnan(amort_tpu) else round(amort_tpu, 4),
         "p50_diff_ms_oracle": None if np.isnan(p50_base) else round(p50_base, 3),
         "oracle_graphs_per_sec": round(base_graphs_per_sec, 1),
+        "p50_diff_impl": diff_impl,
         "e2e": {
             "runs": total_runs,
             "figures": "sample:8",
-            "wall_s": round(e2e_wall, 2),
-            "phases_s": {k: round(v, 2) for k, v in e2e_phases.items()},
+            "wall_s": e2e_wall,
+            "cold": e2e["cold"],
+            "warm": e2e["warm"],
         },
     }
     if jax.default_backend() == "tpu":

@@ -58,10 +58,12 @@ def select_figure_iters(
     if policy in ("", "all"):
         return list(iters)
     sel: set[int] = set()
+    include_good = False
     if policy == "none":
         pass
     elif policy == "failed":
         sel = set(failed_iters)
+        include_good = True
     elif policy.startswith("sample:"):
         n = int(policy.split(":", 1)[1])
         failed_set = set(failed_iters)
@@ -70,11 +72,15 @@ def select_figure_iters(
             if pool and n > 0:
                 stride = max(1, len(pool) // n)
                 sel.update(pool[::stride][:n])
+        include_good = n > 0
     else:
         raise ValueError(
             f"unknown figure policy {policy!r} (expected all, failed, sample:N, none)"
         )
-    if good_iter is not None and sel:
+    # The good baseline run always renders under the restrictive policies —
+    # including on an all-success corpus (ADVICE r2: 'failed'/'sample:N'
+    # used to render nothing when no run failed).
+    if include_good and good_iter is not None:
         sel.add(good_iter)
     return [i for i in iters if i in sel]
 
@@ -120,12 +126,14 @@ def run_debug(
         # emits nonsense when run 0 failed (differential-provenance.go:22);
         # here the backend's good-run policy (base.py:good_run_iter) decides,
         # and on an all-failed corpus diff + corrections are skipped with a
-        # warning instead of raising.
+        # warning instead of raising.  Computed unconditionally (ADVICE r2):
+        # the restrictive figure policies include the good baseline run even
+        # on an all-success corpus.
         good_iter: int | None = None
-        if failed_iters:
-            try:
-                good_iter = backend.good_run_iter()
-            except NoSuccessfulRunError:
+        try:
+            good_iter = backend.good_run_iter()
+        except NoSuccessfulRunError:
+            if failed_iters:
                 print(
                     "warning: no successful run in corpus; skipping "
                     "differential provenance and correction synthesis "
@@ -154,7 +162,9 @@ def run_debug(
             diff_dots, failed_dots = [], []
             missing_events: list[list] = [[] for _ in failed_iters]
             corrections: list[str] = []
-            if good_iter is not None:
+            # Diff + corrections only when failures exist (reference:
+            # main.go:166-173 gates GenerateCorrections on failures).
+            if good_iter is not None and failed_iters:
                 success_post_dot = (
                     post_dots[fig_iters.index(good_iter)]
                     if good_iter in fig_set
@@ -207,8 +217,15 @@ def run_debug(
         this_results_dir = os.path.join(results_root, molly.run_name)
         reporter.prepare(results_root, this_results_dir)
 
+        # Each run entry carries the backend's chosen good-run iteration so
+        # the report frontend points its diff layer stack at the right run
+        # instead of re-deriving the policy in JS (ADVICE r2).  Extra key on
+        # the reference schema; the reference frontend ignores unknown keys.
+        run_jsons = [r.to_json() for r in runs]
+        for rj in run_jsons:
+            rj["goodRunIteration"] = good_iter
         with open(os.path.join(this_results_dir, "debugging.json"), "w", encoding="utf-8") as fh:
-            json.dump([r.to_json() for r in runs], fh)
+            json.dump(run_jsons, fh)
 
         reporter.generate_figures(fig_iters, "spacetime", hazard_dots)
         reporter.generate_figures(fig_iters, "pre_prov", pre_dots)

@@ -148,13 +148,27 @@ class PackedBatch:
 
 
 def pack_batch(
-    run_ids: list[int], graphs: list[PackedGraph], v: int | None = None, e: int | None = None
+    run_ids: list[int],
+    graphs: list[PackedGraph],
+    v: int | None = None,
+    e: int | None = None,
+    b: int | None = None,
 ) -> PackedBatch:
-    b = len(graphs)
+    """Pack graphs into one padded batch.  `b` pads the RUN axis beyond
+    len(graphs) with fully-masked rows (empty graphs): batch size is a shape
+    dim in the compiled program's signature, so padding it to a common
+    bucket lets differently-sized corpora share one compiled program.
+    Padding rows never surface — consumers iterate `run_ids` (len = actual
+    batch) and every kernel respects node_mask/edge_mask."""
+    b = b or len(graphs)
+    if b < len(graphs):
+        raise ValueError(f"batch pad {b} smaller than graph count {len(graphs)}")
     v = v or bucket_size(max((g.n_nodes for g in graphs), default=1))
     e = e or bucket_size(max((len(g.edges) for g in graphs), default=1))
-    n_nodes = np.array([g.n_nodes for g in graphs], dtype=np.int32)
-    n_goals = np.array([g.n_goals for g in graphs], dtype=np.int32)
+    n_nodes = np.zeros(b, dtype=np.int32)
+    n_goals = np.zeros(b, dtype=np.int32)
+    n_nodes[: len(graphs)] = [g.n_nodes for g in graphs]
+    n_goals[: len(graphs)] = [g.n_goals for g in graphs]
     is_goal = np.zeros((b, v), dtype=bool)
     node_mask = np.zeros((b, v), dtype=bool)
     table_id = np.full((b, v), -1, dtype=np.int32)
@@ -241,10 +255,16 @@ def bucketize_pairs(
     for (v, e), (rids, pres, posts) in sorted(groups.items()):
         step = max_batch or len(rids)
         for s in range(0, len(rids), step):
+            chunk = rids[s : s + step]
+            # Pad the run axis to a power-of-two bucket (capped at max_batch)
+            # so differently-sized corpora share compiled programs.
+            b_pad = bucket_size(len(chunk), 8)
+            if max_batch:
+                b_pad = min(b_pad, max_batch)
             batches.append(
                 (
-                    pack_batch(rids[s : s + step], pres[s : s + step], v, e),
-                    pack_batch(rids[s : s + step], posts[s : s + step], v, e),
+                    pack_batch(chunk, pres[s : s + step], v, e, b_pad),
+                    pack_batch(chunk, posts[s : s + step], v, e, b_pad),
                 )
             )
     return batches

@@ -126,12 +126,21 @@ def load_molly_output(output_dir: str) -> MollyOutput:
 
         # Per-run provenance files are indexed by position i, not by the
         # iteration field (molly.go:59-60).
-        for cond, attr in (("pre", "pre_prov"), ("post", "post_prov")):
-            prov_path = os.path.join(output_dir, f"run_{i}_{cond}_provenance.json")
-            with open(prov_path, "r", encoding="utf-8") as f:
-                prov = ProvData.from_json(json.load(f))
-            _fix_clock_times(prov)
-            _namespace_prov(prov, run.iteration, cond)
-            setattr(run, attr, prov)
+        load_run_prov(output_dir, i, run)
 
     return out
+
+
+def load_run_prov(output_dir: str, position: int, run) -> None:
+    """Parse + namespace one run's two provenance files (indexed by file
+    POSITION, not iteration — molly.go:59-60).  Split out of
+    load_molly_output so chunked-ingestion producers (service/client.py)
+    can parse a subset of runs per chunk, overlapping parse/pack of chunk
+    k+1 with device execution of chunk k."""
+    for cond, attr in (("pre", "pre_prov"), ("post", "post_prov")):
+        prov_path = os.path.join(output_dir, f"run_{position}_{cond}_provenance.json")
+        with open(prov_path, "r", encoding="utf-8") as f:
+            prov = ProvData.from_json(json.load(f))
+        _fix_clock_times(prov)
+        _namespace_prov(prov, run.iteration, cond)
+        setattr(run, attr, prov)

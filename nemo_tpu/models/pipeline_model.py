@@ -96,9 +96,9 @@ def analysis_step(
     — changing NEMO_CLOSURE_IMPL between calls takes effect instead of
     silently hitting the stale trace."""
     if closure_impl == "auto":
-        closure_impl = os.environ.get("NEMO_CLOSURE_IMPL", "auto")
-        if closure_impl == "auto":
-            closure_impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+        from nemo_tpu.ops.adjacency import resolve_closure_impl
+
+        closure_impl = resolve_closure_impl()
     return _analysis_step_jit(
         pre,
         post,

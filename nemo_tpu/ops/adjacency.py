@@ -61,6 +61,19 @@ def step_backward(frontier: jax.Array, adj: jax.Array) -> jax.Array:
     return prod > 0.5
 
 
+def resolve_closure_impl(impl: str | None = None) -> str:
+    """Resolve a closure implementation request to a concrete one:
+    None/"auto" -> NEMO_CLOSURE_IMPL env, defaulting to pallas on TPU
+    backends and xla elsewhere.  The single resolution point for closure(),
+    the fused analysis step's pre-jit resolution, and the benchmark."""
+    impl = impl or os.environ.get("NEMO_CLOSURE_IMPL", "auto")
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl not in ("xla", "pallas"):
+        raise ValueError(f"unknown closure impl {impl!r} (expected auto, xla, or pallas)")
+    return impl
+
+
 def closure(adj: jax.Array, impl: str | None = None) -> jax.Array:
     """Reflexive-transitive closure (>=0 hops) by log2(V) squarings.
 
@@ -69,11 +82,7 @@ def closure(adj: jax.Array, impl: str | None = None) -> jax.Array:
     "pallas" (fused VMEM-resident chain, ops/pallas_kernels.py; interpreter
     mode off-TPU), or "auto"/None (NEMO_CLOSURE_IMPL env, defaulting to
     pallas on TPU backends)."""
-    impl = impl or os.environ.get("NEMO_CLOSURE_IMPL", "auto")
-    if impl == "auto":
-        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
-    if impl not in ("xla", "pallas"):
-        raise ValueError(f"unknown closure impl {impl!r} (expected auto, xla, or pallas)")
+    impl = resolve_closure_impl(impl)
     if impl == "pallas":
         from nemo_tpu.ops.pallas_kernels import closure_pallas
 

@@ -108,8 +108,13 @@ function missingEvents(events) {
 }
 
 function goodRunIter(runs) {
-  // Mirror the backend's baseline policy (backend/base.py good_run_iter):
-  // first success that achieved the consequent, else first success, else 0.
+  // The backend emits its chosen baseline run in debugging.json
+  // (pipeline.py: goodRunIteration), so the diff layer stack always points
+  // at the run the diff figures were actually built against.  The local
+  // mirror of the policy (base.py good_run_iter) remains only as a
+  // fallback for reports written before the field existed.
+  const emitted = runs.find((r) => r.goodRunIteration !== undefined && r.goodRunIteration !== null);
+  if (emitted) return emitted.goodRunIteration;
   const succ = runs.filter((r) => r.status === "success");
   const achieving = succ.find((r) => r.timePostHolds && Object.keys(r.timePostHolds).length);
   if (achieving) return achieving.iteration;

@@ -180,6 +180,112 @@ class RemoteExecutor:
         self._client.close()
 
 
+def _stream_pipelined(
+    target: str,
+    n_chunks: int,
+    producer_body,
+    timings: dict[str, float],
+    queue_depth: int = 2,
+    ready_deadline: float = 30.0,
+) -> list[dict[str, np.ndarray]]:
+    """Producer/consumer core of the pipelined analysis paths.
+
+    `producer_body(emit)` runs in a daemon thread and calls
+    `emit((i, pre, post, static))` per chunk; emit blocks with backpressure
+    (bounded queue) and returns False once the consumer has aborted — the
+    producer must then stop.  The bidi AnalyzeStream RPC consumes from the
+    queue, so chunk k+1 packs on the host WHILE chunk k executes on the
+    sidecar's device.
+
+    Failure contract (ADVICE r2): if the stream dies mid-flight, the abort
+    event is set and the queue drained so the producer can never block
+    forever in a full queue (leaking the thread and packed batches), and a
+    producer exception is re-raised chained (not swallowed into a generic
+    RpcError).
+    """
+    import queue
+    import threading
+
+    q: queue.Queue = queue.Queue(maxsize=max(1, queue_depth))
+    abort = threading.Event()
+    prod_exc: list[BaseException] = []
+    _END = object()
+
+    def emit(item) -> bool:
+        while not abort.is_set():
+            try:
+                q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def producer() -> None:
+        try:
+            producer_body(emit)
+        except BaseException as ex:  # surface in the consumer
+            prod_exc.append(ex)
+            emit(ex)
+        finally:
+            emit(_END)
+
+    thread = threading.Thread(target=producer, daemon=True, name="nemo-pack")
+    thread.start()
+
+    def requests():
+        while True:
+            item = q.get()
+            if item is _END or abort.is_set():
+                return
+            if isinstance(item, BaseException):
+                raise item
+            i, pre, post, static = item
+            req = pb.AnalyzeRequest(
+                pre=codec.batch_arrays_to_pb(pre),
+                post=codec.batch_arrays_to_pb(post),
+                chunk=i,
+            )
+            req.static.CopyFrom(codec.static_to_pb(static))
+            yield req
+
+    results: list[dict[str, np.ndarray] | None] = [None] * n_chunks
+    try:
+        with RemoteAnalyzer(target=target) as client:
+            client.wait_ready(ready_deadline)
+            t0 = time.perf_counter()
+            for resp in client._analyze_stream(requests(), timeout=client.timeout):
+                if not 0 <= resp.chunk < n_chunks:
+                    raise SidecarError(f"bad chunk ordinal {resp.chunk}")
+                results[resp.chunk] = codec.outputs_from_pb(resp)
+            timings["stream_s"] = time.perf_counter() - t0
+    except BaseException as ex:
+        if prod_exc:
+            raise SidecarError(
+                f"producer failed while streaming: {prod_exc[0]!r}"
+            ) from prod_exc[0]
+        raise ex
+    finally:
+        abort.set()
+        # Unblock a producer stuck in q.put, then guarantee the sentinel is
+        # IN the queue: grpc's request-consumer thread may be blocked in the
+        # untimed q.get() inside requests(), and after abort the producer's
+        # own emit(_END) no-ops — without this re-put that thread would leak.
+        while True:
+            try:
+                q.put_nowait(_END)
+                break
+            except queue.Full:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    continue
+        thread.join(timeout=5.0)
+    missing = [i for i, o in enumerate(results) if o is None]
+    if missing:
+        raise SidecarError(f"missing responses for chunks {missing}")
+    return results  # type: ignore[return-value]
+
+
 def analyze_dirs(
     target: str, molly_dirs: list[str], queue_depth: int = 2
 ) -> tuple[list[dict[str, np.ndarray]], dict[str, float]]:
@@ -194,60 +300,87 @@ def analyze_dirs(
     pack_s, stream_s, wall_s — overlap win = pack_s + stream_s - wall_s
     when positive).
     """
-    import queue
-    import threading
-
     t_wall0 = time.perf_counter()
     timings = {"pack_s": 0.0, "stream_s": 0.0, "wall_s": 0.0}
-    q: queue.Queue = queue.Queue(maxsize=max(1, queue_depth))
-    _END = object()
 
-    def producer() -> None:
+    def body(emit) -> None:
         from nemo_tpu.ingest.native import pack_molly_dir
 
-        try:
-            for i, d in enumerate(molly_dirs):
-                t0 = time.perf_counter()
-                packed = pack_molly_dir(d)
-                timings["pack_s"] += time.perf_counter() - t0
-                q.put((i, packed))
-        except BaseException as ex:  # surface in the consumer
-            q.put(ex)
-        finally:
-            q.put(_END)
-
-    threading.Thread(target=producer, daemon=True, name="nemo-pack").start()
-
-    def requests():
-        while True:
-            item = q.get()
-            if item is _END:
+        for i, d in enumerate(molly_dirs):
+            t0 = time.perf_counter()
+            pre, post, static = pack_molly_dir(d)
+            timings["pack_s"] += time.perf_counter() - t0
+            if not emit((i, pre, post, static)):
                 return
-            if isinstance(item, BaseException):
-                raise item
-            i, (pre, post, static) = item
-            req = pb.AnalyzeRequest(
-                pre=codec.batch_arrays_to_pb(pre),
-                post=codec.batch_arrays_to_pb(post),
-                chunk=i,
-            )
-            req.static.CopyFrom(codec.static_to_pb(static))
-            yield req
 
-    results: list[dict[str, np.ndarray] | None] = [None] * len(molly_dirs)
-    with RemoteAnalyzer(target=target) as client:
-        client.wait_ready()
-        t0 = time.perf_counter()
-        for resp in client._analyze_stream(requests(), timeout=client.timeout):
-            if not 0 <= resp.chunk < len(molly_dirs):
-                raise SidecarError(f"bad chunk ordinal {resp.chunk}")
-            results[resp.chunk] = codec.outputs_from_pb(resp)
-        timings["stream_s"] = time.perf_counter() - t0
-    missing = [i for i, o in enumerate(results) if o is None]
-    if missing:
-        raise SidecarError(f"missing responses for directories {missing}")
+    results = _stream_pipelined(target, len(molly_dirs), body, timings, queue_depth)
     timings["wall_s"] = time.perf_counter() - t_wall0
-    return results, timings  # type: ignore[return-value]
+    return results, timings
+
+
+def _merge_chunk_outputs(
+    spans: list[tuple[int, int]], results: list[dict[str, np.ndarray]]
+) -> dict[str, np.ndarray]:
+    """Merge per-chunk fused-step outputs into the unchunked equivalent.
+
+    Per-run rows: pad trailing dims up to the widest chunk's (the corpus
+    vocab is append-only, so an earlier chunk's table/label columns are a
+    prefix of a later one's; absent columns pad False/0, and
+    proto_min_depth pads DEPTH_INF = "table absent"), drop the prepended
+    good row of chunks > 0, concatenate.
+
+    Cross-run reductions (proto_inter/proto_union) are recomputed exactly
+    from the merged per-run proto_bits + achieved_pre with reduce_protos
+    semantics (ops/proto.py:81-87) — NOT by AND/OR-ing the chunks' own
+    reductions, which would require every chunk to contain an achieving run
+    and would crash on width-mismatched 1-D outputs.
+    """
+    from nemo_tpu.models.pipeline_model import CORPUS_REDUCTIONS
+    from nemo_tpu.ops.proto import DEPTH_INF
+
+    # Every registered reduction key needs an explicit recompute rule below;
+    # silently dropping one (or AND/OR-ing chunk reductions, which is wrong
+    # when a chunk has no achieving run) must fail loudly instead.
+    unmerged = set(CORPUS_REDUCTIONS) & set(results[0]) - {"proto_inter", "proto_union"}
+    if unmerged:
+        raise SidecarError(
+            f"no chunk-merge rule for reduction outputs {sorted(unmerged)}; "
+            "add a recompute in _merge_chunk_outputs"
+        )
+    pad_value = {"proto_min_depth": DEPTH_INF}
+    merged: dict[str, np.ndarray] = {}
+    for key in results[0]:
+        if key in CORPUS_REDUCTIONS:
+            continue  # recomputed from per-run outputs below
+        arrs = [r[key] for r in results]
+        trailing = tuple(
+            max(a.shape[d] for a in arrs) for d in range(1, arrs[0].ndim)
+        )
+        padded = []
+        for a in arrs:
+            if a.shape[1:] != trailing:
+                wide = np.full(
+                    (a.shape[0],) + trailing, pad_value.get(key, 0), dtype=a.dtype
+                )
+                wide[tuple(slice(0, s) for s in a.shape)] = a
+                a = wide
+            padded.append(a)
+        for (s, e), r in zip(spans, padded):
+            expected = (e - s) + (1 if s > 0 else 0)
+            if r.shape[0] != expected:
+                raise SidecarError(
+                    f"output {key!r} is not per-run shaped "
+                    f"(got leading dim {r.shape[0]}, batch {expected}); "
+                    "register it in models.pipeline_model.CORPUS_REDUCTIONS"
+                )
+        merged[key] = np.concatenate([padded[0]] + [r[1:] for r in padded[1:]], axis=0)
+
+    bits = merged["proto_bits"].astype(bool)
+    ach = merged["achieved_pre"].astype(bool)
+    masked = bits & ach[:, None]
+    merged["proto_inter"] = np.all(masked | ~ach[:, None], axis=0) & ach.any()
+    merged["proto_union"] = np.any(masked, axis=0)
+    return merged
 
 
 def analyze_dir(target: str, molly_dir: str, chunk_runs: int = 0) -> dict[str, np.ndarray]:
@@ -284,27 +417,71 @@ def analyze_dir(target: str, molly_dir: str, chunk_runs: int = 0) -> dict[str, n
         ]
         results = client.analyze_chunks(chunks)
 
-    from nemo_tpu.models.pipeline_model import CORPUS_REDUCTIONS
+    return _merge_chunk_outputs(spans, results)
 
-    merged: dict[str, np.ndarray] = {}
-    for key in results[0]:
-        how = CORPUS_REDUCTIONS.get(key)
-        if how == "and":
-            merged[key] = np.logical_and.reduce([r[key] for r in results])
-        elif how == "or":
-            merged[key] = np.logical_or.reduce([r[key] for r in results])
-        else:
-            # Per-run rows: drop the prepended good-run row of chunks > 0.
-            # Guard against an unregistered reduction output silently being
-            # concatenated as if it were per-run (CORPUS_REDUCTIONS contract).
-            for (s, e), r in zip(spans, results):
-                expected = (e - s) + (1 if s > 0 else 0)
-                if r[key].shape[0] != expected:
-                    raise SidecarError(
-                        f"output {key!r} is not per-run shaped "
-                        f"(got leading dim {r[key].shape[0]}, batch {expected}); "
-                        "register it in models.pipeline_model.CORPUS_REDUCTIONS"
-                    )
-            parts = [results[0][key]] + [r[key][1:] for r in results[1:]]
-            merged[key] = np.concatenate(parts, axis=0)
-    return merged
+
+def analyze_dir_pipelined(
+    target: str, molly_dir: str, chunk_runs: int = 512, queue_depth: int = 2
+) -> tuple[dict[str, np.ndarray], dict[str, float]]:
+    """Single-directory analysis with ingest/compute overlap (VERDICT r2
+    item 8): one big Molly family is parsed + packed in CHUNKS of
+    chunk_runs by the producer thread, so chunk k+1's JSON parse/pack
+    overlaps chunk k's device execution — the same pipeline shape
+    analyze_dirs gives across directories, inside one directory.
+
+    Chunk semantics match analyze_dir's chunked path: every chunk after the
+    first gets the corpus's baseline run (file position 0 — the batch row
+    the fused step diffs against, matching the unchunked dispatch)
+    prepended.  Chunks pack against the shared, append-only corpus vocab,
+    so later chunks may have wider table/label dims and bigger node
+    buckets; _merge_chunk_outputs pads and recombines them into the exact
+    unchunked result.
+
+    Returns (merged outputs, timings with pack_s / stream_s / wall_s —
+    overlap win = pack_s + stream_s - wall_s when positive)."""
+    import json as _json
+    import os as _os
+
+    from nemo_tpu.graphs.packed import CorpusVocab, pack_graph
+    from nemo_tpu.ingest.datatypes import RunData
+    from nemo_tpu.ingest.molly import load_run_prov
+    from nemo_tpu.models.pipeline_model import graphs_to_step
+
+    t_wall0 = time.perf_counter()
+    timings = {"pack_s": 0.0, "stream_s": 0.0, "wall_s": 0.0}
+
+    with open(_os.path.join(molly_dir, "runs.json"), "r", encoding="utf-8") as f:
+        raw_runs = _json.load(f)
+    n = len(raw_runs)
+    if n == 0:
+        raise SidecarError(f"no runs in {molly_dir} (empty runs.json)")
+    chunk_runs = max(1, chunk_runs)
+    spans = [(s, min(s + chunk_runs, n)) for s in range(0, n, chunk_runs)]
+    vocab = CorpusVocab()
+    good: dict = {}  # filled by chunk 0: {"rid", "pre", "post"}
+
+    def body(emit) -> None:
+        for ci, (s, e) in enumerate(spans):
+            t0 = time.perf_counter()
+            rids, pres, posts = [], [], []
+            if ci > 0:
+                rids.append(good["rid"])
+                pres.append(good["pre"])
+                posts.append(good["post"])
+            for pos in range(s, e):
+                run = RunData.from_json(raw_runs[pos])
+                load_run_prov(molly_dir, pos, run)
+                rids.append(run.iteration)
+                pres.append(pack_graph(run.pre_prov, vocab))
+                posts.append(pack_graph(run.post_prov, vocab))
+            if ci == 0:
+                good.update(rid=rids[0], pre=pres[0], post=posts[0])
+            pre_b, post_b, static = graphs_to_step(rids, pres, posts, vocab)
+            timings["pack_s"] += time.perf_counter() - t0
+            if not emit((ci, pre_b, post_b, static)):
+                return
+
+    results = _stream_pipelined(target, len(spans), body, timings, queue_depth)
+    merged = _merge_chunk_outputs(spans, results)
+    timings["wall_s"] = time.perf_counter() - t_wall0
+    return merged, timings
