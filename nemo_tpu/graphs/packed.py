@@ -235,17 +235,21 @@ def bucketize_pairs(
     pre_graphs: list[PackedGraph],
     post_graphs: list[PackedGraph],
     max_batch: int | None = None,
+    min_v: int = 16,
+    min_e: int = 16,
 ) -> list[tuple[PackedBatch, PackedBatch]]:
     """Joint size-bucketing over (pre, post) graph pairs: both conditions of
     a run share one bucket, padded to the pair's common (V, E) — the shape
     contract of the fused analysis step (models/pipeline_model.py), which
     takes the pre and post batches of the same runs in one dispatch.
-    Preserves run order within each bucket."""
+    Preserves run order within each bucket.  min_v/min_e floor the bucket
+    dims (compile-sharing knob: higher floors merge buckets, trading padded
+    FLOPs for fewer compiled programs)."""
     groups: dict[tuple[int, int], tuple[list[int], list[PackedGraph], list[PackedGraph]]] = {}
     for rid, gpre, gpost in zip(run_ids, pre_graphs, post_graphs):
         key = (
-            bucket_size(max(gpre.n_nodes, gpost.n_nodes)),
-            bucket_size(max(1, len(gpre.edges), len(gpost.edges))),
+            bucket_size(max(gpre.n_nodes, gpost.n_nodes), min_v),
+            bucket_size(max(1, len(gpre.edges), len(gpost.edges)), min_e),
         )
         groups.setdefault(key, ([], [], []))
         groups[key][0].append(rid)

@@ -90,11 +90,18 @@ def analysis_step(
     num_labels: int,
     max_depth: int,
     closure_impl: str = "auto",
+    with_diff: bool = True,
 ) -> dict[str, jnp.ndarray]:
     """Jit-cached wrapper that resolves closure_impl="auto" (env + backend)
     BEFORE entering jit, so the resolved impl is part of the static cache key
     — changing NEMO_CLOSURE_IMPL between calls takes effect instead of
-    silently hitting the stale trace."""
+    silently hitting the stale trace.
+
+    with_diff=False drops the differential-provenance tail (diff vs batch
+    row 0) AND the num_labels dim from the compiled program — the
+    production JaxBackend runs diff as its own good-run-anchored dispatch,
+    and without the label vocab in the signature every corpus with the same
+    (V, E, B, T, depth) buckets shares one compiled program."""
     if closure_impl == "auto":
         from nemo_tpu.ops.adjacency import resolve_closure_impl
 
@@ -106,9 +113,10 @@ def analysis_step(
         pre_tid=pre_tid,
         post_tid=post_tid,
         num_tables=num_tables,
-        num_labels=num_labels,
+        num_labels=num_labels if with_diff else 1,
         max_depth=max_depth,
         closure_impl=closure_impl,
+        with_diff=with_diff,
     )
 
 
@@ -124,6 +132,7 @@ def analysis_step(
         "num_labels",
         "max_depth",
         "closure_impl",
+        "with_diff",
     ),
 )
 def _analysis_step_jit(
@@ -136,6 +145,7 @@ def _analysis_step_jit(
     num_labels: int,
     max_depth: int,
     closure_impl: str = "auto",
+    with_diff: bool = True,
 ) -> dict[str, jnp.ndarray]:
     """The full fused pipeline for one run batch.  Returns per-run and
     corpus-level results; everything stays on device."""
@@ -175,23 +185,7 @@ def _analysis_step_jit(
     present = all_rule_bits(post.is_goal, post_alive2, post.table_id, num_tables)
     inter, union = reduce_protos(bits, achieved_pre)
 
-    # Differential provenance of every run vs the successful run in row 0
-    # (differential-provenance.go:18-243).  Label bitsets per run.
-    lid = jnp.clip(post.label_id, 0, num_labels - 1)
-    sel = post.is_goal & post.node_mask & (post.label_id >= 0)
-    run_bits = jnp.zeros((post.label_id.shape[0], num_labels), dtype=bool)
-    run_bits = jax.vmap(lambda b, l, m: b.at[l].max(m))(run_bits, lid, sel)
-    node_keep, edge_keep, frontier_rule, missing_goal = diff_masks(
-        adj_post[0],
-        post.is_goal[0],
-        post.node_mask[0],
-        post.label_id[0],
-        run_bits,
-        max_depth,
-        closure_impl=closure_impl,
-    )
-
-    return {
+    out = {
         "pre_holds": pre_holds,
         "post_holds": post_holds,
         "achieved_pre": achieved_pre,
@@ -206,10 +200,27 @@ def _analysis_step_jit(
         "proto_present": present,
         "proto_inter": inter,
         "proto_union": union,
-        "diff_node_keep": node_keep,
-        "diff_frontier_rule": frontier_rule,
-        "diff_missing_goal": missing_goal,
     }
+    if with_diff:
+        # Differential provenance of every run vs the successful run in row
+        # 0 (differential-provenance.go:18-243).  Label bitsets per run.
+        lid = jnp.clip(post.label_id, 0, num_labels - 1)
+        sel = post.is_goal & post.node_mask & (post.label_id >= 0)
+        run_bits = jnp.zeros((post.label_id.shape[0], num_labels), dtype=bool)
+        run_bits = jax.vmap(lambda b, l, m: b.at[l].max(m))(run_bits, lid, sel)
+        node_keep, edge_keep, frontier_rule, missing_goal = diff_masks(
+            adj_post[0],
+            post.is_goal[0],
+            post.node_mask[0],
+            post.label_id[0],
+            run_bits,
+            max_depth,
+            closure_impl=closure_impl,
+        )
+        out["diff_node_keep"] = node_keep
+        out["diff_frontier_rule"] = frontier_rule
+        out["diff_missing_goal"] = missing_goal
+    return out
 
 
 def graphs_to_step(

@@ -72,3 +72,97 @@ def diff_masks(
         return node_keep, edge_keep, frontier_rule, missing_goal
 
     return jax.vmap(per_run)(fail_bits)
+
+
+def diff_masks_host(
+    edges,  # [E,2] int (src,dst) of the good run's consequent provenance
+    n_nodes: int,
+    is_goal,  # [V] bool (numpy)
+    label_id,  # [V] int
+    fail_bits,  # [B,L] bool
+):
+    """Sparse host-side diff_masks for ONE giant good run.
+
+    Semantics identical to diff_masks, but O(B * (V + E)) on the packed
+    edge list instead of dense [V,V] device arrays: a 10k-node good graph's
+    dense closure is V^3-prohibitive, while its real edge count is ~V (the
+    giant-graph path, backend/jax_backend.py NEMO_GIANT_V dispatch).
+
+    Returns (node_keep [B,V], edge_keep_mask [B,E] — a mask over `edges`
+    rather than a dense [V,V] — frontier_rule [B,V], missing_goal [B,V]).
+    """
+    import numpy as np
+
+    v = n_nodes
+    e = len(edges)
+    src = edges[:, 0] if e else np.zeros(0, dtype=np.int64)
+    dst = edges[:, 1] if e else np.zeros(0, dtype=np.int64)
+    b = fail_bits.shape[0]
+    num_labels = fail_bits.shape[-1]
+    lid = np.clip(label_id, 0, num_labels - 1)
+
+    out_adj: list[list[int]] = [[] for _ in range(v)]
+    in_adj: list[list[int]] = [[] for _ in range(v)]
+    for s, d in zip(src.tolist(), dst.tolist()):
+        out_adj[s].append(d)
+        in_adj[d].append(s)
+
+    def reach(start_mask, adj):
+        seen = start_mask.copy()
+        stack = list(np.nonzero(start_mask)[0])
+        while stack:
+            u = stack.pop()
+            for w in adj[u]:
+                if not seen[w]:
+                    seen[w] = True
+                    stack.append(w)
+        return seen
+
+    node_keep = np.zeros((b, v), dtype=bool)
+    edge_keep = np.zeros((b, e), dtype=bool)
+    frontier_rule = np.zeros((b, v), dtype=bool)
+    missing_goal = np.zeros((b, v), dtype=bool)
+    for j in range(b):
+        in_failed = fail_bits[j][lid] & (label_id >= 0)
+        ok = is_goal & ~in_failed
+        fwd = reach(ok, out_adj)  # >=0 hops from an ok goal
+        bwd = reach(ok, in_adj)  # >=0 hops to an ok goal
+        keep = fwd & bwd
+        node_keep[j] = keep
+        ek = keep[src] & keep[dst] if e else edge_keep[j]
+        edge_keep[j] = ek
+
+        indeg = np.zeros(v, dtype=np.int64)
+        outdeg = np.zeros(v, dtype=np.int64)
+        np.add.at(indeg, dst[ek], 1)
+        np.add.at(outdeg, src[ek], 1)
+        root = is_goal & keep & (indeg == 0)
+        leaf = is_goal & keep & (outdeg == 0)
+
+        # Longest path from roots by topological relaxation over kept edges.
+        dist = np.where(root, 0, NEG_INF)
+        kout: list[list[int]] = [[] for _ in range(v)]
+        for s, d in zip(src[ek].tolist(), dst[ek].tolist()):
+            kout[s].append(d)
+        deg = indeg.copy()
+        stack = [u for u in range(v) if keep[u] and deg[u] == 0]
+        while stack:
+            u = stack.pop()
+            du = dist[u]
+            for w in kout[u]:
+                if du + 1 > dist[w]:
+                    dist[w] = du + 1
+                deg[w] -= 1
+                if deg[w] == 0:
+                    stack.append(w)
+
+        leaf_dist = np.where(leaf & (dist >= 1), dist, NEG_INF)
+        max_len = leaf_dist.max() if v else NEG_INF
+        deepest_leaf = leaf & (dist == max_len)
+        to_deepest = np.zeros(v, dtype=bool)
+        np.logical_or.at(to_deepest, src[ek], deepest_leaf[dst[ek]])
+        frontier_rule[j] = ~is_goal & keep & (dist + 1 == max_len) & to_deepest
+        from_frontier = np.zeros(v, dtype=bool)
+        np.logical_or.at(from_frontier, dst[ek], frontier_rule[j][src[ek]])
+        missing_goal[j] = is_goal & keep & from_frontier
+    return node_keep, edge_keep, frontier_rule, missing_goal

@@ -41,6 +41,22 @@ def hop_depths(adj: jax.Array, start: jax.Array, max_depth: int) -> jax.Array:
     return lax.fori_loop(0, max_depth, body, depth)
 
 
+def _bfs_reach(start: jax.Array, adj: jax.Array, max_depth: int, backward: bool = False) -> jax.Array:
+    """Set-BFS: nodes reachable from `start` in >= 1 hop (forward along
+    edges, or backward with backward=True).  O(max_depth * V^2) — the
+    giant-graph alternative to materializing the all-pairs closure."""
+    hop = step_backward if backward else step_forward
+
+    def body(_, carry):
+        frontier, acc = carry
+        frontier = hop(frontier, adj)
+        return frontier, acc | frontier
+
+    first = hop(start, adj)
+    _, acc = lax.fori_loop(0, max(0, max_depth - 1), body, (first, first))
+    return acc
+
+
 def proto_rule_bits(
     adj: jax.Array,  # [B,V,V] simplified consequent adjacency
     is_goal: jax.Array,  # [B,V]
@@ -50,16 +66,26 @@ def proto_rule_bits(
     num_tables: int,
     max_depth: int,
     closure_impl: str = "auto",
+    use_closure: bool = True,
 ) -> tuple[jax.Array, jax.Array]:
-    """Returns (bits [B,T] bool, min_rule_depth [B,T] int32)."""
+    """Returns (bits [B,T] bool, min_rule_depth [B,T] int32).
+
+    use_closure=False swaps the all-pairs closure for three bounded
+    set-BFS sweeps (O(max_depth * V^2) instead of O(V^3 log V)) — the
+    giant-graph path, exact when max_depth >= the longest path."""
     a = adj & alive[..., None] & alive[..., None, :]
     root = is_goal & alive & ~in_degree_any(a)
-    clo = closure(a, impl=closure_impl)
-    d1 = reach_ge1(a, clo)  # >=1-hop reachability
-    reach = step_forward(root, d1) | jnp.zeros_like(root)  # nodes >=1 hop below a root
     is_rule = ~is_goal & alive
-    rule_desc = step_backward(is_rule, d1)  # has a rule strictly below
-    rule_anc = step_forward(is_rule & reach, d1)  # has a reachable rule strictly above
+    if use_closure:
+        clo = closure(a, impl=closure_impl)
+        d1 = reach_ge1(a, clo)  # >=1-hop reachability
+        reach = step_forward(root, d1) | jnp.zeros_like(root)  # nodes >=1 hop below a root
+        rule_desc = step_backward(is_rule, d1)  # has a rule strictly below
+        rule_anc = step_forward(is_rule & reach, d1)  # has a reachable rule strictly above
+    else:
+        reach = _bfs_reach(root, a, max_depth)
+        rule_desc = _bfs_reach(is_rule, a, max_depth, backward=True)
+        rule_anc = _bfs_reach(is_rule & reach, a, max_depth)
     qualify = is_rule & reach & (rule_desc | rule_anc) & achieved_pre[..., None]
 
     depth = hop_depths(a, root, max_depth)

@@ -45,14 +45,70 @@ def clean_masks(
     return adj_clean, alive
 
 
+def _labels_closure(und, member, v, idx, closure_impl):
+    """Component labels = min member index reachable in the undirected
+    member subgraph (closure on the MXU; log2(V) squarings, O(V^3 log V))."""
+    comp_reach = closure(und, impl=closure_impl)  # includes identity
+    return jnp.min(
+        jnp.where(comp_reach & member[..., None], idx[None, :, None], v), axis=-2
+    )  # [B,V]; == v for non-members
+
+
+def _labels_prop(und, member, v, idx, iters):
+    """Min-label propagation, O(iters * V^2).  Exact when iters >= the
+    undirected diameter of the widest member component."""
+    lab0 = jnp.where(member, idx, v)
+
+    def prop(_, lb):
+        neigh = jnp.min(jnp.where(und, lb[..., None, :], v), axis=-1)
+        return jnp.minimum(lb, neigh)
+
+    lab = jax.lax.fori_loop(0, iters, prop, lab0)
+    return jnp.where(member, lab, v)
+
+
+def _labels_doubling(a, member, v, idx):
+    """Pointer-doubling along the DIRECTED member successor, O(V log V)
+    after one O(V^2) argmax: every member's pointer converges to its chain
+    tail in log2(V) jumps, and the tail index is the component label.
+    Exact ONLY for linear chains (each member has <= 1 member successor) —
+    the shape @next persistence rules generate (`t(C+1)@next :- t(C)`,
+    SURVEY.md §5); the giant-graph dispatcher verifies linearity host-side
+    before choosing this method (parallel/giant.py)."""
+    succ_mask = a & member[..., None] & member[..., None, :]
+    has_succ = succ_mask.any(axis=-1)
+    p = jnp.where(has_succ, jnp.argmax(succ_mask, axis=-1), idx)  # [B,V]
+
+    def jump(_, p):
+        return jnp.take_along_axis(p, p, axis=-1)
+
+    n_iters = max(1, (v - 1).bit_length())
+    p = jax.lax.fori_loop(0, n_iters, jump, p)
+    return jnp.where(member, p, v)
+
+
 def collapse_chains(
     adj: jax.Array,  # [B,V,V] clean adjacency
     is_goal: jax.Array,  # [B,V]
     type_id: jax.Array,  # [B,V]
     alive: jax.Array,  # [B,V]
     closure_impl: str = "auto",
+    comp_iters: int | None = None,
+    comp_doubling: bool = False,
+    rewire: str = "matmul",
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """Returns (adj_new, alive_new, type_new)."""
+    """Returns (adj_new, alive_new, type_new).
+
+    Component labeling (any consistent member-index-valued label works):
+      default            all-pairs closure on the MXU — right for the
+                         small-V batched buckets;
+      comp_iters=<int>   bounded min-label propagation, O(iters * V^2);
+      comp_doubling      pointer doubling, O(V log V) — linear chains only
+                         (the giant deep-@next path).
+
+    rewire: "matmul" moves pred/succ edges onto representatives with two
+    boolean matmuls (MXU, O(V^3) — fine batched at small V); "scatter"
+    uses column/row scatters instead (O(V^2) — the giant path)."""
     v = adj.shape[-1]
     idx = jnp.arange(v)
 
@@ -63,13 +119,14 @@ def collapse_chains(
     chain_goal = is_goal & alive & in_from_next & out_to_next
     member = next_rule | chain_goal
 
-    # Component labels = min member index reachable in the undirected member
-    # subgraph (closure on the MXU; log2(V) squarings).
-    und = (a | jnp.swapaxes(a, -1, -2)) & member[..., None] & member[..., None, :]
-    comp_reach = closure(und, impl=closure_impl)  # includes identity
-    lab = jnp.min(
-        jnp.where(comp_reach & member[..., None], idx[None, :, None], v), axis=-2
-    )  # [B,V]; == v for non-members
+    if comp_doubling:
+        lab = _labels_doubling(a, member, v, idx)
+    else:
+        und = (a | jnp.swapaxes(a, -1, -2)) & member[..., None] & member[..., None, :]
+        if comp_iters is None:
+            lab = _labels_closure(und, member, v, idx, closure_impl)
+        else:
+            lab = _labels_prop(und, member, v, idx, comp_iters)
     lab_c = jnp.clip(lab, 0, v - 1)
 
     in_from_member = step_forward(member, a)
@@ -91,14 +148,31 @@ def collapse_chains(
     is_rep = node_collapsible & (idx == rep_of_node)
     dies = node_collapsible & ~is_rep
 
-    # Column/row moves onto the representative slot.
+    # Edge moves onto the representative slot: external-goal predecessors of
+    # heads rewire to the rep's column, goal successors of tails to its row.
     ext_goal = is_goal & alive & ~member
-    head_map = (rep_of_node[..., None] == idx) & head[..., None] & node_collapsible[..., None]
-    tail_map = (rep_of_node[..., None] == idx) & tail[..., None] & node_collapsible[..., None]
-    pred_edges = bool_matmul(a & ext_goal[..., None], head_map)  # goal -> rep
-    succ_edges = bool_matmul(
-        jnp.swapaxes(tail_map, -1, -2), a & ext_goal[..., None, :]
-    )  # rep -> goal
+    if rewire == "matmul":
+        head_map = (rep_of_node[..., None] == idx) & head[..., None] & node_collapsible[..., None]
+        tail_map = (rep_of_node[..., None] == idx) & tail[..., None] & node_collapsible[..., None]
+        pred_edges = bool_matmul(a & ext_goal[..., None], head_map)  # goal -> rep
+        succ_edges = bool_matmul(
+            jnp.swapaxes(tail_map, -1, -2), a & ext_goal[..., None, :]
+        )  # rep -> goal
+    elif rewire == "scatter":
+        pred_src = a & ext_goal[..., None] & (head & node_collapsible)[..., None, :]
+        succ_src = a & (tail & node_collapsible)[..., None] & ext_goal[..., None, :]
+        zeros = jnp.zeros_like(a)
+
+        def move_cols(m, rep):
+            return jnp.zeros(m.shape, dtype=bool).at[:, rep].max(m)
+
+        def move_rows(m, rep):
+            return jnp.zeros(m.shape, dtype=bool).at[rep, :].max(m)
+
+        pred_edges = zeros | jax.vmap(move_cols)(pred_src, rep_of_node)
+        succ_edges = zeros | jax.vmap(move_rows)(succ_src, rep_of_node)
+    else:
+        raise ValueError(f"unknown rewire {rewire!r} (expected matmul or scatter)")
 
     kill = node_collapsible
     adj_new = (a & ~kill[..., None] & ~kill[..., None, :]) | pred_edges | succ_edges

@@ -1,0 +1,239 @@
+"""Giant-graph analysis: the fused per-run pipeline for a provenance graph
+too large for the batched dense buckets.
+
+The batched path (models/pipeline_model.py) holds [B,V,V] adjacencies and
+runs all-pairs closures — the right trade at case-study sizes (V <= a few
+hundred), but a single giant run (deep @next chains, SURVEY.md §5's
+long-context analog) would OOM the bucket and waste V^3·log V closure work
+on a shallow DAG.  This path analyzes ONE run with:
+
+  * the node dimension sharded over a 1-D device mesh (column-sharded
+    adjacency, XLA/GSPMD inserts the ICI collectives — same layout as
+    parallel/ring.py's explicit ring schedule);
+  * closure-free kernels: component labeling by bounded min-label
+    propagation and prototype reachability by set-BFS, both
+    O(max_depth · V^2) (ops/simplify.py:collapse_chains comp_iters,
+    ops/proto.py:proto_rule_bits use_closure=False) — exact because
+    max_depth bounds the corpus's longest path.
+
+The JaxBackend auto-dispatches here when a run's node count exceeds
+NEMO_GIANT_V (backend/jax_backend.py), so one oversized run in an
+otherwise normal corpus analyzes correctly end-to-end; outputs are
+row-compatible with the fused step's (B=1).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from nemo_tpu.ops.adjacency import build_adjacency
+from nemo_tpu.ops.condition import mark_condition_holds
+from nemo_tpu.ops.proto import all_rule_bits, proto_rule_bits
+from nemo_tpu.ops.simplify import clean_masks, collapse_chains
+
+from .mesh import NODE_AXIS
+from .ring import make_node_mesh
+
+
+def giant_plan(graph) -> tuple[bool, int]:
+    """Host-side O(E) planning for one giant run (graphs.packed.PackedGraph):
+    returns (chains_linear, collapsed_depth_bound).
+
+    chains_linear: every @next chain member has at most one member
+    successor/predecessor in the CLEAN graph — true for the linear
+    `t(C+1)@next :- t(C)` chains the domain generates, enabling the
+    O(V log V) pointer-doubling labels; otherwise the giant step falls back
+    to bounded min-label propagation.
+
+    collapsed_depth_bound: longest path of the graph AFTER contracting each
+    chain component to one node (+1 margin) — the tight trip count for the
+    post-simplification BFS kernels, small even when raw chains are
+    thousands of timesteps deep."""
+    import numpy as np
+
+    from nemo_tpu.graphs.packed import TYPE_NEXT, longest_path_len
+
+    n = graph.n_nodes
+    ng = graph.n_goals
+    edges = graph.edges
+    is_goal = np.zeros(n, dtype=bool)
+    is_goal[:ng] = True
+    # clean_masks mirror: rules alive iff they have both an in-goal and an
+    # out-goal edge; edge g->r kept iff r has an out-goal, r->g iff r has an
+    # in-goal (ops/simplify.py:clean_masks).
+    has_in_goal = np.zeros(n, dtype=bool)
+    has_out_goal = np.zeros(n, dtype=bool)
+    if len(edges):
+        src, dst = edges[:, 0], edges[:, 1]
+        np.logical_or.at(has_in_goal, dst, is_goal[src])
+        np.logical_or.at(has_out_goal, src, is_goal[dst])
+    rule_alive = ~is_goal & has_in_goal & has_out_goal
+    alive = is_goal | rule_alive
+    if len(edges):
+        keep = np.where(is_goal[src], has_out_goal[dst], has_in_goal[src])
+        keep &= alive[src] & alive[dst]
+        src, dst = src[keep], dst[keep]
+    else:
+        src = dst = np.zeros(0, dtype=np.int64)
+
+    next_rule = ~is_goal & alive & (graph.type_id == TYPE_NEXT)
+    in_from_next = np.zeros(n, dtype=bool)
+    out_to_next = np.zeros(n, dtype=bool)
+    if len(src):
+        np.logical_or.at(in_from_next, dst, next_rule[src])
+        np.logical_or.at(out_to_next, src, next_rule[dst])
+    member = next_rule | (is_goal & alive & in_from_next & out_to_next)
+
+    member_edge = member[src] & member[dst] if len(src) else np.zeros(0, dtype=bool)
+    succ_count = np.zeros(n, dtype=np.int64)
+    pred_count = np.zeros(n, dtype=np.int64)
+    np.add.at(succ_count, src[member_edge], 1)
+    np.add.at(pred_count, dst[member_edge], 1)
+    linear = bool((succ_count[member] <= 1).all() and (pred_count[member] <= 1).all())
+
+    # Contract chain components (union-find over member edges) and bound the
+    # collapsed graph's longest path.
+    parent = np.arange(n)
+
+    def find(x: int) -> int:
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    for s, d in zip(src[member_edge], dst[member_edge]):
+        rs, rd = find(int(s)), find(int(d))
+        if rs != rd:
+            parent[rs] = rd
+    rep = np.array([find(i) for i in range(n)])
+    cedges = np.stack([rep[src], rep[dst]], axis=1) if len(src) else np.zeros((0, 2), int)
+    cedges = cedges[cedges[:, 0] != cedges[:, 1]]
+    depth = longest_path_len(n, cedges)
+    return linear, min(n, depth + 2)
+
+
+_MESH_CACHE: dict[int, Mesh] = {}
+
+
+def default_node_mesh(v: int) -> Mesh:
+    """Largest power-of-two device count that divides v (v is a power-of-two
+    bucket, so any power of two <= min(v, n_devices) works).  Cached per
+    size so repeat calls share one Mesh (and the jit cache below hits)."""
+    n_dev = len(jax.devices())
+    n = 1
+    while n * 2 <= n_dev and v % (n * 2) == 0:
+        n *= 2
+    mesh = _MESH_CACHE.get(n)
+    if mesh is None:
+        mesh = _MESH_CACHE[n] = make_node_mesh(n)
+    return mesh
+
+
+_JIT_CACHE: dict = {}
+
+
+def giant_analysis_step(
+    pre,
+    post,
+    v: int,
+    pre_tid: int,
+    post_tid: int,
+    num_tables: int,
+    max_depth: int,
+    comp_linear: bool = True,
+    proto_depth: int | None = None,
+    mesh: Mesh | None = None,
+) -> dict[str, jnp.ndarray]:
+    """Fused-step-compatible outputs for ONE giant run (B=1 batches).
+
+    pre/post: models.pipeline_model.BatchArrays with leading dim 1.
+    comp_linear/proto_depth come from giant_plan (host-side O(E));
+    max_depth is the RAW longest-path bound, proto_depth the collapsed
+    one (the BFS kernels run post-simplification, so the collapsed bound
+    keeps trip counts small even under thousand-step chains).
+    Returns the same keys as analysis_step(with_diff=False)."""
+    mesh = mesh or default_node_mesh(v)
+    n_dev = mesh.devices.size
+    if v % n_dev:
+        raise ValueError(f"V={v} not divisible by node mesh size {n_dev}")
+    spec_node = NamedSharding(mesh, P(None, NODE_AXIS))
+    spec_adj = NamedSharding(mesh, P(None, None, NODE_AXIS))
+    proto_depth = proto_depth or max_depth
+
+    key = (
+        tuple(d.id for d in mesh.devices.flat),  # mesh identity, not just size
+        v,
+        int(pre.edge_src.shape[-1]),
+        int(post.edge_src.shape[-1]),
+        num_tables,
+        max_depth,
+        comp_linear,
+        proto_depth,
+    )
+    fn = _JIT_CACHE.get(key)
+    if fn is None:
+
+        @jax.jit
+        def fn(pre, post, pre_tid, post_tid):
+            out = {}
+            alive2 = {}
+            for name, b, tid in (("pre", pre, pre_tid), ("post", post, post_tid)):
+                adj = build_adjacency(b.edge_src, b.edge_dst, b.edge_mask, v)
+                adj = lax.with_sharding_constraint(adj, spec_adj)
+                out[f"{name}_holds"] = mark_condition_holds(
+                    adj, b.is_goal, b.table_id, b.node_mask, tid, num_tables
+                )
+                adj_c, alive = clean_masks(adj, b.is_goal, b.node_mask)
+                # Linear chains: O(V log V) pointer doubling; otherwise
+                # bounded min-label propagation (und diameter <= 2 * raw
+                # longest path + 2, chains alternate rule/goal).  Edge
+                # rewiring always by O(V^2) scatters — no V^3 matmul.
+                adj2, alive2[name], type2 = collapse_chains(
+                    adj_c,
+                    b.is_goal,
+                    b.type_id,
+                    alive,
+                    comp_iters=None if comp_linear else 2 * max_depth + 2,
+                    comp_doubling=comp_linear,
+                    rewire="scatter",
+                )
+                out[f"{name}_adj_clean"] = lax.with_sharding_constraint(adj2, spec_adj)
+                out[f"{name}_alive"] = alive2[name]
+                out[f"{name}_type"] = type2
+            achieved = out["pre_holds"].any(axis=-1)
+            out["achieved_pre"] = achieved
+            bits, min_depth = proto_rule_bits(
+                out["post_adj_clean"],
+                post.is_goal,
+                alive2["post"],
+                post.table_id,
+                achieved,
+                num_tables,
+                proto_depth,
+                use_closure=False,
+            )
+            out["proto_bits"] = bits
+            out["proto_min_depth"] = min_depth
+            out["proto_present"] = all_rule_bits(
+                post.is_goal, alive2["post"], post.table_id, num_tables
+            )
+            return out
+
+        _JIT_CACHE[key] = fn
+
+    def shard(b):
+        import dataclasses
+
+        return dataclasses.replace(
+            b,
+            is_goal=jax.device_put(b.is_goal, spec_node),
+            table_id=jax.device_put(b.table_id, spec_node),
+            label_id=jax.device_put(b.label_id, spec_node),
+            type_id=jax.device_put(b.type_id, spec_node),
+            node_mask=jax.device_put(b.node_mask, spec_node),
+        )
+
+    return fn(shard(pre), shard(post), pre_tid, post_tid)

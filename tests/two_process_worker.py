@@ -1,0 +1,66 @@
+"""Worker for the two-process jax.distributed test (test_distributed.py).
+
+Each of the two OS processes owns 4 virtual CPU devices; jax.distributed
+wires them into one 8-device runtime and the hybrid (dcn=2, ici=4) mesh
+runs the flagship analysis step SPMD across BOTH processes — the real
+multi-host code path (parallel/distributed.py:init_distributed), not a
+single-process reshape.
+
+Usage: python two_process_worker.py <process_id> <coordinator_port> <out.npz>
+(invoked by the test; env must be prepared BEFORE jax import, so this runs
+as a fresh interpreter, not a pytest fixture).
+"""
+
+import os
+import sys
+
+
+def main() -> int:
+    pid = int(sys.argv[1])
+    port = sys.argv[2]
+    outfile = sys.argv[3]
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from nemo_tpu.models.pipeline_model import synth_batch_arrays
+    from nemo_tpu.parallel.distributed import (
+        analysis_step_hybrid,
+        init_distributed,
+        make_hybrid_mesh,
+    )
+
+    active = init_distributed(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid
+    )
+    assert active, "two-process runtime did not come up"
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 8, jax.devices()
+    assert len(jax.local_devices()) == 4
+
+    # Deterministic per (seed, n_runs): both processes build the same corpus.
+    pre, post, static = synth_batch_arrays(n_runs=13, seed=4)
+    mesh = make_hybrid_mesh(2, 4)
+    out = analysis_step_hybrid(mesh, pre, post, static)
+
+    from jax.experimental import multihost_utils
+
+    gathered = {
+        k: np.asarray(multihost_utils.process_allgather(v, tiled=True))
+        for k, v in out.items()
+    }
+    if pid == 0:
+        np.savez(outfile, **gathered)
+    # Let process 0 finish writing before the runtime tears down.
+    multihost_utils.sync_global_devices("nemo-two-process-done")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
