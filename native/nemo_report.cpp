@@ -88,15 +88,21 @@ std::string html_escape(const char* s) {
 
 extern "C" {
 
-int nemo_report_abi_version() { return 1; }
+int nemo_report_abi_version() { return 2; }
 
 void nemo_report_free(char* p) { std::free(p); }
 
+// node_cluster[i] = cluster ordinal of node i, or -1 (orders after all
+// clusters); cluster_labels has n_clusters entries.  Clusters keep their
+// member nodes contiguous per layer and draw as labeled boxes — the
+// graphviz cluster semantics Molly's spacetime diagrams rely on.
 char* nemo_render_svg(int n_nodes, const char** labels, const int32_t* label_chars,
                       const unsigned char* shape_rect, const unsigned char* node_flags,
                       const char** fill, const char** stroke, const char** fontcolor,
                       int n_edges, const int32_t* esrc, const int32_t* edst,
-                      const char** ecolor, const unsigned char* edge_flags) {
+                      const char** ecolor, const unsigned char* edge_flags,
+                      int n_clusters, const char** cluster_labels,
+                      const int32_t* node_cluster) {
   // Longest-path layering (svg.py:36-57).  Self-loops are excluded from the
   // layering adjacency but still drawn and still count as predecessors for
   // the barycenter, matching the Python renderer.
@@ -141,6 +147,12 @@ char* nemo_render_svg(int n_nodes, const char** labels, const int32_t* label_cha
   }
   std::vector<std::vector<int>> preds(n_nodes);
   for (int e = 0; e < n_edges; ++e) preds[edst[e]].push_back(esrc[e]);
+  // Rank tuple (cluster, barycenter): cluster members stay contiguous per
+  // layer (svg.py cluster_rank; -1 = no cluster, after all clusters).
+  auto rank_of = [&](int node) {
+    int32_t c = node_cluster ? node_cluster[node] : -1;
+    return c < 0 ? n_clusters : static_cast<int>(c);
+  };
   for (int pass = 0; pass < 2; ++pass) {
     for (auto& [li, row] : by_layer) {
       std::vector<double> key(row.size());
@@ -156,8 +168,11 @@ char* nemo_render_svg(int n_nodes, const char** labels, const int32_t* label_cha
       }
       std::vector<int> idx(row.size());
       for (size_t i = 0; i < idx.size(); ++i) idx[i] = static_cast<int>(i);
-      std::stable_sort(idx.begin(), idx.end(),
-                       [&](int a, int b) { return key[a] < key[b]; });
+      std::stable_sort(idx.begin(), idx.end(), [&](int a, int b) {
+        int ra = rank_of(row[a]), rb = rank_of(row[b]);
+        if (ra != rb) return ra < rb;
+        return key[a] < key[b];
+      });
       std::vector<int> sorted(row.size());
       for (size_t i = 0; i < idx.size(); ++i) sorted[i] = row[idx[i]];
       row = std::move(sorted);
@@ -201,6 +216,37 @@ char* nemo_render_svg(int n_nodes, const char** labels, const int32_t* label_cha
   svg +=
       "\n<defs><marker id='arrow' markerWidth='10' markerHeight='8' refX='9' refY='4' "
       "orient='auto'><path d='M0,0 L10,4 L0,8 z' fill='#444'/></marker></defs>";
+
+  // Cluster boxes (svg.py: bounding box of members + 8px padding, labeled
+  // top-left inside the box), drawn under edges and nodes.
+  for (int c = 0; c < n_clusters; ++c) {
+    bool any = false;
+    double x0 = 0, x1 = 0, y0 = 0, y1 = 0;
+    for (int i = 0; i < n_nodes; ++i) {
+      if (!node_cluster || node_cluster[i] != c) continue;
+      double nx0 = cx[i] - node_w[i] / 2, nx1 = cx[i] + node_w[i] / 2;
+      double ny0 = cy[i] - kNodeH / 2, ny1 = cy[i] + kNodeH / 2;
+      if (!any) {
+        x0 = nx0; x1 = nx1; y0 = ny0; y1 = ny1;
+        any = true;
+      } else {
+        x0 = std::min(x0, nx0); x1 = std::max(x1, nx1);
+        y0 = std::min(y0, ny0); y1 = std::max(y1, ny1);
+      }
+    }
+    if (!any) continue;
+    x0 -= 8; x1 += 8; y0 -= 8; y1 += 8;
+    append_fmt(svg,
+               "\n<rect x=\"%.1f\" y=\"%.1f\" width=\"%.1f\" height=\"%.1f\" "
+               "fill=\"none\" stroke=\"#999\" stroke-width=\"1\"/>",
+               x0, y0, x1 - x0, y1 - y0);
+    append_fmt(svg,
+               "\n<text x=\"%.1f\" y=\"%.1f\" font-family=\"monospace\" "
+               "font-size=\"10\" fill=\"#555\">",
+               x0 + 4, y0 + 12);
+    svg += html_escape(cluster_labels[c]);
+    svg += "</text>";
+  }
 
   for (int e = 0; e < n_edges; ++e) {
     if (edge_flags[e] & kInvis) continue;

@@ -215,6 +215,17 @@ class LocalExecutor:
         return {n: np.asarray(o) for n, o in zip(out_names, out)}
 
 
+def _giant_threshold() -> int:
+    """Node count above which a run leaves the dense batched buckets for
+    the giant path (parallel/giant.py) — and above which a good run's diff
+    uses the sparse host computation.  Single definition: the two dispatch
+    sites MUST agree, or a giant run would dodge the dense buckets yet
+    still hit the dense V^3 device diff."""
+    import os
+
+    return int(os.environ.get("NEMO_GIANT_V", "4096"))
+
+
 class _LazyGraphs:
     """Mapping (run, cond) -> PGraph, materialized on first access.
 
@@ -374,13 +385,11 @@ class JaxBackend(GraphBackend):
         per-run, per-phase Cypher round-trips (main.go:106-180)."""
         if self._fused_out is None:
             assert self.molly is not None
-            import os
-
             # Giant-run auto-dispatch: a run whose node count exceeds
             # NEMO_GIANT_V leaves the dense buckets (its [B,V,V] adjacency
             # would dominate or OOM them) and analyzes alone on the
             # node-sharded closure-free path (parallel/giant.py).
-            giant_v = int(os.environ.get("NEMO_GIANT_V", "4096"))
+            giant_v = _giant_threshold()
             run_ids, giant_ids = [], []
             for r in self.molly.runs:
                 n = max(
@@ -575,10 +584,8 @@ class JaxBackend(GraphBackend):
             goal_labels = pg.label_id[: pg.n_goals]
             bits[j, goal_labels] = True
 
-        import os
-
         sparse_edges = None
-        if failed_iters and good.n_nodes > int(os.environ.get("NEMO_GIANT_V", "4096")):
+        if failed_iters and good.n_nodes > _giant_threshold():
             # Giant good run: the dense device diff's V^3 closure (and its
             # depth-bounded max-plus loop) are prohibitive; the sparse host
             # path is O(F * (V + E)) on the packed edge list and exact

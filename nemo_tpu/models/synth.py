@@ -178,18 +178,24 @@ def build_spacetime_dot(
     crashes: dict[str, int] | None = None,
 ) -> str:
     """Space-time DOT diagram in the shape hazard analysis parses: node names
-    end in _<timestep> (reference: graphing/hazard-analysis.go:48-54).  A
-    crashed process's clock edges stop at its crash time.  Shared by the
-    synthetic generators and the mini-Dedalus fault injector."""
+    end in _<timestep> (reference: graphing/hazard-analysis.go:48-54), with
+    each process's timeline wrapped in a `subgraph cluster_<n>` block — the
+    structure Molly emits and the reference's gographviz parse + `dot -Tsvg`
+    pipeline renders as per-process boxes.  A crashed process's clock edges
+    stop at its crash time.  Shared by the synthetic generators and the
+    mini-Dedalus fault injector."""
     crashes = crashes or {}
     lines = ["digraph spacetime {"]
     for n in nodes:
         last = crashes.get(n, eot)
+        lines.append(f'\tsubgraph "cluster_{n}" {{')
+        lines.append(f'\t\tlabel="process {n}";')
         for t in range(1, eot + 1):
             label = f"{n}@{t}" + (" CRASHED" if n in crashes and t >= last else "")
-            lines.append(f'\t"{n}_{t}" [label="{label}"];')
+            lines.append(f'\t\t"{n}_{t}" [label="{label}"];')
         for t in range(1, min(last, eot)):
-            lines.append(f'\t"{n}_{t}" -> "{n}_{t + 1}";')
+            lines.append(f'\t\t"{n}_{t}" -> "{n}_{t + 1}";')
+        lines.append("\t}")
     for m in messages:
         if m["sendTime"] < eot:
             lines.append(f'\t"{m["from"]}_{m["sendTime"]}" -> "{m["to"]}_{m["receiveTime"]}";')

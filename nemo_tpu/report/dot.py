@@ -31,14 +31,31 @@ class DotEdge:
 
 
 @dataclass
+class DotCluster:
+    """A `subgraph cluster_*` block: rendered as a box around its member
+    nodes (graphviz cluster semantics — Molly's spacetime diagrams wrap each
+    process's timeline in one, parsed by the reference via gographviz,
+    graphing/hazard-analysis.go:34)."""
+
+    name: str
+    attrs: dict[str, str] = field(default_factory=dict)
+    nodes: list[str] = field(default_factory=list)
+
+
+@dataclass
 class DotGraph:
-    """A directed DOT graph with insertion-ordered nodes and edges."""
+    """A directed DOT graph with insertion-ordered nodes, edges, and
+    clusters.  Nodes always live in the flat `nodes` list; clusters hold
+    member NAMES only (membership is first-declaration-wins, like dot)."""
 
     name: str = "dataflow"
     graph_attrs: dict[str, str] = field(default_factory=dict)
     nodes: list[DotNode] = field(default_factory=list)
     edges: list[DotEdge] = field(default_factory=list)
+    clusters: list[DotCluster] = field(default_factory=list)
     _lookup: dict[str, DotNode] = field(default_factory=dict)
+    _cluster_lookup: dict[str, DotCluster] = field(default_factory=dict)
+    _cluster_of: dict[str, str] = field(default_factory=dict)
 
     def add_node(self, name: str, attrs: dict[str, str] | None = None) -> DotNode:
         """Add or update a node (last-writer-wins per attribute, matching
@@ -51,6 +68,26 @@ class DotGraph:
         if attrs:
             node.attrs.update(attrs)
         return node
+
+    def add_cluster(self, name: str, attrs: dict[str, str] | None = None) -> DotCluster:
+        cluster = self._cluster_lookup.get(name)
+        if cluster is None:
+            cluster = DotCluster(name=name)
+            self.clusters.append(cluster)
+            self._cluster_lookup[name] = cluster
+        if attrs:
+            cluster.attrs.update(attrs)
+        return cluster
+
+    def assign_cluster(self, node_name: str, cluster_name: str) -> None:
+        """Register membership (first declaration wins, dot semantics)."""
+        if node_name in self._cluster_of:
+            return
+        self._cluster_of[node_name] = cluster_name
+        self._cluster_lookup[cluster_name].nodes.append(node_name)
+
+    def cluster_of(self, node_name: str) -> str | None:
+        return self._cluster_of.get(node_name)
 
     def add_edge(self, src: str, dst: str, attrs: dict[str, str] | None = None) -> DotEdge:
         for endpoint in (src, dst):
@@ -71,6 +108,17 @@ class DotGraph:
         if self.graph_attrs:
             attrs = ",".join(f"{k}={_quote(v)}" for k, v in sorted(self.graph_attrs.items()))
             lines.append(f"\tgraph [ {attrs} ];")
+        # Cluster blocks first (bare member names; attribute statements
+        # follow at top level and merge — membership re-parses
+        # first-declaration-wins, so the roundtrip preserves it).
+        for c in self.clusters:
+            lines.append(f"\tsubgraph {_quote(c.name)} {{")
+            if c.attrs:
+                attrs = ",".join(f"{k}={_quote(v)}" for k, v in sorted(c.attrs.items()))
+                lines.append(f"\t\tgraph [ {attrs} ];")
+            for member in c.nodes:
+                lines.append(f"\t\t{_quote(member)};")
+            lines.append("\t}")
         for n in self.nodes:
             if n.attrs:
                 attrs = ", ".join(f"{k}={_quote(v)}" for k, v in sorted(n.attrs.items()))
@@ -153,14 +201,101 @@ def parse_dot(text: str) -> DotGraph:
             j += 1  # consume ]
         return attrs, j
 
+    # Cluster context: (cluster, depth at which its block opened).  Nodes
+    # first declared while a cluster block is open belong to it (dot
+    # semantics); non-cluster subgraphs still flatten.
+    cluster_stack: list[tuple[DotCluster, int]] = []
+
+    def declare(name: str, attrs: dict[str, str] | None = None) -> None:
+        g.add_node(name, attrs)
+        if cluster_stack:
+            g.assign_cluster(name, cluster_stack[-1][0].name)
+
+    def parse_group(j: int) -> tuple[list[str], int]:
+        """Parse `{ ... }` starting at its opening brace; returns the
+        member node names.  Handles nested groups, inner edge chains
+        (with per-hop edge attrs), and `subgraph [name] { ... }`."""
+        members: list[str] = []
+        j += 1  # consume {
+        prev: list[str] | None = None  # tail of an inner chain
+        while j < len(tokens) and tokens[j] != "}":
+            t = tokens[j]
+            if t in (";", ","):
+                prev = None
+                j += 1
+                continue
+            if t == "->":
+                src_grp = prev or []
+                dst_grp, j = parse_endpoint(j + 1)
+                eattrs, j = parse_attr_list(j)
+                for a in src_grp:
+                    for b in dst_grp:
+                        g.add_edge(a, b, dict(eattrs))
+                members.extend(n for n in dst_grp if n not in members)
+                prev = dst_grp
+                continue
+            if t == "{" or t.lower() == "subgraph":
+                # Nested group/subgraph: its nodes join this group too.
+                inner, j = parse_endpoint(j)
+                members.extend(n for n in inner if n not in members)
+                prev = inner
+                continue
+            if t.lower() in ("graph", "node", "edge") and j + 1 < len(tokens) and tokens[j + 1] == "[":
+                _, j = parse_attr_list(j + 1)  # default-attr statement
+                continue
+            if j + 1 < len(tokens) and tokens[j + 1] == "=":
+                j += 3  # group-local attribute (e.g. rank=same): not a node
+                continue
+            # Node statement (possibly an inner chain head).
+            nm = _unquote(t)
+            node_attrs, j = parse_attr_list(j + 1)
+            declare(nm, node_attrs)
+            if nm not in members:
+                members.append(nm)
+            prev = [nm]
+        return members, j + 1  # consume }
+
+    def parse_endpoint(j: int) -> tuple[list[str], int]:
+        """One chain endpoint: a braced group, a subgraph block, or a
+        bare name.  A bare name does NOT consume a following attr
+        list — that belongs to the edge chain."""
+        if tokens[j] == "{":
+            return parse_group(j)
+        if tokens[j].lower() == "subgraph":
+            j += 1
+            if j < len(tokens) and tokens[j] != "{":
+                j += 1  # optional subgraph name
+            if j < len(tokens) and tokens[j] == "{":
+                return parse_group(j)
+            return [], j
+        return [_unquote(tokens[j])], j + 1
+
+    def parse_chain(endpoints: list[list[str]], j: int) -> int:
+        """Continue an edge chain whose first endpoint group is given;
+        j points at the first `->`."""
+        while j < len(tokens) and tokens[j] == "->":
+            ep, j = parse_endpoint(j + 1)
+            endpoints.append(ep)
+        attrs, j = parse_attr_list(j)
+        for ep in endpoints:
+            for n in ep:  # declare even when the chain has no edges left
+                declare(n)
+        for src_grp, dst_grp in zip(endpoints, endpoints[1:]):
+            for a in src_grp:
+                for b in dst_grp:
+                    g.add_edge(a, b, dict(attrs))
+        return j
+
     depth = 1  # the graph's own brace, consumed above
     while i < len(tokens):
         tok = tokens[i]
         if tok == "}":
+            if cluster_stack and cluster_stack[-1][1] == depth:
+                cluster_stack.pop()
             depth -= 1
             if depth <= 0:
                 break
-            i += 1  # closing a flattened subgraph
+            i += 1  # closing a flattened subgraph / cluster block
             continue
         if tok == ";":
             i += 1
@@ -172,31 +307,51 @@ def parse_dot(text: str) -> DotGraph:
             continue
         if tok.lower() in ("graph", "node", "edge") and i + 1 < len(tokens) and tokens[i + 1] == "[":
             attrs, i = parse_attr_list(i + 1)
-            if tok.lower() == "graph" and depth == 1:
-                # Top level only: a cluster's graph [label=...] must not
-                # clobber the enclosing graph's attributes.
-                g.graph_attrs.update(attrs)
+            if tok.lower() == "graph":
+                if cluster_stack:
+                    # A cluster's graph [label=...] styles the cluster box.
+                    cluster_stack[-1][0].attrs.update(attrs)
+                elif depth == 1:
+                    # Top level only: a flattened subgraph's graph attrs
+                    # must not clobber the enclosing graph's.
+                    g.graph_attrs.update(attrs)
             continue  # default node/edge attrs are not tracked
         if tok.lower() == "subgraph":
-            # Flatten subgraph contents: skip the optional name and the
-            # opening brace; the statements inside parse as usual.
+            # `subgraph cluster_*` keeps its identity (box semantics, like
+            # the reference's gographviz + dot pipeline); anything else
+            # flattens: skip the optional name and the opening brace, the
+            # statements inside parse as usual.
             i += 1
+            sub_name = None
             if i < len(tokens) and tokens[i] != "{":
+                sub_name = _unquote(tokens[i])
                 i += 1
             if i < len(tokens) and tokens[i] == "{":
                 i += 1
                 depth += 1
+                if sub_name and sub_name.startswith("cluster"):
+                    cluster_stack.append((g.add_cluster(sub_name), depth))
             continue
         if tok == "{":
-            i += 1  # anonymous subgraph
-            depth += 1
+            # Anonymous group at statement position: if its closing brace is
+            # followed by `->`, this is a chain HEAD (`{ a b } -> c`); the
+            # group members become the first endpoint set.  Otherwise it is
+            # an anonymous subgraph whose contents were parsed (flattened)
+            # by parse_group either way.
+            members, j = parse_group(i)
+            if j < len(tokens) and tokens[j] == "->":
+                i = parse_chain([members], j)
+            else:
+                i = j
             continue
         name = _unquote(tok)
         if i + 1 < len(tokens) and tokens[i + 1] == "=":
-            # Bare `name = value` sets graph attributes — but only at the
-            # top level; a flattened cluster's label/style must not clobber
-            # the enclosing graph's.
-            if depth == 1:
+            # Bare `name = value`: graph attributes at top level, cluster
+            # attributes inside a cluster block; a flattened subgraph's
+            # must not clobber the enclosing graph's.
+            if cluster_stack:
+                cluster_stack[-1][0].attrs[name] = _unquote(tokens[i + 2])
+            elif depth == 1:
                 g.graph_attrs[name] = _unquote(tokens[i + 2])
             i += 3
             continue
@@ -206,68 +361,8 @@ def parse_dot(text: str) -> DotGraph:
             # grammar's subgraph-as-endpoint semantics, where the group
             # contributes ALL nodes appearing inside it, and inner edge
             # chains are real edges of the graph).
-            def parse_group(j: int) -> tuple[list[str], int]:
-                """Parse `{ ... }` starting at its opening brace; returns the
-                member node names.  Handles nested groups, inner edge chains
-                (with per-hop edge attrs), and `subgraph [name] { ... }`."""
-                members: list[str] = []
-                j += 1  # consume {
-                prev: list[str] | None = None  # tail of an inner chain
-                while j < len(tokens) and tokens[j] != "}":
-                    t = tokens[j]
-                    if t in (";", ","):
-                        prev = None
-                        j += 1
-                        continue
-                    if t == "->":
-                        src_grp = prev or []
-                        dst_grp, j = parse_endpoint(j + 1)
-                        eattrs, j = parse_attr_list(j)
-                        for a in src_grp:
-                            for b in dst_grp:
-                                g.add_edge(a, b, dict(eattrs))
-                        members.extend(n for n in dst_grp if n not in members)
-                        prev = dst_grp
-                        continue
-                    # Node statement (possibly an inner chain head).
-                    nm = _unquote(t)
-                    node_attrs, j = parse_attr_list(j + 1)
-                    g.add_node(nm, node_attrs)
-                    if nm not in members:
-                        members.append(nm)
-                    prev = [nm]
-                return members, j + 1  # consume }
-
-            def parse_endpoint(j: int) -> tuple[list[str], int]:
-                """One chain endpoint: a braced group, a subgraph block, or a
-                bare name.  A bare name does NOT consume a following attr
-                list — that belongs to the edge chain."""
-                if tokens[j] == "{":
-                    return parse_group(j)
-                if tokens[j].lower() == "subgraph":
-                    j += 1
-                    if j < len(tokens) and tokens[j] != "{":
-                        j += 1  # optional subgraph name
-                    if j < len(tokens) and tokens[j] == "{":
-                        return parse_group(j)
-                    return [], j
-                return [_unquote(tokens[j])], j + 1
-
-            endpoints = [[name]]
-            j = i + 1
-            while j < len(tokens) and tokens[j] == "->":
-                ep, j = parse_endpoint(j + 1)
-                endpoints.append(ep)
-            attrs, j = parse_attr_list(j)
-            for ep in endpoints:
-                for n in ep:  # declare even when the chain has no edges left
-                    g.add_node(n)
-            for src_grp, dst_grp in zip(endpoints, endpoints[1:]):
-                for a in src_grp:
-                    for b in dst_grp:
-                        g.add_edge(a, b, dict(attrs))
-            i = j
+            i = parse_chain([[name]], i + 1)
             continue
         attrs, i = parse_attr_list(i + 1)
-        g.add_node(name, attrs)
+        declare(name, attrs)
     return g

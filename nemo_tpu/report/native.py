@@ -44,11 +44,14 @@ def _bind(lib: ctypes.CDLL) -> None:
         ctypes.POINTER(ctypes.c_int32),  # edst
         ctypes.POINTER(ctypes.c_char_p),  # edge color
         ctypes.POINTER(ctypes.c_ubyte),  # edge flags
+        ctypes.c_int,  # n_clusters
+        ctypes.POINTER(ctypes.c_char_p),  # cluster labels
+        ctypes.POINTER(ctypes.c_int32),  # node cluster ordinal (-1 none)
     ]
     lib.nemo_report_free.argtypes = [ctypes.c_void_p]
 
 
-_native = NativeLib(_SRC, _LIB, _bind, "nemo_report_abi_version", 1)
+_native = NativeLib(_SRC, _LIB, _bind, "nemo_report_abi_version", 2)
 
 
 def build_native(force: bool = False) -> str:
@@ -112,9 +115,22 @@ def render_svg_native(g: DotGraph) -> str:
     )
     c_eflags = (ctypes.c_ubyte * m)(*[_style_flags(e.attrs) for e in edges])
 
+    k = len(g.clusters)
+    c_cluster_labels = (ctypes.c_char_p * max(1, k))(
+        *[c.attrs.get("label", c.name).encode("utf-8") for c in g.clusters]
+        or [b""]
+    )
+    node_cluster = [-1] * n
+    for ci, c in enumerate(g.clusters):
+        for member in c.nodes:
+            if member in index:
+                node_cluster[index[member]] = ci
+    c_node_cluster = (ctypes.c_int32 * max(1, n))(*(node_cluster or [0]))
+
     ptr = lib.nemo_render_svg(
         n, c_labels, c_label_chars, c_shape, c_nflags, c_fill, c_stroke, c_fontcolor,
         m, c_esrc, c_edst, c_ecolor, c_eflags,
+        k, c_cluster_labels, c_node_cluster,
     )
     if not ptr:
         raise RuntimeError("native report engine returned NULL")

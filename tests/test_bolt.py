@@ -289,16 +289,19 @@ def test_wire_big_message_chunk_framing(monkeypatch):
     sent = bytes(sock.sent)[len(wire.CLIENT_HANDSHAKE) + len(wire.CLIENT_INIT) :]
     # Walk the frames: first message (RUN) must span multiple chunks.
     sizes = []
+    payload = bytearray()
     i = 0
     while True:
         (size,) = struct.unpack(">H", sent[i : i + 2])
+        payload += sent[i + 2 : i + 2 + size]
         i += 2 + size
         sizes.append(size)
         if size == 0:
             break
     assert sizes[0] == 0xFFFF and len(sizes) >= 3 and sizes[-1] == 0
-    payload_len = sum(sizes)
-    assert payload_len > 100_000  # statement + packstream overhead
+    assert len(payload) > 100_000  # statement + packstream overhead
+    # The framing must equal the spec encoder applied to the payload.
+    assert sent[:i] == wire.chunked_frames(bytes(payload))
     # Remaining bytes are exactly the PULL_ALL frame.
     assert sent[i:] == wire.CLIENT_PULL_ALL
 

@@ -121,3 +121,46 @@ def test_pipeline_figures_parity(tmp_path):
     assert rep.dots
     for dot in rep.dots:
         assert_parity(dot)
+
+
+def test_cluster_boxes_parity():
+    """Clustered graphs (spacetime shape): box rects + labels and the
+    cluster-contiguous layer ordering must match byte-for-byte."""
+    from nemo_tpu.models.synth import build_spacetime_dot
+    from nemo_tpu.report.dot import parse_dot
+
+    text = build_spacetime_dot(
+        ["a", "b", "C"],
+        4,
+        [
+            {"from": "a", "to": "b", "sendTime": 1, "receiveTime": 2},
+            {"from": "b", "to": "C", "sendTime": 2, "receiveTime": 3},
+        ],
+        crashes={"b": 3},
+    )
+    g = parse_dot(text)
+    assert len(g.clusters) == 3
+    svg = render_svg(g)
+    # One visible box + label per process cluster.
+    assert svg.count('stroke="#999"') == 3
+    assert "process a" in svg and "process b" in svg
+    assert_parity(g)
+
+
+def test_cluster_parity_random(seed=7):
+    """Random graphs with a random subset of nodes clustered."""
+    rng = random.Random(seed)
+    for _ in range(10):
+        g = DotGraph()
+        names = [f"n{i}" for i in range(rng.randrange(3, 14))]
+        for nm in names:
+            g.add_node(nm, {"label": nm * rng.randrange(1, 3)})
+        for _ in range(rng.randrange(2, 16)):
+            g.add_edge(rng.choice(names), rng.choice(names))
+        n_clusters = rng.randrange(0, 3)
+        for c in range(n_clusters):
+            g.add_cluster(f"cluster_{c}", {"label": f"box {c}"})
+        for nm in names:
+            if n_clusters and rng.random() < 0.6:
+                g.assign_cluster(nm, f"cluster_{rng.randrange(n_clusters)}")
+        assert_parity(g)
