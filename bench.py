@@ -158,6 +158,12 @@ def child_main() -> None:
     # pass below) load compiled programs from disk instead of recompiling —
     # the cold-vs-warm split quantifies how much of the e2e wall is compile.
     enable_compilation_cache()
+    # Cache state BEFORE this process compiles anything: nonzero means the
+    # "cold" e2e pass may load programs persisted by an EARLIER invocation.
+    _cache_dir = jax.config.jax_compilation_cache_dir
+    disk_cache_entries = (
+        len(os.listdir(_cache_dir)) if _cache_dir and os.path.isdir(_cache_dir) else 0
+    )
 
     n_total = int(os.environ.get("NEMO_BENCH_RUNS", "10200"))
     base_runs = int(os.environ.get("NEMO_BENCH_BASE_RUNS", "32"))
@@ -385,16 +391,24 @@ def child_main() -> None:
         from nemo_tpu.analysis.pipeline import run_debug as _run_debug
         from nemo_tpu.backend.neo4j_backend import Neo4jBackend
 
+        # Sum ONLY the analysis phases (the same set the oracle baseline
+        # times: load -> simplify -> prototypes -> diff), not JSON ingest /
+        # report writing, so the numerator and denominator are comparable.
+        _ANALYSIS_PHASES = (
+            "load_raw_provenance",
+            "simplify",
+            "prototypes",
+            "diff_prov",
+        )
         t_neo = 0.0
         neo_graphs = 0
         neo_root = os.path.join(tmp, "results_neo4j")
         with FakeNeo4jServer() as srv:
             for base_dir, molly in zip(base_dirs, base_mollys):
-                t0 = time.perf_counter()
-                _run_debug(
+                res = _run_debug(
                     base_dir, neo_root, Neo4jBackend(), conn=srv.uri, figures="none"
                 )
-                t_neo += time.perf_counter() - t0
+                t_neo += sum(res.timings.get(k, 0.0) for k in _ANALYSIS_PHASES)
                 neo_graphs += 2 * len(molly.runs)
         neo4j_graphs_per_sec = neo_graphs / t_neo
         log(
@@ -413,8 +427,11 @@ def child_main() -> None:
     # Two passes over the same corpora: the cold pass pays every jit
     # compile; the warm pass reuses the in-process jit caches (plus the
     # persistent on-disk cache), so cold - warm isolates compile cost from
-    # execute cost (VERDICT r2 weak #8).
-    e2e = {}
+    # execute cost (VERDICT r2 weak #8).  "cold" means process-cold: when
+    # the persistent cache already held programs at CHILD START (counted
+    # above, before any compile in this process), the cold pass loads them
+    # from disk instead of compiling.
+    e2e = {"disk_cache_entries_at_start": disk_cache_entries}
     for label in ("cold", "warm"):
         phases: dict[str, float] = {}
         results_root = os.path.join(tmp, f"results_{label}")
@@ -430,6 +447,35 @@ def child_main() -> None:
             f"{wall:.1f}s wall"
         )
     e2e_wall = e2e["cold"]["wall_s"]
+
+    # Single-directory ingest/compute overlap (VERDICT r2 item 8): the
+    # biggest family streams through an in-process sidecar with the
+    # producer thread parsing/packing chunk k+1 while chunk k executes;
+    # overlap win = pack_s + stream_s - wall_s (positive = real overlap).
+    overlap = None
+    try:
+        from nemo_tpu.service.client import analyze_dir_pipelined
+        from nemo_tpu.service.server import make_server
+
+        server, port = make_server(port=0)
+        server.start()
+        try:
+            _, ov = analyze_dir_pipelined(
+                f"127.0.0.1:{port}", big_dirs[0][1], chunk_runs=256
+            )
+            overlap = {
+                "family": big_dirs[0][0],
+                "runs": per_family,
+                "pack_s": round(ov["pack_s"], 2),
+                "stream_s": round(ov["stream_s"], 2),
+                "wall_s": round(ov["wall_s"], 2),
+                "overlap_win_s": round(ov["pack_s"] + ov["stream_s"] - ov["wall_s"], 2),
+            }
+            log(f"single-dir overlap: {json.dumps(overlap)}")
+        finally:
+            server.stop(grace=None)
+    except Exception as ex:  # overlap stress must never sink the bench
+        log(f"single-dir overlap skipped: {type(ex).__name__}: {ex}")
 
     result = {
         "metric": METRIC
@@ -452,10 +498,12 @@ def child_main() -> None:
         "vs_neo4j": None
         if neo4j_graphs_per_sec is None
         else round(value / neo4j_graphs_per_sec, 1),
+        "single_dir_overlap": overlap,
         "e2e": {
             "runs": total_runs,
             "figures": "sample:8",
             "wall_s": e2e_wall,
+            "disk_cache_entries_at_start": e2e["disk_cache_entries_at_start"],
             "cold": e2e["cold"],
             "warm": e2e["warm"],
         },
@@ -477,9 +525,13 @@ def closure_microbench(family_batch) -> dict:
     (~3*B*V^2*S bf16 accesses) while the Pallas kernel keeps the chain
     VMEM-resident (~2*B*V^2 HBM accesses total).  ops/pallas_kernels.py
     claims the workload is HBM-bound at small V — these numbers check that
-    on silicon."""
-    import dataclasses  # noqa: F401  (poke pattern not needed: distinct adj per rep)
+    on silicon.
 
+    Timing: K closures of DISTINCT inputs chained inside ONE jit region
+    (fori_loop flipping a reflexive self-loop bit per iteration, result
+    threaded so nothing is dead-code-eliminated), so the device tunnel's
+    per-dispatch RTT (~tens of ms — larger than the kernel itself) divides
+    by K instead of drowning the measurement."""
     import numpy as np
 
     import jax
@@ -492,26 +544,40 @@ def closure_microbench(family_batch) -> dict:
     b = int(post.is_goal.shape[0])
     adj = build_adjacency(post.edge_src, post.edge_dst, post.edge_mask, v)
     s_steps = max(1, (v - 1).bit_length())
+    k_reps = 16
     flops = 2.0 * b * v**3 * s_steps
-    out = {"v": v, "b": b, "squarings": s_steps}
+    out = {"v": v, "b": b, "squarings": s_steps, "reps_per_dispatch": k_reps}
     for impl in ("xla", "pallas"):
-        fn = jax.jit(lambda a, impl=impl: closure(a, impl=impl))
-        # Distinct bytes per rep: flip one self-loop bit in row 0 (closure is
-        # reflexive, so the result is unchanged but the input bytes differ).
-        jax.block_until_ready(fn(adj))
+
+        @jax.jit
+        def k_closures(a, impl=impl):
+            def body(i, carry):
+                a, acc = carry
+                # Distinct input each rep: toggle one diagonal (reflexive)
+                # bit — results identical, bytes different.
+                a = a.at[0, i % v, i % v].set(True)
+                r = closure(a, impl=impl)
+                return a, acc ^ r  # thread the result: no DCE
+
+            _, acc = jax.lax.fori_loop(
+                0, k_reps, body, (a, jnp.zeros_like(a))
+            )
+            return acc
+
+        jax.block_until_ready(k_closures(adj))
         times = []
-        for rep in range(5):
+        for rep in range(3):
             a = adj.at[0, rep % v, rep % v].set(True)
             jax.block_until_ready(a)
             t0 = time.perf_counter()
-            jax.block_until_ready(fn(a))
+            jax.block_until_ready(k_closures(a))
             times.append(time.perf_counter() - t0)
-        t = float(np.median(times))
+        t = float(np.median(times)) / k_reps
         hbm_bytes = (
             3.0 * b * v * v * 2 * s_steps if impl == "xla" else 2.0 * b * v * v * 2
         )
         out[impl] = {
-            "ms": round(t * 1e3, 2),
+            "ms": round(t * 1e3, 3),
             "tflops_per_sec": round(flops / t / 1e12, 3),
             "est_hbm_gb_per_sec": round(hbm_bytes / t / 1e9, 1),
         }
