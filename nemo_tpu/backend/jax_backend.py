@@ -1,12 +1,15 @@
 """JaxBackend: the batched TPU graph-analytics engine.
 
 All per-run graph analyses run as fixed-shape array kernels over size-bucketed
-run batches (nemo_tpu.ops.*): condition marking, clean-copy + chain
-contraction, prototype bitsets, and differential provenance execute once per
-bucket for the whole batch — the axis the reference loops over sequentially,
-one Bolt round-trip at a time (SURVEY.md §2.3).  Host work is limited to
-packing, report materialization, and the run-0-only trigger queries shared
-with the oracle backend (analysis/queries.py).
+run batches (nemo_tpu.ops.*): ONE fused analysis_step dispatch per joint
+(pre, post) bucket computes condition marking, clean-copy + chain contraction,
+and prototype bitsets for the whole batch — the axis the reference loops over
+sequentially, one Bolt round-trip at a time (SURVEY.md §2.3) — plus one
+good-run-anchored differential-provenance dispatch over all failed runs.
+Runs above NEMO_GIANT_V nodes auto-dispatch to the node-sharded giant path
+(parallel/giant.py).  Host work is limited to packing, report
+materialization, and the good-run-only trigger queries shared with the
+oracle backend (analysis/queries.py).
 """
 
 from __future__ import annotations
@@ -141,12 +144,14 @@ def _k_fused(*args):
 
 
 class LocalExecutor:
-    """The backend's device boundary: four named kernels over named numpy
-    arrays and static int params.  run() is the whole contract — the remote
-    executor (service/client.py:RemoteExecutor) sends the same (verb, arrays,
-    params) triple over the sidecar's Kernel RPC, and the sidecar dispatches
-    right back into this class, so local and two-process deployments execute
-    identical device code.
+    """The backend's device boundary: named kernels over named numpy arrays
+    and static int params ("fused" and "diff" carry the production pipeline;
+    "giant" the oversized-run path; "condition"/"simplify"/"proto" remain as
+    the stable single-verb kernel API).  run() is the whole contract — the
+    remote executor (service/client.py:RemoteExecutor) sends the same
+    (verb, arrays, params) triple over the sidecar's Kernel RPC, and the
+    sidecar dispatches right back into this class, so local and two-process
+    deployments execute identical device code.
     """
 
     VERBS = {
