@@ -197,8 +197,12 @@ class LocalExecutor:
     #: adjacencies (plus alive/type rows) are only ever consumed per-row by
     #: figure materialization (_build_clean), so shipping them host-side
     #: eagerly wastes seconds of transfer at 10k-run scale — over the TPU
-    #: tunnel this dominated the warm e2e wall.  The remote executor still
-    #: materializes everything (the wire has no device handles).
+    #: tunnel this dominated the warm e2e wall.  The diff verb's edge_keep
+    #: deliberately does NOT join this set: its consumers touch many
+    #: per-run rows, and the lazy-slice dispatches cost more in compiles
+    #: and RTTs than the one eager transfer (measured: cold diff 6s -> 39s
+    #: when device-resident).  The remote executor still materializes
+    #: everything (the wire has no device handles).
     ON_DEVICE = frozenset(
         {"pre_adj_clean", "post_adj_clean", "pre_alive", "post_alive", "pre_type", "post_type"}
     )
@@ -217,7 +221,10 @@ class LocalExecutor:
             return {n: (o if n in self.ON_DEVICE else np.asarray(o)) for n, o in out.items()}
         if not isinstance(out, tuple):
             out = (out,)
-        return {n: np.asarray(o) for n, o in zip(out_names, out)}
+        return {
+            n: (o if n in self.ON_DEVICE else np.asarray(o))
+            for n, o in zip(out_names, out)
+        }
 
 
 def _giant_threshold() -> int:
@@ -634,10 +641,11 @@ class JaxBackend(GraphBackend):
         holds[: good.n_nodes] = self.cond_holds[(g, "post")]
 
         def dense_ek(j: int) -> np.ndarray:
-            """edge_keep of run j as dense [V,V] (sparse host path densifies
-            on demand — only figure-selected runs and frontier rows)."""
+            """edge_keep of run j as dense [V,V] (the sparse host path and
+            the device-resident dense plane both densify on demand — only
+            figure-selected runs pay the full-plane transfer)."""
             if sparse_edges is None:
-                return edge_keep[j]
+                return np.asarray(edge_keep[j])
             dense = np.zeros((gb.v, gb.v), dtype=bool)
             kept = sparse_edges[edge_keep[j]]
             if len(kept):
