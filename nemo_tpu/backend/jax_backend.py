@@ -14,6 +14,7 @@ oracle backend (analysis/queries.py).
 
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import jax
@@ -219,12 +220,12 @@ class LocalExecutor:
         out = fn(*args, *statics)
         if isinstance(out, dict):
             return {n: (o if n in self.ON_DEVICE else np.asarray(o)) for n, o in out.items()}
+        # Tuple-returning verbs always materialize: none of their outputs
+        # are in ON_DEVICE, and the diff verb's consumers specifically rely
+        # on host arrays (see the ON_DEVICE comment's 6s->39s measurement).
         if not isinstance(out, tuple):
             out = (out,)
-        return {
-            n: (o if n in self.ON_DEVICE else np.asarray(o))
-            for n, o in zip(out_names, out)
-        }
+        return {n: np.asarray(o) for n, o in zip(out_names, out)}
 
 
 def _giant_threshold() -> int:
@@ -233,9 +234,16 @@ def _giant_threshold() -> int:
     uses the sparse host computation.  Single definition: the two dispatch
     sites MUST agree, or a giant run would dodge the dense buckets yet
     still hit the dense V^3 device diff."""
-    import os
-
     return int(os.environ.get("NEMO_GIANT_V", "4096"))
+
+
+def _verb_arrays(pre_b: PackedBatch, post_b: PackedBatch) -> dict[str, np.ndarray]:
+    """The fused/giant verbs' named-array inputs for one (pre, post) bucket."""
+    return {
+        f"{prefix}_{f}": getattr(b, f)
+        for prefix, b in (("pre", pre_b), ("post", post_b))
+        for f in _BA_FIELDS
+    }
 
 
 class _LazyGraphs:
@@ -433,13 +441,9 @@ class JaxBackend(GraphBackend):
             for pre_b, post_b in bucketize_pairs(
                 run_ids, pre, post, self.max_batch, min_v=min_v, min_e=min_e
             ):
-                arrays = {}
-                for prefix, b in (("pre", pre_b), ("post", post_b)):
-                    for f in _BA_FIELDS:
-                        arrays[f"{prefix}_{f}"] = getattr(b, f)
                 res = self.executor.run(
                     "fused",
-                    arrays,
+                    _verb_arrays(pre_b, post_b),
                     dict(
                         v=pre_b.v,
                         max_depth=bucket_size(max(pre_b.max_depth, post_b.max_depth), 4),
@@ -447,9 +451,9 @@ class JaxBackend(GraphBackend):
                     ),
                 )
                 out.append((pre_b, post_b, res))
-            for rid in giant_ids:
+            if giant_ids:
                 from nemo_tpu.parallel.giant import giant_plan
-
+            for rid in giant_ids:
                 gpre = self.packed[(rid, "pre")]
                 gpost = self.packed[(rid, "post")]
                 v_g = bucket_size(max(gpre.n_nodes, gpost.n_nodes))
@@ -458,13 +462,9 @@ class JaxBackend(GraphBackend):
                 post_b = pack_batch([rid], [gpost], v_g, e_g)
                 lin_pre, depth_pre = giant_plan(gpre)
                 lin_post, depth_post = giant_plan(gpost)
-                arrays = {}
-                for prefix, b in (("pre", pre_b), ("post", post_b)):
-                    for f in _BA_FIELDS:
-                        arrays[f"{prefix}_{f}"] = getattr(b, f)
                 res = self.executor.run(
                     "giant",
-                    arrays,
+                    _verb_arrays(pre_b, post_b),
                     dict(
                         v=v_g,
                         pre_tid=params_common["pre_tid"],

@@ -439,8 +439,8 @@ def analyze_dir_pipelined(
 
     Returns (merged outputs, timings with pack_s / stream_s / wall_s —
     overlap win = pack_s + stream_s - wall_s when positive)."""
-    import json as _json
-    import os as _os
+    import json
+    import os
 
     from nemo_tpu.graphs.packed import CorpusVocab, pack_graph
     from nemo_tpu.ingest.datatypes import RunData
@@ -450,8 +450,8 @@ def analyze_dir_pipelined(
     t_wall0 = time.perf_counter()
     timings = {"pack_s": 0.0, "stream_s": 0.0, "wall_s": 0.0}
 
-    with open(_os.path.join(molly_dir, "runs.json"), "r", encoding="utf-8") as f:
-        raw_runs = _json.load(f)
+    with open(os.path.join(molly_dir, "runs.json"), "r", encoding="utf-8") as f:
+        raw_runs = json.load(f)
     n = len(raw_runs)
     if n == 0:
         raise SidecarError(f"no runs in {molly_dir} (empty runs.json)")
