@@ -46,3 +46,16 @@ def corpus_dir(tmp_path_factory) -> str:
     """
     root = tmp_path_factory.mktemp("molly_out")
     return write_corpus(SynthSpec(n_runs=8, seed=2, eot=6), str(root))
+
+
+@pytest.fixture(scope="session")
+def sidecar():
+    """In-process gRPC sidecar (module under test for the two-process
+    deployment); session-scoped so all service-path tests share one."""
+    grpc = pytest.importorskip("grpc")  # noqa: F841
+    from nemo_tpu.service.server import make_server
+
+    server, port = make_server(port=0)
+    server.start()
+    yield f"127.0.0.1:{port}"
+    server.stop(grace=None)

@@ -90,6 +90,21 @@ def test_host_diff_matches_device(corpus_dir):
         np.testing.assert_array_equal(dense, ek_d[j], err_msg=f"run {j}")
 
 
+def test_giant_dispatch_over_sidecar(sidecar, tmp_path, monkeypatch):
+    """The giant verb over the two-process Kernel RPC: device-resident
+    outputs must materialize through the codec, and the ServiceBackend's
+    report must match the oracle."""
+    from nemo_tpu.backend.service_backend import ServiceBackend
+
+    corpus = write_corpus(SynthSpec(n_runs=3, seed=5, eot=60, name="deepsvc"), str(tmp_path))
+    monkeypatch.setenv("NEMO_GIANT_V", "64")
+    svc = run_debug(
+        corpus, str(tmp_path / "svc"), ServiceBackend(target=sidecar), figures="failed"
+    )
+    py = run_debug(corpus, str(tmp_path / "py"), PythonBackend(), figures="failed")
+    assert _report(svc.report_dir) == _report(py.report_dir)
+
+
 @pytest.mark.skipif(
     os.environ.get("NEMO_TEST_GIANT_10K", "") == "0", reason="opt-out via NEMO_TEST_GIANT_10K=0"
 )

@@ -15,14 +15,6 @@ from nemo_tpu.service.server import make_server  # noqa: E402
 
 
 @pytest.fixture(scope="module")
-def sidecar():
-    server, port = make_server(port=0)
-    server.start()
-    yield f"127.0.0.1:{port}"
-    server.stop(grace=None)
-
-
-@pytest.fixture(scope="module")
 def packed(corpus_dir):
     return pack_molly_for_step(load_molly_output(corpus_dir))
 
