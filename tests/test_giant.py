@@ -23,14 +23,30 @@ def _report(d):
         return json.load(f)
 
 
-def test_giant_dispatch_matches_oracle(tmp_path, monkeypatch):
+@pytest.fixture(scope="module")
+def deep_corpus(tmp_path_factory):
+    """Deep-chain corpus shared by the giant-dispatch tests."""
+    root = tmp_path_factory.mktemp("deep_root")
+    return write_corpus(SynthSpec(n_runs=3, seed=5, eot=60, name="deep"), str(root))
+
+
+@pytest.fixture(scope="module")
+def deep_oracle_report(deep_corpus, tmp_path_factory):
+    res = run_debug(
+        deep_corpus,
+        str(tmp_path_factory.mktemp("deep_py")),
+        PythonBackend(),
+        figures="failed",
+    )
+    return _report(res.report_dir)
+
+
+def test_giant_dispatch_matches_oracle(deep_corpus, deep_oracle_report, tmp_path, monkeypatch):
     """A deep-chain corpus routed through the giant path (threshold forced
     low) produces a byte-identical report to the Python oracle."""
-    corpus = write_corpus(SynthSpec(n_runs=3, seed=5, eot=60, name="deep"), str(tmp_path))
     monkeypatch.setenv("NEMO_GIANT_V", "64")  # every run is "giant"
-    jx = run_debug(corpus, str(tmp_path / "jx"), JaxBackend(), figures="failed")
-    py = run_debug(corpus, str(tmp_path / "py"), PythonBackend(), figures="failed")
-    assert _report(jx.report_dir) == _report(py.report_dir)
+    jx = run_debug(deep_corpus, str(tmp_path / "jx"), JaxBackend(), figures="failed")
+    assert _report(jx.report_dir) == deep_oracle_report
 
 
 def test_mixed_corpus_giant_and_dense(tmp_path, monkeypatch):
@@ -90,19 +106,17 @@ def test_host_diff_matches_device(corpus_dir):
         np.testing.assert_array_equal(dense, ek_d[j], err_msg=f"run {j}")
 
 
-def test_giant_dispatch_over_sidecar(sidecar, tmp_path, monkeypatch):
+def test_giant_dispatch_over_sidecar(sidecar, deep_corpus, deep_oracle_report, tmp_path, monkeypatch):
     """The giant verb over the two-process Kernel RPC: device-resident
     outputs must materialize through the codec, and the ServiceBackend's
     report must match the oracle."""
     from nemo_tpu.backend.service_backend import ServiceBackend
 
-    corpus = write_corpus(SynthSpec(n_runs=3, seed=5, eot=60, name="deepsvc"), str(tmp_path))
     monkeypatch.setenv("NEMO_GIANT_V", "64")
     svc = run_debug(
-        corpus, str(tmp_path / "svc"), ServiceBackend(target=sidecar), figures="failed"
+        deep_corpus, str(tmp_path / "svc"), ServiceBackend(target=sidecar), figures="failed"
     )
-    py = run_debug(corpus, str(tmp_path / "py"), PythonBackend(), figures="failed")
-    assert _report(svc.report_dir) == _report(py.report_dir)
+    assert _report(svc.report_dir) == deep_oracle_report
 
 
 @pytest.mark.skipif(
