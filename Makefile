@@ -27,6 +27,7 @@ bench:
 # Regenerate protobuf message code for the sidecar wire protocol.
 proto:
 	protoc --python_out=nemo_tpu/service proto/nemo_service.proto
+	python3 proto/fix_pb2_offsets.py nemo_tpu/service/proto/nemo_service_pb2.py
 
 # Wipe generated reports.  (The reference's `make reset`, Makefile:9-14,
 # also tears down its Neo4j container and tmp/ volume; this repo runs no

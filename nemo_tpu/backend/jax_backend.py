@@ -231,9 +231,12 @@ class LocalExecutor:
 def _giant_threshold() -> int:
     """Node count above which a run leaves the dense batched buckets for
     the giant path (parallel/giant.py) — and above which a good run's diff
-    uses the sparse host computation.  Single definition: the two dispatch
-    sites MUST agree, or a giant run would dodge the dense buckets yet
-    still hit the dense V^3 device diff."""
+    uses the sparse host computation.  Single definition, read ONCE per
+    JaxBackend corpus (init_graph_db) and cached on the instance: the two
+    dispatch sites (_fused and build_figures) run at different times, so a
+    mid-process env change must not make them disagree — a giant run would
+    dodge the dense buckets yet still hit the dense V^3 device diff
+    (ADVICE r3 #3)."""
     return int(os.environ.get("NEMO_GIANT_V", "4096"))
 
 
@@ -291,11 +294,15 @@ class JaxBackend(GraphBackend):
         # (run, cond) -> host-materialized (alive, adj, type) rows.
         self._clean_rows: dict[tuple[int, str], tuple] = {}
         self._run_by_iter: dict[int, object] = {}
+        self._giant_v = _giant_threshold()
 
     # ------------------------------------------------------------------ setup
 
     def init_graph_db(self, conn: str, molly: MollyOutput) -> None:
         # Full state reset: a backend instance may be reused across corpora.
+        # The giant threshold is re-read here and ONLY here, so _fused and
+        # build_figures can never disagree within one corpus.
+        self._giant_v = _giant_threshold()
         self.molly = molly
         self.vocab = CorpusVocab()
         self.packed = {}
@@ -409,7 +416,7 @@ class JaxBackend(GraphBackend):
             # NEMO_GIANT_V leaves the dense buckets (its [B,V,V] adjacency
             # would dominate or OOM them) and analyzes alone on the
             # node-sharded closure-free path (parallel/giant.py).
-            giant_v = _giant_threshold()
+            giant_v = self._giant_v
             run_ids, giant_ids = [], []
             for r in self.molly.runs:
                 n = max(
@@ -597,7 +604,7 @@ class JaxBackend(GraphBackend):
             bits[j, goal_labels] = True
 
         sparse_edges = None
-        if failed_iters and good.n_nodes > _giant_threshold():
+        if failed_iters and good.n_nodes > self._giant_v:
             # Giant good run: the dense device diff's V^3 closure (and its
             # depth-bounded max-plus loop) are prohibitive; the sparse host
             # path is O(F * (V + E)) on the packed edge list and exact

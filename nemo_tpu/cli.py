@@ -18,7 +18,12 @@ import os
 import sys
 
 from nemo_tpu.analysis.pipeline import run_debug
-from nemo_tpu.utils.jax_config import enable_compilation_cache, ensure_platform, pin_platform
+from nemo_tpu.utils.jax_config import (
+    PlatformUnavailableError,
+    enable_compilation_cache,
+    ensure_platform,
+    pin_platform,
+)
 
 
 def make_backend(name: str):
@@ -133,7 +138,13 @@ def main(argv: list[str] | None = None) -> int:
         # the platform under a watchdog so a tunnel outage degrades to CPU
         # instead of hanging (the reference CLI always terminates,
         # main.go:65-292 — every error is log.Fatalf).
-        platform = ensure_platform(args.platform)
+        try:
+            platform = ensure_platform(args.platform)
+        except PlatformUnavailableError as e:
+            # Explicit --platform=tpu with no reachable device: terminate
+            # nonzero (log.Fatalf semantics) rather than silently degrading.
+            print(f"fatal: {e}", file=sys.stderr)
+            return 2
         print(f"jax platform: {platform}", file=sys.stderr)
     else:
         # python/neo4j run no device code; the service backend's device

@@ -280,6 +280,14 @@ def _stream_pipelined(
                 except queue.Empty:
                     continue
         thread.join(timeout=5.0)
+    if prod_exc:
+        # The stream itself completed, but the producer still failed (e.g.
+        # after its last emitted chunk was consumed).  Don't drop it: a
+        # clean-looking result from a failed producer is a silent-corruption
+        # hazard (ADVICE r3 #2).
+        raise SidecarError(
+            f"producer failed after streaming completed: {prod_exc[0]!r}"
+        ) from prod_exc[0]
     missing = [i for i, o in enumerate(results) if o is None]
     if missing:
         raise SidecarError(f"missing responses for chunks {missing}")

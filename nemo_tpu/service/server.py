@@ -144,12 +144,22 @@ def main(argv: list[str] | None = None) -> int:
     )
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
-    from nemo_tpu.utils.jax_config import enable_compilation_cache, ensure_platform
+    from nemo_tpu.utils.jax_config import (
+        PlatformUnavailableError,
+        enable_compilation_cache,
+        ensure_platform,
+    )
 
     # The sidecar owns the accelerator; resolve the platform under a
     # watchdog so a tunnel outage degrades to a CPU sidecar (loudly) instead
-    # of a server whose first RPC hangs forever (VERDICT r2 weak #3).
-    platform = ensure_platform(args.platform, log=log.warning)
+    # of a server whose first RPC hangs forever (VERDICT r2 weak #3).  An
+    # explicit --platform=tpu demand with no reachable device refuses to
+    # start at all rather than serving CPU answers under a TPU flag.
+    try:
+        platform = ensure_platform(args.platform, log=log.warning)
+    except PlatformUnavailableError as e:
+        log.error("fatal: %s", e)
+        return 2
     log.info("jax platform: %s", platform)
     enable_compilation_cache()
     if args.profiler_port:

@@ -31,6 +31,14 @@ import sys
 #: Platform names that mean "use the environment's default selection".
 _DEFAULT_NAMES = ("", "auto", "tpu", "axon", "default")
 
+#: Subset of _DEFAULT_NAMES that is an *explicit demand for the device*:
+#: resolution must not silently degrade to CPU for these (ADVICE r3 #1).
+_EXPLICIT_DEVICE_NAMES = ("tpu", "axon")
+
+
+class PlatformUnavailableError(RuntimeError):
+    """An explicitly requested device platform could not be reached."""
+
 
 def pin_platform(platform: str) -> None:
     """Pin jax's platform selection, overriding the sitecustomize override.
@@ -94,9 +102,14 @@ def ensure_platform(
 
     requested:
       "cpu" (or any concrete local platform)  -> pinned immediately, no probe
-      None / "auto" / "tpu" / "axon"          -> probe the default selection
+      None / "auto"                           -> probe the default selection
           under a watchdog; healthy -> leave the selection alone (the only
           way to reach the tunnel device); unreachable -> pin "cpu" and warn.
+      "tpu" / "axon"                          -> same probe (pinning
+          JAX_PLATFORMS=tpu fails under the tunnel, so the device is still
+          reached via the default selection), but the request is an explicit
+          demand: if the probe fails or resolves to a host-only platform,
+          raise PlatformUnavailableError instead of degrading to CPU.
 
     Defaults come from env: NEMO_PLATFORM (request),
     NEMO_PROBE_TIMEOUT / NEMO_PROBE_RETRIES (watchdog knobs).
@@ -117,14 +130,32 @@ def ensure_platform(
     retries = probe_retries if probe_retries is not None else int(
         os.environ.get("NEMO_PROBE_RETRIES", "2")
     )
+    # "Explicit" means the CALLER demanded the device (--platform=tpu / a
+    # direct ensure_platform("tpu")).  A NEMO_PLATFORM=tpu *environment
+    # default* keeps the loud CPU fallback: an env-configured deployment
+    # (e.g. a long-lived sidecar) should survive a tunnel outage, while a
+    # user typing the flag should get an error, not a silent downgrade.
+    explicit = (requested or "").lower() in _EXPLICIT_DEVICE_NAMES
     info = probe_default_platform(timeout_s, retries, log=log)
     if info is None:
+        if explicit:
+            raise PlatformUnavailableError(
+                f"platform {req!r} explicitly requested but the device probe "
+                "failed (tunnel outage or no device); refusing to silently "
+                "run on CPU — pass --platform=auto to allow the fallback"
+            )
         log(
             "warning: device platform unreachable (probe timed out); "
             "falling back to CPU"
         )
         pin_platform("cpu")
         return "cpu"
+    if explicit and info["platform"] == "cpu":
+        raise PlatformUnavailableError(
+            f"platform {req!r} explicitly requested but only CPU devices are "
+            f"visible (default selection resolved to {info['platform']!r} "
+            f"x{info['n']}); refusing to silently run on CPU"
+        )
     return info["platform"]
 
 

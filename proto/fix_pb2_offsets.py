@@ -1,0 +1,90 @@
+"""Post-process a protoc-generated ``*_pb2.py``: fix nested-type offsets.
+
+protoc's Python generator locates each message's serialized bytes inside the
+file's ``FileDescriptorProto`` and emits ``_serialized_start/_end`` markers.
+When two messages have byte-identical serializations (here: the map-entry
+``OutputsEntry`` nested in both ``AnalyzeResponse`` and ``KernelResponse``),
+the generator can emit the FIRST occurrence's offsets for both — observed
+with libprotoc 3.21.12: ``_KERNELRESPONSE_OUTPUTSENTRY`` gets 729/790, which
+lies inside ``AnalyzeResponse`` (620..790) instead of ``KernelResponse``
+(1032..1185).
+
+This script enforces the invariant that a nested type's span lies within its
+parent's span: for each ``_PARENT_CHILD._serialized_start/_end`` pair whose
+span falls outside ``_PARENT``'s, it re-locates the child's serialized bytes
+*within* the parent span and rewrites the two integers.  Run automatically by
+``make proto``; idempotent.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import sys
+
+
+def main(path: str) -> int:
+    src = open(path, encoding="utf-8").read()
+
+    # The FileDescriptorProto bytes come from the file being edited (the
+    # AddSerializedFile literal), not from importing any particular module —
+    # the script works on any pb2 file from any cwd.
+    m = re.search(r"AddSerializedFile\(\s*(b(?:'[^\n]*'|\"[^\n]*\"))\s*\)", src)
+    if m is None:
+        print(f"fix_pb2_offsets: no AddSerializedFile literal in {path}", file=sys.stderr)
+        return 1
+    fd = ast.literal_eval(m.group(1))
+
+    pat = re.compile(r"^  (_[A-Z0-9_]+)\._serialized_start=(\d+)$", re.M)
+    spans: dict[str, list[int]] = {}
+    for m in pat.finditer(src):
+        name, start = m.group(1), int(m.group(2))
+        em = re.search(
+            rf"^  {re.escape(name)}\._serialized_end=(\d+)$", src, re.M
+        )
+        if em:
+            spans[name] = [start, int(em.group(1))]
+
+    fixed = 0
+    for name, (start, end) in spans.items():
+        # Parent = longest strictly-shorter prefix that is itself a message.
+        parent = max(
+            (p for p in spans if p != name and name.startswith(p + "_")),
+            key=len,
+            default=None,
+        )
+        if parent is None:
+            continue
+        pstart, pend = spans[parent]
+        if pstart <= start and end <= pend:
+            continue  # already consistent
+        child_bytes = fd[start:end]
+        loc = fd.find(child_bytes, pstart, pend)
+        if loc < 0:
+            print(f"fix_pb2_offsets: cannot relocate {name}", file=sys.stderr)
+            return 1
+        new_start, new_end = loc, loc + len(child_bytes)
+        src = re.sub(
+            rf"^  {re.escape(name)}\._serialized_start=\d+$",
+            f"  {name}._serialized_start={new_start}",
+            src,
+            flags=re.M,
+        )
+        src = re.sub(
+            rf"^  {re.escape(name)}\._serialized_end=\d+$",
+            f"  {name}._serialized_end={new_end}",
+            src,
+            flags=re.M,
+        )
+        print(f"fix_pb2_offsets: {name}: {start}..{end} -> {new_start}..{new_end}")
+        fixed += 1
+
+    if fixed:
+        open(path, "w", encoding="utf-8").write(src)
+    else:
+        print("fix_pb2_offsets: all nested spans consistent")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1]))

@@ -45,6 +45,54 @@ def test_device_request_leaves_selection_alone(monkeypatch):
     assert os.environ["JAX_PLATFORMS"] == "sentinel"
 
 
+def test_explicit_tpu_raises_when_probe_fails(monkeypatch):
+    """--platform=tpu is a demand, not a hint: probe failure must raise,
+    never silently degrade to CPU (ADVICE r3 #1)."""
+    import pytest
+
+    monkeypatch.setattr(jax_config, "probe_default_platform", lambda *a, **k: None)
+    with pytest.raises(jax_config.PlatformUnavailableError, match="explicitly requested"):
+        jax_config.ensure_platform("tpu")
+
+
+def test_explicit_tpu_raises_on_cpu_only_host(monkeypatch):
+    """If the default selection resolves to CPU, an explicit tpu/axon
+    request must error instead of returning 'cpu' (ADVICE r3 #1)."""
+    import pytest
+
+    monkeypatch.setattr(
+        jax_config, "probe_default_platform", lambda *a, **k: {"platform": "cpu", "n": 8}
+    )
+    with pytest.raises(jax_config.PlatformUnavailableError, match="only CPU"):
+        jax_config.ensure_platform("axon")
+    # auto on the same host is fine: the fallback is the point of auto.
+    assert jax_config.ensure_platform("auto") == "cpu"
+
+
+def test_cli_explicit_tpu_exits_nonzero_when_unreachable(monkeypatch, corpus_dir, tmp_path, capsys):
+    """CLI contract: explicit --platform=tpu with no device terminates rc!=0
+    with a fatal message (log.Fatalf semantics, main.go:65-292)."""
+    from nemo_tpu import cli as cli_mod
+
+    monkeypatch.setattr(
+        cli_mod, "ensure_platform",
+        lambda *a, **k: (_ for _ in ()).throw(
+            jax_config.PlatformUnavailableError("platform 'tpu' explicitly requested but the device probe failed")
+        ),
+    )
+    rc = cli_mod.main(
+        [
+            "-faultInjOut", corpus_dir,
+            "--graph-backend", "jax",
+            "--platform", "tpu",
+            "--results-dir", str(tmp_path / "results"),
+            "--figures", "none",
+        ]
+    )
+    assert rc == 2
+    assert "fatal:" in capsys.readouterr().err
+
+
 def test_probe_timeout_kills_hung_subprocess(monkeypatch):
     """A probe whose subprocess hangs must return None within the timeout,
     not block forever (the observed outage mode)."""

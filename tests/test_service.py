@@ -184,6 +184,45 @@ def test_merge_chunk_outputs_pads_widths_and_recomputes_reductions():
     assert (merged["proto_min_depth"][:2, 2:] == DEPTH_INF).all()
 
 
+def test_producer_failure_after_stream_completes_raises(monkeypatch):
+    """A producer exception must surface even when every chunk result
+    arrived and the stream ended cleanly — a clean-looking result from a
+    failed producer is a silent-corruption hazard (ADVICE r3 #2)."""
+    from nemo_tpu.service import client as client_mod
+    from nemo_tpu.service.client import SidecarError, _stream_pipelined
+
+    class FakeAnalyzer:
+        timeout = 1.0
+
+        def __init__(self, target):
+            pass
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+        def wait_ready(self, deadline):
+            pass
+
+        def _analyze_stream(self, requests_iter, timeout=None):
+            # Complete the stream WITHOUT draining the request iterator, so
+            # the producer's exception is never seen mid-stream; only the
+            # epilogue check can surface it.
+            yield client_mod.pb.AnalyzeResponse(chunk=0)
+
+    monkeypatch.setattr(client_mod, "RemoteAnalyzer", FakeAnalyzer)
+
+    def body(emit):
+        emit((0, None, None, {}))
+        raise RuntimeError("late producer failure")
+
+    with pytest.raises(SidecarError, match="after streaming completed") as ei:
+        _stream_pipelined("ignored:0", 1, body, {}, queue_depth=2)
+    assert isinstance(ei.value.__cause__, RuntimeError)
+
+
 def test_stream_abort_unblocks_producer():
     """If the consumer dies mid-stream, the producer must not stay blocked
     in a full queue (ADVICE r2: thread + batch leak)."""
