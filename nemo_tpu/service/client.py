@@ -280,6 +280,14 @@ def _stream_pipelined(
                 except queue.Empty:
                     continue
         thread.join(timeout=5.0)
+    if thread.is_alive():
+        # The join timed out with the producer still running: its exception
+        # state is unknowable, so a clean-looking result can't be trusted
+        # (the daemon thread could raise right after we return).
+        raise SidecarError(
+            "producer thread still running after streaming completed "
+            "(join timed out); result discarded as unverifiable"
+        )
     if prod_exc:
         # The stream itself completed, but the producer still failed (e.g.
         # after its last emitted chunk was consumed).  Don't drop it: a
