@@ -294,6 +294,98 @@ struct Vocab {
   }
 };
 
+// ---------------------------------------------------------------------------
+// Namespaced prov JSON serialization (debugging.json embedding).
+//
+// Byte-for-byte what the Python path produces via
+// json.dumps(ProvData.to_json()) with default separators (", " / ": ") and
+// ensure_ascii=True, after ingest/molly.py's transforms (namespacing + clock
+// time fix): the report writer splices these strings into debugging.json
+// without ever parsing provenance in Python (VERDICT r3 task 1).
+// ---------------------------------------------------------------------------
+
+// Python json.dumps ensure_ascii escaping for a decoded UTF-8 string.
+void append_escaped(std::string& out, const std::string& s) {
+  out += '"';
+  size_t i = 0, n = s.size();
+  while (i < n) {
+    unsigned char c = (unsigned char)s[i];
+    if (c == '"') { out += "\\\""; ++i; }
+    else if (c == '\\') { out += "\\\\"; ++i; }
+    else if (c == '\b') { out += "\\b"; ++i; }
+    else if (c == '\f') { out += "\\f"; ++i; }
+    else if (c == '\n') { out += "\\n"; ++i; }
+    else if (c == '\r') { out += "\\r"; ++i; }
+    else if (c == '\t') { out += "\\t"; ++i; }
+    else if (c < 0x20) {
+      char buf[8];
+      std::snprintf(buf, sizeof buf, "\\u%04x", (unsigned)c);
+      out += buf;
+      ++i;
+    } else if (c < 0x80) {
+      out += (char)c;
+      ++i;
+    } else {
+      // Decode one UTF-8 sequence -> codepoint -> \uXXXX (surrogate pair
+      // beyond the BMP), matching ensure_ascii=True.
+      unsigned cp = 0;
+      int len = 1;
+      if ((c & 0xE0) == 0xC0) { cp = c & 0x1F; len = 2; }
+      else if ((c & 0xF0) == 0xE0) { cp = c & 0x0F; len = 3; }
+      else if ((c & 0xF8) == 0xF0) { cp = c & 0x07; len = 4; }
+      else { cp = 0xFFFD; len = 1; }
+      if (len > 1) {
+        if (i + (size_t)len > n) { cp = 0xFFFD; len = 1; }
+        else {
+          for (int k = 1; k < len; ++k) cp = (cp << 6) | ((unsigned char)s[i + k] & 0x3F);
+        }
+      }
+      char buf[16];
+      if (cp < 0x10000) {
+        std::snprintf(buf, sizeof buf, "\\u%04x", cp);
+        out += buf;
+      } else {
+        cp -= 0x10000;
+        std::snprintf(buf, sizeof buf, "\\u%04x\\u%04x", 0xD800 + (cp >> 10),
+                      0xDC00 + (cp & 0x3FF));
+        out += buf;
+      }
+      i += (size_t)len;
+    }
+  }
+  out += '"';
+}
+
+// Append `"key": <value>` mirroring Python `d.get(key, "")` then json.dumps:
+// absent -> "", string -> escaped, number -> raw token, null -> null,
+// bool -> true/false (dataclass field passthrough).
+//
+// Numeric caveat: the raw token is spliced verbatim, while Python's path
+// round-trips through float() for non-integer tokens (json.load -> dumps
+// canonicalizes "1e2" to 100.0, "1.50" to 1.5).  Molly emits integer and
+// string scalars only, so the paths agree on every real corpus; exotic
+// float spellings would diverge and are caught by the byte-parity tests
+// (tests/test_fast_ingest.py), not silently mangled.
+void append_field(std::string& out, const JVal& obj, const char* key) {
+  out += '"';
+  out += key;
+  out += "\": ";
+  const JVal* v = obj.get(key);
+  if (!v) { out += "\"\""; return; }
+  switch (v->type) {
+    case JVal::STR: append_escaped(out, v->s); break;
+    case JVal::NUM: out += v->s; break;
+    case JVal::BOOL: out += v->b ? "true" : "false"; break;
+    case JVal::NUL: out += "null"; break;
+    default: out += "\"\""; break;  // arrays/objects never survive from_json
+  }
+}
+
+// Append the always-a-string field value (Python str() coercion).
+void append_str_value(std::string& out, const std::string& s) {
+  append_escaped(out, s);
+}
+
 // One provenance graph after parsing + namespacing, before interning.
 struct RawGraph {
   int32_t n_goals = 0;
@@ -303,7 +395,23 @@ struct RawGraph {
   std::vector<std::string> times;   // goals only meaningful; rules ""
   std::vector<int32_t> types;       // 0 none, 1 async, 2 next, 3 collapsed
   std::vector<int32_t> esrc, edst;  // slot indices
+  std::string prov_json;            // namespaced serialization (see above)
 };
+
+// True when a JVal would be falsy in Python (omitted by `if self.sender:`).
+bool jval_falsy(const JVal* v) {
+  if (!v) return true;
+  switch (v->type) {
+    case JVal::STR: return v->s.empty();
+    case JVal::NUM: {
+      double d = std::strtod(v->s.c_str(), nullptr);
+      return d == 0.0;
+    }
+    case JVal::BOOL: return !v->b;
+    case JVal::NUL: return true;
+    default: return false;  // non-empty containers never survive from_json
+  }
+}
 
 int32_t type_id_of(const std::string& t) {
   if (t == "async") return 1;
@@ -320,6 +428,19 @@ std::string read_file(const std::string& path) {
   return ss.str();
 }
 
+// Python str() of a JSON value fetched via d.get(key, "") — the coercion
+// Goal.from_json applies to "time" (datatypes.py:166).
+std::string py_str_of(const JVal* v) {
+  if (!v) return "";
+  switch (v->type) {
+    case JVal::STR: return v->s;
+    case JVal::NUM: return v->s;
+    case JVal::NUL: return "None";
+    case JVal::BOOL: return v->b ? "True" : "False";
+    default: return "";
+  }
+}
+
 RawGraph parse_prov(const std::string& path, long iteration, const char* cond) {
   JVal doc = JsonParser(read_file(path)).parse();
   if (doc.type != JVal::OBJ) throw std::runtime_error(path + ": provenance root not an object");
@@ -331,12 +452,20 @@ RawGraph parse_prov(const std::string& path, long iteration, const char* cond) {
   const JVal* rules = doc.get("rules");
   const JVal* edges = doc.get("edges");
 
+  // The namespaced serialization is built alongside the packed arrays so
+  // the DOM is walked exactly once; `js` accumulates the byte-exact
+  // json.dumps(ProvData.to_json()) output.
+  std::string& js = g.prov_json;
+  js.reserve(4096);
+  js += "{\"goals\": [";
+
   if (goals && goals->type == JVal::ARR) {
+    bool first = true;
     for (const JVal& jg : goals->arr) {
       std::string id = jg.get_str("id");
       std::string table = jg.get_str("table");
       std::string label = jg.get_str("label");
-      std::string time = jg.get_str("time");
+      std::string time = py_str_of(jg.get("time"));
       if (table == "clock") {  // molly.go:76-89: wild first, two-number wins
         std::string t;
         if (match_clock_wild(label, t)) time = t;
@@ -348,10 +477,37 @@ RawGraph parse_prov(const std::string& path, long iteration, const char* cond) {
       g.labels.push_back(label);
       g.times.push_back(time);
       g.types.push_back(0);
+
+      if (!first) js += ", ";
+      first = false;
+      // Goal.to_json key order: id, label, table, time, [conditionHolds —
+      // never: ingest pins cond_holds=False, molly.py:55], [sender],
+      // [receiver] (datatypes.py:171-185).
+      js += "{\"id\": ";
+      append_escaped(js, g.ids.back());
+      js += ", ";
+      append_field(js, jg, "label");
+      js += ", ";
+      append_field(js, jg, "table");
+      js += ", \"time\": ";
+      append_str_value(js, time);
+      const JVal* sender = jg.get("sender");
+      if (!jval_falsy(sender)) {
+        js += ", ";
+        append_field(js, jg, "sender");
+      }
+      const JVal* receiver = jg.get("receiver");
+      if (!jval_falsy(receiver)) {
+        js += ", ";
+        append_field(js, jg, "receiver");
+      }
+      js += '}';
     }
   }
   g.n_goals = (int32_t)g.ids.size();
+  js += "], \"rules\": [";
   if (rules && rules->type == JVal::ARR) {
+    bool first = true;
     for (const JVal& jr : rules->arr) {
       std::string id = jr.get_str("id");
       slot[id] = (int32_t)g.ids.size();  // last occurrence wins (packed.py pack_graph)
@@ -360,18 +516,44 @@ RawGraph parse_prov(const std::string& path, long iteration, const char* cond) {
       g.labels.push_back(jr.get_str("label"));
       g.times.push_back("");
       g.types.push_back(type_id_of(jr.get_str("type")));
+
+      if (!first) js += ", ";
+      first = false;
+      // Rule.to_json: all four keys, unconditionally (datatypes.py:209).
+      js += "{\"id\": ";
+      append_escaped(js, g.ids.back());
+      js += ", ";
+      append_field(js, jr, "label");
+      js += ", ";
+      append_field(js, jr, "table");
+      js += ", ";
+      append_field(js, jr, "type");
+      js += '}';
     }
   }
+  js += "], \"edges\": [";
   if (edges && edges->type == JVal::ARR) {
+    bool first = true;
     for (const JVal& je : edges->arr) {
-      auto si = slot.find(je.get_str("from"));
-      auto di = slot.find(je.get_str("to"));
+      std::string esrc = je.get_str("from");
+      std::string edst = je.get_str("to");
+      auto si = slot.find(esrc);
+      auto di = slot.find(edst);
       if (si == slot.end() || di == slot.end())
         throw std::runtime_error(path + ": edge endpoint not a known goal/rule id");
       g.esrc.push_back(si->second);
       g.edst.push_back(di->second);
+
+      if (!first) js += ", ";
+      first = false;
+      js += "{\"from\": ";
+      append_escaped(js, prefix + esrc);
+      js += ", \"to\": ";
+      append_escaped(js, prefix + edst);
+      js += '}';
     }
   }
+  js += "]}";
   return g;
 }
 
@@ -419,6 +601,7 @@ struct PackedCond {
   std::vector<uint8_t> edge_mask;                             // [B*E]
   std::vector<int32_t> n_nodes, n_goals;                      // [B]
   std::vector<std::string> node_ids_joined;                   // per run, '\n'-joined
+  std::vector<std::string> prov_json;                         // per run, namespaced
 };
 
 struct Corpus {
@@ -430,7 +613,7 @@ struct Corpus {
   std::string error;  // empty on success
 };
 
-void pack_cond(const std::vector<RawGraph>& graphs, int64_t v, int64_t e, Corpus& c,
+void pack_cond(std::vector<RawGraph>& graphs, int64_t v, int64_t e, Corpus& c,
                PackedCond& out) {
   int64_t b = (int64_t)graphs.size();
   out.table_id.assign(b * v, -1);
@@ -445,8 +628,10 @@ void pack_cond(const std::vector<RawGraph>& graphs, int64_t v, int64_t e, Corpus
   out.n_nodes.resize(b);
   out.n_goals.resize(b);
   out.node_ids_joined.resize(b);
+  out.prov_json.resize(b);
   for (int64_t i = 0; i < b; ++i) {
-    const RawGraph& g = graphs[i];
+    RawGraph& g = graphs[i];
+    out.prov_json[i] = std::move(g.prov_json);
     int32_t n = (int32_t)g.ids.size();
     out.n_nodes[i] = n;
     out.n_goals[i] = g.n_goals;
@@ -589,9 +774,19 @@ const char* nemo_node_ids(void* h, int cond, int run) {
   return p.node_ids_joined[(size_t)run].c_str();
 }
 
+// Byte-exact namespaced prov serialization of one run's graph (cond 0/1):
+// what json.dumps(ProvData.to_json()) produces after ingest transforms.
+// Valid until free.
+const char* nemo_prov_json(void* h, int cond, int run) {
+  auto* c = (Corpus*)h;
+  const PackedCond& p = c->cond[cond];
+  if (run < 0 || (size_t)run >= p.prov_json.size()) return "";
+  return p.prov_json[(size_t)run].c_str();
+}
+
 void nemo_free(void* h) { delete (Corpus*)h; }
 
 // ABI version for the ctypes wrapper to sanity-check.
-int nemo_abi_version() { return 2; }
+int nemo_abi_version() { return 3; }
 
 }  // extern "C"

@@ -40,6 +40,53 @@ class DebugResult:
     timings: dict[str, float]
 
 
+def _prov_json_str(prov) -> str:
+    """Serialized provenance: RawProv splices its C++-held bytes verbatim;
+    ProvData encodes through to_json as before."""
+    if hasattr(prov, "json_str"):
+        return prov.json_str()
+    return json.dumps(prov.to_json())
+
+
+def _run_json_str(run, good_iter: int | None) -> str:
+    """One debugging.json run entry, byte-identical to
+    json.dumps({**run.to_json(), "goodRunIteration": good_iter}) on the
+    object-ingest path (same key order, same omitempty policy,
+    datatypes.py:RunData.to_json), but able to splice RawProv byte strings
+    without ever parsing provenance in Python."""
+    pairs: list[tuple[str, str]] = [
+        ("iteration", json.dumps(run.iteration)),
+        ("status", json.dumps(run.status)),
+        ("failureSpec", json.dumps(run.failure_spec.to_json() if run.failure_spec else None)),
+        ("model", json.dumps(run.model.to_json() if run.model else None)),
+        ("messages", json.dumps([m.to_json() for m in run.messages])),
+    ]
+    if run.pre_prov is not None:
+        pairs.append(("preProv", _prov_json_str(run.pre_prov)))
+    if run.time_pre_holds:
+        pairs.append(("timePreHolds", json.dumps(run.time_pre_holds)))
+    if run.post_prov is not None:
+        pairs.append(("postProv", _prov_json_str(run.post_prov)))
+    if run.time_post_holds:
+        pairs.append(("timePostHolds", json.dumps(run.time_post_holds)))
+    if run.recommendation:
+        pairs.append(("recommendation", json.dumps(run.recommendation)))
+    if run.corrections:
+        pairs.append(("corrections", json.dumps(run.corrections)))
+    if run.missing_events:
+        pairs.append(("missingEvents", json.dumps([m.to_json() for m in run.missing_events])))
+    if run.inter_proto:
+        pairs.append(("interProto", json.dumps(run.inter_proto)))
+    if run.inter_proto_missing:
+        pairs.append(("interProtoMissing", json.dumps(run.inter_proto_missing)))
+    if run.union_proto:
+        pairs.append(("unionProto", json.dumps(run.union_proto)))
+    if run.union_proto_missing:
+        pairs.append(("unionProtoMissing", json.dumps(run.union_proto_missing)))
+    pairs.append(("goodRunIteration", json.dumps(good_iter)))
+    return "{" + ", ".join(f'"{k}": {v}' for k, v in pairs) + "}"
+
+
 def select_figure_iters(
     policy: str, iters: list[int], failed_iters: list[int], good_iter: int | None
 ) -> list[int]:
@@ -85,6 +132,18 @@ def select_figure_iters(
     return [i for i in iters if i in sel]
 
 
+def _choose_packed_ingest(backend: GraphBackend, save_corpus_path: str | None) -> bool:
+    """Auto ingest policy: the packed-first loader (C++ ETL, RawProv
+    placeholders) applies when the backend consumes packed arrays directly
+    and nothing downstream needs the Python provenance object tree
+    (--save-corpus packs from ProvData, so it pins the object loader)."""
+    if not getattr(backend, "supports_packed_ingest", False) or save_corpus_path:
+        return False
+    from nemo_tpu.ingest.native import native_available
+
+    return native_available()
+
+
 def run_debug(
     fault_inj_out: str,
     results_root: str,
@@ -94,12 +153,15 @@ def run_debug(
     save_corpus_path: str | None = None,
     profile_dir: str | None = None,
     figures: str = "all",
+    ingest: str = "auto",
 ) -> DebugResult:
     """Full debug pipeline.  With profile_dir set, the analysis phases run
     under jax.profiler.trace — open the directory with TensorBoard or
     xprof to see per-kernel device timelines (SURVEY.md §5: the rebuild's
     tracing story).  `figures` is the figure materialization policy
-    (select_figure_iters)."""
+    (select_figure_iters).  `ingest` selects the ETL: "python" (object
+    loader), "native" (packed-first C++ loader, array backends only), or
+    "auto" (native when the backend supports it and the library builds)."""
     import contextlib
 
     trace_ctx: contextlib.AbstractContextManager = contextlib.nullcontext()
@@ -109,8 +171,35 @@ def run_debug(
         trace_ctx = jax.profiler.trace(profile_dir)
     timer = PhaseTimer()
 
+    if ingest == "auto":
+        use_packed = _choose_packed_ingest(backend, save_corpus_path)
+    elif ingest == "native":
+        # Fail fast with the reason, not deep in the pipeline: RawProv
+        # placeholders crash object backends/--save-corpus only after the
+        # full native ingest already ran.
+        if not getattr(backend, "supports_packed_ingest", False):
+            raise ValueError(
+                "ingest='native' requires a packed-ingest backend (jax/service); "
+                f"{type(backend).__name__} consumes provenance objects"
+            )
+        if save_corpus_path:
+            raise ValueError(
+                "ingest='native' is incompatible with --save-corpus "
+                "(corpus bundling packs from the Python object tree)"
+            )
+        use_packed = True
+    elif ingest == "python":
+        use_packed = False
+    else:
+        raise ValueError(f"unknown ingest mode {ingest!r} (expected auto, native, python)")
+
     with timer.phase("ingest"):
-        molly = load_molly_output(fault_inj_out)
+        if use_packed:
+            from nemo_tpu.ingest.native import load_molly_output_packed
+
+            molly = load_molly_output_packed(fault_inj_out)
+        else:
+            molly = load_molly_output(fault_inj_out)
     if save_corpus_path:
         from nemo_tpu.graphs.corpus import pack_corpus, save_corpus
 
@@ -221,14 +310,15 @@ def run_debug(
         # the report frontend points its diff layer stack at the right run
         # instead of re-deriving the policy in JS (ADVICE r2).  Extra key on
         # the reference schema; the reference frontend ignores unknown keys.
-        run_jsons = [r.to_json() for r in runs]
-        for rj in run_jsons:
-            rj["goodRunIteration"] = good_iter
         with open(os.path.join(this_results_dir, "debugging.json"), "w", encoding="utf-8") as fh:
-            # dumps + write, NOT json.dump: dump streams through the pure-
-            # Python encoder while dumps uses the C one — at 10k+ runs the
-            # difference is seconds of report wall-clock (profiled).
-            fh.write(json.dumps(run_jsons))
+            # Assembled by string splicing, NOT one json.dumps over object
+            # trees: on the packed-first ingest path each run's pre/post
+            # provenance exists only as a C++-serialized byte string
+            # (ingest/native.py:RawProv) spliced in verbatim — byte-identical
+            # to what the object path would have encoded (tests/test_fast_ingest.py).
+            fh.write("[")
+            fh.write(", ".join(_run_json_str(r, good_iter) for r in runs))
+            fh.write("]")
 
         reporter.generate_figures(fig_iters, "spacetime", hazard_dots)
         reporter.generate_figures(fig_iters, "pre_prov", pre_dots)

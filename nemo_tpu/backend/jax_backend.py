@@ -29,10 +29,13 @@ from nemo_tpu.analysis.queries import (
     find_pre_triggers,
 )
 from nemo_tpu.graphs.packed import (
+    TYPE_NAMES,
+    CorpusGraphs,
     CorpusVocab,
     PackedBatch,
     bucket_size,
     bucketize_pairs,
+    bucketize_pairs_corpus,
     pack_batch,
     pack_graph,
     rewrite_run_prefix,
@@ -256,6 +259,20 @@ def _giant_threshold() -> int:
     return int(os.environ.get("NEMO_GIANT_V", "4096"))
 
 
+def _diff_host_work_budget() -> int:
+    """Crossover for differential provenance (VERDICT r3 task 3): jobs with
+    failed_runs x (V + E_good) at or below this run on the exact sparse host
+    path (ops/diff.py:diff_masks_host) instead of paying a device dispatch.
+
+    Measured on the TPU tunnel (CA-2083 base corpus, V=32, E=27): the host
+    path costs ~0.18 ms for one failed run and ~0.08 ms/run batched
+    (~1.4 us per work unit), while a single device dispatch is ~68 ms
+    RTT-dominated — so below ~50k work units the host path wins outright;
+    above it, the batched device diff amortizes better and keeps the
+    stress-scale path on device."""
+    return int(os.environ.get("NEMO_DIFF_HOST_WORK", "50000"))
+
+
 def _verb_arrays(pre_b: PackedBatch, post_b: PackedBatch) -> dict[str, np.ndarray]:
     """The fused/giant verbs' named-array inputs for one (pre, post) bucket."""
     return {
@@ -287,7 +304,26 @@ class _LazyGraphs:
         self._cache[key] = value
 
 
+class _CorpusPacked:
+    """Lazy (run iteration, cond) -> PackedGraph mapping over a NativeCorpus
+    (packed-first ingest): graphs materialize as array views on first access
+    instead of 2N eager Python repacks (VERDICT r3 task 1)."""
+
+    def __init__(self, graphs: CorpusGraphs, row_by_iter: dict[int, int]) -> None:
+        self._graphs = graphs
+        self._row_by_iter = row_by_iter
+
+    def __getitem__(self, key: tuple[int, str]):
+        rid, cond = key
+        return self._graphs.get(cond, self._row_by_iter[rid])
+
+
 class JaxBackend(GraphBackend):
+    #: run_debug's auto ingest policy keys off this: the backend consumes
+    #: packed corpus arrays directly, so the pipeline may skip building the
+    #: per-goal Python object tree entirely (ingest/native.py:RawProv).
+    supports_packed_ingest = True
+
     def __init__(self, max_batch: int | None = None, executor=None) -> None:
         self.max_batch = max_batch
         # The device boundary.  LocalExecutor runs kernels in-process; the
@@ -311,6 +347,11 @@ class JaxBackend(GraphBackend):
         self._clean_rows: dict[tuple[int, str], tuple] = {}
         self._run_by_iter: dict[int, object] = {}
         self._giant_v = _giant_threshold()
+        self._diff_host_work = _diff_host_work_budget()
+        # Packed-first ingest state (native corpus arrays; else None/empty).
+        self._corpus = None
+        self._corpus_graphs: CorpusGraphs | None = None
+        self._row_by_iter: dict[int, int] = {}
 
     # ------------------------------------------------------------------ setup
 
@@ -319,6 +360,7 @@ class JaxBackend(GraphBackend):
         # The giant threshold is re-read here and ONLY here, so _fused and
         # build_figures can never disagree within one corpus.
         self._giant_v = _giant_threshold()
+        self._diff_host_work = _diff_host_work_budget()
         self.molly = molly
         self.vocab = CorpusVocab()
         self.packed = {}
@@ -331,13 +373,35 @@ class JaxBackend(GraphBackend):
         self._fused_out = None
         self._clean_rows = {}
         self._run_by_iter = {r.iteration: r for r in molly.runs}
-        for run in molly.runs:
-            for cond, prov in (("pre", run.pre_prov), ("post", run.post_prov)):
-                self.packed[(run.iteration, cond)] = pack_graph(prov, self.vocab)
+        nc = getattr(molly, "native_corpus", None)
+        self._corpus = nc
+        if nc is not None:
+            # Packed-first path: the native ETL already produced batch-layout
+            # arrays and the interning order is bit-identical to the Python
+            # path by construction (native/nemo_native.cpp:ingest), so the
+            # vocab rebuilds from the corpus string lists and per-run graphs
+            # become lazy array views — no per-graph Python repack.
+            for t in nc.tables:
+                self.vocab.tables.intern(t)
+            for lb in nc.labels:
+                self.vocab.labels.intern(lb)
+            for tm in nc.times:
+                self.vocab.times.intern(tm)
+            self._corpus_graphs = CorpusGraphs(nc)
+            self._row_by_iter = {int(it): i for i, it in enumerate(nc.iteration)}
+            self.packed = _CorpusPacked(self._corpus_graphs, self._row_by_iter)
+        else:
+            self._corpus_graphs = None
+            self._row_by_iter = {}
+            for run in molly.runs:
+                for cond, prov in (("pre", run.pre_prov), ("post", run.post_prov)):
+                    self.packed[(run.iteration, cond)] = pack_graph(prov, self.vocab)
 
     def close_db(self) -> None:
         # Release everything init_graph_db allocates (reference: CloseDB,
-        # graphing/helpers.go:58-86); the backend stays reusable.
+        # graphing/helpers.go:58-86); the backend stays reusable.  The native
+        # corpus handle is NOT closed here: the report writer splices its
+        # prov JSON after close_db, and molly owns its lifetime (GC).
         self.molly = None
         self.vocab = None
         self.packed = {}
@@ -350,6 +414,9 @@ class JaxBackend(GraphBackend):
         self._fused_out = None
         self._clean_rows = {}
         self._run_by_iter = {}
+        self._corpus = None
+        self._corpus_graphs = None
+        self._row_by_iter = {}
 
     # ------------------------------------------------------- lazy host graphs
 
@@ -358,6 +425,8 @@ class JaxBackend(GraphBackend):
         with condition_holds mirrored from the kernel output."""
         assert self.molly is not None
         rid, cond = key
+        if self._corpus is not None:
+            return self._corpus_pgraph(key)
         run = self._run_by_iter[rid]
         g = build_pgraph(run.pre_prov if cond == "pre" else run.post_prov)
         holds = self.cond_holds.get(key)
@@ -365,6 +434,39 @@ class JaxBackend(GraphBackend):
             pg = self.packed[key]
             for slot in range(pg.n_goals):
                 g.nodes[pg.node_ids[slot]].cond_holds = bool(holds[slot])
+        return g
+
+    def _corpus_pgraph(self, key: tuple[int, str]) -> PGraph:
+        """build_pgraph equivalent over packed corpus arrays: identical node
+        insertion order (goals then rules, prov order), identical edge order
+        and MERGE dedup — the DOT/query layers see the same graph the Python
+        ingest path would have built."""
+        from nemo_tpu.graphs.pgraph import PNode
+
+        pg = self.packed[key]
+        holds = self.cond_holds.get(key)
+        tables, labels, times = self.vocab.tables, self.vocab.labels, self.vocab.times
+        ids = [pg.node_ids[s] for s in range(pg.n_nodes)]
+        g = PGraph()
+        table_l = pg.table_id.tolist()
+        label_l = pg.label_id.tolist()
+        time_l = pg.time_id.tolist()
+        type_l = pg.type_id.tolist()
+        for s in range(pg.n_nodes):
+            is_goal = s < pg.n_goals
+            g.add_node(
+                PNode(
+                    id=ids[s],
+                    is_goal=is_goal,
+                    label=labels[label_l[s]],
+                    table=tables[table_l[s]],
+                    time=times[time_l[s]] if is_goal else "",
+                    type="" if is_goal else TYPE_NAMES.get(type_l[s], ""),
+                    cond_holds=bool(holds[s]) if (is_goal and holds is not None) else False,
+                )
+            )
+        for s, d in pg.edges.tolist():
+            g.add_edge(ids[s], ids[d])
         return g
 
     def _build_clean(self, key: tuple[int, str]) -> PGraph:
@@ -375,7 +477,7 @@ class JaxBackend(GraphBackend):
         bi, row = self._simplified_row[(base_rid, cond)]
         batch, adj, alive, type_new = self.simplified[cond][bi]
         holds = self.cond_holds[(base_rid, cond)]
-        n = batch.graphs[row].n_nodes
+        n = int(batch.n_nodes[row])
         padded_holds = np.zeros(batch.v, dtype=bool)
         padded_holds[:n] = holds
         rows = self._clean_rows.get((base_rid, cond))
@@ -438,15 +540,23 @@ class JaxBackend(GraphBackend):
             # would dominate or OOM them) and analyzes alone on the
             # node-sharded closure-free path (parallel/giant.py).
             giant_v = self._giant_v
-            run_ids, giant_ids = [], []
-            for r in self.molly.runs:
-                n = max(
-                    self.packed[(r.iteration, "pre")].n_nodes,
-                    self.packed[(r.iteration, "post")].n_nodes,
-                )
-                (giant_ids if n > giant_v else run_ids).append(r.iteration)
-            pre = [self.packed[(i, "pre")] for i in run_ids]
-            post = [self.packed[(i, "post")] for i in run_ids]
+            if self._corpus is not None:
+                # Packed-first: node counts come from the corpus arrays —
+                # never materialize 2N lazy graph views just to size-split.
+                nc = self._corpus
+                nmax = np.maximum(nc.pre.n_nodes, nc.post.n_nodes)
+                rows = np.nonzero(nmax <= giant_v)[0].tolist()
+                giant_ids = [int(nc.iteration[i]) for i in np.nonzero(nmax > giant_v)[0]]
+                n_dense = len(rows)
+            else:
+                run_ids, giant_ids = [], []
+                for r in self.molly.runs:
+                    n = max(
+                        self.packed[(r.iteration, "pre")].n_nodes,
+                        self.packed[(r.iteration, "post")].n_nodes,
+                    )
+                    (giant_ids if n > giant_v else run_ids).append(r.iteration)
+                n_dense = len(run_ids)
             # Static dims round to powers of two (see graphs_to_step) so
             # corpora with nearby vocab sizes share compiled programs; at
             # stress scale, size FLOORS collapse the per-family bucket
@@ -456,7 +566,7 @@ class JaxBackend(GraphBackend):
             # excluded (with_diff=0): the backend diffs against the chosen
             # good run in its own dispatch, and dropping it removes the
             # label vocab (the most corpus-varying dim) from the signature.
-            big = len(run_ids) >= 512
+            big = n_dense >= 512
             min_v, min_e, min_t = (64, 256, 32) if big else (16, 16, 8)
             params_common = dict(
                 pre_tid=self.vocab.tables.lookup("pre"),
@@ -465,10 +575,23 @@ class JaxBackend(GraphBackend):
                 num_labels=8,  # unused without the diff tail
                 with_diff=0,
             )
+            if self._corpus is not None:
+                batches = bucketize_pairs_corpus(
+                    self._corpus_graphs,
+                    rows,
+                    self._corpus.iteration,
+                    self.max_batch,
+                    min_v=min_v,
+                    min_e=min_e,
+                )
+            else:
+                pre = [self.packed[(i, "pre")] for i in run_ids]
+                post = [self.packed[(i, "post")] for i in run_ids]
+                batches = bucketize_pairs(
+                    run_ids, pre, post, self.max_batch, min_v=min_v, min_e=min_e
+                )
             out = []
-            for pre_b, post_b in bucketize_pairs(
-                run_ids, pre, post, self.max_batch, min_v=min_v, min_e=min_e
-            ):
+            for pre_b, post_b in batches:
                 res = self.executor.run(
                     "fused",
                     _verb_arrays(pre_b, post_b),
@@ -516,11 +639,12 @@ class JaxBackend(GraphBackend):
             # lazily on first access (_build_raw), so 10k-run corpora pay
             # no per-node Python cost here (VERDICT r1).
             for cond, b, holds in (("pre", pre_b, res["pre_holds"]), ("post", post_b, res["post_holds"])):
+                ns = b.n_nodes.tolist()
                 for row, rid in enumerate(b.run_ids):
-                    n = b.graphs[row].n_nodes
-                    self.cond_holds[(rid, cond)] = holds[row, :n]
+                    self.cond_holds[(rid, cond)] = holds[row, : ns[row]]
+            ach = np.asarray(res["achieved_pre"]).tolist()
             for row, rid in enumerate(pre_b.run_ids):
-                self.achieved_pre[rid] = bool(res["achieved_pre"][row])
+                self.achieved_pre[rid] = bool(ach[row])
         # Any raw property-graph built BEFORE this point lacks cond_holds
         # styling; drop the lazy cache so those rebuild with holds mirrored
         # (ADVICE r2: the cache must not pin an order-dependent invariant).
@@ -619,18 +743,33 @@ class JaxBackend(GraphBackend):
         gb = pack_batch([g], [good])
 
         bits = np.zeros((bucket_size(max(1, len(failed_iters)), 8), num_labels), dtype=bool)
-        for j, f in enumerate(failed_iters):
-            pg = self.packed[(f, "post")]
-            goal_labels = pg.label_id[: pg.n_goals]
-            bits[j, goal_labels] = True
+        if self._corpus is not None:
+            # Packed-first: one vectorized scatter over the corpus arrays
+            # instead of a per-failed-run view materialization (is_goal is
+            # exactly the slots-below-n_goals mask the legacy slice takes).
+            rows = np.asarray([self._row_by_iter[f] for f in failed_iters], dtype=np.int64)
+            isg = self._corpus.post.is_goal[rows]
+            lab = self._corpus.post.label_id[rows]
+            j_idx, s_idx = np.nonzero(isg)
+            bits[j_idx, lab[j_idx, s_idx]] = True
+        else:
+            for j, f in enumerate(failed_iters):
+                pg = self.packed[(f, "post")]
+                goal_labels = pg.label_id[: pg.n_goals]
+                bits[j, goal_labels] = True
 
+        # Routing (VERDICT r3 task 3): giant good runs MUST take the sparse
+        # host path (dense V^3 closure prohibitive); small jobs TAKE it
+        # because it wins — below the measured work crossover a single
+        # tunnel dispatch costs more than the whole exact host computation.
+        host_work = len(failed_iters) * (good.n_nodes + len(good.edges))
+        use_host = good.n_nodes > self._giant_v or host_work <= self._diff_host_work
         sparse_edges = None
-        if failed_iters and good.n_nodes > self._giant_v:
-            # Giant good run: the dense device diff's V^3 closure (and its
-            # depth-bounded max-plus loop) are prohibitive; the sparse host
-            # path is O(F * (V + E)) on the packed edge list and exact
-            # (ops/diff.py:diff_masks_host).  edge_keep comes back as a mask
-            # over `good.edges`, densified only for figure-selected runs.
+        if failed_iters and use_host:
+            # Sparse host diff: O(F * (V + E)) on the packed edge list and
+            # exact (ops/diff.py:diff_masks_host).  edge_keep comes back as
+            # a mask over `good.edges`, densified only for figure-selected
+            # runs.
             from nemo_tpu.ops.diff import diff_masks_host
 
             padded_goal = np.zeros(gb.v, dtype=bool)
@@ -772,11 +911,19 @@ class JaxBackend(GraphBackend):
     def generate_extensions(self) -> tuple[bool, list[str]]:
         assert self.molly is not None
         pre_tid = self.vocab.tables.lookup("pre")
+        # One vectorized reduction per fused bucket (equivalent to the
+        # per-run holds[:n_goals] & table==pre sum: is_goal is exactly the
+        # slots-below-n_goals mask, and padding rows are all-False).
         achieved = 0
-        for run in self.molly.runs:
-            pg = self.packed[(run.iteration, "pre")]
-            holds = self.cond_holds[(run.iteration, "pre")]
-            achieved += int(np.sum(holds[: pg.n_goals] & (pg.table_id[: pg.n_goals] == pre_tid)))
+        for pre_b, _post_b, res in self._fused():
+            holds = np.asarray(res["pre_holds"])
+            k = len(pre_b.run_ids)
+            sel = (
+                holds[:k]
+                & np.asarray(pre_b.is_goal[:k])
+                & (np.asarray(pre_b.table_id[:k]) == pre_tid)
+            )
+            achieved += int(sel.sum())
         all_achieved = achieved >= len(self.molly.runs)
         if all_achieved:
             return True, []

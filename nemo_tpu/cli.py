@@ -128,6 +128,15 @@ def main(argv: list[str] | None = None) -> int:
         "bundle (graphs/corpus.py) so analysis can be re-run without "
         "re-parsing the Molly output",
     )
+    parser.add_argument(
+        "--ingest",
+        default="auto",
+        choices=("auto", "native", "python"),
+        help="ETL selection: 'native' parses+packs all provenance in the "
+        "C++ engine (array backends only), 'python' builds the object "
+        "tree, 'auto' (default) picks native when the backend supports "
+        "packed ingest and the library builds",
+    )
     args = parser.parse_args(argv)
 
     if not os.path.isdir(args.fault_inj_out):
@@ -161,6 +170,7 @@ def main(argv: list[str] | None = None) -> int:
         save_corpus_path=args.save_corpus,
         profile_dir=args.profile,
         figures=args.figures,
+        ingest=args.ingest,
     )
 
     if args.timings:

@@ -274,6 +274,199 @@ def bucketize_pairs(
     return batches
 
 
+# ---------------------------------------------------------------------------
+# Packed-first corpus views (native ETL -> device batches with no per-graph
+# Python repack; VERDICT r3 task 1)
+# ---------------------------------------------------------------------------
+
+
+class LazyNodeIds:
+    """list-like slot->namespaced-id view fetched from the C++ corpus handle
+    on first index; at stress scale only figure-selected runs (plus the good
+    run) ever materialize their id strings."""
+
+    __slots__ = ("_corpus", "_cond", "_row", "_ids")
+
+    def __init__(self, corpus, cond: str, row: int) -> None:
+        self._corpus = corpus
+        self._cond = cond
+        self._row = row
+        self._ids: list[str] | None = None
+
+    def _materialize(self) -> list[str]:
+        if self._ids is None:
+            self._ids = self._corpus.lazy_node_ids(self._cond, self._row)
+        return self._ids
+
+    def __getitem__(self, i):
+        return self._materialize()[i]
+
+    def __len__(self) -> int:
+        return len(self._materialize())
+
+    def __iter__(self):
+        return iter(self._materialize())
+
+
+class CorpusGraphs:
+    """Shared cache of per-(cond, row) PackedGraph views over a NativeCorpus.
+
+    A view's node/edge arrays are numpy slices of the corpus batch arrays
+    (no copies beyond the edge stack); node ids are LazyNodeIds."""
+
+    def __init__(self, corpus) -> None:
+        self.corpus = corpus
+        self._cache: dict[tuple[str, int], PackedGraph] = {}
+
+    def get(self, cond: str, row: int) -> PackedGraph:
+        key = (cond, row)
+        g = self._cache.get(key)
+        if g is None:
+            cb = self.corpus.cond(cond)
+            n = int(cb.n_nodes[row])
+            ne = int(cb.edge_mask[row].sum())  # contiguous True prefix
+            edges = np.stack(
+                [cb.edge_src[row, :ne], cb.edge_dst[row, :ne]], axis=1
+            ).astype(np.int32, copy=False)
+            g = self._cache[key] = PackedGraph(
+                n_goals=int(cb.n_goals[row]),
+                n_nodes=n,
+                node_ids=LazyNodeIds(self.corpus, cond, row),
+                table_id=cb.table_id[row, :n],
+                label_id=cb.label_id[row, :n],
+                time_id=cb.time_id[row, :n],
+                type_id=cb.type_id[row, :n],
+                edges=edges,
+            )
+        return g
+
+
+class BatchGraphs:
+    """PackedBatch.graphs for a corpus-built batch: batch row -> lazy view."""
+
+    __slots__ = ("_cg", "_cond", "_rows")
+
+    def __init__(self, cg: CorpusGraphs, cond: str, rows: list[int]) -> None:
+        self._cg = cg
+        self._cond = cond
+        self._rows = rows
+
+    def __getitem__(self, i: int) -> PackedGraph:
+        return self._cg.get(self._cond, self._rows[i])
+
+    def __len__(self) -> int:
+        return len(self._rows)
+
+
+def pack_batch_corpus(
+    cg: CorpusGraphs,
+    cond: str,
+    rows: list[int],
+    run_ids: list[int],
+    v: int,
+    e: int,
+    b_pad: int,
+    max_depth: int,
+) -> PackedBatch:
+    """pack_batch over corpus rows with vectorized numpy slicing — no
+    per-graph Python loop.  Column-slicing to the sub-bucket (v, e) is exact
+    because every selected row satisfies n_nodes <= v and n_edges <= e (its
+    bucket key), so dropped columns are all padding."""
+    cb = cg.corpus.cond(cond)
+    k = len(rows)
+    idx = np.asarray(rows, dtype=np.int64)
+
+    def node_arr(src: np.ndarray, fill) -> np.ndarray:
+        # The target bucket can be narrower (sub-bucket) OR wider (stress
+        # floor above the corpus dim) than the source arrays; the copied
+        # window is exact either way — everything outside it is padding.
+        w = min(v, src.shape[1])
+        out = np.full((b_pad, v), fill, dtype=src.dtype)
+        # src[idx, :w], not src[idx][:, :w]: the latter materializes a full
+        # corpus-width temporary per array before dropping the columns.
+        out[:k, :w] = src[idx, :w]
+        return out
+
+    def edge_arr(src: np.ndarray, fill) -> np.ndarray:
+        w = min(e, src.shape[1])
+        out = np.full((b_pad, e), fill, dtype=src.dtype)
+        out[:k, :w] = src[idx, :w]
+        return out
+
+    n_nodes = np.zeros(b_pad, dtype=np.int32)
+    n_goals = np.zeros(b_pad, dtype=np.int32)
+    n_nodes[:k] = cb.n_nodes[idx]
+    n_goals[:k] = cb.n_goals[idx]
+    return PackedBatch(
+        run_ids=list(run_ids),
+        graphs=BatchGraphs(cg, cond, list(rows)),
+        v=v,
+        e=e,
+        max_depth=min(v, max(1, max_depth)),
+        n_nodes=n_nodes,
+        n_goals=n_goals,
+        is_goal=node_arr(cb.is_goal, False),
+        node_mask=node_arr(cb.node_mask, False),
+        table_id=node_arr(cb.table_id, -1),
+        label_id=node_arr(cb.label_id, -1),
+        type_id=node_arr(cb.type_id, 0),
+        edge_src=edge_arr(cb.edge_src, 0),
+        edge_dst=edge_arr(cb.edge_dst, 0),
+        edge_mask=edge_arr(cb.edge_mask, False),
+    )
+
+
+def bucketize_pairs_corpus(
+    cg: CorpusGraphs,
+    rows: list[int],
+    iterations: np.ndarray,
+    max_batch: int | None = None,
+    min_v: int = 16,
+    min_e: int = 16,
+) -> list[tuple[PackedBatch, PackedBatch]]:
+    """bucketize_pairs over corpus rows: identical grouping/padding policy
+    (joint pre/post bucket key, power-of-two run-axis pad, run order
+    preserved within buckets), built by array slicing instead of per-graph
+    packing.  max_depth is the corpus-wide DAG bound rather than per-bucket
+    tight — identical results (relaxation iterations beyond the longest path
+    are no-ops) and one shared compile signature with the bench/native
+    sweep."""
+    corpus = cg.corpus
+    pre_cb, post_cb = corpus.pre, corpus.post
+    idx = np.asarray(rows, dtype=np.int64)
+    nmax = np.maximum(pre_cb.n_nodes[idx], post_cb.n_nodes[idx])
+    emax = np.maximum(
+        1, np.maximum(pre_cb.edge_mask[idx].sum(1), post_cb.edge_mask[idx].sum(1))
+    )
+
+    def vbucket(x: np.ndarray, floor: int) -> np.ndarray:
+        x = np.maximum(x, floor).astype(np.float64)
+        return (2 ** np.ceil(np.log2(x))).astype(np.int64)
+
+    v_arr = vbucket(nmax, min_v).tolist()
+    e_arr = vbucket(emax, min_e).tolist()
+    groups: dict[tuple[int, int], list[int]] = {}
+    for r, vv, ee in zip(rows, v_arr, e_arr):
+        groups.setdefault((vv, ee), []).append(r)
+    batches = []
+    for (v, e), rws in sorted(groups.items()):
+        step = max_batch or len(rws)
+        for s in range(0, len(rws), step):
+            chunk = rws[s : s + step]
+            b_pad = bucket_size(len(chunk), 8)
+            if max_batch:
+                b_pad = min(b_pad, max_batch)
+            run_ids = [int(iterations[r]) for r in chunk]
+            depth = int(corpus.max_depth)
+            batches.append(
+                (
+                    pack_batch_corpus(cg, "pre", chunk, run_ids, v, e, b_pad, depth),
+                    pack_batch_corpus(cg, "post", chunk, run_ids, v, e, b_pad, depth),
+                )
+            )
+    return batches
+
+
 def rewrite_run_prefix(orig_id: str, new_prefix: str) -> str:
     """Replace the run_<i>_<cond>_ namespace of an ingested node id
     (ingest/molly.py prefixing, reference molly.go:92) with a shadow-run

@@ -101,6 +101,24 @@ class MollyOutput:
         return os.path.join(self.output_dir, f"run_{iteration}_spacetime.dot")
 
 
+def attach_run_metadata(out: MollyOutput, run) -> None:
+    """Holds-maps + success/failure classification for one parsed run —
+    shared by the object loader below and the packed-first loader
+    (ingest/native.py:load_molly_output_packed) so the keying and status
+    rules can never drift apart.
+
+    Holds-maps: keyed by the string timestep in the last column of each
+    'pre'/'post' model-table row (molly.go:38-48)."""
+    tables = run.model.tables if run.model else {}
+    run.time_pre_holds = {row[-1]: True for row in tables.get("pre", []) if row}
+    run.time_post_holds = {row[-1]: True for row in tables.get("post", []) if row}
+    out.runs_iters.append(run.iteration)
+    if run.succeeded:
+        out.success_runs_iters.append(run.iteration)
+    else:
+        out.failed_runs_iters.append(run.iteration)
+
+
 def load_molly_output(output_dir: str) -> MollyOutput:
     """Load a Molly output directory.  Reference: faultinjectors/molly.go:15-163."""
     out = MollyOutput(run_name=os.path.basename(os.path.normpath(output_dir)), output_dir=output_dir)
@@ -112,18 +130,7 @@ def load_molly_output(output_dir: str) -> MollyOutput:
     out.runs = [RunData.from_json(r) for r in raw_runs]
 
     for i, run in enumerate(out.runs):
-        # Holds-maps: keyed by the string timestep in the last column of each
-        # 'pre'/'post' model-table row (molly.go:38-48).
-        tables = run.model.tables if run.model else {}
-        run.time_pre_holds = {row[-1]: True for row in tables.get("pre", []) if row}
-        run.time_post_holds = {row[-1]: True for row in tables.get("post", []) if row}
-
-        out.runs_iters.append(run.iteration)
-        if run.succeeded:
-            out.success_runs_iters.append(run.iteration)
-        else:
-            out.failed_runs_iters.append(run.iteration)
-
+        attach_run_metadata(out, run)
         # Per-run provenance files are indexed by position i, not by the
         # iteration field (molly.go:59-60).
         load_run_prov(output_dir, i, run)

@@ -1,0 +1,48 @@
+"""Profiling harness for the warm e2e path (not shipped; dev tool).
+
+Generates one bench-scale family corpus, runs run_debug once to warm the jit
+caches, then cProfiles a second run_debug and prints phase timings plus the
+top cumulative-time entries.
+"""
+
+import cProfile
+import io
+import os
+import pstats
+import sys
+import tempfile
+import time
+
+from nemo_tpu.analysis.pipeline import run_debug
+from nemo_tpu.backend.jax_backend import JaxBackend
+from nemo_tpu.models.case_studies import write_case_study
+from nemo_tpu.utils.jax_config import enable_compilation_cache
+
+enable_compilation_cache()
+
+family = os.environ.get("FAMILY", "CA-2083-hinted-handoff")
+n_runs = int(os.environ.get("RUNS", "1700"))
+tmp = tempfile.mkdtemp(prefix="nemo_prof_")
+t0 = time.perf_counter()
+d = write_case_study(family, n_runs=n_runs, seed=11, out_dir=os.path.join(tmp, "big"))
+print(f"gen: {time.perf_counter()-t0:.2f}s", file=sys.stderr)
+
+res = run_debug(d, os.path.join(tmp, "r1"), JaxBackend(), figures="sample:8")
+print("cold phases:", {k: round(v, 2) for k, v in res.timings.items()}, file=sys.stderr)
+
+pr = cProfile.Profile()
+for i in range(3):
+    t0 = time.perf_counter()
+    if i == 2:
+        pr.enable()
+    res = run_debug(d, os.path.join(tmp, f"r2_{i}"), JaxBackend(), figures="sample:8")
+    if i == 2:
+        pr.disable()
+    wall = time.perf_counter() - t0
+    print(f"warm wall [{i}]: {wall:.2f}s", file=sys.stderr)
+    print(f"warm phases [{i}]:", {k: round(v, 2) for k, v in res.timings.items()}, file=sys.stderr)
+
+s = io.StringIO()
+ps = pstats.Stats(pr, stream=s).sort_stats("cumulative")
+ps.print_stats(45)
+print(s.getvalue())

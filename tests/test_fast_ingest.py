@@ -1,0 +1,109 @@
+"""Packed-first ingest parity: the C++ ETL + RawProv splice path must be
+byte-identical to the pure-Python object path — same debugging.json, same
+figures — across corpus families (VERDICT r3 task 1: the CLI pipeline's
+ingest/report walls were Python object churn; the fast path may not change
+a single output byte)."""
+
+import json
+import os
+
+import pytest
+
+from nemo_tpu.analysis.pipeline import run_debug
+from nemo_tpu.backend.jax_backend import JaxBackend
+from nemo_tpu.ingest.molly import load_molly_output
+from nemo_tpu.ingest.native import (
+    ingest_native,
+    load_molly_output_packed,
+    native_available,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native ETL unavailable (no toolchain)"
+)
+
+
+def _tree_bytes(root: str) -> dict[str, bytes]:
+    out = {}
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for f in filenames:
+            p = os.path.join(dirpath, f)
+            with open(p, "rb") as fh:
+                out[os.path.relpath(p, root)] = fh.read()
+    return out
+
+
+@pytest.mark.parametrize("family", ["pb_asynchronous", "CA-2083-hinted-handoff"])
+def test_prov_json_byte_parity(tmp_path, family):
+    """nemo_prov_json == json.dumps(ProvData.to_json()) for every run/cond."""
+    from nemo_tpu.models.case_studies import write_case_study
+
+    d = write_case_study(family, n_runs=12, seed=5, out_dir=str(tmp_path))
+    molly = load_molly_output(d)
+    nc = ingest_native(d, with_node_ids=False, keep_handle=True)
+    assert nc.n_runs == len(molly.runs)
+    for i, run in enumerate(molly.runs):
+        for cond, prov in (("pre", run.pre_prov), ("post", run.post_prov)):
+            assert nc.prov_json(cond, i).decode() == json.dumps(prov.to_json()), (
+                f"run {i} {cond}"
+            )
+
+
+def test_packed_loader_metadata_matches_python(tmp_path):
+    from nemo_tpu.models.synth import SynthSpec, write_corpus
+
+    d = write_corpus(SynthSpec(n_runs=6, seed=3), str(tmp_path))
+    py = load_molly_output(d)
+    pk = load_molly_output_packed(d)
+    assert pk.runs_iters == py.runs_iters
+    assert pk.success_runs_iters == py.success_runs_iters
+    assert pk.failed_runs_iters == py.failed_runs_iters
+    assert pk.run_name == py.run_name
+    for a, b in zip(pk.runs, py.runs):
+        assert a.iteration == b.iteration
+        assert a.status == b.status
+        assert a.time_pre_holds == b.time_pre_holds
+        assert a.time_post_holds == b.time_post_holds
+        assert json.dumps(a.failure_spec.to_json()) == json.dumps(b.failure_spec.to_json())
+    # RawProv placeholders refuse object access loudly.
+    with pytest.raises(AttributeError):
+        pk.runs[0].pre_prov.goals
+
+
+@pytest.mark.parametrize("figures", ["all", "sample:2"])
+def test_pipeline_byte_parity_object_vs_packed(tmp_path, figures):
+    """Full run_debug on both ingest paths: every output byte identical."""
+    from nemo_tpu.models.synth import SynthSpec, write_corpus
+
+    d = write_corpus(SynthSpec(n_runs=6, seed=11), str(tmp_path))
+    r_obj = run_debug(d, str(tmp_path / "obj"), JaxBackend(), figures=figures, ingest="python")
+    r_pk = run_debug(d, str(tmp_path / "pk"), JaxBackend(), figures=figures, ingest="native")
+    obj = _tree_bytes(r_obj.report_dir)
+    pk = _tree_bytes(r_pk.report_dir)
+    assert sorted(obj) == sorted(pk)
+    for name in obj:
+        assert obj[name] == pk[name], f"{name} differs between ingest paths"
+
+
+def test_pipeline_parity_case_study_with_clock_goals(tmp_path):
+    """Clock-time regex extraction must agree across the two ETLs end-to-end."""
+    from nemo_tpu.models.case_studies import write_case_study
+
+    d = write_case_study("ZK-1270-racing-sent-flag", n_runs=8, seed=2, out_dir=str(tmp_path))
+    r_obj = run_debug(d, str(tmp_path / "obj"), JaxBackend(), figures="sample:2", ingest="python")
+    r_pk = run_debug(d, str(tmp_path / "pk"), JaxBackend(), figures="sample:2", ingest="native")
+    obj = _tree_bytes(r_obj.report_dir)
+    pk = _tree_bytes(r_pk.report_dir)
+    assert sorted(obj) == sorted(pk)
+    for name in obj:
+        assert obj[name] == pk[name], f"{name} differs between ingest paths"
+
+
+def test_auto_policy_selection(tmp_path):
+    """auto -> packed for JaxBackend, object loader for --save-corpus."""
+    from nemo_tpu.analysis.pipeline import _choose_packed_ingest
+    from nemo_tpu.backend.python_ref import PythonBackend
+
+    assert _choose_packed_ingest(JaxBackend(), None) is True
+    assert _choose_packed_ingest(JaxBackend(), "x.npz") is False
+    assert _choose_packed_ingest(PythonBackend(), None) is False
