@@ -5,7 +5,7 @@ NATIVE_LIB := native/build/libnemo_native.so
 REPORT_SRC := native/nemo_report.cpp
 REPORT_LIB := native/build/libnemo_report.so
 
-.PHONY: all native test bench clean reset proto
+.PHONY: all native test bench prewarm clean reset proto neo4j-up neo4j-validate neo4j-down
 
 all: native
 
@@ -24,14 +24,40 @@ test:
 bench:
 	python bench.py
 
+# Compile the stress-floor bucket programs into the persistent jax cache so
+# a first stress run loads from disk instead of compiling (utils/prewarm.py).
+prewarm:
+	python -m nemo_tpu.utils.prewarm
+
 # Regenerate protobuf message code for the sidecar wire protocol.
 proto:
 	protoc --python_out=nemo_tpu/service proto/nemo_service.proto
 	python3 proto/fix_pb2_offsets.py nemo_tpu/service/proto/nemo_service_pb2.py
 
+# Live-Neo4j validation harness (docker/): the reference L0 store
+# (neo4j:3.3.3 + APOC, auth off — reference Dockerfile:1-7,
+# docker-compose.yml:5-28) brought up for wire-stack validation wherever
+# docker exists.  The gated test and the full neo4j-backend pipeline run
+# against it; in docker-less environments the test self-skips.
+neo4j-up:
+	cd docker && docker compose up -d --build
+	@echo "waiting for Bolt on 127.0.0.1:7687 ..."
+	@for i in $$(seq 1 60); do \
+		python -c "import socket; socket.create_connection(('127.0.0.1', 7687), 1).close()" 2>/dev/null && break; \
+		sleep 1; \
+	done; \
+	python -c "import socket; socket.create_connection(('127.0.0.1', 7687), 1).close()" || \
+		{ echo "FATAL: Bolt never came up on 127.0.0.1:7687"; exit 1; }
+
+neo4j-validate: neo4j-up
+	python docker/validate_live.py bolt://127.0.0.1:7687
+
+neo4j-down:
+	cd docker && docker compose down -v
+
 # Wipe generated reports.  (The reference's `make reset`, Makefile:9-14,
-# also tears down its Neo4j container and tmp/ volume; this repo runs no
-# container — external Neo4j lifecycle is the operator's.)
+# also tears down its Neo4j container and tmp/ volume; this repo keeps the
+# validation container's lifecycle in its own neo4j-up/down targets.)
 reset:
 	rm -rf results
 

@@ -657,6 +657,12 @@ void pack_cond(std::vector<RawGraph>& graphs, int64_t v, int64_t e, Corpus& c,
 
 Corpus* ingest(const std::string& dir) {
   auto c = std::make_unique<Corpus>();
+  // Pin "pre"/"post" to table ids 0/1 (mirror of graphs/packed.py
+  // CorpusVocab.__post_init__): the condition-table ids are static args of
+  // the fused device program, so pinning makes the compile signature
+  // corpus-content-independent.
+  c->tables.intern("pre");
+  c->tables.intern("post");
   JVal runs = JsonParser(read_file(dir + "/runs.json")).parse();
   if (runs.type != JVal::ARR) throw std::runtime_error("runs.json: root not an array");
   c->n_runs = (int64_t)runs.arr.size();

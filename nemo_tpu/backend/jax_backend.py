@@ -264,13 +264,17 @@ def _diff_host_work_budget() -> int:
     failed_runs x (V + E_good) at or below this run on the exact sparse host
     path (ops/diff.py:diff_masks_host) instead of paying a device dispatch.
 
-    Measured on the TPU tunnel (CA-2083 base corpus, V=32, E=27): the host
-    path costs ~0.18 ms for one failed run and ~0.08 ms/run batched
-    (~1.4 us per work unit), while a single device dispatch is ~68 ms
-    RTT-dominated — so below ~50k work units the host path wins outright;
-    above it, the batched device diff amortizes better and keeps the
-    stress-scale path on device."""
-    return int(os.environ.get("NEMO_DIFF_HOST_WORK", "50000"))
+    Measured on the TPU tunnel: the host path costs ~0.18 ms for one failed
+    run, ~0.15-0.18 ms/run batched at the stress shape (V=64, E~30, ~950
+    failed runs -> ~150 ms per family, ~1.6 us per work unit), while the
+    device path pays ~70 ms dispatch RTT plus the dense edge_keep
+    [F,V,V] readback (~4 MB/family at ~8.5 MB/s tunnel bandwidth) plus a
+    per-signature fresh compile (tens of seconds) — the host path wins by
+    >2x at every corpus this repo generates.  The 2M default (~3 s of host
+    work) is where tunnel-deployment device costs finally amortize; on
+    directly-attached TPU (no tunnel RTT/bandwidth tax) lower it via
+    NEMO_DIFF_HOST_WORK."""
+    return int(os.environ.get("NEMO_DIFF_HOST_WORK", "2000000"))
 
 
 def _verb_arrays(pre_b: PackedBatch, post_b: PackedBatch) -> dict[str, np.ndarray]:
@@ -567,7 +571,13 @@ class JaxBackend(GraphBackend):
             # good run in its own dispatch, and dropping it removes the
             # label vocab (the most corpus-varying dim) from the signature.
             big = n_dense >= 512
-            min_v, min_e, min_t = (64, 256, 32) if big else (16, 16, 8)
+            # min_d floors the depth-bucket: per-family corpus depths (15-19
+            # across the case studies) otherwise bucket to 16 vs 32 and split
+            # an identical shape into two compiled programs; with the floor
+            # (and the pinned pre/post table ids) every big corpus shares
+            # ONE fused program — each extra program costs tens of seconds
+            # of fresh TPU compile, the extra trip counts cost microseconds.
+            min_v, min_e, min_t, min_d = (64, 256, 32, 32) if big else (16, 16, 8, 4)
             params_common = dict(
                 pre_tid=self.vocab.tables.lookup("pre"),
                 post_tid=self.vocab.tables.lookup("post"),
@@ -597,7 +607,7 @@ class JaxBackend(GraphBackend):
                     _verb_arrays(pre_b, post_b),
                     dict(
                         v=pre_b.v,
-                        max_depth=bucket_size(max(pre_b.max_depth, post_b.max_depth), 4),
+                        max_depth=bucket_size(max(pre_b.max_depth, post_b.max_depth), min_d),
                         **params_common,
                     ),
                 )

@@ -47,7 +47,9 @@ def diff_masks(
     missing_goal [B,V])."""
     num_labels = fail_bits.shape[-1]
     lid = jnp.clip(label_id, 0, num_labels - 1)
-    clo = closure(adj_good, impl=closure_impl)  # [V,V], shared across failed runs
+    # [V,V], shared across failed runs; directed DAG closure, so the corpus
+    # longest-path bound caps the squaring chain.
+    clo = closure(adj_good, impl=closure_impl, max_len=max_depth)
 
     def per_run(bits: jax.Array):
         in_failed = bits[lid] & (label_id >= 0)

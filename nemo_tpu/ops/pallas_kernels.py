@@ -16,8 +16,14 @@ thresholded at > 0 each squaring — sums of 0/1 products are non-negative
 integers, so the threshold is exact in both.
 
 Used via ops.adjacency.closure's impl dispatch (NEMO_CLOSURE_IMPL =
-auto|xla|pallas; auto picks pallas on TPU backends).  CPU tests run the same
-kernel in interpreter mode (tests/test_pallas.py).
+auto|xla|pallas).  NOTE: auto resolves to XLA — the v5e sweep in
+resolve_closure_impl's docstring shows XLA winning or tying at every
+production shape even against this kernel's block-diagonal packing; the
+closure is too small to be HBM-bound there, so the fused chain's thesis
+does not pay.  The kernel remains the explicit opt-in fused option and the
+reference for Mosaic patterns (block-diag MXU packing, VMEM scratch
+assembly).  CPU tests run the same kernel in interpreter mode
+(tests/test_pallas.py).
 """
 
 from __future__ import annotations
@@ -27,29 +33,61 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 
-def _closure_kernel(adj_ref, out_ref, *, n_steps: int, block_b: int, v: int, compute_dtype):
+def _closure_kernel(
+    adj_ref, out_ref, scratch_ref=None, *, n_steps: int, block_b: int, v: int, g: int, compute_dtype
+):
+    """Fused squaring chain with block-diagonal MXU packing: g = 128//v
+    graphs share one (g*v, g*v) matrix, so each jnp.dot drives a full
+    128-wide MXU tile instead of a v/128 sliver (a 32x32 matmul uses 1/16th
+    of the systolic array; packing 4 such graphs recovers it).  Exact: the
+    off-diagonal blocks start zero and products of block-diagonal matrices
+    stay block-diagonal, so each graph's closure is untouched by its
+    neighbors.  The identity is added over the full packed matrix — every
+    diagonal element lies inside a diagonal block.  The packed matrix is
+    assembled in a VMEM scratch ref with static slice stores (Mosaic has no
+    dynamic_update_slice lowering)."""
     acc_dtype = jnp.int32 if compute_dtype == jnp.int8 else jnp.float32
-    row = jax.lax.broadcasted_iota(jnp.int32, (v, v), 0)
-    col = jax.lax.broadcasted_iota(jnp.int32, (v, v), 1)
+    gv = g * v
+    row = jax.lax.broadcasted_iota(jnp.int32, (gv, gv), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (gv, gv), 1)
     eye = (row == col).astype(compute_dtype)
-    # Static unroll over the graphs of this block: Mosaic's dot lowering is
-    # 2-D, and block_b is small (VMEM-bounded), so unrolling beats a loop.
-    for i in range(block_b):
-        r = jnp.maximum(adj_ref[i], eye)
+    # Static unroll over the packed matrices of this block: Mosaic's dot
+    # lowering is 2-D, and block_b is small (VMEM-bounded), so unrolling
+    # beats a loop.
+    for t in range(block_b // g):
+        if g == 1:
+            r = jnp.maximum(adj_ref[t], eye)
+        else:
+            scratch_ref[...] = jnp.zeros((gv, gv), dtype=compute_dtype)
+            for a in range(g):
+                scratch_ref[a * v : (a + 1) * v, a * v : (a + 1) * v] = adj_ref[t * g + a]
+            r = jnp.maximum(scratch_ref[...], eye)
         for _ in range(n_steps):
             p = jnp.dot(r, r, preferred_element_type=acc_dtype)
             r = (p > 0).astype(compute_dtype)
-        out_ref[i] = r
+        if g == 1:
+            out_ref[t] = r
+        else:
+            for a in range(g):
+                out_ref[t * g + a] = r[a * v : (a + 1) * v, a * v : (a + 1) * v]
+
+
+def pack_factor(v: int) -> int:
+    """Graphs per 128-wide MXU tile (1 for V >= 128)."""
+    return max(1, 128 // v)
 
 
 def default_block_b(v: int, itemsize: int = 2) -> int:
-    """Graphs per grid instance, sized so ~3 live [block_b,V,V] buffers stay
-    well under VMEM (~16 MB/core); int8 compute fits twice as many as bf16."""
+    """Graphs per grid instance, sized so the live packed buffers (input
+    block, packed matrix, accumulator) stay well under VMEM (~16 MB/core);
+    int8 compute fits twice as many as bf16.  Always a multiple of
+    pack_factor(v) so blocks split evenly into packed matrices."""
     scale = max(1, 2 // itemsize)
     if v <= 128:
-        return 8 * scale
+        return 8 * pack_factor(v) * scale
     if v <= 256:
         return 4 * scale
     if v <= 512:
@@ -78,26 +116,42 @@ def closure_pallas(
     block_b: int | None = None,
     interpret: bool = False,
     compute_dtype=None,
+    max_len: int | None = None,
 ) -> jax.Array:
     """Reflexive-transitive closure of [B,V,V] (or [V,V]) boolean adjacency,
-    fused squaring chain in VMEM.  Bit-identical to adjacency.closure."""
+    fused squaring chain in VMEM with block-diagonal MXU packing.
+    Bit-identical to adjacency.closure.  max_len: static longest-path bound
+    (adjacency.closure_steps)."""
+    from nemo_tpu.ops.adjacency import closure_steps
+
     squeeze = adj.ndim == 2
     if squeeze:
         adj = adj[None]
     dt = compute_dtype or _compute_dtype()
     b, v, _ = adj.shape
-    n_steps = max(1, (v - 1).bit_length())
-    bb = min(block_b or default_block_b(v, jnp.dtype(dt).itemsize), b)
+    n_steps = closure_steps(v, max_len)
+    g = pack_factor(v)
+    bb = block_b or default_block_b(v, jnp.dtype(dt).itemsize)
+    bb = max(g, (bb // g) * g)  # multiple of the pack factor
+    if bb > b:
+        # Shrink to the batch, keeping divisibility (padding fills the rest).
+        bb = max(g, (b // g) * g if b >= g else g)
     x = adj.astype(dt)
     pad = (-b) % bb
     if pad:
         x = jnp.pad(x, ((0, pad), (0, 0), (0, 0)))
     out = pl.pallas_call(
-        functools.partial(_closure_kernel, n_steps=n_steps, block_b=bb, v=v, compute_dtype=dt),
+        functools.partial(
+            _closure_kernel, n_steps=n_steps, block_b=bb, v=v, g=g, compute_dtype=dt
+        ),
         out_shape=jax.ShapeDtypeStruct(x.shape, dt),
         grid=(x.shape[0] // bb,),
         in_specs=[pl.BlockSpec((bb, v, v), lambda i: (i, 0, 0))],
         out_specs=pl.BlockSpec((bb, v, v), lambda i: (i, 0, 0)),
+        # The packed-assembly scratch exists only when packing happens
+        # (g>1): at V>=128 it would idle 2-8 MB of the VMEM budget the
+        # large-V blocks need.
+        scratch_shapes=[pltpu.VMEM((g * v, g * v), dt)] if g > 1 else [],
         interpret=interpret,
     )(x)
     res = out[:b] > 0

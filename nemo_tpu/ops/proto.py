@@ -77,7 +77,9 @@ def proto_rule_bits(
     root = is_goal & alive & ~in_degree_any(a)
     is_rule = ~is_goal & alive
     if use_closure:
-        clo = closure(a, impl=closure_impl)
+        # Directed DAG closure: path lengths are bounded by the corpus
+        # longest-path bound, so the squaring chain shortens with it.
+        clo = closure(a, impl=closure_impl, max_len=max_depth)
         d1 = reach_ge1(a, clo)  # >=1-hop reachability
         reach = step_forward(root, d1) | jnp.zeros_like(root)  # nodes >=1 hop below a root
         rule_desc = step_backward(is_rule, d1)  # has a rule strictly below

@@ -1,0 +1,77 @@
+"""Closure impl sweep on the real device (dev tool, drives the auto table).
+
+Every rep's chain feeds a live reduction (no DCE), and every timed call gets
+distinct input bytes via a per-call roll amount (the device tunnel serves
+byte-identical dispatches from cache)."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nemo_tpu.ops.adjacency import bool_matmul, closure_steps
+from nemo_tpu.ops.pallas_kernels import closure_pallas
+from nemo_tpu.utils.jax_config import enable_compilation_cache
+
+enable_compilation_cache()
+print("backend:", jax.default_backend())
+
+REPS_IN = 32  # chains per jit call, each on distinct bytes
+
+
+def time_fn(f, adj):
+    jax.block_until_ready(f(adj, jnp.int32(99)))  # compile
+    ts = []
+    for s in range(5):
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(adj, jnp.int32(s)))
+        ts.append((time.perf_counter() - t0) / REPS_IN)
+    return float(np.median(ts))
+
+
+def make_xla(v, n_steps):
+    eye = jnp.eye(v, dtype=bool)
+
+    @jax.jit
+    def f(adj, s):
+        tot = jnp.zeros((), jnp.float32)
+        a0 = jnp.roll(adj, s, axis=0)
+        for k in range(REPS_IN):
+            r = jnp.roll(a0, k, axis=0) | eye
+            for _ in range(n_steps):
+                r = bool_matmul(r, r)
+            tot += jnp.sum(r.astype(jnp.float32))
+        return tot
+
+    return f
+
+
+def make_pallas(v, max_len, block_b=None):
+    @jax.jit
+    def f(adj, s):
+        tot = jnp.zeros((), jnp.float32)
+        a0 = jnp.roll(adj, s, axis=0)
+        for k in range(REPS_IN):
+            r = closure_pallas(jnp.roll(a0, k, axis=0), max_len=max_len, block_b=block_b)
+            tot += jnp.sum(r.astype(jnp.float32))
+        return tot
+
+    return f
+
+
+rng = np.random.default_rng(0)
+for v in (32, 64, 128, 256):
+    for b in (1700,):
+        adj = jnp.asarray(rng.random((b, v, v)) < (2.0 / v))
+        depth_bound = 16
+        for label, ml in (("full", None), ("d16", depth_bound)):
+            n_steps = closure_steps(v, ml)
+            t_x = time_fn(make_xla(v, n_steps), adj)
+            t_p = time_fn(make_pallas(v, ml), adj)
+            print(
+                f"V={v:4d} B={b:5d} {label:4s} steps={n_steps}: "
+                f"xla {t_x * 1e3:8.3f} ms  pallas {t_p * 1e3:8.3f} ms  "
+                f"xla/pallas {t_x / t_p:5.2f}x",
+                flush=True,
+            )
