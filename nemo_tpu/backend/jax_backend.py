@@ -614,29 +614,42 @@ class JaxBackend(GraphBackend):
                 out.append((pre_b, post_b, res))
             if giant_ids:
                 from nemo_tpu.parallel.giant import giant_plan
-            for rid in giant_ids:
-                gpre = self.packed[(rid, "pre")]
-                gpost = self.packed[(rid, "post")]
-                v_g = bucket_size(max(gpre.n_nodes, gpost.n_nodes))
-                e_g = bucket_size(max(1, len(gpre.edges), len(gpost.edges)))
-                pre_b = pack_batch([rid], [gpre], v_g, e_g)
-                post_b = pack_batch([rid], [gpost], v_g, e_g)
-                lin_pre, depth_pre = giant_plan(gpre)
-                lin_post, depth_post = giant_plan(gpost)
-                res = self.executor.run(
-                    "giant",
-                    _verb_arrays(pre_b, post_b),
-                    dict(
-                        v=v_g,
-                        pre_tid=params_common["pre_tid"],
-                        post_tid=params_common["post_tid"],
-                        num_tables=params_common["num_tables"],
-                        max_depth=max(pre_b.max_depth, post_b.max_depth),
-                        comp_linear=int(lin_pre and lin_post),
-                        proto_depth=max(depth_pre, depth_post),
-                    ),
+
+                # Corpus-common giant buckets + power-of-two depth buckets:
+                # the giant program's jit key is (V, E, depths, ...), so
+                # per-run raw values would compile one program per giant run
+                # (tens of seconds each on TPU) — bucketing shares one
+                # program across the corpus's giants at the cost of a few
+                # extra masked iterations.
+                g_graphs = [
+                    (self.packed[(rid, "pre")], self.packed[(rid, "post")])
+                    for rid in giant_ids
+                ]
+                v_g = bucket_size(max(g.n_nodes for pair in g_graphs for g in pair))
+                e_g = bucket_size(
+                    max(1, *(len(g.edges) for pair in g_graphs for g in pair))
                 )
-                out.append((pre_b, post_b, res))
+                for rid, (gpre, gpost) in zip(giant_ids, g_graphs):
+                    pre_b = pack_batch([rid], [gpre], v_g, e_g)
+                    post_b = pack_batch([rid], [gpost], v_g, e_g)
+                    lin_pre, depth_pre = giant_plan(gpre)
+                    lin_post, depth_post = giant_plan(gpost)
+                    res = self.executor.run(
+                        "giant",
+                        _verb_arrays(pre_b, post_b),
+                        dict(
+                            v=v_g,
+                            pre_tid=params_common["pre_tid"],
+                            post_tid=params_common["post_tid"],
+                            num_tables=params_common["num_tables"],
+                            max_depth=bucket_size(
+                                max(pre_b.max_depth, post_b.max_depth), 4
+                            ),
+                            comp_linear=int(lin_pre and lin_post),
+                            proto_depth=bucket_size(max(depth_pre, depth_post), 8),
+                        ),
+                    )
+                    out.append((pre_b, post_b, res))
             self._fused_out = out
         return self._fused_out
 

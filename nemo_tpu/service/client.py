@@ -173,7 +173,40 @@ class RemoteExecutor:
             self._client.close()
             raise
 
+    #: Hard wire limit of one Kernel RPC message (server and client channels
+    #: both configure grpc.max_*_message_length = 1 GiB, service/server.py).
+    MAX_MESSAGE_BYTES = 1 << 30
+
     def run(self, verb: str, arrays: dict, params: dict) -> dict[str, np.ndarray]:
+        # A single Kernel RPC ships the whole batch in one message each way;
+        # bool planes bit-pack 8x on the wire (service/codec.py).  Fail
+        # BEFORE serialization with the remedy, not deep inside grpc with
+        # RESOURCE_EXHAUSTED — and bound the RESPONSE too: the diff verb's
+        # edge_keep readback is a dense [F,V,V] bool plane that dwarfs its
+        # own request (F=1024 x V=4096 packs to 2 GiB), and fused/giant
+        # return two [B,V,V] clean-adjacency planes.
+        def packed_bytes(a) -> int:
+            a = np.asarray(a)
+            return a.size // 8 if a.dtype == np.bool_ else a.nbytes
+
+        est_req = sum(packed_bytes(v) for v in arrays.values())
+        est_resp = 0
+        if verb == "diff":
+            f = int(np.asarray(arrays["fail_bits"]).shape[0])
+            v = int(params["v"])
+            est_resp = (f * v * v + 3 * f * v) // 8
+        elif verb in ("fused", "giant"):
+            b, v = np.asarray(arrays["pre_is_goal"]).shape
+            est_resp = 2 * b * v * v // 8 + 8 * b * v
+        est = max(est_req, est_resp)
+        if est > self.MAX_MESSAGE_BYTES:
+            raise SidecarError(
+                f"kernel {verb!r} would move ~{est >> 20} MiB in one message "
+                f"(request ~{est_req >> 20}, response ~{est_resp >> 20}), above "
+                f"the {self.MAX_MESSAGE_BYTES >> 20} MiB gRPC cap; split the "
+                "corpus or use the chunked streaming ingest "
+                "(service.client.analyze_dir_pipelined)"
+            )
         return self._client.kernel(verb, arrays, params)
 
     def close(self) -> None:

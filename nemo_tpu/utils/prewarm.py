@@ -29,7 +29,7 @@ import tempfile
 import time
 
 
-def prewarm_family(name: str, n_probe: int, b_pad: int, log) -> float:
+def prewarm_family(name: str, n_probe: int, b_pad: int) -> float:
     import numpy as np
 
     from nemo_tpu.graphs.packed import bucket_size
@@ -116,7 +116,7 @@ def main(argv: list[str] | None = None) -> int:
     b_pad = bucket_size(args.runs_per_family, 8)
     total = 0.0
     for name in sorted(CASE_STUDIES):
-        dt = prewarm_family(name, args.probe_runs, b_pad, print)
+        dt = prewarm_family(name, args.probe_runs, b_pad)
         total += dt
         print(f"  {name}: compiled+ran in {dt:.1f}s (B={b_pad})", file=sys.stderr)
     print(f"prewarm done in {total:.1f}s; persistent cache is hot", file=sys.stderr)
