@@ -204,7 +204,7 @@ def child_main() -> None:
     base_dirs = []
     base_mollys = []
     total_runs = 0
-    t_gen = t_pack = 0.0
+    t_gen = t_pack = t_linear_check = 0.0
     tmp = tempfile.mkdtemp(prefix="nemo_bench_")
     import atexit
 
@@ -224,6 +224,16 @@ def child_main() -> None:
             pre, post, static = pack_molly_dir(big_dir)
         else:
             pre, post, static = pack_molly_for_step(load_molly_output(big_dir))
+        # The deployment path verifies chain linearity host-side and takes
+        # the O(V log V) component-label fast path when it holds
+        # (backend/jax_backend.py _fused); the sweep measures the same step,
+        # and the check's own host cost is reported (linear_check_ms) —
+        # deployment pays it once per bucket per corpus, not per dispatch.
+        from nemo_tpu.ops.simplify import pair_chains_linear
+
+        t_lc = time.perf_counter()
+        static = dict(static, comp_linear=pair_chains_linear(pre, post))
+        t_linear_check += time.perf_counter() - t_lc
         t2 = time.perf_counter()
         t_gen += t1 - t0
         t_pack += t2 - t1
@@ -231,7 +241,10 @@ def child_main() -> None:
         total_runs += b
         family_batches.append((name, pre, post, static))
         big_dirs.append((name, big_dir))
-        log(f"  {name}: {b} distinct runs, bucket V={static['v']}")
+        log(
+            f"  {name}: {b} distinct runs, bucket V={static['v']}, "
+            f"linear_chains={static['comp_linear']}"
+        )
     graphs = 2 * total_runs  # pre + post provenance per run
     log(
         f"stress corpus: {len(family_batches)} families, {total_runs} distinct runs, "
@@ -573,6 +586,7 @@ def child_main() -> None:
         "platform": jax.devices()[0].platform,
         "distinct_runs": total_runs,
         "sweep_ms": round(t_step * 1e3, 1),
+        "linear_check_ms": round(t_linear_check * 1e3, 1),
         "p50_diff_ms": None if np.isnan(p50_routed) else round(p50_routed, 4),
         "p50_diff_ms_device": None if np.isnan(p50_tpu) else round(p50_tpu, 3),
         "p50_diff_ms_amortized": None if np.isnan(amort_tpu) else round(amort_tpu, 4),

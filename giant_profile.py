@@ -1,0 +1,43 @@
+"""Giant-path TPU profile (dev tool; VERDICT r3 task 7).
+
+Runs the 10k-node scenario on the real device twice (cold incl. compile,
+then warm) against the Python oracle, with phase timings."""
+
+import sys
+import tempfile
+import time
+
+from nemo_tpu.utils.jax_config import enable_compilation_cache, ensure_platform
+
+platform = ensure_platform(None)
+print("platform:", platform, file=sys.stderr)
+enable_compilation_cache()
+
+import os
+
+os.environ["NEMO_GIANT_V"] = "4096"
+
+from nemo_tpu.analysis.pipeline import run_debug
+from nemo_tpu.backend.jax_backend import JaxBackend
+from nemo_tpu.backend.python_ref import PythonBackend
+from nemo_tpu.models.synth import SynthSpec, write_corpus
+
+tmp = tempfile.mkdtemp(prefix="nemo_giant_")
+corpus = write_corpus(SynthSpec(n_runs=2, seed=2, eot=3000, name="giant10k"), tmp)
+
+for label in ("cold", "warm"):
+    t0 = time.perf_counter()
+    jx = run_debug(corpus, f"{tmp}/jx_{label}", JaxBackend(), figures="none")
+    wall = time.perf_counter() - t0
+    print(f"giant [{label}]: {wall:.1f}s", {k: round(v, 2) for k, v in jx.timings.items()})
+
+t0 = time.perf_counter()
+py = run_debug(corpus, f"{tmp}/py", PythonBackend(), figures="none")
+t_py = time.perf_counter() - t0
+print(f"oracle: {t_py:.1f}s", {k: round(v, 2) for k, v in py.timings.items()})
+
+import json
+
+a = json.load(open(f"{tmp}/jx_warm/giant10k/debugging.json"))
+b = json.load(open(f"{tmp}/py/giant10k/debugging.json"))
+print("identical:", a == b)

@@ -328,13 +328,16 @@ def pack_molly_dir(output_dir: str):
     if native_available():
         c = ingest_native(output_dir, with_node_ids=False)
         from nemo_tpu.models.pipeline_model import BatchArrays
+        from nemo_tpu.ops.simplify import pair_chains_linear
 
         # NativeCondBatch exposes the same field names as PackedBatch, so the
-        # shared constructor applies.
+        # shared constructor applies; the linearity flag is computed on the
+        # packed arrays exactly like graphs_to_step does.
+        static = dict(c.static_kwargs, comp_linear=pair_chains_linear(c.pre, c.post))
         return (
             BatchArrays.from_packed(c.pre),
             BatchArrays.from_packed(c.post),
-            c.static_kwargs,
+            static,
         )
     from nemo_tpu.ingest.molly import load_molly_output
     from nemo_tpu.models.pipeline_model import pack_molly_for_step

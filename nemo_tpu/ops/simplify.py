@@ -67,6 +67,82 @@ def _labels_prop(und, member, v, idx, iters):
     return jnp.where(member, lab, v)
 
 
+def chains_linear_host(is_goal, node_mask, type_id, edge_src, edge_dst, edge_mask) -> bool:
+    """Host-side (numpy) batched mirror of giant_plan's linearity check over
+    [B,V]/[B,E] packed batch arrays: True iff EVERY run's @next chain-member
+    subgraph (after the clean_masks restriction) has member in/out degree
+    <= 1 — the precondition for the O(V log V) pointer-doubling labels in
+    collapse_chains(comp_doubling=True).
+
+    Conservative by construction: duplicate edge-list entries inflate the
+    host degree counts (the device adjacency dedups them), so a duplicated
+    chain edge can only flip the answer to False — costing the closure
+    fallback, never correctness.  All scatters are flat bincounts (the
+    ufunc.at equivalents are orders of magnitude slower at stress scale)."""
+    import numpy as np
+
+    is_goal = np.asarray(is_goal)
+    node_mask = np.asarray(node_mask)
+    type_id = np.asarray(type_id)
+    src = np.asarray(edge_src).astype(np.int64)
+    dst = np.asarray(edge_dst).astype(np.int64)
+    em = np.asarray(edge_mask).astype(bool)
+    b, v = is_goal.shape
+    rows = np.broadcast_to(np.arange(b)[:, None], src.shape)
+    flat_src = (rows * v + src).ravel()
+    flat_dst = (rows * v + dst).ravel()
+
+    def scatter_any(flat_idx, vals) -> "np.ndarray":
+        return (
+            np.bincount(flat_idx[vals.ravel()], minlength=b * v).reshape(b, v) > 0
+        )
+
+    goal = is_goal & node_mask
+    src_goal = np.take(goal.ravel(), flat_src).reshape(src.shape) & em
+    dst_goal = np.take(goal.ravel(), flat_dst).reshape(src.shape) & em
+    has_in_goal = scatter_any(flat_dst, src_goal)
+    has_out_goal = scatter_any(flat_src, dst_goal)
+    rule_alive = ~is_goal & node_mask & has_in_goal & has_out_goal
+    alive = goal | rule_alive
+    # clean_masks edge keep: from a goal iff the rule dst has an out-goal;
+    # from a rule iff it has an in-goal; endpoints alive.
+    keep = (
+        em
+        & np.where(
+            np.take(goal.ravel(), flat_src).reshape(src.shape),
+            np.take(has_out_goal.ravel(), flat_dst).reshape(src.shape),
+            np.take(has_in_goal.ravel(), flat_src).reshape(src.shape),
+        )
+        & np.take(alive.ravel(), flat_src).reshape(src.shape)
+        & np.take(alive.ravel(), flat_dst).reshape(src.shape)
+    )
+    next_rule = ~is_goal & alive & (type_id == TYPE_NEXT)
+    in_from_next = scatter_any(flat_dst, np.take(next_rule.ravel(), flat_src).reshape(src.shape) & keep)
+    out_to_next = scatter_any(flat_src, np.take(next_rule.ravel(), flat_dst).reshape(src.shape) & keep)
+    member = next_rule | (goal & alive & in_from_next & out_to_next)
+    member_edge = (
+        keep
+        & np.take(member.ravel(), flat_src).reshape(src.shape)
+        & np.take(member.ravel(), flat_dst).reshape(src.shape)
+    )
+    succ = np.bincount(flat_src[member_edge.ravel()], minlength=b * v).reshape(b, v)
+    pred = np.bincount(flat_dst[member_edge.ravel()], minlength=b * v).reshape(b, v)
+    return bool(((succ <= 1) | ~member).all() and ((pred <= 1) | ~member).all())
+
+
+def pair_chains_linear(pre, post) -> bool:
+    """chains_linear_host over a (pre, post) batch pair — the single
+    reduction every dispatch site uses (backend fused loop, bench sweep,
+    prewarm, sidecar chunk producers), so the linearity criterion can never
+    diverge between the measured and the deployed flag."""
+    return all(
+        chains_linear_host(
+            b.is_goal, b.node_mask, b.type_id, b.edge_src, b.edge_dst, b.edge_mask
+        )
+        for b in (pre, post)
+    )
+
+
 def _labels_doubling(a, member, v, idx):
     """Pointer-doubling along the DIRECTED member successor, O(V log V)
     after one O(V^2) argmax: every member's pointer converges to its chain

@@ -69,6 +69,7 @@ def static_from_pb(m: pb.StaticParams) -> dict:
         num_tables=int(m.num_tables),
         num_labels=int(m.num_labels),
         max_depth=int(m.max_depth),
+        comp_linear=bool(m.comp_linear),
     )
 
 

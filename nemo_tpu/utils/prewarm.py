@@ -50,7 +50,10 @@ def prewarm_family(name: str, n_probe: int, b_pad: int) -> float:
 
     # Stress-floor statics of the fused dispatch (backend/jax_backend.py
     # _fused, big-corpus branch): V/E floors 64/256, table bucket floor 32,
-    # labels pinned to 8 (no diff tail), run axis padded to b_pad.
+    # labels pinned to 8 (no diff tail), run axis padded to b_pad, and the
+    # linearity flag the deployment's host check would set for this family.
+    from nemo_tpu.ops.simplify import pair_chains_linear
+
     v = max(64, static["v"])
     e = max(256, int(pre.edge_src.shape[1]))
     static = dict(
@@ -59,6 +62,7 @@ def prewarm_family(name: str, n_probe: int, b_pad: int) -> float:
         num_tables=bucket_size(static["num_tables"], 32),
         num_labels=8,
         max_depth=bucket_size(static["max_depth"], 32),
+        comp_linear=pair_chains_linear(pre, post),
     )
     static["with_diff"] = 0
 

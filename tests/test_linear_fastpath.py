@@ -1,0 +1,120 @@
+"""Linear-chain fast path (comp_linear): the pointer-doubling component
+labels must produce identical pipeline output to the all-pairs closure
+labels wherever the host linearity check admits them — and the check itself
+must reject non-linear member subgraphs (where doubling would be wrong)."""
+
+import numpy as np
+import pytest
+
+from nemo_tpu.graphs.packed import CorpusVocab, pack_batch, pack_graph
+from nemo_tpu.ops.simplify import chains_linear_host
+
+
+def _outputs(corpus_dir, force_linear: bool):
+    import json
+    import os
+    import tempfile
+    from unittest import mock
+
+    from nemo_tpu.analysis.pipeline import run_debug
+    from nemo_tpu.backend.jax_backend import JaxBackend
+
+    out_dir = tempfile.mkdtemp()
+    with mock.patch(
+        "nemo_tpu.ops.simplify.chains_linear_host", return_value=force_linear
+    ):
+        res = run_debug(corpus_dir, out_dir, JaxBackend(), figures="all", ingest="python")
+    with open(os.path.join(res.report_dir, "debugging.json")) as f:
+        report = json.load(f)
+    figs = {}
+    fig_dir = os.path.join(res.report_dir, "figures")
+    for name in sorted(os.listdir(fig_dir)):
+        with open(os.path.join(fig_dir, name), "rb") as f:
+            figs[name] = f.read()
+    return report, figs
+
+
+def test_doubling_matches_closure_end_to_end(tmp_path):
+    """Same corpus through comp_linear=1 (doubling) and comp_linear=0
+    (closure): every output byte identical.  The corpus's chains really are
+    linear (asserted), so forcing the flag matches what the auto check
+    would decide."""
+    from nemo_tpu.ingest.molly import load_molly_output
+    from nemo_tpu.models.case_studies import write_case_study
+
+    d = write_case_study("CA-2083-hinted-handoff", n_runs=12, seed=5, out_dir=str(tmp_path))
+    molly = load_molly_output(d)
+    vocab = CorpusVocab()
+    graphs = [pack_graph(r.post_prov, vocab) for r in molly.runs]
+    b = pack_batch(list(range(len(graphs))), graphs)
+    assert chains_linear_host(
+        b.is_goal, b.node_mask, b.type_id, b.edge_src, b.edge_dst, b.edge_mask
+    )
+    lin = _outputs(d, force_linear=True)
+    clo = _outputs(d, force_linear=False)
+    assert lin == clo
+
+
+def _graph(goals, rules, edges):
+    from nemo_tpu.ingest.datatypes import Edge, Goal, ProvData, Rule
+
+    return ProvData(
+        goals=[Goal(id=g, label=g, table="t", time="1") for g in goals],
+        rules=[Rule(id=r, label=r, table="t", type=ty) for r, ty in rules],
+        edges=[Edge(src=s, dst=d) for s, d in edges],
+    )
+
+
+def _linear_of(prov) -> bool:
+    vocab = CorpusVocab()
+    b = pack_batch([0], [pack_graph(prov, vocab)])
+    return chains_linear_host(
+        b.is_goal, b.node_mask, b.type_id, b.edge_src, b.edge_dst, b.edge_mask
+    )
+
+
+def test_linear_check_accepts_chain():
+    # g0 -> r1(@next) -> g1 -> r2(@next) -> g2, plus out-goals keeping rules
+    # alive: a plain linear persistence chain.
+    prov = _graph(
+        ["g0", "g1", "g2"],
+        [("r1", "next"), ("r2", "next")],
+        [("g0", "r1"), ("r1", "g1"), ("g1", "r2"), ("r2", "g2")],
+    )
+    assert _linear_of(prov) is True
+
+
+def test_linear_check_rejects_branching_members():
+    # Goal g1 feeds TWO @next rules (member out-degree 2): pointer doubling
+    # would pick an arbitrary successor, so the check must say False.
+    prov = _graph(
+        ["g0", "g1", "g2", "g3"],
+        [("r1", "next"), ("r2", "next"), ("r3", "next")],
+        [
+            ("g0", "r1"),
+            ("r1", "g1"),
+            ("g1", "r2"),
+            ("r2", "g2"),
+            ("g1", "r3"),
+            ("r3", "g3"),
+        ],
+    )
+    assert _linear_of(prov) is False
+
+
+def test_linear_check_ignores_non_member_branching():
+    # Branching among NON-member (deductive) rules must not block the fast
+    # path: only the @next member subgraph's degrees matter.
+    prov = _graph(
+        ["g0", "g1", "g2", "g3"],
+        [("r1", "next"), ("ra", ""), ("rb", "")],
+        [
+            ("g0", "r1"),
+            ("r1", "g1"),
+            ("g1", "ra"),
+            ("ra", "g2"),
+            ("g1", "rb"),
+            ("rb", "g3"),
+        ],
+    )
+    assert _linear_of(prov) is True
