@@ -29,16 +29,23 @@ import tempfile
 import time
 
 
-def prewarm_family(name: str, n_probe: int, b_pad: int) -> float:
+def stress_signature(name: str, n_probe: int, b_pad: int):
+    """The exact (pre, post, static) the stress-scale fused dispatch uses
+    for this family: probe-corpus statics under the backend's big-corpus
+    floors (tests/test_compile_sharing.py:test_prewarm_matches_deployment
+    pins this derivation to the real dispatch signature)."""
     import numpy as np
 
     from nemo_tpu.graphs.packed import bucket_size
     from nemo_tpu.ingest.native import native_available, pack_molly_dir
     from nemo_tpu.models.case_studies import write_case_study
-    from nemo_tpu.models.pipeline_model import BatchArrays, analysis_step, pack_molly_for_step
+    from nemo_tpu.models.pipeline_model import BatchArrays, pack_molly_for_step
 
-    import jax
-
+    if b_pad < n_probe:
+        raise ValueError(
+            f"run-axis pad {b_pad} smaller than the probe corpus ({n_probe} runs); "
+            "raise --runs-per-family or lower --probe-runs"
+        )
     with tempfile.TemporaryDirectory(prefix="nemo_prewarm_") as tmp:
         d = write_case_study(name, n_runs=n_probe, seed=11, out_dir=tmp)
         if native_available():
@@ -50,10 +57,12 @@ def prewarm_family(name: str, n_probe: int, b_pad: int) -> float:
 
     # Stress-floor statics of the fused dispatch (backend/jax_backend.py
     # _fused, big-corpus branch): V/E floors 64/256, table bucket floor 32,
-    # labels pinned to 8 (no diff tail), run axis padded to b_pad, and the
-    # linearity flag the deployment's host check would set for this family.
-    from nemo_tpu.ops.simplify import pair_chains_linear
-
+    # labels pinned to 8 (no diff tail), depth bucket floor 32, run axis
+    # padded to b_pad, and the linearity flag the deployment's host check
+    # would set for this family.
+    # comp_linear arrives in `static` from the pack path itself
+    # (graphs_to_step / pack_molly_dir) — the same reduction the deployment
+    # dispatch uses.
     v = max(64, static["v"])
     e = max(256, int(pre.edge_src.shape[1]))
     static = dict(
@@ -62,7 +71,6 @@ def prewarm_family(name: str, n_probe: int, b_pad: int) -> float:
         num_tables=bucket_size(static["num_tables"], 32),
         num_labels=8,
         max_depth=bucket_size(static["max_depth"], 32),
-        comp_linear=pair_chains_linear(pre, post),
     )
     static["with_diff"] = 0
 
@@ -84,8 +92,17 @@ def prewarm_family(name: str, n_probe: int, b_pad: int) -> float:
             node_mask=grow(ba.node_mask, v, False),
         )
 
+    return pad_arrays(pre), pad_arrays(post), static
+
+
+def prewarm_family(name: str, n_probe: int, b_pad: int) -> float:
+    import jax
+
+    from nemo_tpu.models.pipeline_model import analysis_step
+
+    pre, post, static = stress_signature(name, n_probe, b_pad)
     t0 = time.perf_counter()
-    out = analysis_step(pad_arrays(pre), pad_arrays(post), **static)
+    out = analysis_step(pre, post, **static)
     jax.block_until_ready(out)
     return time.perf_counter() - t0
 

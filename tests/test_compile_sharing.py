@@ -66,3 +66,31 @@ def test_pre_post_table_ids_pinned():
     v = CorpusVocab()
     assert v.tables.lookup("pre") == 0
     assert v.tables.lookup("post") == 1
+
+
+def test_prewarm_matches_deployment(tmp_path):
+    """make prewarm must compile the EXACT signature the stress dispatch
+    uses — shapes and statics — or it warms a program nobody runs."""
+    from nemo_tpu.graphs.packed import bucket_size
+    from nemo_tpu.ingest.molly import load_molly_output
+    from nemo_tpu.models.case_studies import write_case_study
+    from nemo_tpu.utils.prewarm import stress_signature
+
+    fam = "CA-2083-hinted-handoff"
+    n_runs = 600  # >= the big-corpus threshold (512)
+    d = write_case_study(fam, n_runs=n_runs, seed=11, out_dir=str(tmp_path))
+    (verb, params, shapes) = _fused_sigs(load_molly_output(d))[0]
+    assert verb == "fused"
+    dispatch_params = dict(params)
+
+    pre, post, static = stress_signature(fam, n_probe=64, b_pad=bucket_size(n_runs, 8))
+    assert {k: int(v) for k, v in static.items()} == {
+        k: int(v) for k, v in dispatch_params.items()
+    }
+    shape_by_name = dict(shapes)
+    for prefix, ba in (("pre", pre), ("post", post)):
+        for field in ("edge_src", "edge_dst", "edge_mask", "is_goal",
+                      "table_id", "label_id", "type_id", "node_mask"):
+            assert shape_by_name[f"{prefix}_{field}"] == np.asarray(
+                getattr(ba, field)
+            ).shape, f"{prefix}_{field} shape drifted from the dispatch"
