@@ -26,6 +26,19 @@ def test_health(sidecar):
     assert h["version"] == "1"
 
 
+def test_static_codec_round_trips_every_field(packed):
+    """Explicit field-level round-trip: a silently dropped StaticParams
+    field (e.g. comp_linear) would NOT change analysis outputs — doubling
+    and closure labels agree wherever the flag is legal — so only this
+    check catches the fast path quietly dying on the wire."""
+    from nemo_tpu.service import codec
+
+    _, _, static = packed
+    assert static["comp_linear"] is True  # the case-study chains are linear
+    rt = codec.static_from_pb(codec.static_to_pb(static))
+    assert {k: int(v) for k, v in rt.items()} == {k: int(v) for k, v in static.items()}
+
+
 def test_unary_analyze_matches_local(sidecar, packed):
     pre, post, static = packed
     local = analysis_step(pre, post, **static)
