@@ -103,13 +103,15 @@ def _k_giant(*args):
     bucket threshold analyzes on a node-sharded mesh with closure-free
     kernels (parallel/giant.py) — the 'ring attention' analog of SURVEY.md
     §5 reaching production instead of living only in tests (VERDICT r2
-    missing #4)."""
+    missing #4).  The two label planes carry giant_plan's exact union-find
+    component labels, used when the chains are not verified-linear."""
     from nemo_tpu.models.pipeline_model import BatchArrays
     from nemo_tpu.parallel.giant import giant_analysis_step
 
     pre = BatchArrays(*args[:8])
     post = BatchArrays(*args[8:16])
-    v, pre_tid, post_tid, num_tables, max_depth, comp_linear, proto_depth = args[16:]
+    pre_labels, post_labels = args[16:18]
+    v, pre_tid, post_tid, num_tables, max_depth, comp_linear, proto_depth = args[18:]
     return giant_analysis_step(
         pre,
         post,
@@ -120,6 +122,8 @@ def _k_giant(*args):
         max_depth=max_depth,
         comp_linear=bool(comp_linear),
         proto_depth=proto_depth,
+        pre_labels=pre_labels,
+        post_labels=post_labels,
     )
 
 
@@ -201,7 +205,9 @@ class LocalExecutor:
         ),
         "giant": (
             _k_giant,
-            tuple(f"pre_{f}" for f in _BA_FIELDS) + tuple(f"post_{f}" for f in _BA_FIELDS),
+            tuple(f"pre_{f}" for f in _BA_FIELDS)
+            + tuple(f"post_{f}" for f in _BA_FIELDS)
+            + ("pre_comp_labels", "post_comp_labels"),
             ("v", "pre_tid", "post_tid", "num_tables", "max_depth", "comp_linear", "proto_depth"),
             None,  # dict-returning, fused-compatible keys (B=1)
         ),
@@ -225,6 +231,11 @@ class LocalExecutor:
     #: the generic (assumption-free) code path.
     OPTIONAL_PARAMS = frozenset({"comp_linear"})
 
+    #: Array inputs that may be absent likewise; None reaches the kernel,
+    #: which falls back to its assumption-free path (the giant verb without
+    #: host labels runs the exact — if expensive — closure labeling).
+    OPTIONAL_ARRAYS = frozenset({"pre_comp_labels", "post_comp_labels"})
+
     def run(self, verb: str, arrays: dict, params: dict) -> dict[str, np.ndarray]:
         """Returns a dict of array-likes: numpy for summary outputs, jax
         device arrays for the ON_DEVICE bulk outputs (consumers slice rows
@@ -232,7 +243,12 @@ class LocalExecutor:
         if verb not in self.VERBS:
             raise ValueError(f"unknown kernel verb {verb!r}")
         fn, array_names, param_names, out_names = self.VERBS[verb]
-        args = [jnp.asarray(arrays[n]) for n in array_names]
+        args = [
+            (jnp.asarray(arrays[n]) if arrays.get(n) is not None else None)
+            if n in self.OPTIONAL_ARRAYS
+            else jnp.asarray(arrays[n])
+            for n in array_names
+        ]
         # OPTIONAL statics default to their safe value (0 = generic path)
         # so a sidecar can serve one protocol version ahead of its clients.
         statics = [
@@ -660,11 +676,20 @@ class JaxBackend(GraphBackend):
                 for rid, (gpre, gpost) in zip(giant_ids, g_graphs):
                     pre_b = pack_batch([rid], [gpre], v_g, e_g)
                     post_b = pack_batch([rid], [gpost], v_g, e_g)
-                    lin_pre, depth_pre = giant_plan(gpre)
-                    lin_post, depth_post = giant_plan(gpost)
+                    lin_pre, depth_pre, lab_pre = giant_plan(gpre)
+                    lin_post, depth_post, lab_post = giant_plan(gpost)
+
+                    def pad_labels(lab, n):
+                        out = np.full((1, v_g), v_g, dtype=np.int32)
+                        out[0, :n] = lab
+                        return out
+
+                    arrays = _verb_arrays(pre_b, post_b)
+                    arrays["pre_comp_labels"] = pad_labels(lab_pre, gpre.n_nodes)
+                    arrays["post_comp_labels"] = pad_labels(lab_post, gpost.n_nodes)
                     res = self.executor.run(
                         "giant",
-                        _verb_arrays(pre_b, post_b),
+                        arrays,
                         dict(
                             v=v_g,
                             pre_tid=params_common["pre_tid"],

@@ -172,15 +172,25 @@ def collapse_chains(
     comp_iters: int | None = None,
     comp_doubling: bool = False,
     rewire: str = "matmul",
+    comp_labels: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Returns (adj_new, alive_new, type_new).
 
     Component labeling (any consistent member-index-valued label works):
-      default            all-pairs closure on the MXU — right for the
-                         small-V batched buckets;
-      comp_iters=<int>   bounded min-label propagation, O(iters * V^2);
+      default            all-pairs closure on the MXU — exact for ANY
+                         member structure; right for the small-V batched
+                         buckets;
+      comp_labels=<arr>  precomputed [B,V] labels (host union-find — the
+                         giant path's exact labels for arbitrary member
+                         structures; no bounded device iteration is sound
+                         there, see parallel/giant.py:giant_plan);
+      comp_iters=<int>   bounded min-label propagation, O(iters * V^2) —
+                         exact ONLY when iters >= the widest member
+                         component's undirected diameter, which the caller
+                         must guarantee;
       comp_doubling      pointer doubling, O(V log V) — linear chains only
-                         (the giant deep-@next path).
+                         (caller-verified, ops/simplify.py:
+                         chains_linear_host / parallel/giant.py:giant_plan).
 
     rewire: "matmul" moves pred/succ edges onto representatives with two
     boolean matmuls (MXU, O(V^3) — fine batched at small V); "scatter"
@@ -195,7 +205,9 @@ def collapse_chains(
     chain_goal = is_goal & alive & in_from_next & out_to_next
     member = next_rule | chain_goal
 
-    if comp_doubling:
+    if comp_labels is not None:
+        lab = jnp.where(member, comp_labels, v)
+    elif comp_doubling:
         lab = _labels_doubling(a, member, v, idx)
     else:
         und = (a | jnp.swapaxes(a, -1, -2)) & member[..., None] & member[..., None, :]

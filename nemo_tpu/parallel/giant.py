@@ -38,15 +38,23 @@ from .mesh import NODE_AXIS
 from .ring import make_node_mesh
 
 
-def giant_plan(graph) -> tuple[bool, int]:
+def giant_plan(graph) -> tuple[bool, int, "object"]:
     """Host-side O(E) planning for one giant run (graphs.packed.PackedGraph):
-    returns (chains_linear, collapsed_depth_bound).
+    returns (chains_linear, collapsed_depth_bound, comp_labels).
 
     chains_linear: every @next chain member has at most one member
     successor/predecessor in the CLEAN graph — true for the linear
     `t(C+1)@next :- t(C)` chains the domain generates, enabling the
-    O(V log V) pointer-doubling labels; otherwise the giant step falls back
-    to bounded min-label propagation.
+    O(V log V) pointer-doubling labels on device.
+
+    comp_labels [n_nodes] int32: EXACT union-find component labels of the
+    member subgraph (member-index-valued; v for non-members).  The giant
+    step uses these when the chains are NOT linear: no bounded device
+    iteration is sound there — an undirected member component's diameter is
+    not bounded by the directed longest path (alternating-orientation
+    "zigzag" structures grow the diameter with component size while the
+    directed depth stays constant), so only precomputed exact labels keep
+    the contraction equal to the oracle's component semantics.
 
     collapsed_depth_bound: longest path of the graph AFTER contracting each
     chain component to one node (+1 margin) — the tight trip count for the
@@ -109,10 +117,11 @@ def giant_plan(graph) -> tuple[bool, int]:
         if rs != rd:
             parent[rs] = rd
     rep = np.array([find(i) for i in range(n)])
+    comp_labels = np.where(member, rep, n).astype(np.int32)
     cedges = np.stack([rep[src], rep[dst]], axis=1) if len(src) else np.zeros((0, 2), int)
     cedges = cedges[cedges[:, 0] != cedges[:, 1]]
     depth = longest_path_len(n, cedges)
-    return linear, min(n, depth + 2)
+    return linear, min(n, depth + 2), comp_labels
 
 
 _MESH_CACHE: dict[int, Mesh] = {}
@@ -146,14 +155,23 @@ def giant_analysis_step(
     comp_linear: bool = True,
     proto_depth: int | None = None,
     mesh: Mesh | None = None,
+    pre_labels=None,
+    post_labels=None,
 ) -> dict[str, jnp.ndarray]:
     """Fused-step-compatible outputs for ONE giant run (B=1 batches).
 
     pre/post: models.pipeline_model.BatchArrays with leading dim 1.
-    comp_linear/proto_depth come from giant_plan (host-side O(E));
+    comp_linear/proto_depth/labels come from giant_plan (host-side O(E));
     max_depth is the RAW longest-path bound, proto_depth the collapsed
     one (the BFS kernels run post-simplification, so the collapsed bound
     keeps trip counts small even under thousand-step chains).
+
+    comp_linear=True uses O(V log V) pointer-doubling labels on device
+    (exact for the verified-linear chains).  comp_linear=False REQUIRES
+    pre_labels/post_labels [1,V] — giant_plan's exact union-find labels —
+    because no bounded device iteration is sound for arbitrary member
+    structures (an undirected component's diameter is not bounded by the
+    directed longest path).
     Returns the same keys as analysis_step(with_diff=False)."""
     mesh = mesh or default_node_mesh(v)
     n_dev = mesh.devices.size
@@ -173,13 +191,29 @@ def giant_analysis_step(
         comp_linear,
         proto_depth,
     )
+    # Label strategy, in order of preference:
+    #   doubling  verified-linear chains, O(V log V) on device
+    #   host      exact union-find labels shipped in (the only sound bounded
+    #             option for arbitrary member structures)
+    #   closure   no labels supplied (e.g. a one-version-behind client over
+    #             the Kernel RPC): the assumption-free all-pairs closure —
+    #             O(V^3 log V) at giant V is expensive but CORRECT, which
+    #             beats the pre-r4 bounded propagation that silently
+    #             under-labeled zigzag components.
+    label_mode = (
+        "doubling"
+        if comp_linear
+        else ("host" if pre_labels is not None and post_labels is not None else "closure")
+    )
+    key = key + (label_mode,)
     fn = _JIT_CACHE.get(key)
     if fn is None:
 
         @jax.jit
-        def fn(pre, post, pre_tid, post_tid):
+        def fn(pre, post, pre_tid, post_tid, pre_lab, post_lab):
             out = {}
             alive2 = {}
+            labs = {"pre": pre_lab, "post": post_lab}
             for name, b, tid in (("pre", pre, pre_tid), ("post", post, post_tid)):
                 adj = build_adjacency(b.edge_src, b.edge_dst, b.edge_mask, v)
                 adj = lax.with_sharding_constraint(adj, spec_adj)
@@ -187,17 +221,14 @@ def giant_analysis_step(
                     adj, b.is_goal, b.table_id, b.node_mask, tid, num_tables
                 )
                 adj_c, alive = clean_masks(adj, b.is_goal, b.node_mask)
-                # Linear chains: O(V log V) pointer doubling; otherwise
-                # bounded min-label propagation (und diameter <= 2 * raw
-                # longest path + 2, chains alternate rule/goal).  Edge
-                # rewiring always by O(V^2) scatters — no V^3 matmul.
+                # Edge rewiring always by O(V^2) scatters — no V^3 matmul.
                 adj2, alive2[name], type2 = collapse_chains(
                     adj_c,
                     b.is_goal,
                     b.type_id,
                     alive,
-                    comp_iters=None if comp_linear else 2 * max_depth + 2,
-                    comp_doubling=comp_linear,
+                    comp_doubling=label_mode == "doubling",
+                    comp_labels=labs[name] if label_mode == "host" else None,
                     rewire="scatter",
                 )
                 out[f"{name}_adj_clean"] = lax.with_sharding_constraint(adj2, spec_adj)
@@ -236,4 +267,19 @@ def giant_analysis_step(
             node_mask=jax.device_put(b.node_mask, spec_node),
         )
 
-    return fn(shard(pre), shard(post), pre_tid, post_tid)
+    import numpy as _np
+
+    if pre_labels is None:
+        # Unused by the comp_linear trace; a zero plane keeps the jit
+        # signature uniform across both variants.
+        pre_labels = _np.zeros(pre.is_goal.shape, dtype=_np.int32)
+    if post_labels is None:
+        post_labels = _np.zeros(post.is_goal.shape, dtype=_np.int32)
+    return fn(
+        shard(pre),
+        shard(post),
+        pre_tid,
+        post_tid,
+        jax.device_put(_np.asarray(pre_labels, dtype=_np.int32), spec_node),
+        jax.device_put(_np.asarray(post_labels, dtype=_np.int32), spec_node),
+    )

@@ -1,0 +1,157 @@
+"""Giant-path soundness for NON-linear @next member structures.
+
+A "zigzag" member subgraph (each @next rule feeding two member goals) has
+an undirected component diameter that grows with component size while the
+directed longest path stays constant — so bounded device iteration
+(propagation with a depth-derived trip count, the pre-r4 giant fallback)
+under-labels the component and diverges from the oracle's exact component
+contraction.  The giant path now ships giant_plan's exact host union-find
+labels instead; this test builds such a corpus on disk and requires the
+giant-routed report to equal the oracle's."""
+
+import json
+import os
+
+import pytest
+
+from nemo_tpu.analysis.pipeline import run_debug
+from nemo_tpu.backend.jax_backend import JaxBackend
+from nemo_tpu.backend.python_ref import PythonBackend
+
+K = 20  # zigzag sections: und diameter ~3K >> directed depth (~4)
+
+
+def _zigzag_prov(prefix: str) -> dict:
+    """One provenance graph whose member subgraph is a long zigzag:
+    u_i(@next) -> g_i and u_i -> g_{i-1}; every g_i also feeds w_i(@next)
+    so the goals qualify as members (in from @next AND out to @next)."""
+    goals, rules, edges = [], [], []
+
+    def goal(gid, table="t"):
+        goals.append({"id": gid, "label": f"{table}({gid})", "table": table, "time": "1"})
+
+    def rule(rid, type_="next"):
+        rules.append({"id": rid, "label": rid, "table": "t", "type": type_})
+
+    for i in range(K + 1):
+        goal(f"g{i}")
+    for i in range(1, K + 1):
+        goal(f"gin{i}")  # non-member in-goal of u_i
+        rule(f"u{i}")
+        edges.append({"from": f"gin{i}", "to": f"u{i}"})
+        edges.append({"from": f"u{i}", "to": f"g{i}"})
+        edges.append({"from": f"u{i}", "to": f"g{i - 1}"})
+    for i in range(K + 1):
+        goal(f"z{i}")  # out-goal of w_i keeps it alive
+        rule(f"w{i}")
+        edges.append({"from": f"g{i}", "to": f"w{i}"})
+        edges.append({"from": f"w{i}", "to": f"z{i}"})
+    # A '<prefix>' condition goal so condition marking/holds have a target.
+    goal("p0", table=prefix)
+    rule("rp", type_="")
+    edges.append({"from": "g0", "to": "rp"})
+    edges.append({"from": "rp", "to": "p0"})
+    return {"goals": goals, "rules": rules, "edges": edges}
+
+
+@pytest.fixture()
+def zigzag_corpus(tmp_path):
+    d = tmp_path / "zigzag"
+    d.mkdir()
+    runs = []
+    for i, status in enumerate(["success", "fail"]):
+        runs.append(
+            {
+                "iteration": i,
+                "status": status,
+                "failureSpec": {"eot": 4, "eff": 2, "maxCrashes": 0, "nodes": ["n1"]},
+                "model": {"tables": {"pre": [["n1", "1"]], "post": [["n1", "1"]]}},
+                "messages": [],
+            }
+        )
+        for cond in ("pre", "post"):
+            with open(d / f"run_{i}_{cond}_provenance.json", "w") as f:
+                json.dump(_zigzag_prov(cond), f)
+    with open(d / "runs.json", "w") as f:
+        json.dump(runs, f)
+    return str(d)
+
+
+def test_nonlinear_giant_matches_oracle(zigzag_corpus, tmp_path, monkeypatch):
+    monkeypatch.setenv("NEMO_GIANT_V", "16")  # force the giant path
+    jx = run_debug(zigzag_corpus, str(tmp_path / "jx"), JaxBackend(), figures="none")
+    py = run_debug(zigzag_corpus, str(tmp_path / "py"), PythonBackend(), figures="none")
+    with open(os.path.join(jx.report_dir, "debugging.json")) as f:
+        a = json.load(f)
+    with open(os.path.join(py.report_dir, "debugging.json")) as f:
+        b = json.load(f)
+    assert a == b
+
+
+def test_giant_verb_without_labels_falls_back_to_closure(zigzag_corpus):
+    """Protocol skew: an older client's giant Kernel RPC carries no label
+    planes and no comp_linear param — the executor must run the exact (if
+    expensive) closure labeling, matching the labeled dispatch bit-for-bit."""
+    import numpy as np
+
+    from nemo_tpu.backend.jax_backend import LocalExecutor, _verb_arrays
+    from nemo_tpu.graphs.packed import CorpusVocab, bucket_size, pack_batch, pack_graph
+    from nemo_tpu.ingest.molly import load_molly_output
+    from nemo_tpu.parallel.giant import giant_plan
+
+    molly = load_molly_output(zigzag_corpus)
+    vocab = CorpusVocab()
+    gpre = pack_graph(molly.runs[0].pre_prov, vocab)
+    gpost = pack_graph(molly.runs[0].post_prov, vocab)
+    v = bucket_size(max(gpre.n_nodes, gpost.n_nodes))
+    e = bucket_size(max(1, len(gpre.edges), len(gpost.edges)))
+    pre_b = pack_batch([0], [gpre], v, e)
+    post_b = pack_batch([0], [gpost], v, e)
+    _, _, lab_pre = giant_plan(gpre)
+    _, _, lab_post = giant_plan(gpost)
+
+    def pad(lab, n):
+        out = np.full((1, v), v, dtype=np.int32)
+        out[0, :n] = lab
+        return out
+
+    params = dict(
+        v=v,
+        pre_tid=vocab.tables.lookup("pre"),
+        post_tid=vocab.tables.lookup("post"),
+        num_tables=bucket_size(len(vocab.tables), 8),
+        max_depth=max(pre_b.max_depth, post_b.max_depth),
+        comp_linear=0,
+        proto_depth=max(pre_b.max_depth, post_b.max_depth),
+    )
+    ex = LocalExecutor()
+    labeled_arrays = _verb_arrays(pre_b, post_b)
+    labeled_arrays["pre_comp_labels"] = pad(lab_pre, gpre.n_nodes)
+    labeled_arrays["post_comp_labels"] = pad(lab_post, gpost.n_nodes)
+    labeled = ex.run("giant", labeled_arrays, params)
+
+    skewed_params = {k: v_ for k, v_ in params.items() if k != "comp_linear"}
+    skewed = ex.run("giant", _verb_arrays(pre_b, post_b), skewed_params)
+    assert set(labeled) == set(skewed)
+    for k in labeled:
+        np.testing.assert_array_equal(
+            np.asarray(labeled[k]), np.asarray(skewed[k]), err_msg=k
+        )
+
+
+def test_zigzag_plan_is_nonlinear_with_exact_labels(zigzag_corpus):
+    """giant_plan must flag the zigzag non-linear and return one label per
+    member component (the whole zigzag is ONE component)."""
+    import numpy as np
+
+    from nemo_tpu.graphs.packed import CorpusVocab, pack_graph
+    from nemo_tpu.ingest.molly import load_molly_output
+    from nemo_tpu.parallel.giant import giant_plan
+
+    molly = load_molly_output(zigzag_corpus)
+    g = pack_graph(molly.runs[0].post_prov, CorpusVocab())
+    linear, _depth, labels = giant_plan(g)
+    assert linear is False
+    member_labels = labels[labels < g.n_nodes]
+    assert len(member_labels) > 3 * K  # the zigzag + w-rules are members
+    assert len(np.unique(member_labels)) == 1, "zigzag must be ONE component"
