@@ -657,7 +657,7 @@ class JaxBackend(GraphBackend):
                 )
                 out.append((pre_b, post_b, res))
             if giant_ids:
-                from nemo_tpu.parallel.giant import giant_plan
+                from nemo_tpu.parallel.giant import giant_plan, pad_comp_labels
 
                 # Corpus-common giant buckets + power-of-two depth buckets:
                 # the giant program's jit key is (V, E, depths, ...), so
@@ -678,15 +678,9 @@ class JaxBackend(GraphBackend):
                     post_b = pack_batch([rid], [gpost], v_g, e_g)
                     lin_pre, depth_pre, lab_pre = giant_plan(gpre)
                     lin_post, depth_post, lab_post = giant_plan(gpost)
-
-                    def pad_labels(lab, n):
-                        out = np.full((1, v_g), v_g, dtype=np.int32)
-                        out[0, :n] = lab
-                        return out
-
                     arrays = _verb_arrays(pre_b, post_b)
-                    arrays["pre_comp_labels"] = pad_labels(lab_pre, gpre.n_nodes)
-                    arrays["post_comp_labels"] = pad_labels(lab_post, gpost.n_nodes)
+                    arrays["pre_comp_labels"] = pad_comp_labels(lab_pre, gpre.n_nodes, v_g)
+                    arrays["post_comp_labels"] = pad_comp_labels(lab_post, gpost.n_nodes, v_g)
                     res = self.executor.run(
                         "giant",
                         arrays,

@@ -10,11 +10,12 @@ on a shallow DAG.  This path analyzes ONE run with:
   * the node dimension sharded over a 1-D device mesh (column-sharded
     adjacency, XLA/GSPMD inserts the ICI collectives — same layout as
     parallel/ring.py's explicit ring schedule);
-  * closure-free kernels: component labeling by bounded min-label
-    propagation and prototype reachability by set-BFS, both
-    O(max_depth · V^2) (ops/simplify.py:collapse_chains comp_iters,
-    ops/proto.py:proto_rule_bits use_closure=False) — exact because
-    max_depth bounds the corpus's longest path.
+  * closure-free kernels: component labels by O(V log V) pointer doubling
+    (verified-linear chains) or exact host union-find labels shipped in
+    (any other member structure — no bounded DEVICE iteration is sound
+    there, see giant_plan), and prototype reachability by set-BFS,
+    O(proto_depth · V^2) (ops/proto.py:proto_rule_bits use_closure=False)
+    — exact because the DIRECTED depth bound holds for directed BFS.
 
 The JaxBackend auto-dispatches here when a run's node count exceeds
 NEMO_GIANT_V (backend/jax_backend.py), so one oversized run in an
@@ -48,7 +49,8 @@ def giant_plan(graph) -> tuple[bool, int, "object"]:
     O(V log V) pointer-doubling labels on device.
 
     comp_labels [n_nodes] int32: EXACT union-find component labels of the
-    member subgraph (member-index-valued; v for non-members).  The giant
+    member subgraph (member-index-valued; the sentinel for non-members is
+    n_nodes — pad_comp_labels re-sentinels to the bucket V when padding).  The giant
     step uses these when the chains are NOT linear: no bounded device
     iteration is sound there — an undirected member component's diameter is
     not bounded by the directed longest path (alternating-orientation
@@ -124,6 +126,17 @@ def giant_plan(graph) -> tuple[bool, int, "object"]:
     return linear, min(n, depth + 2), comp_labels
 
 
+def pad_comp_labels(labels, n_nodes: int, v: int):
+    """giant_plan's [n_nodes] labels -> the giant verb's [1, v] plane, with
+    the non-member sentinel re-pinned to the bucket V (collapse_chains masks
+    by member, so any >= n value works; V keeps it shape-consistent)."""
+    import numpy as np
+
+    out = np.full((1, v), v, dtype=np.int32)
+    out[0, :n_nodes] = labels
+    return out
+
+
 _MESH_CACHE: dict[int, Mesh] = {}
 
 
@@ -167,11 +180,12 @@ def giant_analysis_step(
     keeps trip counts small even under thousand-step chains).
 
     comp_linear=True uses O(V log V) pointer-doubling labels on device
-    (exact for the verified-linear chains).  comp_linear=False REQUIRES
+    (exact for the verified-linear chains).  comp_linear=False expects
     pre_labels/post_labels [1,V] — giant_plan's exact union-find labels —
     because no bounded device iteration is sound for arbitrary member
     structures (an undirected component's diameter is not bounded by the
-    directed longest path).
+    directed longest path); without them (a one-version-behind Kernel RPC
+    client) the step falls back to the exact all-pairs closure labeling.
     Returns the same keys as analysis_step(with_diff=False)."""
     mesh = mesh or default_node_mesh(v)
     n_dev = mesh.devices.size
@@ -187,7 +201,9 @@ def giant_analysis_step(
         int(pre.edge_src.shape[-1]),
         int(post.edge_src.shape[-1]),
         num_tables,
-        max_depth,
+        # max_depth deliberately NOT in the key: the trace no longer uses it
+        # (the bounded-propagation path is gone), and distinct depth buckets
+        # would recompile identical programs at tens of seconds each.
         comp_linear,
         proto_depth,
     )
@@ -267,19 +283,20 @@ def giant_analysis_step(
             node_mask=jax.device_put(b.node_mask, spec_node),
         )
 
-    import numpy as _np
-
     if pre_labels is None:
-        # Unused by the comp_linear trace; a zero plane keeps the jit
-        # signature uniform across both variants.
-        pre_labels = _np.zeros(pre.is_goal.shape, dtype=_np.int32)
+        # Unused by the non-"host" traces; a zero plane keeps the jit
+        # signature uniform across the variants.
+        pre_labels = jnp.zeros(pre.is_goal.shape, dtype=jnp.int32)
     if post_labels is None:
-        post_labels = _np.zeros(post.is_goal.shape, dtype=_np.int32)
+        post_labels = jnp.zeros(post.is_goal.shape, dtype=jnp.int32)
+    # jnp.asarray + device_put: no host round-trip when the planes already
+    # live on device (the executor converts kernel inputs eagerly; a numpy
+    # coercion here would cost two synchronous tunnel transfers per run).
     return fn(
         shard(pre),
         shard(post),
         pre_tid,
         post_tid,
-        jax.device_put(_np.asarray(pre_labels, dtype=_np.int32), spec_node),
-        jax.device_put(_np.asarray(post_labels, dtype=_np.int32), spec_node),
+        jax.device_put(jnp.asarray(pre_labels, dtype=jnp.int32), spec_node),
+        jax.device_put(jnp.asarray(post_labels, dtype=jnp.int32), spec_node),
     )

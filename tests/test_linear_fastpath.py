@@ -102,6 +102,48 @@ def test_linear_check_rejects_branching_members():
     assert _linear_of(prov) is False
 
 
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4, 5, 6, 7])
+def test_linear_check_matches_giant_plan_on_random_graphs(seed):
+    """Property: the batched host check must agree with giant_plan's
+    per-graph linearity verdict (the two dispatchers' gatekeepers for the
+    pointer-doubling labels) on arbitrary random bipartite graphs."""
+    import numpy as np
+
+    from nemo_tpu.ingest.datatypes import Edge, Goal, ProvData, Rule
+    from nemo_tpu.parallel.giant import giant_plan
+
+    rng = np.random.default_rng(seed)
+    graphs = []
+    for _ in range(6):
+        n_goals = int(rng.integers(2, 10))
+        n_rules = int(rng.integers(1, 8))
+        goals = [f"g{i}" for i in range(n_goals)]
+        rules = [(f"r{i}", rng.choice(["", "next", "async", "next"])) for i in range(n_rules)]
+        edges = []
+        for _ in range(int(rng.integers(2, 24))):
+            g = goals[int(rng.integers(n_goals))]
+            r = rules[int(rng.integers(n_rules))][0]
+            edges.append((g, r) if rng.random() < 0.5 else (r, g))
+        graphs.append(
+            ProvData(
+                goals=[Goal(id=g, label=g, table="t", time="1") for g in goals],
+                rules=[Rule(id=r, label=r, table="t", type=t) for r, t in rules],
+                edges=[Edge(src=s, dst=d) for s, d in edges],
+            )
+        )
+    vocab = CorpusVocab()
+    packed = [pack_graph(p, vocab) for p in graphs]
+    per_graph = all(giant_plan(g)[0] for g in packed)
+    b = pack_batch(list(range(len(packed))), packed)
+    batched = chains_linear_host(
+        b.is_goal, b.node_mask, b.type_id, b.edge_src, b.edge_dst, b.edge_mask
+    )
+    # Both implementations count raw edge-list entries (both conservative
+    # vs the deduped device adjacency in exactly the same way), so their
+    # verdicts must agree exactly.
+    assert batched == per_graph
+
+
 def test_linear_check_ignores_non_member_branching():
     # Branching among NON-member (deductive) rules must not block the fast
     # path: only the @next member subgraph's degrees matter.
