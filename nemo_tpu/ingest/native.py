@@ -321,19 +321,27 @@ def load_molly_output_packed(output_dir: str):
     return out
 
 
+def pack_molly_dir_host(output_dir: str):
+    """Directory -> (NativeCorpus, static kwargs): the native ETL's host-side
+    product — numpy batch arrays plus the analysis_step statics (including
+    the host-verified comp_linear flag) — with NO device transfer.  The
+    sidecar's chunk producers slice these rows straight into protobufs;
+    pack_molly_dir wraps them in device BatchArrays for in-process use."""
+    from nemo_tpu.ops.simplify import pair_chains_linear
+
+    c = ingest_native(output_dir, with_node_ids=False)
+    static = dict(c.static_kwargs, comp_linear=pair_chains_linear(c.pre, c.post))
+    return c, static
+
+
 def pack_molly_dir(output_dir: str):
     """Directory -> (pre BatchArrays, post BatchArrays, static kwargs) for
     models.pipeline_model.analysis_step, via the native engine when available
     and the Python path otherwise."""
     if native_available():
-        c = ingest_native(output_dir, with_node_ids=False)
         from nemo_tpu.models.pipeline_model import BatchArrays
-        from nemo_tpu.ops.simplify import pair_chains_linear
 
-        # NativeCondBatch exposes the same field names as PackedBatch, so the
-        # shared constructor applies; the linearity flag is computed on the
-        # packed arrays exactly like graphs_to_step does.
-        static = dict(c.static_kwargs, comp_linear=pair_chains_linear(c.pre, c.post))
+        c, static = pack_molly_dir_host(output_dir)
         return (
             BatchArrays.from_packed(c.pre),
             BatchArrays.from_packed(c.post),
