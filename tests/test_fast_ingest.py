@@ -49,6 +49,60 @@ def test_prov_json_byte_parity(tmp_path, family):
             )
 
 
+def test_prov_json_parity_exotic_content(tmp_path):
+    """Serializer edge cases the case studies never produce: unicode beyond
+    the BMP, every JSON escape class, sender/receiver passthrough, numeric
+    time, absent time — C++ bytes must equal json.dumps(to_json())."""
+    prov = {
+        "goals": [
+            {
+                "id": "g0",
+                "label": 'quote " backslash \\ slash / tab \t newline \n höhe é',
+                "table": "tü",
+                "time": 3,
+                "sender": "node☃",  # snowman (BMP)
+                "receiver": "astral \U0001f600",  # needs a surrogate pair
+            },
+            {"id": "g1", "label": "ctrl \b\f\r\x01 end", "table": "clock",
+             "time": "9", "sender": "", "receiver": "r"},
+            # clock-time regex: two-number form wins over the wildcard
+            {"id": "g2", "label": "c(n, 4, __WILDCARD__) c(n, 5, 6)",
+             "table": "clock", "time": "1"},
+            {"id": "g3", "label": "no_time_key", "table": "t"},
+        ],
+        "rules": [
+            {"id": "r0", "label": "label with \u00fcn\u00efcode", "table": "t", "type": "next"},
+            {"id": "r1", "label": "plain", "table": "t", "type": ""},
+        ],
+        "edges": [
+            {"from": "g0", "to": "r0"},
+            {"from": "r0", "to": "g1"},
+            {"from": "g1", "to": "r1"},
+            {"from": "r1", "to": "g2"},
+        ],
+    }
+    runs = [
+        {
+            "iteration": 0,
+            "status": "success",
+            "failureSpec": {"eot": 3, "eff": 2, "maxCrashes": 0, "nodes": ["n"]},
+            "model": {"tables": {"pre": [["n", "1"]], "post": [["n", "1"]]}},
+            "messages": [],
+        }
+    ]
+    d = tmp_path / "exotic"
+    d.mkdir()
+    (d / "runs.json").write_text(json.dumps(runs))
+    for cond in ("pre", "post"):
+        (d / f"run_0_{cond}_provenance.json").write_text(
+            json.dumps(prov, ensure_ascii=False), encoding="utf-8"
+        )
+    molly = load_molly_output(str(d))
+    nc = ingest_native(str(d), with_node_ids=False, keep_handle=True)
+    for cond, p in (("pre", molly.runs[0].pre_prov), ("post", molly.runs[0].post_prov)):
+        assert nc.prov_json(cond, 0).decode() == json.dumps(p.to_json()), cond
+
+
 def test_packed_loader_metadata_matches_python(tmp_path):
     from nemo_tpu.models.synth import SynthSpec, write_corpus
 
