@@ -569,11 +569,55 @@ def child_main() -> None:
     except Exception as ex:  # overlap stress must never sink the bench
         log(f"single-dir overlap skipped: {type(ex).__name__}: {ex}")
 
-    # Peak RSS of this measurement child (Linux ru_maxrss is KiB): the
-    # memory-footprint evidence for the scale stress (VERDICT r3 task 6).
+    # Peak RSS so far (Linux ru_maxrss is KiB): the memory-footprint
+    # evidence for the scale stress (VERDICT r3 task 6).  Snapshot BEFORE
+    # the giant section below — ru_maxrss is a process-lifetime max, and
+    # the 10k-node compile/oracle must not masquerade as the scale
+    # stress's footprint.
     import resource
 
     peak_rss_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+    # Giant-path single-run stress (VERDICT r3 task 7): the shared
+    # giant10k scenario (models/synth.py:giant10k_spec — a ~10k-node deep
+    # @next chain, the reference's collapseNextChains worst case at ~1000x
+    # its case-study depth) auto-dispatches to the node-sharded
+    # closure-free path; measured process-cold, warm, and against the
+    # sequential oracle.  "process_cold" loads whatever the persistent
+    # compilation cache holds (the e2e tiers above quantify fresh-compile
+    # cost; a truly fresh giant compile is one-time ~60s on the tunnel).
+    giant = None
+    try:
+        from nemo_tpu.models.synth import (
+            GIANT10K_THRESHOLD_V,
+            giant10k_spec,
+            write_corpus,
+        )
+
+        # Pin the dispatch threshold: with NEMO_GIANT_V raised above ~10k
+        # this scenario would take the dense [B,V,V] path (V^3 closure).
+        os.environ["NEMO_GIANT_V"] = str(GIANT10K_THRESHOLD_V)
+        gdir = write_corpus(giant10k_spec(), os.path.join(tmp, "giant"))
+        gwalls = {}
+        for glabel in ("process_cold", "warm"):
+            t0 = time.perf_counter()
+            run_debug(gdir, os.path.join(tmp, f"giant_{glabel}"), JaxBackend(),
+                      figures="none")
+            gwalls[glabel] = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        run_debug(gdir, os.path.join(tmp, "giant_py"), PythonBackend(),
+                  figures="none")
+        t_goracle = time.perf_counter() - t0
+        giant = {
+            "scenario": "giant10k eot=3000 (~10k-node @next chain), 2 runs",
+            "process_cold_s": round(gwalls["process_cold"], 1),
+            "warm_s": round(gwalls["warm"], 2),
+            "oracle_s": round(t_goracle, 1),
+            "vs_oracle_warm": round(t_goracle / gwalls["warm"], 1),
+        }
+        log(f"giant path: {json.dumps(giant)}")
+    except Exception as ex:  # giant stress must never sink the bench
+        log(f"giant path skipped: {type(ex).__name__}: {ex}")
 
     result = {
         "metric": METRIC
@@ -600,6 +644,7 @@ def child_main() -> None:
         if neo4j_graphs_per_sec is None
         else round(value / neo4j_graphs_per_sec, 1),
         "single_dir_overlap": overlap,
+        "giant": giant,
         "e2e": {
             "runs": total_runs,
             "figures": "sample:8",

@@ -15,15 +15,15 @@ enable_compilation_cache()
 
 import os
 
-os.environ["NEMO_GIANT_V"] = "4096"
-
 from nemo_tpu.analysis.pipeline import run_debug
 from nemo_tpu.backend.jax_backend import JaxBackend
 from nemo_tpu.backend.python_ref import PythonBackend
-from nemo_tpu.models.synth import SynthSpec, write_corpus
+from nemo_tpu.models.synth import GIANT10K_THRESHOLD_V, giant10k_spec, write_corpus
+
+os.environ["NEMO_GIANT_V"] = str(GIANT10K_THRESHOLD_V)
 
 tmp = tempfile.mkdtemp(prefix="nemo_giant_")
-corpus = write_corpus(SynthSpec(n_runs=2, seed=2, eot=3000, name="giant10k"), tmp)
+corpus = write_corpus(giant10k_spec(), tmp)
 
 for label in ("cold", "warm"):
     t0 = time.perf_counter()

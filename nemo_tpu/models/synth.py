@@ -365,3 +365,16 @@ def write_corpus(spec: SynthSpec, out_dir: str) -> str:
             else:
                 f.write(content)
     return corpus_dir
+
+
+# The shared 10k-node giant-path stress scenario (VERDICT r3 task 7): a
+# ~3000-step @next chain — the reference's collapseNextChains worst case
+# (preprocessing.go:253-353) at ~1000x its case-study depth.  One definition
+# so bench.py, giant_profile.py, and tests/test_giant.py measure the SAME
+# workload; NEMO_GIANT_V must stay at its 4096 default (below the ~10k node
+# count) for the run to take the giant path.
+GIANT10K_THRESHOLD_V = 4096
+
+
+def giant10k_spec() -> SynthSpec:
+    return SynthSpec(n_runs=2, seed=2, eot=3000, name="giant10k")

@@ -15,7 +15,12 @@ from nemo_tpu.analysis.pipeline import run_debug
 from nemo_tpu.backend.jax_backend import JaxBackend
 from nemo_tpu.backend.python_ref import PythonBackend
 from nemo_tpu.ingest.molly import load_molly_output
-from nemo_tpu.models.synth import SynthSpec, write_corpus
+from nemo_tpu.models.synth import (
+    GIANT10K_THRESHOLD_V,
+    SynthSpec,
+    giant10k_spec,
+    write_corpus,
+)
 
 
 def _report(d):
@@ -126,15 +131,13 @@ def test_10k_node_run_end_to_end(tmp_path, monkeypatch):
     """The VERDICT criterion: one >=10k-node provenance graph (a ~3000-step
     @next chain — the long-context analog) analyzed correctly end-to-end on
     the node-sharded path, against the oracle's debugging.json."""
-    corpus = write_corpus(
-        SynthSpec(n_runs=2, seed=2, eot=3000, name="giant10k"), str(tmp_path)
-    )
+    corpus = write_corpus(giant10k_spec(), str(tmp_path))
     molly = load_molly_output(corpus)
     n_max = max(
         len(r.post_prov.goals) + len(r.post_prov.rules) for r in molly.runs
     )
     assert n_max >= 10_000, f"corpus too small for the 10k criterion: {n_max}"
-    monkeypatch.setenv("NEMO_GIANT_V", "4096")
+    monkeypatch.setenv("NEMO_GIANT_V", str(GIANT10K_THRESHOLD_V))
     jx = run_debug(corpus, str(tmp_path / "jx"), JaxBackend(), figures="none")
     py = run_debug(corpus, str(tmp_path / "py"), PythonBackend(), figures="none")
     assert _report(jx.report_dir) == _report(py.report_dir)
