@@ -43,6 +43,19 @@ class BatchArrays:
     type_id: jnp.ndarray
     node_mask: jnp.ndarray
 
+    # Field-name tuple for generic row slicing/padding (not a dataclass
+    # field: no annotation).
+    FIELDS = (
+        "edge_src",
+        "edge_dst",
+        "edge_mask",
+        "is_goal",
+        "table_id",
+        "label_id",
+        "type_id",
+        "node_mask",
+    )
+
     @classmethod
     def from_packed(cls, batch) -> "BatchArrays":
         return cls(

@@ -367,11 +367,39 @@ def analyze_dirs(
     return results, timings
 
 
-def _chunk_rows(batch_like, s: int, e: int, with_baseline: bool):
+def _uniform_spans(n: int, chunk_runs: int) -> tuple[list[tuple[int, int]], int]:
+    """(spans, pad_to): corpus row spans sized so every chunk DISPATCH has
+    exactly pad_to rows — chunk 0 is rows [0, chunk_runs) (row 0 is the
+    corpus baseline), later chunks carry the prepended baseline plus
+    chunk_runs-1 fresh rows, and short tails pad with baseline copies
+    (_chunk_rows pad_to).  Uniform shapes mean the sidecar compiles ONE
+    program per corpus bucket signature for the whole stream — per-chunk
+    shapes were costing a fresh jit compile (~10s on the TPU tunnel) per
+    distinct batch size, which dwarfed the overlap win chunking exists for.
+
+    pad_to is 0 (no padding) when nothing is gained by it: a single-span
+    corpus keeps its natural b (the same shape the unchunked deployment
+    dispatch compiles), and chunk_runs==1 has no room for the baseline
+    prepend (size-1 spans dispatch at b=1 then b=2, as before)."""
+    if n <= chunk_runs:
+        return [(0, n)], 0
+    if chunk_runs <= 1:
+        return [(s, s + 1) for s in range(n)], 0
+    spans = [(0, chunk_runs)]
+    s = chunk_runs
+    while s < n:
+        spans.append((s, min(s + chunk_runs - 1, n)))
+        s = spans[-1][1]
+    return spans, chunk_runs
+
+
+def _chunk_rows(batch_like, s: int, e: int, with_baseline: bool, pad_to: int = 0):
     """Rows [s:e) of a batch (BatchArrays OR the native corpus's host-side
     cond batch — anything exposing the 8 packed fields) as host-numpy
     BatchArrays, optionally with the corpus baseline run (row 0 — the row
-    the fused step diffs against) prepended.  The SINGLE chunk-slicing
+    the fused step diffs against) prepended, then padded to pad_to rows
+    with baseline copies (pad rows are the good run diffed against itself;
+    _merge_chunk_outputs drops them).  The SINGLE chunk-slicing
     implementation for analyze_dir's chunked path and the pipelined
     producer, so the baseline-prepend semantics can never diverge; always
     numpy so chunk payloads never bounce through the device before protobuf
@@ -380,29 +408,27 @@ def _chunk_rows(batch_like, s: int, e: int, with_baseline: bool):
 
     def cut(x):
         x = np.asarray(x)
-        return np.concatenate([x[:1], x[s:e]]) if with_baseline else x[s:e]
+        out = np.concatenate([x[:1], x[s:e]]) if with_baseline else x[s:e]
+        if pad_to and out.shape[0] < pad_to:
+            pad = np.repeat(x[:1], pad_to - out.shape[0], axis=0)
+            out = np.concatenate([out, pad])
+        return out
 
     return BatchArrays(
-        **{
-            f: cut(getattr(batch_like, f))
-            for f in (
-                "edge_src",
-                "edge_dst",
-                "edge_mask",
-                "is_goal",
-                "table_id",
-                "label_id",
-                "type_id",
-                "node_mask",
-            )
-        }
+        **{f: cut(getattr(batch_like, f)) for f in BatchArrays.FIELDS}
     )
 
 
 def _merge_chunk_outputs(
-    spans: list[tuple[int, int]], results: list[dict[str, np.ndarray]]
+    spans: list[tuple[int, int]],
+    results: list[dict[str, np.ndarray]],
+    pad_to: int = 0,
 ) -> dict[str, np.ndarray]:
     """Merge per-chunk fused-step outputs into the unchunked equivalent.
+
+    pad_to nonzero means every chunk was dispatched at exactly pad_to rows
+    (_uniform_spans/_chunk_rows) — tail baseline-copy pad rows are dropped
+    before concatenation.
 
     Per-run rows: pad trailing dims up to the widest chunk's (the corpus
     vocab is append-only, so an earlier chunk's table/label columns are a
@@ -446,15 +472,20 @@ def _merge_chunk_outputs(
                 wide[tuple(slice(0, s) for s in a.shape)] = a
                 a = wide
             padded.append(a)
+        rows = []
         for (s, e), r in zip(spans, padded):
-            expected = (e - s) + (1 if s > 0 else 0)
+            real = (e - s) + (1 if s > 0 else 0)
+            expected = pad_to if pad_to else real
             if r.shape[0] != expected:
                 raise SidecarError(
                     f"output {key!r} is not per-run shaped "
                     f"(got leading dim {r.shape[0]}, batch {expected}); "
                     "register it in models.pipeline_model.CORPUS_REDUCTIONS"
                 )
-        merged[key] = np.concatenate([padded[0]] + [r[1:] for r in padded[1:]], axis=0)
+            # Drop tail pad rows (baseline copies), then the prepended
+            # baseline of chunks > 0.
+            rows.append(r[1:real] if s > 0 else r[:real])
+        merged[key] = np.concatenate(rows, axis=0)
 
     bits = merged["proto_bits"].astype(bool)
     ach = merged["achieved_pre"].astype(bool)
@@ -483,18 +514,18 @@ def analyze_dir(target: str, molly_dir: str, chunk_runs: int = 0) -> dict[str, n
         if not chunk_runs or chunk_runs >= b:
             return client.analyze(pre, post, static)
 
-        spans = [(s, min(s + chunk_runs, b)) for s in range(0, b, chunk_runs)]
+        spans, pad_to = _uniform_spans(b, chunk_runs)
         chunks = [
             (
-                _chunk_rows(pre, s, e, with_baseline=s > 0),
-                _chunk_rows(post, s, e, with_baseline=s > 0),
+                _chunk_rows(pre, s, e, with_baseline=s > 0, pad_to=pad_to),
+                _chunk_rows(post, s, e, with_baseline=s > 0, pad_to=pad_to),
                 static,
             )
             for s, e in spans
         ]
         results = client.analyze_chunks(chunks)
 
-    return _merge_chunk_outputs(spans, results)
+    return _merge_chunk_outputs(spans, results, pad_to=pad_to)
 
 
 def analyze_dir_pipelined(
@@ -534,15 +565,16 @@ def analyze_dir_pipelined(
     if n == 0:
         raise SidecarError(f"no runs in {molly_dir} (empty runs.json)")
     chunk_runs = max(1, chunk_runs)
-    spans = [(s, min(s + chunk_runs, n)) for s in range(0, n, chunk_runs)]
+    spans, pad_to = _uniform_spans(n, chunk_runs)
 
     if native_available():
         # Packed-first producer: ONE C++ parse of the whole directory (~6x
         # the Python per-chunk parser's throughput), then chunks are plain
         # HOST row slices of the corpus arrays (_chunk_rows — never through
         # the device; the wire wants host bytes anyway).  All chunks share
-        # the corpus-wide vocab and bucket, so the sidecar compiles at most
-        # two programs (chunk 0's B and the +1-baseline-row B of the rest).
+        # the corpus-wide vocab and bucket AND a uniform batch size
+        # (_uniform_spans), so the sidecar compiles exactly one program
+        # for the whole stream.
         from nemo_tpu.ingest.native import pack_molly_dir_host
 
         t0 = time.perf_counter()
@@ -558,8 +590,8 @@ def analyze_dir_pipelined(
                 t0 = time.perf_counter()
                 chunk = (
                     ci,
-                    _chunk_rows(corpus.pre, s, e, with_baseline=ci > 0),
-                    _chunk_rows(corpus.post, s, e, with_baseline=ci > 0),
+                    _chunk_rows(corpus.pre, s, e, with_baseline=ci > 0, pad_to=pad_to),
+                    _chunk_rows(corpus.post, s, e, with_baseline=ci > 0, pad_to=pad_to),
                     static,
                 )
                 timings["pack_s"] += time.perf_counter() - t0
@@ -586,12 +618,18 @@ def analyze_dir_pipelined(
                     posts.append(pack_graph(run.post_prov, vocab))
                 if ci == 0:
                     good.update(rid=rids[0], pre=pres[0], post=posts[0])
+                while pad_to and len(rids) < pad_to:
+                    # Tail pad with baseline copies so the dispatch batch
+                    # size stays uniform (dropped by _merge_chunk_outputs).
+                    rids.append(good["rid"])
+                    pres.append(good["pre"])
+                    posts.append(good["post"])
                 pre_b, post_b, static = graphs_to_step(rids, pres, posts, vocab)
                 timings["pack_s"] += time.perf_counter() - t0
                 if not emit((ci, pre_b, post_b, static)):
                     return
 
     results = _stream_pipelined(target, len(spans), body, timings, queue_depth)
-    merged = _merge_chunk_outputs(spans, results)
+    merged = _merge_chunk_outputs(spans, results, pad_to=pad_to)
     timings["wall_s"] = time.perf_counter() - t_wall0
     return merged, timings

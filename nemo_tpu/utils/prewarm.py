@@ -95,15 +95,58 @@ def stress_signature(name: str, n_probe: int, b_pad: int):
     return pad_arrays(pre), pad_arrays(post), static
 
 
-def prewarm_family(name: str, n_probe: int, b_pad: int) -> float:
+def chunk_signature(name: str, n_probe: int, chunk_runs: int):
+    """The sidecar's streamed-chunk dispatch signature for this family:
+    every pipelined chunk (service/client.py:_uniform_spans) is exactly
+    chunk_runs rows with the corpus statics passed VERBATIM (the server
+    applies no floors — server.py:_analyze_one), and the family
+    generators' statics are corpus-size-independent (same template per
+    run), so a probe corpus padded on the run axis reproduces the exact
+    jit cache key the first streamed chunk would compile."""
+    import numpy as np
+
+    from nemo_tpu.ingest.native import native_available, pack_molly_dir
+    from nemo_tpu.models.case_studies import write_case_study
+    from nemo_tpu.models.pipeline_model import BatchArrays, pack_molly_for_step
+
+    with tempfile.TemporaryDirectory(prefix="nemo_prewarm_") as tmp:
+        d = write_case_study(name, n_runs=n_probe, seed=11, out_dir=tmp)
+        if native_available():
+            pre, post, static = pack_molly_dir(d)
+        else:
+            from nemo_tpu.ingest.molly import load_molly_output
+
+            pre, post, static = pack_molly_for_step(load_molly_output(d))
+
+    def pad_rows(ba: BatchArrays) -> BatchArrays:
+        def grow(a):
+            a = np.asarray(a)[:chunk_runs]
+            if a.shape[0] < chunk_runs:
+                a = np.concatenate(
+                    [a, np.repeat(a[:1], chunk_runs - a.shape[0], axis=0)]
+                )
+            return a
+
+        return BatchArrays(**{f: grow(getattr(ba, f)) for f in BatchArrays.FIELDS})
+
+    return pad_rows(pre), pad_rows(post), static
+
+
+def prewarm_family(name: str, n_probe: int, b_pad: int, chunk_runs: int = 0) -> float:
     import jax
 
     from nemo_tpu.models.pipeline_model import analysis_step
 
-    pre, post, static = stress_signature(name, n_probe, b_pad)
+    signatures = [stress_signature(name, n_probe, b_pad)]
+    if chunk_runs:
+        signatures.append(chunk_signature(name, n_probe, chunk_runs))
+    # Time ONLY compile+execute: operators read a near-zero per-family
+    # number as "cache already hot", so corpus generation/packing I/O
+    # must stay outside the window.
     t0 = time.perf_counter()
-    out = analysis_step(pre, post, **static)
-    jax.block_until_ready(out)
+    for pre, post, static in signatures:
+        out = analysis_step(pre, post, **static)
+        jax.block_until_ready(out)
     return time.perf_counter() - t0
 
 
@@ -125,6 +168,13 @@ def main(argv: list[str] | None = None) -> int:
         default=64,
         help="small corpus size used to derive each family's statics",
     )
+    p.add_argument(
+        "--chunk-runs",
+        type=int,
+        default=512,
+        help="also compile the sidecar's uniform streamed-chunk signature "
+        "at this batch size (the analyze_dir_pipelined default); 0 disables",
+    )
     p.add_argument("--platform", default=None)
     args = p.parse_args(argv)
 
@@ -137,9 +187,13 @@ def main(argv: list[str] | None = None) -> int:
     b_pad = bucket_size(args.runs_per_family, 8)
     total = 0.0
     for name in sorted(CASE_STUDIES):
-        dt = prewarm_family(name, args.probe_runs, b_pad)
+        dt = prewarm_family(name, args.probe_runs, b_pad, args.chunk_runs)
         total += dt
-        print(f"  {name}: compiled+ran in {dt:.1f}s (B={b_pad})", file=sys.stderr)
+        print(
+            f"  {name}: compiled+ran in {dt:.1f}s "
+            f"(B={b_pad}, chunk B={args.chunk_runs or 'off'})",
+            file=sys.stderr,
+        )
     print(f"prewarm done in {total:.1f}s; persistent cache is hot", file=sys.stderr)
     return 0
 

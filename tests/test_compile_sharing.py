@@ -94,3 +94,43 @@ def test_prewarm_matches_deployment(tmp_path):
             assert shape_by_name[f"{prefix}_{field}"] == np.asarray(
                 getattr(ba, field)
             ).shape, f"{prefix}_{field} shape drifted from the dispatch"
+
+
+def test_prewarm_chunk_matches_stream(tmp_path):
+    """prewarm --chunk-runs must compile the EXACT signature the sidecar's
+    uniform streamed chunks dispatch (service/client.py:_uniform_spans +
+    _chunk_rows, statics passed verbatim to the server) — shapes, dtypes,
+    and statics."""
+    from nemo_tpu.ingest.native import native_available, pack_molly_dir
+    from nemo_tpu.models.case_studies import write_case_study
+    from nemo_tpu.models.pipeline_model import BatchArrays
+    from nemo_tpu.service.client import _chunk_rows, _uniform_spans
+    from nemo_tpu.utils.prewarm import chunk_signature
+
+    if not native_available():
+        pytest.skip("native ETL engine not built")
+
+    fam = "CA-2083-hinted-handoff"
+    chunk_runs = 256
+    d = write_case_study(fam, n_runs=600, seed=11, out_dir=str(tmp_path))
+    pre, post, static = pack_molly_dir(d)
+    spans, pad_to = _uniform_spans(600, chunk_runs)
+    assert pad_to == chunk_runs
+    assert len(spans) > 1 and all(
+        (e - s) + (1 if s > 0 else 0) <= chunk_runs for s, e in spans
+    )
+    # The tail chunk exercises baseline-prepend AND pad-to-uniform.
+    s, e = spans[-1]
+    stream_pre = _chunk_rows(pre, s, e, with_baseline=True, pad_to=chunk_runs)
+
+    warm_pre, warm_post, warm_static = chunk_signature(
+        fam, n_probe=64, chunk_runs=chunk_runs
+    )
+    assert {k: int(v) for k, v in warm_static.items()} == {
+        k: int(v) for k, v in static.items()
+    }
+    for field in BatchArrays.FIELDS:
+        got = np.asarray(getattr(stream_pre, field))
+        want = np.asarray(getattr(warm_pre, field))
+        assert got.shape == want.shape, f"{field} shape drifted from the stream"
+        assert got.dtype == want.dtype, f"{field} dtype drifted from the stream"

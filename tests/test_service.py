@@ -261,3 +261,19 @@ def test_stream_abort_unblocks_producer():
         _stream_pipelined("127.0.0.1:1", 4, body, timings, queue_depth=1, ready_deadline=1.0)
     assert started.wait(1.0)
     assert stopped.wait(5.0), "producer still blocked after stream failure"
+
+
+def test_uniform_spans_degenerate_sizes():
+    """chunk_runs=1 must terminate (size-1 spans, no padding) and every
+    span set must cover the corpus exactly once, in order."""
+    from nemo_tpu.service.client import _uniform_spans
+
+    for n, chunk_runs in [(1, 1), (2, 1), (5, 1), (5, 2), (600, 256), (600, 600), (3, 7)]:
+        spans, pad_to = _uniform_spans(n, chunk_runs)
+        assert spans[0][0] == 0 and spans[-1][1] == n
+        for (s0, e0), (s1, e1) in zip(spans, spans[1:]):
+            assert e0 == s1 and s0 < e0
+        if pad_to:
+            assert all((e - s) + (1 if s > 0 else 0) <= pad_to for s, e in spans)
+        if chunk_runs <= 1 or n <= chunk_runs:
+            assert pad_to == 0
