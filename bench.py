@@ -513,7 +513,12 @@ def child_main() -> None:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     _reset_compilation_cache()
     try:
-        for label in ("fresh_cold", "cached_cold", "warm"):
+        # The warm tier runs TWICE and keeps the better pass (both walls
+        # recorded): the tunnel's host-side service shares this machine's
+        # single core, and an unlucky contention window was observed to
+        # inflate one warm pass ~2.5x (20.5s vs 7.7s on identical code) —
+        # a single sample would report the weather, not the pipeline.
+        for label in ("fresh_cold", "cached_cold", "warm", "warm2"):
             if label in ("fresh_cold", "cached_cold"):
                 jax.clear_caches()
             phases: dict[str, float] = {}
@@ -534,6 +539,11 @@ def child_main() -> None:
                 f"end-to-end pipeline [{label}] ({total_runs} runs, figures=sample:8): "
                 f"{wall:.1f}s wall"
             )
+        walls = [e2e["warm"]["wall_s"], e2e["warm2"]["wall_s"]]
+        e2e["warm_passes_s"] = walls
+        if walls[1] < walls[0]:
+            e2e["warm"], e2e["warm2"] = e2e["warm2"], e2e["warm"]
+        del e2e["warm2"]
     finally:
         jax.config.update("jax_compilation_cache_dir", orig_cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", orig_min_compile)
@@ -553,7 +563,9 @@ def child_main() -> None:
         server.start()
         try:
             _, ov = analyze_dir_pipelined(
-                f"127.0.0.1:{port}", big_dirs[0][1], chunk_runs=256
+                # The API/prewarm default chunk size, so `make prewarm`
+                # covers this exact program (prewarm.py --chunk-runs).
+                f"127.0.0.1:{port}", big_dirs[0][1], chunk_runs=512
             )
             overlap = {
                 "family": big_dirs[0][0],
@@ -653,6 +665,7 @@ def child_main() -> None:
             "fresh_cold": e2e["fresh_cold"],
             "cached_cold": e2e["cached_cold"],
             "warm": e2e["warm"],
+            "warm_passes_s": e2e["warm_passes_s"],
         },
     }
     if jax.default_backend() == "tpu":
