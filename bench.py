@@ -165,7 +165,7 @@ def child_main() -> None:
 
     from nemo_tpu.backend.python_ref import PythonBackend
     from nemo_tpu.ingest.molly import load_molly_output
-    from nemo_tpu.ingest.native import native_available, pack_molly_dir
+    from nemo_tpu.ingest.native import pack_molly_dir
     from nemo_tpu.models.case_studies import CASE_STUDIES, write_case_study
     from nemo_tpu.models.pipeline_model import BatchArrays, analysis_step, pack_molly_for_step
     from nemo_tpu.utils.jax_config import enable_compilation_cache
@@ -220,20 +220,18 @@ def child_main() -> None:
         t1 = time.perf_counter()
         base_dirs.append(base_dir)
         base_mollys.append(load_molly_output(base_dir))
-        if native_available():
-            pre, post, static = pack_molly_dir(big_dir)
-        else:
-            pre, post, static = pack_molly_for_step(load_molly_output(big_dir))
-        # The deployment path verifies chain linearity host-side and takes
-        # the O(V log V) component-label fast path when it holds
-        # (backend/jax_backend.py _fused); the sweep measures the same step,
-        # and the check's own host cost is reported (linear_check_ms) —
-        # deployment pays it once per bucket per corpus, not per dispatch.
-        from nemo_tpu.ops.simplify import pair_chains_linear
-
-        t_lc = time.perf_counter()
-        static = dict(static, comp_linear=pair_chains_linear(pre, post))
-        t_linear_check += time.perf_counter() - t_lc
+        # Both pack paths verify chain linearity host-side (numpy, BEFORE
+        # any device transfer) and carry the flag in static, enabling the
+        # O(V log V) component-label fast path (backend/jax_backend.py
+        # _fused).  The check's cost comes from the canonical pack path's
+        # timing hook (linear_check_ms); recomputing it on the device
+        # BatchArrays here instead would round-trip every array back through
+        # the TPU tunnel (~1 s/family of pure transfer, measured r4).  On
+        # the non-native fallback the check runs inside pack_molly_for_step
+        # and its cost folds into pack_s.
+        lc_t: dict = {}
+        pre, post, static = pack_molly_dir(big_dir, timings=lc_t)
+        t_linear_check += lc_t.get("linear_check_s", 0.0)
         t2 = time.perf_counter()
         t_gen += t1 - t0
         t_pack += t2 - t1

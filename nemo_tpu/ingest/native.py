@@ -321,27 +321,38 @@ def load_molly_output_packed(output_dir: str):
     return out
 
 
-def pack_molly_dir_host(output_dir: str):
+def pack_molly_dir_host(output_dir: str, timings: dict | None = None):
     """Directory -> (NativeCorpus, static kwargs): the native ETL's host-side
     product — numpy batch arrays plus the analysis_step statics (including
     the host-verified comp_linear flag) — with NO device transfer.  The
     sidecar's chunk producers slice these rows straight into protobufs;
-    pack_molly_dir wraps them in device BatchArrays for in-process use."""
+    pack_molly_dir wraps them in device BatchArrays for in-process use.
+    When `timings` is given, the linearity check's wall time is recorded
+    under "linear_check_s" (bench evidence that the fast-path gate is host
+    bincounts, not device transfers)."""
+    import time
+
     from nemo_tpu.ops.simplify import pair_chains_linear
 
     c = ingest_native(output_dir, with_node_ids=False)
-    static = dict(c.static_kwargs, comp_linear=pair_chains_linear(c.pre, c.post))
+    t0 = time.perf_counter()
+    lin = pair_chains_linear(c.pre, c.post)
+    if timings is not None:
+        timings["linear_check_s"] = time.perf_counter() - t0
+    static = dict(c.static_kwargs, comp_linear=lin)
     return c, static
 
 
-def pack_molly_dir(output_dir: str):
+def pack_molly_dir(output_dir: str, timings: dict | None = None):
     """Directory -> (pre BatchArrays, post BatchArrays, static kwargs) for
     models.pipeline_model.analysis_step, via the native engine when available
-    and the Python path otherwise."""
+    and the Python path otherwise.  `timings` passes through to
+    pack_molly_dir_host (no-op on the Python fallback, where the linearity
+    check runs inside pack_molly_for_step)."""
     if native_available():
         from nemo_tpu.models.pipeline_model import BatchArrays
 
-        c, static = pack_molly_dir_host(output_dir)
+        c, static = pack_molly_dir_host(output_dir, timings=timings)
         return (
             BatchArrays.from_packed(c.pre),
             BatchArrays.from_packed(c.post),
