@@ -161,3 +161,20 @@ def test_auto_policy_selection(tmp_path):
     assert _choose_packed_ingest(JaxBackend(), None) is True
     assert _choose_packed_ingest(JaxBackend(), "x.npz") is False
     assert _choose_packed_ingest(PythonBackend(), None) is False
+
+
+def test_pack_molly_dir_timings_hook(tmp_path):
+    """The optional timings dict records the linearity check's wall time and
+    the returned static carries the same comp_linear flag either way — the
+    contract bench.py's linear_check_ms reporting relies on."""
+    from nemo_tpu.ingest.native import pack_molly_dir
+    from nemo_tpu.models.synth import SynthSpec, write_corpus
+
+    d = write_corpus(SynthSpec(n_runs=4, seed=5), str(tmp_path))
+    timings: dict = {}
+    pre_t, post_t, static_t = pack_molly_dir(d, timings=timings)
+    pre, post, static = pack_molly_dir(d)
+    assert timings["linear_check_s"] >= 0.0
+    assert static_t == static
+    assert pre_t.is_goal.shape == pre.is_goal.shape
+    assert post_t.edge_src.shape == post.edge_src.shape
