@@ -220,15 +220,16 @@ def child_main() -> None:
         t1 = time.perf_counter()
         base_dirs.append(base_dir)
         base_mollys.append(load_molly_output(base_dir))
-        # Both pack paths verify chain linearity host-side (numpy, BEFORE
-        # any device transfer) and carry the flag in static, enabling the
+        # Both pack paths verify chain linearity host-side (BEFORE any
+        # device transfer) and carry the flag in static, enabling the
         # O(V log V) component-label fast path (backend/jax_backend.py
-        # _fused).  The check's cost comes from the canonical pack path's
-        # timing hook (linear_check_ms); recomputing it on the device
-        # BatchArrays here instead would round-trip every array back through
-        # the TPU tunnel (~1 s/family of pure transfer, measured r4).  On
-        # the non-native fallback the check runs inside pack_molly_for_step
-        # and its cost folds into pack_s.
+        # _fused).  On the native path the per-graph verification rides the
+        # C++ parse (graph_chain_linear) and linear_check_ms records only
+        # the residual flag-AND (near zero BY DESIGN — the work moved into
+        # pack, it didn't disappear; r3 timed ~6.4 s here because the check
+        # recomputed on device BatchArrays, round-tripping every array
+        # through the TPU tunnel).  On the non-native fallback the numpy
+        # check runs inside pack_molly_for_step and folds into pack_s.
         lc_t: dict = {}
         pre, post, static = pack_molly_dir(big_dir, timings=lc_t)
         t_linear_check += lc_t.get("linear_check_s", 0.0)

@@ -17,6 +17,9 @@
 // Exposed as a C ABI consumed via ctypes (nemo_tpu/ingest/native.py); no
 // external dependencies (self-contained minimal JSON parser below).
 
+#include <charconv>
+#include <clocale>
+#include <cmath>
 #include <cstdint>
 #include <cstring>
 #include <fstream>
@@ -200,7 +203,14 @@ class JsonParser {
       std::string key = string();
       ws();
       expect(':');
-      v.obj.emplace_back(std::move(key), value());
+      JVal val = value();
+      // Duplicate keys are last-wins, matching Python json.loads (the
+      // parity reference for both the prov and head serializers); the
+      // key keeps its first position like dict insertion order does.
+      bool replaced = false;
+      for (auto& kv : v.obj)
+        if (kv.first == key) { kv.second = std::move(val); replaced = true; break; }
+      if (!replaced) v.obj.emplace_back(std::move(key), std::move(val));
       ws();
       if (peek() == ',') { ++p_; continue; }
       expect('}');
@@ -384,6 +394,350 @@ void append_field(std::string& out, const JVal& obj, const char* key) {
 // Append the always-a-string field value (Python str() coercion).
 void append_str_value(std::string& out, const std::string& s) {
   append_escaped(out, s);
+}
+
+// Generic canonical serialization of a parsed JSON value, matching Python
+// json.load -> json.dumps (default separators, ensure_ascii=True, dict
+// insertion order preserved).  Same numeric caveat as append_field: NUM
+// raw tokens are spliced verbatim, so exotic float spellings ("1e2",
+// "1.50") diverge from Python's float canonicalization — caught by the
+// byte-parity tests, never silently mangled.
+void append_jval(std::string& out, const JVal& v) {
+  switch (v.type) {
+    case JVal::NUL: out += "null"; break;
+    case JVal::BOOL: out += v.b ? "true" : "false"; break;
+    case JVal::NUM: out += v.s; break;
+    case JVal::STR: append_escaped(out, v.s); break;
+    case JVal::ARR:
+      out += '[';
+      for (size_t i = 0; i < v.arr.size(); ++i) {
+        if (i) out += ", ";
+        append_jval(out, v.arr[i]);
+      }
+      out += ']';
+      break;
+    case JVal::OBJ:
+      out += '{';
+      for (size_t i = 0; i < v.obj.size(); ++i) {
+        if (i) out += ", ";
+        append_escaped(out, v.obj[i].first);
+        out += ": ";
+        append_jval(out, v.obj[i].second);
+      }
+      out += '}';
+      break;
+  }
+}
+
+// Python `int(d.get(key, dflt))` over a parsed value, emitted as the
+// decimal string json.dumps would print.  Pure-integer tokens pass through
+// digit-for-digit (arbitrary precision, matching Python ints beyond 64
+// bits; leading zeros/'+' normalized away).  Tokens with '.'/'e'/'E' go
+// through strtod + truncation toward zero, matching int(float) for every
+// value a double represents exactly.  BOOL -> 0/1, absent/other -> dflt.
+std::string coerce_int_str(const JVal* v, long dflt) {
+  if (v && (v->type == JVal::NUM || v->type == JVal::STR)) {
+    // Python int(str) strips whitespace and allows single underscores
+    // between digits; mirror the ASCII-whitespace strip and underscores
+    // for string values.  (JSON NUM tokens can contain neither.)
+    // Remaining known divergences, both Python-accepted forms this
+    // rejects to the default: non-ASCII unicode digits and
+    // unicode-whitespace padding (e.g. NBSP) — schema-invalid for Molly
+    // (Go json marshaling never emits them) and out of parity scope.
+    std::string s = v->s;
+    size_t b = 0, e2 = s.size();
+    while (b < e2 && std::isspace((unsigned char)s[b])) ++b;
+    while (e2 > b && std::isspace((unsigned char)s[e2 - 1])) --e2;
+    s = s.substr(b, e2 - b);
+    size_t i = 0;
+    bool neg = false;
+    if (i < s.size() && (s[i] == '+' || s[i] == '-')) neg = s[i++] == '-';
+    std::string digits;
+    bool ok = i < s.size();
+    bool prev_digit = false;
+    for (; i < s.size(); ++i) {
+      if (std::isdigit((unsigned char)s[i])) {
+        digits += s[i];
+        prev_digit = true;
+      } else if (s[i] == '_' && prev_digit && i + 1 < s.size() &&
+                 std::isdigit((unsigned char)s[i + 1])) {
+        prev_digit = false;  // single separator between digits
+      } else {
+        ok = false;
+        break;
+      }
+    }
+    if (ok && !digits.empty()) {
+      size_t nz = 0;
+      while (nz + 1 < digits.size() && digits[nz] == '0') ++nz;  // keep lone "0"
+      std::string out = digits.substr(nz);
+      if (neg && out != "0") out.insert(out.begin(), '-');
+      return out;
+    }
+    // Gate strtod behind a JSON-decimal shape check: strtod also accepts
+    // hex ("0x10"), "inf"/"nan" — forms Python int() rejects.  Where
+    // Python raises (non-numeric strings, hex), the packed path is
+    // deliberately LENIENT and emits the default instead of failing the
+    // whole corpus; that divergence is one-sided (the object path crashes,
+    // so there is no reference output to mismatch).
+    bool decimal = true;
+    {
+      size_t j = 0;
+      if (j < s.size() && (s[j] == '+' || s[j] == '-')) ++j;
+      bool any = false;
+      while (j < s.size() && std::isdigit((unsigned char)s[j])) { ++j; any = true; }
+      if (j < s.size() && s[j] == '.') {
+        ++j;
+        while (j < s.size() && std::isdigit((unsigned char)s[j])) { ++j; any = true; }
+      }
+      if (any && j < s.size() && (s[j] == 'e' || s[j] == 'E')) {
+        ++j;
+        if (j < s.size() && (s[j] == '+' || s[j] == '-')) ++j;
+        bool exp_digit = false;
+        while (j < s.size() && std::isdigit((unsigned char)s[j])) { ++j; exp_digit = true; }
+        if (!exp_digit) decimal = false;
+      }
+      if (!any || j != s.size()) decimal = false;
+    }
+    // Locale-independent parse with full-consumption check: strtod honors
+    // LC_NUMERIC (a host app setting de_DE would stop at '.'), while
+    // from_chars always uses the JSON radix.  FP from_chars needs
+    // libstdc++ >= GCC 11; older toolchains (this library self-compiles on
+    // the user's machine) fall back to strtod with the radix character
+    // swapped to whatever the active locale expects.
+    double d = 0.0;
+    bool parsed = false;
+    if (decimal) {
+      // Neither parser accepts a leading '+' the way Python float() does.
+      std::string t = (!s.empty() && s[0] == '+') ? s.substr(1) : s;
+#if defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L
+      auto res = std::from_chars(t.data(), t.data() + t.size(), d,
+                                 std::chars_format::general);
+      parsed = res.ec == std::errc() && res.ptr == t.data() + t.size();
+#else
+      const char* radix = std::localeconv()->decimal_point;
+      if (radix && radix[0] && radix[0] != '.')
+        for (char& ch : t)
+          if (ch == '.') ch = radix[0];
+      char* end = nullptr;
+      d = std::strtod(t.c_str(), &end);
+      parsed = end == t.c_str() + t.size();
+#endif
+    }
+    if (parsed && std::isfinite(d)) {
+      // %.0f prints the double's exact integer value at any magnitude
+      // (doubles >= 2^53 are integral), matching Python int(float) even
+      // beyond the long long range where a cast would be UB.
+      double t = std::trunc(d);
+      char buf[512];
+      std::snprintf(buf, sizeof buf, "%.0f", t);
+      // %.0f spells negative zero "-0"; Python int(-0.4) prints "0".
+      return (buf[0] == '-' && buf[1] == '0' && buf[2] == '\0') ? "0" : buf;
+    }
+  }
+  if (v && v->type == JVal::BOOL) return v->b ? "1" : "0";
+  return std::to_string(dflt);
+}
+
+// Python iteration over a non-array JSON value: string -> its characters
+// (codepoints, as STR JVals), dict -> its keys; NUM/BOOL/null raise
+// TypeError in Python (signaled by returning false).  Arrays are the
+// common case and are iterated in place by the callers — no JVal copies.
+bool py_iter_items(const JVal& v, std::vector<JVal>& items) {
+  JVal tmp;
+  tmp.type = JVal::STR;
+  if (v.type == JVal::STR) {
+    for (size_t ci = 0; ci < v.s.size();) {
+      unsigned char c0 = (unsigned char)v.s[ci];
+      size_t len = c0 < 0x80 ? 1 : (c0 & 0xE0) == 0xC0 ? 2 : (c0 & 0xF0) == 0xE0 ? 3 : 4;
+      if (ci + len > v.s.size()) len = 1;
+      tmp.s = v.s.substr(ci, len);
+      items.push_back(tmp);
+      ci += len;
+    }
+    return true;
+  }
+  if (v.type == JVal::OBJ) {
+    for (const auto& kv : v.obj) {
+      tmp.s = kv.first;
+      items.push_back(tmp);
+    }
+    return true;
+  }
+  return false;
+}
+
+// Python `list(v)` then json.dumps; non-iterables emit null (Python raises
+// TypeError there — no parity to match).
+void append_pylist(std::string& out, const JVal& v) {
+  if (v.type == JVal::ARR) {  // list(arr) passthrough, no element copies
+    append_jval(out, v);
+    return;
+  }
+  std::vector<JVal> items;
+  if (!py_iter_items(v, items)) {
+    out += "null";
+    return;
+  }
+  out += '[';
+  for (size_t i = 0; i < items.size(); ++i) {
+    if (i) out += ", ";
+    append_jval(out, items[i]);
+  }
+  out += ']';
+}
+
+// Canonical head fragment of one debugging.json run entry — the five
+// metadata pairs every run carries, byte-identical to what the pure-Python
+// path emits via RunData.from_json -> to_json -> json.dumps
+// (ingest/datatypes.py, analysis/pipeline.py:_run_json_str).  The from_json
+// normalizations (missing-key defaults, int coercion, fixed key order,
+// reading ONLY the schema fields) are reproduced here so the compiled ETL
+// can serve report metadata without Python ever building run objects.
+// Reference schema: faultinjectors/data-types.go:6-98.
+std::string build_run_head(const JVal& r) {
+  std::string out;
+  out += "\"iteration\": ";
+  out += coerce_int_str(r.get("iteration"), 0);
+  out += ", \"status\": ";
+  {
+    const JVal* st = r.get("status");
+    if (!st) out += "\"\"";
+    else append_jval(out, *st);
+  }
+  out += ", \"failureSpec\": ";
+  const JVal* fs = r.get("failureSpec");
+  if (!fs || fs->type == JVal::NUL) {
+    out += "null";
+  } else {
+    out += "{\"eot\": ";
+    out += coerce_int_str(fs->get("eot"), 0);
+    out += ", \"eff\": ";
+    out += coerce_int_str(fs->get("eff"), 0);
+    out += ", \"maxCrashes\": ";
+    out += coerce_int_str(fs->get("maxCrashes"), 0);
+    out += ", \"nodes\": ";
+    // FailureSpec.from_json does list(d["nodes"]) when present/non-null.
+    const JVal* nodes = fs->get("nodes");
+    if (!nodes || nodes->type == JVal::NUL) out += "null";
+    else append_pylist(out, *nodes);
+    out += ", \"crashes\": ";
+    const JVal* crashes = fs->get("crashes");
+    if (!crashes || crashes->type == JVal::NUL) {
+      out += "null";
+    } else {
+      out += '[';
+      for (size_t i = 0; i < crashes->arr.size(); ++i) {
+        if (i) out += ", ";
+        const JVal& cr = crashes->arr[i];
+        out += "{\"node\": ";
+        const JVal* n = cr.get("node");
+        if (!n) out += "\"\"";
+        else append_jval(out, *n);
+        out += ", \"time\": ";
+        out += coerce_int_str(cr.get("time"), 0);
+        out += '}';
+      }
+      out += ']';
+    }
+    out += ", \"omissions\": ";
+    const JVal* om = fs->get("omissions");
+    if (!om || om->type == JVal::NUL) {
+      out += "null";
+    } else {
+      out += '[';
+      for (size_t i = 0; i < om->arr.size(); ++i) {
+        if (i) out += ", ";
+        const JVal& o = om->arr[i];
+        out += "{\"from\": ";
+        const JVal* f = o.get("from");
+        if (!f) out += "\"\"";
+        else append_jval(out, *f);
+        out += ", \"to\": ";
+        const JVal* t = o.get("to");
+        if (!t) out += "\"\"";
+        else append_jval(out, *t);
+        out += ", \"time\": ";
+        out += coerce_int_str(o.get("time"), 0);
+        out += '}';
+      }
+      out += ']';
+    }
+    out += '}';
+  }
+  out += ", \"model\": ";
+  const JVal* model = r.get("model");
+  if (!model || model->type == JVal::NUL) {
+    out += "null";
+  } else {
+    // Model.from_json reads ONLY "tables" (missing -> {}); everything else
+    // in the raw model object is dropped by the schema, and each table row
+    // is normalized via Python list(r).
+    out += "{\"tables\": ";
+    const JVal* tables = model->get("tables");
+    if (!tables || tables->type != JVal::OBJ) {
+      out += "{}";
+    } else {
+      out += '{';
+      for (size_t ti = 0; ti < tables->obj.size(); ++ti) {
+        if (ti) out += ", ";
+        append_escaped(out, tables->obj[ti].first);
+        out += ": ";
+        // [list(r) for r in v]: Python iteration over the rows container,
+        // then list(r) per row; non-iterables raise in Python — null.
+        const JVal& rows = tables->obj[ti].second;
+        if (rows.type == JVal::ARR) {  // common case, iterate in place
+          out += '[';
+          for (size_t ri = 0; ri < rows.arr.size(); ++ri) {
+            if (ri) out += ", ";
+            append_pylist(out, rows.arr[ri]);
+          }
+          out += ']';
+        } else {
+          std::vector<JVal> elems;
+          if (!py_iter_items(rows, elems)) {
+            out += "null";
+          } else {
+            out += '[';
+            for (size_t ri = 0; ri < elems.size(); ++ri) {
+              if (ri) out += ", ";
+              append_pylist(out, elems[ri]);
+            }
+            out += ']';
+          }
+        }
+      }
+      out += '}';
+    }
+    out += '}';
+  }
+  out += ", \"messages\": [";
+  const JVal* msgs = r.get("messages");
+  if (msgs && msgs->type == JVal::ARR) {
+    for (size_t i = 0; i < msgs->arr.size(); ++i) {
+      if (i) out += ", ";
+      const JVal& m = msgs->arr[i];
+      out += "{\"table\": ";
+      const JVal* tb = m.get("table");
+      if (!tb) out += "\"\"";
+      else append_jval(out, *tb);
+      out += ", \"from\": ";
+      const JVal* f = m.get("from");
+      if (!f) out += "\"\"";
+      else append_jval(out, *f);
+      out += ", \"to\": ";
+      const JVal* t = m.get("to");
+      if (!t) out += "\"\"";
+      else append_jval(out, *t);
+      out += ", \"sendTime\": ";
+      out += coerce_int_str(m.get("sendTime"), 0);
+      out += ", \"receiveTime\": ";
+      out += coerce_int_str(m.get("receiveTime"), 0);
+      out += '}';
+    }
+  }
+  out += ']';
+  return out;
 }
 
 // One provenance graph after parsing + namespacing, before interning.
@@ -600,9 +954,60 @@ struct PackedCond {
   std::vector<int32_t> edge_src, edge_dst;                    // [B*E]
   std::vector<uint8_t> edge_mask;                             // [B*E]
   std::vector<int32_t> n_nodes, n_goals;                      // [B]
+  std::vector<uint8_t> chain_linear;                          // [B]
   std::vector<std::string> node_ids_joined;                   // per run, '\n'-joined
   std::vector<std::string> prov_json;                         // per run, namespaced
 };
+
+// Per-graph mirror of ops/simplify.py:chains_linear_host: True iff the
+// graph's @next chain-member subgraph (after the clean_masks restriction)
+// has member in/out degree <= 1 — the precondition for the O(V log V)
+// pointer-doubling component labels.  Duplicate edge-list entries inflate
+// the counts exactly like the numpy batched check (conservative: a
+// duplicated chain edge can only flip the answer to False, costing the
+// closure fallback, never correctness).
+bool graph_chain_linear(const RawGraph& g) {
+  const int32_t n = (int32_t)g.ids.size();
+  const int32_t ng = g.n_goals;  // slots [0, ng) are goals, rest rules
+  const size_t m = g.esrc.size();
+  // has_in_goal[x]: some goal -> x edge; has_out_goal[x]: some x -> goal.
+  std::vector<uint8_t> has_in_goal(n, 0), has_out_goal(n, 0);
+  for (size_t k = 0; k < m; ++k) {
+    int32_t s = g.esrc[k], d = g.edst[k];
+    if (s < ng) has_in_goal[d] = 1;   // goal s feeds d
+    if (d < ng) has_out_goal[s] = 1;  // s feeds goal d
+  }
+  std::vector<uint8_t> alive(n, 0);
+  for (int32_t s = 0; s < n; ++s)
+    alive[s] = s < ng || (has_in_goal[s] && has_out_goal[s]);
+  std::vector<uint8_t> next_rule(n, 0);
+  for (int32_t s = ng; s < n; ++s)
+    next_rule[s] = alive[s] && g.types[s] == 2;  // 2 = "next"
+  // clean_masks edge keep: from a goal iff the rule dst has an out-goal;
+  // from a rule iff it has an in-goal; endpoints alive.
+  std::vector<uint8_t> keep(m, 0), in_from_next(n, 0), out_to_next(n, 0);
+  for (size_t k = 0; k < m; ++k) {
+    int32_t s = g.esrc[k], d = g.edst[k];
+    bool kp = (s < ng ? has_out_goal[d] : has_in_goal[s]) && alive[s] && alive[d];
+    keep[k] = kp;
+    if (kp && next_rule[s]) in_from_next[d] = 1;
+    if (kp && next_rule[d]) out_to_next[s] = 1;
+  }
+  std::vector<uint8_t> member(n, 0);
+  for (int32_t s = 0; s < n; ++s)
+    member[s] = next_rule[s] ||
+                (s < ng && alive[s] && in_from_next[s] && out_to_next[s]);
+  std::vector<int32_t> succ(n, 0), pred(n, 0);
+  for (size_t k = 0; k < m; ++k) {
+    if (!keep[k]) continue;
+    int32_t s = g.esrc[k], d = g.edst[k];
+    if (member[s] && member[d]) {
+      if (++succ[s] > 1) return false;
+      if (++pred[d] > 1) return false;
+    }
+  }
+  return true;
+}
 
 struct Corpus {
   int64_t n_runs = 0, v = 0, e = 0, max_depth = 1;
@@ -610,6 +1015,7 @@ struct Corpus {
   PackedCond cond[2];  // 0 = pre, 1 = post
   std::vector<int32_t> iteration;
   std::vector<uint8_t> success;
+  std::vector<std::string> run_heads;  // per run, canonical head JSON fragment
   std::string error;  // empty on success
 };
 
@@ -627,10 +1033,12 @@ void pack_cond(std::vector<RawGraph>& graphs, int64_t v, int64_t e, Corpus& c,
   out.edge_mask.assign(b * e, 0);
   out.n_nodes.resize(b);
   out.n_goals.resize(b);
+  out.chain_linear.resize(b);
   out.node_ids_joined.resize(b);
   out.prov_json.resize(b);
   for (int64_t i = 0; i < b; ++i) {
     RawGraph& g = graphs[i];
+    out.chain_linear[i] = graph_chain_linear(g) ? 1 : 0;
     out.prov_json[i] = std::move(g.prov_json);
     int32_t n = (int32_t)g.ids.size();
     out.n_nodes[i] = n;
@@ -655,7 +1063,7 @@ void pack_cond(std::vector<RawGraph>& graphs, int64_t v, int64_t e, Corpus& c,
   }
 }
 
-Corpus* ingest(const std::string& dir) {
+Corpus* ingest(const std::string& dir, bool with_heads) {
   auto c = std::make_unique<Corpus>();
   // Pin "pre"/"post" to table ids 0/1 (mirror of graphs/packed.py
   // CorpusVocab.__post_init__): the condition-table ids are static args of
@@ -675,6 +1083,10 @@ Corpus* ingest(const std::string& dir) {
     long iter = r.get_int("iteration");
     c->iteration.push_back((int32_t)iter);
     c->success.push_back(r.get_str("status") == "success");  // molly.go:53
+    // Head fragments are only reachable through a live handle
+    // (nemo_run_head_json); bench/prewarm ingests that drop the handle
+    // skip building them — the messages arrays dominate runs.json.
+    if (with_heads) c->run_heads.push_back(build_run_head(r));
     // Provenance files are indexed by position i, not iteration (molly.go:59-60).
     pre_graphs.push_back(
         parse_prov(dir + "/run_" + std::to_string(i) + "_pre_provenance.json", iter, "pre"));
@@ -709,9 +1121,11 @@ Corpus* ingest(const std::string& dir) {
 extern "C" {
 
 // Returns an opaque handle, or nullptr with a message in err[0..errlen).
-void* nemo_ingest(const char* dir, char* err, int errlen) {
+// with_heads != 0 pre-serializes each run's debugging.json head fragment
+// (nemo_run_head_json); callers that never read heads pass 0.
+void* nemo_ingest(const char* dir, char* err, int errlen, int with_heads) {
   try {
-    return ingest(dir);
+    return ingest(dir, with_heads != 0);
   } catch (const std::exception& ex) {
     if (err && errlen > 0) {
       std::strncpy(err, ex.what(), (size_t)errlen - 1);
@@ -741,9 +1155,11 @@ void nemo_dims(void* h, int64_t* out) {
 // n_nodes/n_goals B.
 void nemo_copy(void* h, int cond, int32_t* table_id, int32_t* label_id, int32_t* time_id,
                int32_t* type_id, uint8_t* is_goal, uint8_t* node_mask, int32_t* edge_src,
-               int32_t* edge_dst, uint8_t* edge_mask, int32_t* n_nodes, int32_t* n_goals) {
+               int32_t* edge_dst, uint8_t* edge_mask, int32_t* n_nodes, int32_t* n_goals,
+               uint8_t* chain_linear) {
   auto* c = (Corpus*)h;
   const PackedCond& p = c->cond[cond];
+  std::memcpy(chain_linear, p.chain_linear.data(), p.chain_linear.size());
   std::memcpy(table_id, p.table_id.data(), p.table_id.size() * sizeof(int32_t));
   std::memcpy(label_id, p.label_id.data(), p.label_id.size() * sizeof(int32_t));
   std::memcpy(time_id, p.time_id.data(), p.time_id.size() * sizeof(int32_t));
@@ -790,9 +1206,18 @@ const char* nemo_prov_json(void* h, int cond, int run) {
   return p.prov_json[(size_t)run].c_str();
 }
 
+// Canonical debugging.json head fragment of one run (the five metadata
+// pairs: iteration/status/failureSpec/model/messages), byte-identical to
+// the pure-Python RunData round-trip.  Valid until free.
+const char* nemo_run_head_json(void* h, int run) {
+  auto* c = (Corpus*)h;
+  if (run < 0 || (size_t)run >= c->run_heads.size()) return "";
+  return c->run_heads[(size_t)run].c_str();
+}
+
 void nemo_free(void* h) { delete (Corpus*)h; }
 
 // ABI version for the ctypes wrapper to sanity-check.
-int nemo_abi_version() { return 3; }
+int nemo_abi_version() { return 5; }
 
 }  // extern "C"

@@ -54,13 +54,21 @@ def _run_json_str(run, good_iter: int | None) -> str:
     object-ingest path (same key order, same omitempty policy,
     datatypes.py:RunData.to_json), but able to splice RawProv byte strings
     without ever parsing provenance in Python."""
-    pairs: list[tuple[str, str]] = [
-        ("iteration", json.dumps(run.iteration)),
-        ("status", json.dumps(run.status)),
-        ("failureSpec", json.dumps(run.failure_spec.to_json() if run.failure_spec else None)),
-        ("model", json.dumps(run.model.to_json() if run.model else None)),
-        ("messages", json.dumps([m.to_json() for m in run.messages])),
-    ]
+    head = getattr(run, "head_json", None)
+    if head is not None:
+        # Packed-first ingest: the five metadata pairs were canonically
+        # serialized by the C++ engine at parse time
+        # (nemo_native.cpp:build_run_head) — splice the fragment verbatim
+        # instead of rebuilding the typed objects per run.
+        pairs: list[tuple[str, str]] = [("", head.decode())]
+    else:
+        pairs = [
+            ("iteration", json.dumps(run.iteration)),
+            ("status", json.dumps(run.status)),
+            ("failureSpec", json.dumps(run.failure_spec.to_json() if run.failure_spec else None)),
+            ("model", json.dumps(run.model.to_json() if run.model else None)),
+            ("messages", json.dumps([m.to_json() for m in run.messages])),
+        ]
     if run.pre_prov is not None:
         pairs.append(("preProv", _prov_json_str(run.pre_prov)))
     if run.time_pre_holds:
@@ -84,7 +92,9 @@ def _run_json_str(run, good_iter: int | None) -> str:
     if run.union_proto_missing:
         pairs.append(("unionProtoMissing", json.dumps(run.union_proto_missing)))
     pairs.append(("goodRunIteration", json.dumps(good_iter)))
-    return "{" + ", ".join(f'"{k}": {v}' for k, v in pairs) + "}"
+    # A pair with an empty key is a pre-rendered multi-pair fragment (the
+    # C++ head); every other pair renders as `"key": value`.
+    return "{" + ", ".join(v if not k else f'"{k}": {v}' for k, v in pairs) + "}"
 
 
 def select_figure_iters(

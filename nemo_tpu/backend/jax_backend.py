@@ -391,6 +391,8 @@ class JaxBackend(GraphBackend):
         self._corpus = None
         self._corpus_graphs: CorpusGraphs | None = None
         self._row_by_iter: dict[int, int] = {}
+        # iteration -> parse-time linearity flag (AND over colliding rows).
+        self._lin_by_iter: dict[int, bool] = {}
 
     # ------------------------------------------------------------------ setup
 
@@ -429,9 +431,18 @@ class JaxBackend(GraphBackend):
             self._corpus_graphs = CorpusGraphs(nc)
             self._row_by_iter = {int(it): i for i, it in enumerate(nc.iteration)}
             self.packed = _CorpusPacked(self._corpus_graphs, self._row_by_iter)
+            # Per-iteration linearity for the fused fast-path gate, built
+            # POSITIONALLY so duplicate iteration values (which would make
+            # _row_by_iter lossy) AND their flags together — a collision
+            # can only force the closure fallback, never a wrong fast path.
+            self._lin_by_iter = {}
+            for i, it in enumerate(nc.iteration):
+                f = bool(nc.pre.chain_linear[i] and nc.post.chain_linear[i])
+                self._lin_by_iter[int(it)] = self._lin_by_iter.get(int(it), True) and f
         else:
             self._corpus_graphs = None
             self._row_by_iter = {}
+            self._lin_by_iter = {}
             for run in molly.runs:
                 for cond, prov in (("pre", run.pre_prov), ("post", run.post_prov)):
                     self.packed[(run.iteration, cond)] = pack_graph(prov, self.vocab)
@@ -456,6 +467,7 @@ class JaxBackend(GraphBackend):
         self._corpus = None
         self._corpus_graphs = None
         self._row_by_iter = {}
+        self._lin_by_iter = {}
 
     # ------------------------------------------------------- lazy host graphs
 
@@ -640,11 +652,16 @@ class JaxBackend(GraphBackend):
             out = []
             for pre_b, post_b in batches:
                 # Linear-chain fast path: when every run's @next member
-                # subgraph is a verified linear chain (O(B*(V+E)) host
-                # bincounts, once per bucket per corpus), the device step
+                # subgraph is a verified linear chain, the device step
                 # labels components by O(V log V) pointer doubling instead
                 # of all-pairs closures — ~2/3 of the fused step's V^3 work.
-                linear = pair_chains_linear(pre_b, post_b)
+                # On the packed-first path the per-run flags were computed
+                # by the C++ engine at parse time (graph_chain_linear);
+                # otherwise the O(B*(V+E)) host bincounts run per bucket.
+                if self._corpus is not None:
+                    linear = all(self._lin_by_iter[i] for i in pre_b.run_ids)
+                else:
+                    linear = pair_chains_linear(pre_b, post_b)
                 res = self.executor.run(
                     "fused",
                     _verb_arrays(pre_b, post_b),

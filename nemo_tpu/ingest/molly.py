@@ -101,15 +101,18 @@ class MollyOutput:
         return os.path.join(self.output_dir, f"run_{iteration}_spacetime.dot")
 
 
-def attach_run_metadata(out: MollyOutput, run) -> None:
+def attach_run_metadata(out: MollyOutput, run, tables: dict | None = None) -> None:
     """Holds-maps + success/failure classification for one parsed run —
     shared by the object loader below and the packed-first loader
     (ingest/native.py:load_molly_output_packed) so the keying and status
     rules can never drift apart.
 
     Holds-maps: keyed by the string timestep in the last column of each
-    'pre'/'post' model-table row (molly.go:38-48)."""
-    tables = run.model.tables if run.model else {}
+    'pre'/'post' model-table row (molly.go:38-48).  `tables` supplies the
+    model tables directly (the packed loader passes the raw dict so run
+    metadata objects stay unbuilt); default reads run.model."""
+    if tables is None:
+        tables = run.model.tables if run.model else {}
     run.time_pre_holds = {row[-1]: True for row in tables.get("pre", []) if row}
     run.time_post_holds = {row[-1]: True for row in tables.get("post", []) if row}
     out.runs_iters.append(run.iteration)

@@ -131,10 +131,13 @@ def chains_linear_host(is_goal, node_mask, type_id, edge_src, edge_dst, edge_mas
 
 
 def pair_chains_linear(pre, post) -> bool:
-    """chains_linear_host over a (pre, post) batch pair — the single
-    reduction every dispatch site uses (backend fused loop, bench sweep,
-    prewarm, sidecar chunk producers), so the linearity criterion can never
-    diverge between the measured and the deployed flag."""
+    """chains_linear_host over a (pre, post) batch pair — the reduction the
+    object-ingest dispatch sites use.  The packed-first path reads per-run
+    flags computed at parse time by the C++ mirror of the same criterion
+    (native/nemo_native.cpp:graph_chain_linear); the two implementations
+    are pinned together by the per-run parity tests in
+    tests/test_fast_ingest.py (case-study + zigzag corpora), which is the
+    contract keeping the measured and deployed flags from diverging."""
     return all(
         chains_linear_host(
             b.is_goal, b.node_mask, b.type_id, b.edge_src, b.edge_dst, b.edge_mask

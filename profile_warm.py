@@ -13,6 +13,16 @@ import sys
 import tempfile
 import time
 
+# Resolve the platform BEFORE jax init like every entry point (the
+# environment's tunnel plugin force-registers itself; during an outage its
+# client-init hangs/dies even for CPU-intended runs).  ensure_platform()
+# honors the repo-wide NEMO_PLATFORM convention: cpu pins immediately,
+# tpu demands the device via the watchdog probe, unset probes and falls
+# back to CPU loudly.
+from nemo_tpu.utils.jax_config import ensure_platform
+
+ensure_platform()
+
 from nemo_tpu.analysis.pipeline import run_debug
 from nemo_tpu.backend.jax_backend import JaxBackend
 from nemo_tpu.models.case_studies import write_case_study

@@ -178,3 +178,178 @@ def test_pack_molly_dir_timings_hook(tmp_path):
     assert static_t == static
     assert pre_t.is_goal.shape == pre.is_goal.shape
     assert post_t.edge_src.shape == post.edge_src.shape
+
+
+def _py_linear_per_run(cond) -> list[bool]:
+    """chains_linear_host per single-run row slice (the numpy reference for
+    the C++ per-graph flags)."""
+    from nemo_tpu.ops.simplify import chains_linear_host
+
+    b = cond.is_goal.shape[0]
+    return [
+        chains_linear_host(
+            cond.is_goal[i : i + 1],
+            cond.node_mask[i : i + 1],
+            cond.type_id[i : i + 1],
+            cond.edge_src[i : i + 1],
+            cond.edge_dst[i : i + 1],
+            cond.edge_mask[i : i + 1],
+        )
+        for i in range(b)
+    ]
+
+
+@pytest.mark.parametrize("family", ["CA-2083-hinted-handoff", "ZK-1270-racing-sent-flag"])
+def test_native_chain_linear_parity_case_studies(tmp_path, family):
+    """C++ parse-time linearity flags == the numpy batched check, per run."""
+    from nemo_tpu.models.case_studies import write_case_study
+
+    d = write_case_study(family, n_runs=10, seed=7, out_dir=str(tmp_path))
+    c = ingest_native(d, with_node_ids=False)
+    for cond in (c.pre, c.post):
+        assert cond.chain_linear.dtype == bool
+        assert list(cond.chain_linear) == _py_linear_per_run(cond)
+
+
+def test_native_chain_linear_rejects_zigzag(tmp_path):
+    """A branching @next member subgraph must flag non-linear (the closure
+    fallback gate) — built from the giant-nonlinear test's zigzag shape."""
+    import json as _json
+
+    from tests.test_giant_nonlinear import _zigzag_prov
+
+    d = tmp_path / "zig"
+    d.mkdir()
+    runs = []
+    for i in range(2):
+        runs.append({"iteration": i, "status": "success" if i == 0 else "fail",
+                     "failureSpec": None, "model": {"tables": {}}, "messages": []})
+        for cond in ("pre", "post"):
+            with open(d / f"run_{i}_{cond}_provenance.json", "w") as f:
+                _json.dump(_zigzag_prov(cond), f)
+    with open(d / "runs.json", "w") as f:
+        _json.dump(runs, f)
+    c = ingest_native(str(d), with_node_ids=False)
+    for cond in (c.pre, c.post):
+        assert not cond.chain_linear.any()
+        assert list(cond.chain_linear) == _py_linear_per_run(cond)
+
+
+
+def _py_head(raw: dict) -> str:
+    """Python-side reference for the C++ head fragment: the five-pair
+    RunData round-trip serialization (the single source both parity tests
+    assert against)."""
+    from nemo_tpu.ingest.datatypes import RunData
+
+    r = RunData.from_json(raw)
+    return ", ".join(
+        f'"{k}": {json.dumps(v)}'
+        for k, v in (
+            ("iteration", r.iteration),
+            ("status", r.status),
+            ("failureSpec", r.failure_spec.to_json() if r.failure_spec else None),
+            ("model", r.model.to_json() if r.model else None),
+            ("messages", [m.to_json() for m in r.messages]),
+        )
+    )
+
+
+def test_run_head_json_parity_exotic_metadata(tmp_path):
+    """Head canonicalizer edge cases the case studies never produce —
+    unicode, missing/null schema keys, exponent/decimal/string numerics,
+    extra keys the schema drops — C++ head bytes must equal the Python
+    RunData round-trip serialization."""
+    runs = [
+        {  # fully-populated with exotic content
+            "iteration": 7,
+            "status": 'weird " statüs \U0001f600',
+            "failureSpec": {
+                "eot": "12",  # string int -> int coercion
+                "eff": 2.0,  # float token -> truncation
+                "maxCrashes": 1e2,  # exponent form -> 100
+                "nodes": ["nö", "n2"],
+                "crashes": [{"node": "a☃", "time": 3}, {"time": "4"}],
+                "omissions": [{"from": "x", "to": "ü", "time": 2}],
+            },
+            "model": {"tables": {"pre": [["n", 1, "2"]], "höhe": [["é"]]},
+                      "dropped_by_schema": True},
+            "messages": [
+                {"table": "t\n", "from": "a", "to": "b", "sendTime": 1,
+                 "receiveTime": "2", "extra_key_dropped": 1},
+                {},  # all defaults
+            ],
+        },
+        {  # minimal: schema keys absent entirely
+            "iteration": 123456789012345678901234567890,  # beyond 64 bits
+            "status": "success",
+        },
+        {  # nulls where objects are expected
+            "iteration": 1,
+            "status": "fail",
+            "failureSpec": None,
+            "model": None,
+            "messages": None,
+        },
+    ]
+    prov = {"goals": [{"id": "g0", "label": "t(n)", "table": "t", "time": "1"}],
+            "rules": [], "edges": []}
+    d = tmp_path / "exotic_meta"
+    d.mkdir()
+    (d / "runs.json").write_text(json.dumps(runs, ensure_ascii=False), encoding="utf-8")
+    for i in range(len(runs)):
+        for cond in ("pre", "post"):
+            (d / f"run_{i}_{cond}_provenance.json").write_text(json.dumps(prov))
+
+    nc = ingest_native(str(d), with_node_ids=False, keep_handle=True)
+    for i, raw in enumerate(runs):
+        assert nc.run_head_json(i).decode() == _py_head(raw), f"run {i}"
+
+
+def test_run_head_json_numeric_and_nodes_edge_cases(tmp_path):
+    """Coercion corners: huge float ints (beyond long long), negative-zero
+    truncation, string-typed nodes (Python list() = characters) — C++ head
+    bytes must equal the Python round-trip."""
+    runs = [{"iteration": 0, "status": "s",
+             "failureSpec": {"eot": 1e20, "eff": -0.4, "maxCrashes": 2.5,
+                             "nodes": "abé"},
+             "model": None, "messages": []},
+            {"iteration": 1, "status": "s2",
+             # Python int(str) forms: whitespace padding, underscore
+             # separators, leading zeros
+             "failureSpec": {"eot": " 12", "eff": "1_2", "maxCrashes": "\t007\n",
+                             "nodes": None},
+             "model": {"tables": {"pre": ["ab", {"k": 1}], "post": "xy"}},
+             "messages": []}]
+    prov = {"goals": [{"id": "g0", "label": "t(n)", "table": "t", "time": "1"}],
+            "rules": [], "edges": []}
+    d = tmp_path / "edge"
+    d.mkdir()
+    (d / "runs.json").write_text(json.dumps(runs, ensure_ascii=False), encoding="utf-8")
+    for i in range(len(runs)):
+        for cond in ("pre", "post"):
+            (d / f"run_{i}_{cond}_provenance.json").write_text(json.dumps(prov))
+
+    nc = ingest_native(str(d), with_node_ids=False, keep_handle=True)
+    for i, raw in enumerate(runs):
+        assert nc.run_head_json(i).decode() == _py_head(raw), f"run {i}"
+
+
+def test_lazy_run_mutation_invalidates_head(tmp_path):
+    """Assigning any of the lazy trio must drop the parse-time head so the
+    report rebuilds from the mutated objects instead of splicing stale
+    bytes."""
+    from nemo_tpu.models.synth import SynthSpec, write_corpus
+
+    d = write_corpus(SynthSpec(n_runs=2, seed=9), str(tmp_path))
+    pk = load_molly_output_packed(d)
+    run = pk.runs[0]
+    assert run.head_json
+    run.messages = []
+    assert run.head_json is None
+    assert run.messages == []
+    run2 = pk.runs[1]
+    assert run2.head_json
+    run2.status = "reclassified"
+    assert run2.head_json is None
+    assert run2.status == "reclassified" and not run2.succeeded
