@@ -236,6 +236,21 @@ def test_native_chain_linear_rejects_zigzag(tmp_path):
 
 
 
+
+def _write_head_corpus(root, runs) -> str:
+    """Write a minimal Molly dir for head-parity tests: the given runs.json
+    plus one trivial provenance graph per run/cond."""
+    prov = {"goals": [{"id": "g0", "label": "t(n)", "table": "t", "time": "1"}],
+            "rules": [], "edges": []}
+    root.mkdir()
+    (root / "runs.json").write_text(json.dumps(runs, ensure_ascii=False),
+                                    encoding="utf-8")
+    for i in range(len(runs)):
+        for cond in ("pre", "post"):
+            (root / f"run_{i}_{cond}_provenance.json").write_text(json.dumps(prov))
+    return str(root)
+
+
 def _py_head(raw: dict) -> str:
     """Python-side reference for the C++ head fragment: the five-pair
     RunData round-trip serialization (the single source both parity tests
@@ -292,16 +307,8 @@ def test_run_head_json_parity_exotic_metadata(tmp_path):
             "messages": None,
         },
     ]
-    prov = {"goals": [{"id": "g0", "label": "t(n)", "table": "t", "time": "1"}],
-            "rules": [], "edges": []}
-    d = tmp_path / "exotic_meta"
-    d.mkdir()
-    (d / "runs.json").write_text(json.dumps(runs, ensure_ascii=False), encoding="utf-8")
-    for i in range(len(runs)):
-        for cond in ("pre", "post"):
-            (d / f"run_{i}_{cond}_provenance.json").write_text(json.dumps(prov))
-
-    nc = ingest_native(str(d), with_node_ids=False, keep_handle=True)
+    nc = ingest_native(_write_head_corpus(tmp_path / "exotic_meta", runs),
+                       with_node_ids=False, keep_handle=True)
     for i, raw in enumerate(runs):
         assert nc.run_head_json(i).decode() == _py_head(raw), f"run {i}"
 
@@ -321,16 +328,8 @@ def test_run_head_json_numeric_and_nodes_edge_cases(tmp_path):
                              "nodes": None},
              "model": {"tables": {"pre": ["ab", {"k": 1}], "post": "xy"}},
              "messages": []}]
-    prov = {"goals": [{"id": "g0", "label": "t(n)", "table": "t", "time": "1"}],
-            "rules": [], "edges": []}
-    d = tmp_path / "edge"
-    d.mkdir()
-    (d / "runs.json").write_text(json.dumps(runs, ensure_ascii=False), encoding="utf-8")
-    for i in range(len(runs)):
-        for cond in ("pre", "post"):
-            (d / f"run_{i}_{cond}_provenance.json").write_text(json.dumps(prov))
-
-    nc = ingest_native(str(d), with_node_ids=False, keep_handle=True)
+    nc = ingest_native(_write_head_corpus(tmp_path / "edge", runs),
+                       with_node_ids=False, keep_handle=True)
     for i, raw in enumerate(runs):
         assert nc.run_head_json(i).decode() == _py_head(raw), f"run {i}"
 
@@ -353,3 +352,51 @@ def test_lazy_run_mutation_invalidates_head(tmp_path):
     run2.status = "reclassified"
     assert run2.head_json is None
     assert run2.status == "reclassified" and not run2.succeeded
+
+
+def test_run_head_random_json_fuzz(tmp_path):
+    """Randomized schema-shaped metadata: nested unicode strings, random
+    numeric forms, missing keys — C++ head bytes must equal the Python
+    round-trip on every seed."""
+    import random
+    import string as _string
+
+    rng = random.Random(20260731)
+    pool = _string.ascii_letters + ' _"\\\n\t{}[]:,' + "éü☃\U0001f600"
+
+    def rstr():
+        return "".join(rng.choice(pool) for _ in range(rng.randint(0, 12)))
+
+    def rint():
+        return rng.choice([
+            rng.randint(-5, 5), rng.randint(-10**12, 10**12),
+            str(rng.randint(0, 99)), float(rng.randint(-50, 50)) / 4,
+        ])
+
+    runs = []
+    for i in range(25):
+        r = {"iteration": i, "status": rng.choice(["success", "fail", rstr()])}
+        if rng.random() < 0.8:
+            fs = {"eot": rint(), "eff": rint(), "maxCrashes": rint()}
+            if rng.random() < 0.7:
+                fs["nodes"] = [rstr() for _ in range(rng.randint(0, 3))]
+            if rng.random() < 0.6:
+                fs["crashes"] = [{"node": rstr(), "time": rint()}
+                                 for _ in range(rng.randint(0, 2))]
+            if rng.random() < 0.6:
+                fs["omissions"] = [{"from": rstr(), "to": rstr(), "time": rint()}
+                                   for _ in range(rng.randint(0, 2))]
+            r["failureSpec"] = fs
+        if rng.random() < 0.8:
+            r["model"] = {"tables": {rstr(): [[rstr() for _ in range(rng.randint(0, 3))]
+                                              for _ in range(rng.randint(0, 2))]
+                                     for _ in range(rng.randint(0, 3))}}
+        if rng.random() < 0.8:
+            r["messages"] = [{"table": rstr(), "from": rstr(), "to": rstr(),
+                              "sendTime": rint(), "receiveTime": rint()}
+                             for _ in range(rng.randint(0, 3))]
+        runs.append(r)
+    nc = ingest_native(_write_head_corpus(tmp_path / "fuzz", runs),
+                       with_node_ids=False, keep_handle=True)
+    for i, raw in enumerate(runs):
+        assert nc.run_head_json(i).decode() == _py_head(raw), f"run {i}: {raw}"
