@@ -137,7 +137,8 @@ def _k_fused(*args):
 
     pre = BatchArrays(*args[:8])
     post = BatchArrays(*args[8:16])
-    v, pre_tid, post_tid, num_tables, num_labels, max_depth, with_diff, comp_linear = args[16:]
+    (v, pre_tid, post_tid, num_tables, num_labels, max_depth, with_diff,
+     comp_linear, pack_out) = args[16:]
     return analysis_step(
         pre,
         post,
@@ -149,6 +150,7 @@ def _k_fused(*args):
         max_depth=max_depth,
         with_diff=bool(with_diff),
         comp_linear=bool(comp_linear),
+        pack_out=bool(pack_out),
     )
 
 
@@ -200,6 +202,7 @@ class LocalExecutor:
                 "max_depth",
                 "with_diff",
                 "comp_linear",
+                "pack_out",
             ),
             None,  # dict-returning: output names come from analysis_step
         ),
@@ -228,8 +231,11 @@ class LocalExecutor:
     )
 
     #: Statics that may be absent from older clients' Kernel RPCs; 0 selects
-    #: the generic (assumption-free) code path.
-    OPTIONAL_PARAMS = frozenset({"comp_linear"})
+    #: the generic (assumption-free) code path.  pack_out is special: when
+    #: the caller omits it, run() resolves it from the LOCAL backend (the
+    #: process that owns the device decides whether its device->host copies
+    #: ride a serialized tunnel), so remote clients never need to know.
+    OPTIONAL_PARAMS = frozenset({"comp_linear", "pack_out"})
 
     #: Array inputs that may be absent likewise; None reaches the kernel,
     #: which falls back to its assumption-free path (the giant verb without
@@ -243,6 +249,8 @@ class LocalExecutor:
         if verb not in self.VERBS:
             raise ValueError(f"unknown kernel verb {verb!r}")
         fn, array_names, param_names, out_names = self.VERBS[verb]
+        if verb == "fused" and "pack_out" not in params:
+            params = dict(params, pack_out=_pack_out_default())
         args = [
             (jnp.asarray(arrays[n]) if arrays.get(n) is not None else None)
             if n in self.OPTIONAL_ARRAYS
@@ -258,9 +266,19 @@ class LocalExecutor:
         out = fn(*args, *statics)
         if isinstance(out, dict):
             _prefetch_to_host(o for n, o in out.items() if n not in self.ON_DEVICE)
-            return {
+            res = {
                 n: (o if n in self.ON_DEVICE else np.asarray(o)) for n, o in out.items()
             }
+            if "packed_summary" in res:
+                res.update(
+                    _unpack_summary(
+                        res.pop("packed_summary"),
+                        b=int(np.shape(arrays["pre_is_goal"])[0]),
+                        v=int(params["v"]),
+                        t=int(params["num_tables"]),
+                    )
+                )
+            return res
         # Tuple-returning verbs always materialize: none of their outputs
         # are in ON_DEVICE, and the diff verb's consumers specifically rely
         # on host arrays (see the ON_DEVICE comment's 6s->39s measurement).
@@ -268,6 +286,37 @@ class LocalExecutor:
             out = (out,)
         _prefetch_to_host(out)
         return {n: np.asarray(o) for n, o in zip(out_names, out)}
+
+
+def _pack_out_default() -> int:
+    """Whether the fused verb should fold its bool summary outputs into one
+    bit-packed device->host transfer: yes on device backends (the TPU
+    tunnel serializes copies at ~an RTT each, so seven transfers collapse
+    to one 8x-smaller one), no on CPU where host "transfers" are free.
+    Resolved by the process that OWNS the device (the sidecar server, or
+    the in-process backend) — remote clients never send it.
+    NEMO_PACK_XFER=0/1 overrides."""
+    env = os.environ.get("NEMO_PACK_XFER", "")
+    if env:
+        return int(env)
+    return int(jax.default_backend() != "cpu")
+
+
+def _unpack_summary(packed: np.ndarray, b: int, v: int, t: int) -> dict[str, np.ndarray]:
+    """Inverse of the pack_out folding (models/pipeline_model.py:
+    SUMMARY_PACK_LAYOUT): one host np.unpackbits + views, no device work."""
+    from nemo_tpu.models.pipeline_model import SUMMARY_PACK_LAYOUT
+
+    dims = {"bv": (b, v), "b": (b,), "bt": (b, t), "t": (t,)}
+    flat = np.unpackbits(np.asarray(packed)).astype(bool)
+    out: dict[str, np.ndarray] = {}
+    ofs = 0
+    for name, key in SUMMARY_PACK_LAYOUT:
+        shape = dims[key]
+        n = int(np.prod(shape))
+        out[name] = flat[ofs : ofs + n].reshape(shape)
+        ofs += n
+    return out
 
 
 def _prefetch_to_host(arrays) -> None:

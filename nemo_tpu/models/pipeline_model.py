@@ -92,6 +92,21 @@ jax.tree_util.register_dataclass(
 # any new cross-run reduction output MUST be added here.
 CORPUS_REDUCTIONS = {"proto_inter": "and", "proto_union": "or"}
 
+# The bool summary outputs folded into one bit-packed device->host transfer
+# under pack_out=True, in pack order; the shape key resolves with b=batch,
+# v=nodes, t=num_tables (backend/jax_backend.py:_unpack_summary is the
+# inverse).  Every entry must be a bool output of the with_diff=False
+# return dict.
+SUMMARY_PACK_LAYOUT = (
+    ("pre_holds", "bv"),
+    ("post_holds", "bv"),
+    ("achieved_pre", "b"),
+    ("proto_bits", "bt"),
+    ("proto_present", "bt"),
+    ("proto_inter", "t"),
+    ("proto_union", "t"),
+)
+
 
 def analysis_step(
     pre: BatchArrays,
@@ -105,11 +120,19 @@ def analysis_step(
     closure_impl: str = "auto",
     with_diff: bool = True,
     comp_linear: bool = False,
+    pack_out: bool = False,
 ) -> dict[str, jnp.ndarray]:
     """Jit-cached wrapper that resolves closure_impl="auto" (env + backend)
     BEFORE entering jit, so the resolved impl is part of the static cache key
     — changing NEMO_CLOSURE_IMPL between calls takes effect instead of
     silently hitting the stale trace.
+
+    pack_out=True replaces the seven bool summary outputs with one
+    bit-packed "packed_summary" uint8 vector (SUMMARY_PACK_LAYOUT) so a
+    device behind an RPC-serialized tunnel ships one small transfer
+    instead of eight; the executor boundary unpacks
+    (backend/jax_backend.py:_unpack_summary).  Production-fused-path only
+    (with_diff must be False).
 
     with_diff=False drops the differential-provenance tail (diff vs batch
     row 0) AND the num_labels dim from the compiled program — the
@@ -126,6 +149,8 @@ def analysis_step(
         from nemo_tpu.ops.adjacency import resolve_closure_impl
 
         closure_impl = resolve_closure_impl()
+    if pack_out and with_diff:
+        raise ValueError("pack_out requires with_diff=False (the fused production path)")
     return _analysis_step_jit(
         pre,
         post,
@@ -138,6 +163,7 @@ def analysis_step(
         closure_impl=closure_impl,
         with_diff=with_diff,
         comp_linear=comp_linear,
+        pack_out=pack_out,
     )
 
 
@@ -155,6 +181,7 @@ def analysis_step(
         "closure_impl",
         "with_diff",
         "comp_linear",
+        "pack_out",
     ),
 )
 def _analysis_step_jit(
@@ -169,6 +196,7 @@ def _analysis_step_jit(
     closure_impl: str = "auto",
     with_diff: bool = True,
     comp_linear: bool = False,
+    pack_out: bool = False,
 ) -> dict[str, jnp.ndarray]:
     """The full fused pipeline for one run batch.  Returns per-run and
     corpus-level results; everything stays on device."""
@@ -226,6 +254,17 @@ def _analysis_step_jit(
         "proto_inter": inter,
         "proto_union": union,
     }
+    if pack_out:
+        # Fuse the seven bool summary outputs into ONE bit-packed vector,
+        # INSIDE this compiled program (a separate pack dispatch would pay
+        # its own tunnel RTT).  Device->host copies over the TPU tunnel are
+        # RPC-serialized at ~RTT each regardless of size (measured ~190 ms
+        # x ~8 summary arrays per 17k-run bucket), so one 8x-smaller
+        # transfer replaces eight.  LocalExecutor._unpack_summary is the
+        # inverse; layout = SUMMARY_PACK_LAYOUT.
+        out["packed_summary"] = jnp.packbits(
+            jnp.concatenate([out.pop(name).ravel() for name, _ in SUMMARY_PACK_LAYOUT])
+        )
     if with_diff:
         # Differential provenance of every run vs the successful run in row
         # 0 (differential-provenance.go:18-243).  Label bitsets per run.

@@ -52,6 +52,12 @@ class _Impl:
         post = codec.batch_arrays_from_pb(request.post)
         static = codec.static_from_pb(request.static)
         t0 = time.perf_counter()
+        # This path runs with_diff=True by contract (chunks diff against
+        # their prepended good row; the client merge consumes the diff
+        # tail), so the fused verb's pack_out transfer folding does not
+        # apply — extending it here needs a diff-tail pack layout (the
+        # server-side device->host copies are the remaining unfolded
+        # transfers; the wire itself already bit-packs bools 8x).
         out = analysis_step(pre, post, **static)
         out = jax.block_until_ready(out)
         dt = time.perf_counter() - t0

@@ -73,6 +73,12 @@ def stress_signature(name: str, n_probe: int, b_pad: int):
         max_depth=bucket_size(static["max_depth"], 32),
     )
     static["with_diff"] = 0
+    # Match the executor's transfer-packing choice for THIS backend, or the
+    # prewarmed program won't be the one the deployment dispatches
+    # (backend/jax_backend.py:_pack_out_default).
+    from nemo_tpu.backend.jax_backend import _pack_out_default
+
+    static["pack_out"] = bool(_pack_out_default())
 
     def pad_arrays(ba: BatchArrays) -> BatchArrays:
         def grow(a, cols, fill):

@@ -81,7 +81,12 @@ def test_prewarm_matches_deployment(tmp_path):
     d = write_case_study(fam, n_runs=n_runs, seed=11, out_dir=str(tmp_path))
     (verb, params, shapes) = _fused_sigs(load_molly_output(d))[0]
     assert verb == "fused"
-    dispatch_params = dict(params)
+    # The backend omits pack_out; LocalExecutor.run injects the
+    # backend-resolved default before dispatch, so the COMPILED signature
+    # carries it — prewarm must match that, not the raw dispatch params.
+    from nemo_tpu.backend.jax_backend import _pack_out_default
+
+    dispatch_params = dict(params, pack_out=_pack_out_default())
 
     pre, post, static = stress_signature(fam, n_probe=64, b_pad=bucket_size(n_runs, 8))
     assert {k: int(v) for k, v in static.items()} == {

@@ -1,0 +1,74 @@
+"""pack_out transfer folding: the fused verb's seven bool summary outputs
+collapse into one bit-packed device->host transfer on device backends
+(backend/jax_backend.py:_pack_out_default), unpacked at the executor
+boundary — results must be bit-identical to the unpacked program, and the
+full pipeline must produce byte-identical reports either way."""
+
+import os
+
+import numpy as np
+
+from nemo_tpu.backend.jax_backend import JaxBackend, LocalExecutor
+from nemo_tpu.models.pipeline_model import SUMMARY_PACK_LAYOUT
+
+
+def _fused_params(static: dict, pack_out: int) -> dict:
+    return dict(
+        v=static["v"],
+        pre_tid=static["pre_tid"],
+        post_tid=static["post_tid"],
+        num_tables=static["num_tables"],
+        num_labels=8,
+        max_depth=static["max_depth"],
+        with_diff=0,
+        comp_linear=int(static.get("comp_linear", False)),
+        pack_out=pack_out,
+    )
+
+
+def test_fused_pack_out_parity(tmp_path):
+    from nemo_tpu.ingest.molly import load_molly_output
+    from nemo_tpu.models.case_studies import write_case_study
+    from nemo_tpu.models.pipeline_model import pack_molly_for_step
+
+    d = write_case_study("CA-2083-hinted-handoff", n_runs=10, seed=3, out_dir=str(tmp_path))
+    pre, post, static = pack_molly_for_step(load_molly_output(d))
+    ex = LocalExecutor()
+    arrays = {f"pre_{f}": np.asarray(getattr(pre, f)) for f in pre.FIELDS}
+    arrays.update({f"post_{f}": np.asarray(getattr(post, f)) for f in post.FIELDS})
+    plain = ex.run("fused", arrays, _fused_params(static, pack_out=0))
+    packed = ex.run("fused", arrays, _fused_params(static, pack_out=1))
+    assert sorted(plain) == sorted(packed)
+    for name, _ in SUMMARY_PACK_LAYOUT:
+        got = packed[name]
+        assert got.dtype == bool, name
+        np.testing.assert_array_equal(got, np.asarray(plain[name]), err_msg=name)
+    np.testing.assert_array_equal(
+        np.asarray(packed["proto_min_depth"]), np.asarray(plain["proto_min_depth"])
+    )
+
+
+def test_pipeline_byte_parity_packed_vs_not(tmp_path, monkeypatch):
+    """run_debug with transfer packing forced ON equals the default-off CPU
+    run byte-for-byte (the e2e contract the TPU deployment relies on)."""
+    from nemo_tpu.analysis.pipeline import run_debug
+    from nemo_tpu.models.synth import SynthSpec, write_corpus
+
+    d = write_corpus(SynthSpec(n_runs=8, seed=13), str(tmp_path))
+    monkeypatch.setenv("NEMO_PACK_XFER", "0")
+    r_off = run_debug(d, str(tmp_path / "off"), JaxBackend(), figures="sample:2")
+    monkeypatch.setenv("NEMO_PACK_XFER", "1")
+    r_on = run_debug(d, str(tmp_path / "on"), JaxBackend(), figures="sample:2")
+
+    def tree(root):
+        out = {}
+        for dirpath, _dirs, files in os.walk(root):
+            for f in files:
+                p = os.path.join(dirpath, f)
+                out[os.path.relpath(p, root)] = open(p, "rb").read()
+        return out
+
+    a, b = tree(r_off.report_dir), tree(r_on.report_dir)
+    assert sorted(a) == sorted(b)
+    for name in a:
+        assert a[name] == b[name], f"{name} differs with transfer packing"
