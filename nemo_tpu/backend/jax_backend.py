@@ -276,6 +276,7 @@ class LocalExecutor:
                         b=int(np.shape(arrays["pre_is_goal"])[0]),
                         v=int(params["v"]),
                         t=int(params["num_tables"]),
+                        with_diff=bool(params.get("with_diff", 0)),
                     )
                 )
             return res
@@ -302,16 +303,20 @@ def _pack_out_default() -> int:
     return int(jax.default_backend() != "cpu")
 
 
-def _unpack_summary(packed: np.ndarray, b: int, v: int, t: int) -> dict[str, np.ndarray]:
+def _unpack_summary(
+    packed: np.ndarray, b: int, v: int, t: int, with_diff: bool = False
+) -> dict[str, np.ndarray]:
     """Inverse of the pack_out folding (models/pipeline_model.py:
-    SUMMARY_PACK_LAYOUT): one host np.unpackbits + views, no device work."""
-    from nemo_tpu.models.pipeline_model import SUMMARY_PACK_LAYOUT
+    SUMMARY_PACK_LAYOUT + DIFF_PACK_LAYOUT): one host np.unpackbits +
+    views, no device work."""
+    from nemo_tpu.models.pipeline_model import DIFF_PACK_LAYOUT, SUMMARY_PACK_LAYOUT
 
+    layout = SUMMARY_PACK_LAYOUT + (DIFF_PACK_LAYOUT if with_diff else ())
     dims = {"bv": (b, v), "b": (b,), "bt": (b, t), "t": (t,)}
     flat = np.unpackbits(np.asarray(packed)).astype(bool)
     out: dict[str, np.ndarray] = {}
     ofs = 0
-    for name, key in SUMMARY_PACK_LAYOUT:
+    for name, key in layout:
         shape = dims[key]
         n = int(np.prod(shape))
         out[name] = flat[ofs : ofs + n].reshape(shape)

@@ -107,6 +107,14 @@ SUMMARY_PACK_LAYOUT = (
     ("proto_union", "t"),
 )
 
+# The diff-tail bool outputs appended to the folded transfer when
+# with_diff=True (the sidecar Analyze path; all [B,V]).
+DIFF_PACK_LAYOUT = (
+    ("diff_node_keep", "bv"),
+    ("diff_frontier_rule", "bv"),
+    ("diff_missing_goal", "bv"),
+)
+
 
 def analysis_step(
     pre: BatchArrays,
@@ -127,12 +135,12 @@ def analysis_step(
     — changing NEMO_CLOSURE_IMPL between calls takes effect instead of
     silently hitting the stale trace.
 
-    pack_out=True replaces the seven bool summary outputs with one
-    bit-packed "packed_summary" uint8 vector (SUMMARY_PACK_LAYOUT) so a
-    device behind an RPC-serialized tunnel ships one small transfer
-    instead of eight; the executor boundary unpacks
-    (backend/jax_backend.py:_unpack_summary).  Production-fused-path only
-    (with_diff must be False).
+    pack_out=True replaces the bool summary outputs (and the diff tail's,
+    when with_diff) with one bit-packed "packed_summary" uint8 vector
+    (SUMMARY_PACK_LAYOUT / DIFF_PACK_LAYOUT) so a device behind an
+    RPC-serialized tunnel ships one small transfer instead of many; the
+    device-owning boundary unpacks (backend/jax_backend.py:_unpack_summary,
+    service/server.py:_analyze_one).
 
     with_diff=False drops the differential-provenance tail (diff vs batch
     row 0) AND the num_labels dim from the compiled program — the
@@ -149,8 +157,6 @@ def analysis_step(
         from nemo_tpu.ops.adjacency import resolve_closure_impl
 
         closure_impl = resolve_closure_impl()
-    if pack_out and with_diff:
-        raise ValueError("pack_out requires with_diff=False (the fused production path)")
     return _analysis_step_jit(
         pre,
         post,
@@ -254,17 +260,6 @@ def _analysis_step_jit(
         "proto_inter": inter,
         "proto_union": union,
     }
-    if pack_out:
-        # Fuse the seven bool summary outputs into ONE bit-packed vector,
-        # INSIDE this compiled program (a separate pack dispatch would pay
-        # its own tunnel RTT).  Device->host copies over the TPU tunnel are
-        # RPC-serialized at ~RTT each regardless of size (measured ~190 ms
-        # x ~8 summary arrays per 17k-run bucket), so one 8x-smaller
-        # transfer replaces eight.  LocalExecutor._unpack_summary is the
-        # inverse; layout = SUMMARY_PACK_LAYOUT.
-        out["packed_summary"] = jnp.packbits(
-            jnp.concatenate([out.pop(name).ravel() for name, _ in SUMMARY_PACK_LAYOUT])
-        )
     if with_diff:
         # Differential provenance of every run vs the successful run in row
         # 0 (differential-provenance.go:18-243).  Label bitsets per run.
@@ -284,6 +279,19 @@ def _analysis_step_jit(
         out["diff_node_keep"] = node_keep
         out["diff_frontier_rule"] = frontier_rule
         out["diff_missing_goal"] = missing_goal
+    if pack_out:
+        # Fold every bool summary output (plus the diff tail's, when
+        # present) into ONE bit-packed vector, INSIDE this compiled program
+        # (a separate pack dispatch would pay its own tunnel RTT).
+        # Device->host copies over the TPU tunnel are RPC-serialized at
+        # ~RTT each regardless of size (measured ~190 ms x ~8 summary
+        # arrays per 17k-run bucket), so one 8x-smaller transfer replaces
+        # them all.  backend/jax_backend.py:_unpack_summary is the inverse;
+        # layout = SUMMARY_PACK_LAYOUT (+ DIFF_PACK_LAYOUT iff with_diff).
+        layout = SUMMARY_PACK_LAYOUT + (DIFF_PACK_LAYOUT if with_diff else ())
+        out["packed_summary"] = jnp.packbits(
+            jnp.concatenate([out.pop(name).ravel() for name, _ in layout])
+        )
     return out
 
 

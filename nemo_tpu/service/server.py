@@ -48,19 +48,33 @@ class _Impl:
 
         from nemo_tpu.models.pipeline_model import analysis_step
 
+        from nemo_tpu.backend.jax_backend import _pack_out_default, _unpack_summary
+
         pre = codec.batch_arrays_from_pb(request.pre)
         post = codec.batch_arrays_from_pb(request.post)
         static = codec.static_from_pb(request.static)
         t0 = time.perf_counter()
-        # This path runs with_diff=True by contract (chunks diff against
-        # their prepended good row; the client merge consumes the diff
-        # tail), so the fused verb's pack_out transfer folding does not
-        # apply — extending it here needs a diff-tail pack layout (the
-        # server-side device->host copies are the remaining unfolded
-        # transfers; the wire itself already bit-packs bools 8x).
+        # The server owns the device, so it decides the transfer folding
+        # (like LocalExecutor.run): with pack_out the program's bool
+        # outputs — including this path's diff tail — arrive as ONE
+        # bit-packed device->host copy and unpack here, before the wire
+        # codec (which bit-packs bools again for transport).  Clients are
+        # unaffected; this static never comes from the request.
+        static = dict(static, pack_out=bool(_pack_out_default()))
         out = analysis_step(pre, post, **static)
         out = jax.block_until_ready(out)
         dt = time.perf_counter() - t0
+        if "packed_summary" in out:
+            out = dict(out)
+            out.update(
+                _unpack_summary(
+                    out.pop("packed_summary"),
+                    b=int(pre.is_goal.shape[0]),
+                    v=int(static["v"]),
+                    t=int(static["num_tables"]),
+                    with_diff=True,  # this path always runs the diff tail
+                )
+            )
         return codec.outputs_to_pb(out, chunk=request.chunk, step_seconds=dt)
 
     def analyze(self, request: pb.AnalyzeRequest, context) -> pb.AnalyzeResponse:

@@ -135,6 +135,12 @@ def chunk_signature(name: str, n_probe: int, chunk_runs: int):
 
         return BatchArrays(**{f: grow(getattr(ba, f)) for f in BatchArrays.FIELDS})
 
+    # The server injects its transfer-packing choice before dispatch
+    # (server.py:_analyze_one); mirror it or the prewarmed chunk program
+    # isn't the one the stream compiles.
+    from nemo_tpu.backend.jax_backend import _pack_out_default
+
+    static = dict(static, pack_out=bool(_pack_out_default()))
     return pad_rows(pre), pad_rows(post), static
 
 

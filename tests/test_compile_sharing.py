@@ -131,8 +131,14 @@ def test_prewarm_chunk_matches_stream(tmp_path):
     warm_pre, warm_post, warm_static = chunk_signature(
         fam, n_probe=64, chunk_runs=chunk_runs
     )
+    # The client sends statics verbatim; the SERVER injects its
+    # transfer-packing choice before dispatch (server.py:_analyze_one), so
+    # the compiled signature — which chunk_signature must mirror — is the
+    # client statics plus that injection.
+    from nemo_tpu.backend.jax_backend import _pack_out_default
+
     assert {k: int(v) for k, v in warm_static.items()} == {
-        k: int(v) for k, v in static.items()
+        k: int(v) for k, v in dict(static, pack_out=_pack_out_default()).items()
     }
     for field in BatchArrays.FIELDS:
         got = np.asarray(getattr(stream_pre, field))

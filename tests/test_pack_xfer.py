@@ -72,3 +72,53 @@ def test_pipeline_byte_parity_packed_vs_not(tmp_path, monkeypatch):
     assert sorted(a) == sorted(b)
     for name in a:
         assert a[name] == b[name], f"{name} differs with transfer packing"
+
+
+def test_analysis_step_pack_with_diff_parity(tmp_path):
+    """Direct analysis_step with the diff tail: pack_out folds the diff
+    bools too (the sidecar Analyze variant) and round-trips exactly."""
+    import jax
+
+    from nemo_tpu.backend.jax_backend import _unpack_summary
+    from nemo_tpu.ingest.molly import load_molly_output
+    from nemo_tpu.models.case_studies import write_case_study
+    from nemo_tpu.models.pipeline_model import (
+        DIFF_PACK_LAYOUT,
+        analysis_step,
+        pack_molly_for_step,
+    )
+
+    d = write_case_study("pb_asynchronous", n_runs=8, seed=7, out_dir=str(tmp_path))
+    pre, post, static = pack_molly_for_step(load_molly_output(d))
+    plain = jax.block_until_ready(analysis_step(pre, post, **static))
+    packed = jax.block_until_ready(analysis_step(pre, post, **static, pack_out=True))
+    b, v = np.asarray(pre.is_goal).shape
+    got = _unpack_summary(
+        np.asarray(packed["packed_summary"]),
+        b=b, v=v, t=static["num_tables"], with_diff=True,
+    )
+    for name, _ in SUMMARY_PACK_LAYOUT + DIFF_PACK_LAYOUT:
+        np.testing.assert_array_equal(got[name], np.asarray(plain[name]), err_msg=name)
+
+
+def test_streamed_analyze_pack_parity(tmp_path, monkeypatch):
+    """The sidecar's streamed Analyze path with server-side transfer
+    packing forced on returns results identical to packing off."""
+    from nemo_tpu.models.case_studies import write_case_study
+    from nemo_tpu.service.client import analyze_dir
+    from nemo_tpu.service.server import make_server
+
+    d = write_case_study("CA-2083-hinted-handoff", n_runs=24, seed=5, out_dir=str(tmp_path))
+    results = {}
+    for flag in ("0", "1"):
+        monkeypatch.setenv("NEMO_PACK_XFER", flag)
+        server, port = make_server(port=0)
+        server.start()
+        try:
+            results[flag] = analyze_dir(f"127.0.0.1:{port}", d, chunk_runs=16)
+        finally:
+            server.stop(grace=None)
+    a, b = results["0"], results["1"]
+    assert sorted(a) == sorted(b)
+    for name in a:
+        np.testing.assert_array_equal(np.asarray(a[name]), np.asarray(b[name]), err_msg=name)
