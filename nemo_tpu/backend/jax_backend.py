@@ -111,7 +111,8 @@ def _k_giant(*args):
     pre = BatchArrays(*args[:8])
     post = BatchArrays(*args[8:16])
     pre_labels, post_labels = args[16:18]
-    v, pre_tid, post_tid, num_tables, max_depth, comp_linear, proto_depth = args[18:]
+    (v, pre_tid, post_tid, num_tables, max_depth, comp_linear, proto_depth,
+     pack_out) = args[18:]
     return giant_analysis_step(
         pre,
         post,
@@ -124,6 +125,7 @@ def _k_giant(*args):
         proto_depth=proto_depth,
         pre_labels=pre_labels,
         post_labels=post_labels,
+        pack_out=bool(pack_out),
     )
 
 
@@ -211,7 +213,8 @@ class LocalExecutor:
             tuple(f"pre_{f}" for f in _BA_FIELDS)
             + tuple(f"post_{f}" for f in _BA_FIELDS)
             + ("pre_comp_labels", "post_comp_labels"),
-            ("v", "pre_tid", "post_tid", "num_tables", "max_depth", "comp_linear", "proto_depth"),
+            ("v", "pre_tid", "post_tid", "num_tables", "max_depth", "comp_linear",
+             "proto_depth", "pack_out"),
             None,  # dict-returning, fused-compatible keys (B=1)
         ),
     }
@@ -249,7 +252,7 @@ class LocalExecutor:
         if verb not in self.VERBS:
             raise ValueError(f"unknown kernel verb {verb!r}")
         fn, array_names, param_names, out_names = self.VERBS[verb]
-        if verb == "fused" and "pack_out" not in params:
+        if verb in ("fused", "giant") and "pack_out" not in params:
             params = dict(params, pack_out=_pack_out_default())
         args = [
             (jnp.asarray(arrays[n]) if arrays.get(n) is not None else None)
@@ -277,6 +280,7 @@ class LocalExecutor:
                         v=int(params["v"]),
                         t=int(params["num_tables"]),
                         with_diff=bool(params.get("with_diff", 0)),
+                        giant=verb == "giant",
                     )
                 )
             return res
@@ -304,14 +308,26 @@ def _pack_out_default() -> int:
 
 
 def _unpack_summary(
-    packed: np.ndarray, b: int, v: int, t: int, with_diff: bool = False
+    packed: np.ndarray,
+    b: int,
+    v: int,
+    t: int,
+    with_diff: bool = False,
+    giant: bool = False,
 ) -> dict[str, np.ndarray]:
     """Inverse of the pack_out folding (models/pipeline_model.py:
-    SUMMARY_PACK_LAYOUT + DIFF_PACK_LAYOUT): one host np.unpackbits +
-    views, no device work."""
-    from nemo_tpu.models.pipeline_model import DIFF_PACK_LAYOUT, SUMMARY_PACK_LAYOUT
+    SUMMARY_PACK_LAYOUT + DIFF_PACK_LAYOUT, or GIANT_PACK_LAYOUT for the
+    giant verb): one host np.unpackbits + views, no device work."""
+    from nemo_tpu.models.pipeline_model import (
+        DIFF_PACK_LAYOUT,
+        GIANT_PACK_LAYOUT,
+        SUMMARY_PACK_LAYOUT,
+    )
 
-    layout = SUMMARY_PACK_LAYOUT + (DIFF_PACK_LAYOUT if with_diff else ())
+    if giant:
+        layout = GIANT_PACK_LAYOUT
+    else:
+        layout = SUMMARY_PACK_LAYOUT + (DIFF_PACK_LAYOUT if with_diff else ())
     dims = {"bv": (b, v), "b": (b,), "bt": (b, t), "t": (t,)}
     flat = np.unpackbits(np.asarray(packed)).astype(bool)
     out: dict[str, np.ndarray] = {}

@@ -115,6 +115,28 @@ DIFF_PACK_LAYOUT = (
     ("diff_missing_goal", "bv"),
 )
 
+# The giant verb's folded layout (parallel/giant.py): its fused-compatible
+# output set has no proto_inter/proto_union — the backend merges giant
+# prototype bitsets with the dense buckets' host-side.
+GIANT_PACK_LAYOUT = (
+    ("pre_holds", "bv"),
+    ("post_holds", "bv"),
+    ("achieved_pre", "b"),
+    ("proto_bits", "bt"),
+    ("proto_present", "bt"),
+)
+
+
+def fold_packed_summary(out: dict, layout) -> None:
+    """Replace `layout`'s bool outputs in `out` with one bit-packed
+    "packed_summary" vector, in place.  Must run INSIDE the compiled
+    program (a separate pack dispatch would pay its own tunnel RTT);
+    backend/jax_backend.py:_unpack_summary is the inverse, keyed by the
+    same layout tuple."""
+    out["packed_summary"] = jnp.packbits(
+        jnp.concatenate([out.pop(name).ravel() for name, _ in layout])
+    )
+
 
 def analysis_step(
     pre: BatchArrays,
@@ -280,17 +302,12 @@ def _analysis_step_jit(
         out["diff_frontier_rule"] = frontier_rule
         out["diff_missing_goal"] = missing_goal
     if pack_out:
-        # Fold every bool summary output (plus the diff tail's, when
-        # present) into ONE bit-packed vector, INSIDE this compiled program
-        # (a separate pack dispatch would pay its own tunnel RTT).
         # Device->host copies over the TPU tunnel are RPC-serialized at
         # ~RTT each regardless of size (measured ~190 ms x ~8 summary
-        # arrays per 17k-run bucket), so one 8x-smaller transfer replaces
-        # them all.  backend/jax_backend.py:_unpack_summary is the inverse;
-        # layout = SUMMARY_PACK_LAYOUT (+ DIFF_PACK_LAYOUT iff with_diff).
-        layout = SUMMARY_PACK_LAYOUT + (DIFF_PACK_LAYOUT if with_diff else ())
-        out["packed_summary"] = jnp.packbits(
-            jnp.concatenate([out.pop(name).ravel() for name, _ in layout])
+        # arrays per 17k-run bucket), so one 8x-smaller folded transfer
+        # replaces them all.
+        fold_packed_summary(
+            out, SUMMARY_PACK_LAYOUT + (DIFF_PACK_LAYOUT if with_diff else ())
         )
     return out
 

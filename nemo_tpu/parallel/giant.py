@@ -170,8 +170,15 @@ def giant_analysis_step(
     mesh: Mesh | None = None,
     pre_labels=None,
     post_labels=None,
+    pack_out: bool = False,
 ) -> dict[str, jnp.ndarray]:
     """Fused-step-compatible outputs for ONE giant run (B=1 batches).
+
+    pack_out=True folds the bool summary outputs into one bit-packed
+    "packed_summary" vector (models/pipeline_model.py:GIANT_PACK_LAYOUT)
+    inside the compiled program — same transfer-folding rationale as the
+    dense fused step (the device tunnel serializes each device->host copy
+    at ~an RTT); backend/jax_backend.py:_unpack_summary inverts it.
 
     pre/post: models.pipeline_model.BatchArrays with leading dim 1.
     comp_linear/proto_depth/labels come from giant_plan (host-side O(E));
@@ -206,6 +213,7 @@ def giant_analysis_step(
         # would recompile identical programs at tens of seconds each.
         comp_linear,
         proto_depth,
+        pack_out,
     )
     # Label strategy, in order of preference:
     #   doubling  verified-linear chains, O(V log V) on device
@@ -267,6 +275,13 @@ def giant_analysis_step(
             out["proto_present"] = all_rule_bits(
                 post.is_goal, alive2["post"], post.table_id, num_tables
             )
+            if pack_out:
+                from nemo_tpu.models.pipeline_model import (
+                    GIANT_PACK_LAYOUT,
+                    fold_packed_summary,
+                )
+
+                fold_packed_summary(out, GIANT_PACK_LAYOUT)
             return out
 
         _JIT_CACHE[key] = fn

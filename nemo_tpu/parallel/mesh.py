@@ -77,8 +77,13 @@ def run_step_sharded(
     post_s = shard_arrays(mesh, post_s, spec)
     # closure_impl is pinned to the partitionable XLA einsum chain: GSPMD
     # cannot shard through a Mosaic pallas_call, so the fused pallas closure
-    # is single-device-only (ops/adjacency.py:closure).
-    out = analysis_step(pre_s, post_s, **{**static, "closure_impl": "xla"})
+    # is single-device-only (ops/adjacency.py:closure).  pack_out is forced
+    # OFF: the transfer folding targets a serialized device tunnel, which
+    # the multi-chip path doesn't have, and the un-pad slice below would
+    # corrupt a 1-D packed vector (it assumes a leading run axis).
+    out = analysis_step(
+        pre_s, post_s, **{**static, "closure_impl": "xla", "pack_out": False}
+    )
     # Un-pad only the outputs whose leading axis is the run axis; corpus-level
     # outputs (proto_inter/proto_union over the table axis) pass through.
     corpus_level = {"proto_inter", "proto_union"}

@@ -122,3 +122,43 @@ def test_streamed_analyze_pack_parity(tmp_path, monkeypatch):
     assert sorted(a) == sorted(b)
     for name in a:
         np.testing.assert_array_equal(np.asarray(a[name]), np.asarray(b[name]), err_msg=name)
+
+
+def test_giant_verb_pack_parity(tmp_path):
+    """The giant verb with transfer packing forced on matches packing off
+    bit-for-bit across its fused-compatible output set."""
+    from nemo_tpu.backend.jax_backend import _verb_arrays
+    from nemo_tpu.graphs.packed import CorpusVocab, bucket_size, pack_batch, pack_graph
+    from nemo_tpu.ingest.molly import load_molly_output
+    from nemo_tpu.models.case_studies import write_case_study
+    from nemo_tpu.parallel.giant import giant_plan
+
+    d = write_case_study("ZK-1270-racing-sent-flag", n_runs=2, seed=4, out_dir=str(tmp_path))
+    molly = load_molly_output(d)
+    vocab = CorpusVocab()
+    gpre = pack_graph(molly.runs[0].pre_prov, vocab)
+    gpost = pack_graph(molly.runs[0].post_prov, vocab)
+    v = bucket_size(max(gpre.n_nodes, gpost.n_nodes))
+    e = bucket_size(max(1, len(gpre.edges), len(gpost.edges)))
+    pre_b = pack_batch([0], [gpre], v, e)
+    post_b = pack_batch([0], [gpost], v, e)
+    lin_pre, depth_pre, _ = giant_plan(gpre)
+    lin_post, depth_post, _ = giant_plan(gpost)
+    params = dict(
+        v=v,
+        pre_tid=vocab.tables.lookup("pre"),
+        post_tid=vocab.tables.lookup("post"),
+        num_tables=bucket_size(len(vocab.tables), 8),
+        max_depth=max(pre_b.max_depth, post_b.max_depth),
+        comp_linear=int(lin_pre and lin_post),
+        proto_depth=max(depth_pre, depth_post),
+    )
+    ex = LocalExecutor()
+    arrays = _verb_arrays(pre_b, post_b)
+    plain = ex.run("giant", arrays, dict(params, pack_out=0))
+    packed = ex.run("giant", arrays, dict(params, pack_out=1))
+    assert sorted(plain) == sorted(packed)
+    for name in plain:
+        np.testing.assert_array_equal(
+            np.asarray(plain[name]), np.asarray(packed[name]), err_msg=name
+        )
