@@ -192,10 +192,13 @@ class JsonParser {
     return out;
   }
 
+  static constexpr size_t kObjIndexThreshold = 16;
+
   JVal object() {
     expect('{');
     JVal v;
     v.type = JVal::OBJ;
+    std::unordered_map<std::string, size_t> key_index;
     ws();
     if (peek() == '}') { ++p_; return v; }
     while (true) {
@@ -207,10 +210,27 @@ class JsonParser {
       // Duplicate keys are last-wins, matching Python json.loads (the
       // parity reference for both the prov and head serializers); the
       // key keeps its first position like dict insertion order does.
+      // Small objects take the linear scan; wide ones (model "tables"
+      // at stress scale) switch to a key->index map so each insert
+      // stays O(1) instead of O(k) (ADVICE r4 #4).
       bool replaced = false;
-      for (auto& kv : v.obj)
-        if (kv.first == key) { kv.second = std::move(val); replaced = true; break; }
-      if (!replaced) v.obj.emplace_back(std::move(key), std::move(val));
+      if (v.obj.size() < kObjIndexThreshold) {
+        for (auto& kv : v.obj)
+          if (kv.first == key) { kv.second = std::move(val); replaced = true; break; }
+      } else {
+        if (key_index.empty())  // built lazily on first wide lookup
+          for (size_t i = 0; i < v.obj.size(); ++i)
+            key_index.emplace(v.obj[i].first, i);
+        auto it = key_index.find(key);
+        if (it != key_index.end()) {
+          v.obj[it->second].second = std::move(val);
+          replaced = true;
+        }
+      }
+      if (!replaced) {
+        if (!key_index.empty()) key_index.emplace(key, v.obj.size());
+        v.obj.emplace_back(std::move(key), std::move(val));
+      }
       ws();
       if (peek() == ',') { ++p_; continue; }
       expect('}');

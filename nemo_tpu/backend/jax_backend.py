@@ -15,6 +15,7 @@ oracle backend (analysis/queries.py).
 from __future__ import annotations
 
 import os
+import warnings
 from functools import partial
 
 import jax
@@ -301,9 +302,20 @@ def _pack_out_default() -> int:
     Resolved by the process that OWNS the device (the sidecar server, or
     the in-process backend) — remote clients never send it.
     NEMO_PACK_XFER=0/1 overrides."""
-    env = os.environ.get("NEMO_PACK_XFER", "")
+    env = os.environ.get("NEMO_PACK_XFER", "").strip().lower()
     if env:
-        return int(env)
+        # Accept the usual boolean spellings; an unrecognized value falls
+        # through to the backend default rather than raising at dispatch
+        # time inside the executor/server/prewarm (ADVICE r4 #1).
+        if env in ("1", "true", "yes", "on"):
+            return 1
+        if env in ("0", "false", "no", "off"):
+            return 0
+        warnings.warn(
+            f"NEMO_PACK_XFER={env!r} is not a recognized boolean; "
+            "using the backend default",
+            stacklevel=2,
+        )
     return int(jax.default_backend() != "cpu")
 
 

@@ -97,12 +97,30 @@ class CorpusHandle:
     def prov_json(self, cond: int, run: int) -> bytes:
         if self._h is None:
             raise RuntimeError("native corpus handle already closed")
-        return self._lib.nemo_prov_json(self._h, cond, run)
+        out = self._lib.nemo_prov_json(self._h, cond, run)
+        if not out:
+            # Same guard as run_head_json: the C side returns "" for an
+            # out-of-range row, and splicing that into debugging.json
+            # would emit malformed output with no error.
+            raise RuntimeError(
+                f"no serialized provenance for cond {cond} run row {run} "
+                "(row out of range)"
+            )
+        return out
 
     def run_head_json(self, run: int) -> bytes:
         if self._h is None:
             raise RuntimeError("native corpus handle already closed")
-        return self._lib.nemo_run_head_json(self._h, run)
+        out = self._lib.nemo_run_head_json(self._h, run)
+        if not out:
+            # The C side returns "" for an out-of-range row or a handle
+            # ingested without heads; splicing that into debugging.json
+            # would emit malformed output with no error (ADVICE r4 #3).
+            raise RuntimeError(
+                f"no head fragment for run row {run} "
+                "(row out of range, or corpus ingested without heads)"
+            )
+        return out
 
     def node_ids(self, cond: int, run: int) -> list[str]:
         if self._h is None:

@@ -72,7 +72,10 @@ class _Impl:
                     b=int(pre.is_goal.shape[0]),
                     v=int(static["v"]),
                     t=int(static["num_tables"]),
-                    with_diff=True,  # this path always runs the diff tail
+                    # Derive from the same dict used for dispatch so the
+                    # packed layout and the unpack can never diverge if the
+                    # codec ever starts carrying with_diff (ADVICE r4 #2).
+                    with_diff=bool(static.get("with_diff", True)),
                 )
             )
         return codec.outputs_to_pb(out, chunk=request.chunk, step_seconds=dt)

@@ -400,3 +400,30 @@ def test_run_head_random_json_fuzz(tmp_path):
                        with_node_ids=False, keep_handle=True)
     for i, raw in enumerate(runs):
         assert nc.run_head_json(i).decode() == _py_head(raw), f"run {i}: {raw}"
+
+
+def test_run_head_json_empty_result_raises(tmp_path):
+    """An out-of-range row (or a wide duplicate-keyed object, exercising the
+    indexed last-wins path) must never silently return b'' — splicing an
+    empty fragment would emit malformed debugging.json (ADVICE r4 #3/#4)."""
+    # Wide object with >16 keys incl. a duplicate: last-wins via the
+    # key-index fallback must match Python json.loads.
+    tables = {f"t{i:02d}": [[str(i)]] for i in range(20)}
+    runs = [{"iteration": 0, "status": "success",
+             "model": {"tables": tables}}]
+    raw = json.dumps(runs)
+    dup = raw.replace('"t19": [["19"]]', '"t00": [["dup"]], "t19": [["19"]]', 1)
+    root = tmp_path / "widehead"
+    os.makedirs(root)
+    with open(root / "runs.json", "w") as f:
+        f.write(dup)
+    prov = {"goals": [], "rules": [], "edges": []}
+    for c in ("pre", "post"):
+        with open(root / f"run_0_{c}_provenance.json", "w") as f:
+            json.dump(prov, f)
+    nc = ingest_native(str(root), with_node_ids=False, keep_handle=True)
+    expected = _py_head(json.loads(dup)[0])
+    assert nc.run_head_json(0).decode() == expected
+    assert '"t00": [["dup"]]' in expected
+    with pytest.raises(RuntimeError, match="head fragment"):
+        nc.handle.run_head_json(99)

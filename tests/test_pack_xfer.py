@@ -162,3 +162,24 @@ def test_giant_verb_pack_parity(tmp_path):
         np.testing.assert_array_equal(
             np.asarray(plain[name]), np.asarray(packed[name]), err_msg=name
         )
+
+
+def test_pack_out_default_env_parsing(monkeypatch):
+    """NEMO_PACK_XFER accepts boolean spellings; junk falls back to the
+    backend default with a warning instead of raising at dispatch time
+    inside the executor/server/prewarm (ADVICE r4 #1)."""
+    import warnings
+
+    from nemo_tpu.backend.jax_backend import _pack_out_default
+
+    for v, want in (("1", 1), ("true", 1), ("YES", 1), ("on", 1),
+                    ("0", 0), ("false", 0), ("No", 0), ("off", 0)):
+        monkeypatch.setenv("NEMO_PACK_XFER", v)
+        assert _pack_out_default() == want, v
+    monkeypatch.setenv("NEMO_PACK_XFER", "banana")
+    import jax
+    default = int(jax.default_backend() != "cpu")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert _pack_out_default() == default
+    assert any("NEMO_PACK_XFER" in str(x.message) for x in w)
