@@ -44,6 +44,17 @@ class ServiceBackend(JaxBackend):
             self.executor = RemoteExecutor(target=self.target)
         super().init_graph_db(conn, molly)
 
+    def _resolve_giant_impl(self) -> str:
+        """Giant crossover routing (VERDICT r4 task 2): "auto" keeps the
+        Kernel RPC — the sidecar owns the accelerator, so the client's own
+        jax platform is the wrong crossover signal.  Only an explicit
+        NEMO_GIANT_IMPL=host routes the exact sparse analysis client-side
+        (useful when the sidecar itself is known to be CPU-bound)."""
+        from nemo_tpu.backend.jax_backend import _giant_impl_env
+
+        impl = _giant_impl_env()
+        return "device" if impl == "auto" else impl
+
     def close_db(self) -> None:
         super().close_db()
         if not isinstance(self.executor, _Unconnected):
@@ -56,3 +67,4 @@ class _Unconnected:
 
     def run(self, verb, arrays, params):
         raise RuntimeError("ServiceBackend is not connected; call init_graph_db first")
+

@@ -315,3 +315,190 @@ def giant_analysis_step(
         jax.device_put(jnp.asarray(pre_labels, dtype=jnp.int32), spec_node),
         jax.device_put(jnp.asarray(post_labels, dtype=jnp.int32), spec_node),
     )
+
+
+def giant_analysis_host(
+    pre,
+    post,
+    pre_tid: int,
+    post_tid: int,
+    num_tables: int,
+    pre_labels,
+    post_labels,
+) -> dict:
+    """Exact sparse HOST mirror of giant_analysis_step (VERDICT r4 task 2).
+
+    Same inputs (B=1 PackedBatch pair + giant_plan's padded union-find
+    label planes), same output keys/shapes/dtypes — but every kernel runs
+    as O(V + E) numpy edge-list scatters and fix-point BFS instead of
+    dense [V,V] device work.  This is the crossover target for the giant
+    dispatch: on a CPU fallback the dense node-sharded path is 5-6x
+    SLOWER than the sequential oracle (BENCH_r04: 87.4 s vs 14.3 s for
+    the 10k-node run), while this path does the same analysis in
+    milliseconds; on the TPU the dense path wins 10-14x vs the oracle
+    and stays the default (backend/jax_backend.py:_giant_impl_default).
+
+    Exactness notes (vs the bounded device kernels):
+      * BFS sweeps run to fix point, so no depth bound is needed;
+      * component labels are giant_plan's exact union-find labels — the
+        same partition the device uses in "host"-label mode, and the same
+        reps (min head index per component) in "doubling" mode;
+      * the dense [V,V] adj_clean planes are materialized host-side only
+        here (downstream row-gathers and figure rendering index them the
+        same way they index the device gathers).
+
+    Reference semantics: markConditionHolds (pre-post-prov.go:220-243),
+    clean-copy + collapseNextChains (preprocessing.go:17-345),
+    extractProtos (prototype.go:11-24) — via the array forms in
+    ops/condition.py, ops/simplify.py, ops/proto.py.
+    """
+    import numpy as np
+
+    from nemo_tpu.graphs.packed import TYPE_COLLAPSED, TYPE_NEXT
+    from nemo_tpu.ops.proto import DEPTH_INF
+
+    out: dict = {}
+    alive_clean: dict = {}
+    coll_edges: dict = {}
+    labs = {"pre": pre_labels, "post": post_labels}
+
+    for name, b, tid in (("pre", pre, pre_tid), ("post", post, post_tid)):
+        v = b.v
+        idx = np.arange(v)
+        is_goal = np.asarray(b.is_goal[0])
+        node_mask = np.asarray(b.node_mask[0])
+        table = np.asarray(b.table_id[0]).astype(np.int64)
+        type_id = np.asarray(b.type_id[0]).astype(np.int32)
+        em = np.asarray(b.edge_mask[0]).astype(bool)
+        src = np.asarray(b.edge_src[0])[em].astype(np.int64)
+        dst = np.asarray(b.edge_dst[0])[em].astype(np.int64)
+
+        def scat_any(at, vals, v=v):
+            """bool [v]: any val scattered to index (bincount beats
+            ufunc.at by orders of magnitude at giant E)."""
+            return np.bincount(at[vals], minlength=v) > 0
+
+        goal = is_goal & node_mask
+
+        # --- condition marking (ops/condition.py:mark_condition_holds)
+        indeg = scat_any(dst, np.ones(len(dst), dtype=bool))
+        root = goal & (table == tid) & ~indeg
+        rule = scat_any(dst, root[src]) & ~is_goal & node_mask & (table == tid)
+        trig = scat_any(dst, rule[src]) & is_goal & node_mask
+        any_trig = bool(trig.any())
+        trig_tables = np.zeros(num_tables, dtype=bool)
+        tt = table[trig]
+        trig_tables[np.clip(tt, 0, num_tables - 1)[tt >= 0]] = True
+        in_trig_table = trig_tables[np.clip(table, 0, num_tables - 1)] & (table >= 0)
+        holds = goal & any_trig & ((table == tid) | in_trig_table)
+        out[f"{name}_holds"] = holds[None]
+
+        # --- clean-copy restriction (ops/simplify.py:clean_masks)
+        has_in_goal = scat_any(dst, goal[src])
+        has_out_goal = scat_any(src, goal[dst])
+        is_rule = ~is_goal & node_mask
+        alive = goal | (is_rule & has_in_goal & has_out_goal)
+        keep = np.where(goal[src], has_out_goal[dst], has_in_goal[src])
+        keep &= alive[src] & alive[dst]
+        ksrc, kdst = src[keep], dst[keep]
+
+        # --- chain contraction (ops/simplify.py:collapse_chains)
+        next_rule = is_rule & alive & (type_id == TYPE_NEXT)
+        in_from_next = scat_any(kdst, next_rule[ksrc])
+        out_to_next = scat_any(ksrc, next_rule[kdst])
+        member = next_rule | (goal & alive & in_from_next & out_to_next)
+        lab = np.where(member, np.asarray(labs[name][0]).astype(np.int64), v)
+        in_from_member = scat_any(kdst, member[ksrc])
+        out_to_member = scat_any(ksrc, member[kdst])
+        head = next_rule & ~in_from_member
+        tail = next_rule & ~out_to_member
+
+        rep_per_comp = np.full(v, v, dtype=np.int64)
+        hm = member & head
+        np.minimum.at(rep_per_comp, np.clip(lab[hm], 0, v - 1), idx[hm])
+        nm = member & next_rule
+        n_rules_per_comp = np.bincount(np.clip(lab[nm], 0, v - 1), minlength=v)
+        collapsible_comp = (n_rules_per_comp >= 2) & (rep_per_comp < v)
+        lab_c = np.clip(lab, 0, v - 1)
+        node_collapsible = member & collapsible_comp[lab_c]
+        rep_of_node = np.where(node_collapsible, rep_per_comp[lab_c], idx)
+        is_rep = node_collapsible & (idx == rep_of_node)
+        dies = node_collapsible & ~is_rep
+        ext_goal = goal & alive & ~member
+
+        survive = ~node_collapsible[ksrc] & ~node_collapsible[kdst]
+        pred_sel = ext_goal[ksrc] & (head & node_collapsible)[kdst]
+        succ_sel = (tail & node_collapsible)[ksrc] & ext_goal[kdst]
+        new_src = np.concatenate(
+            [ksrc[survive], ksrc[pred_sel], rep_of_node[ksrc[succ_sel]]]
+        )
+        new_dst = np.concatenate(
+            [kdst[survive], rep_of_node[kdst[pred_sel]], kdst[succ_sel]]
+        )
+        alive_new = alive & ~dies
+        type_new = np.where(is_rep, TYPE_COLLAPSED, type_id).astype(type_id.dtype)
+        adj_new = np.zeros((v, v), dtype=bool)
+        adj_new[new_src, new_dst] = True
+        out[f"{name}_adj_clean"] = adj_new[None]
+        out[f"{name}_alive"] = alive_new[None]
+        out[f"{name}_type"] = type_new[None]
+        alive_clean[name] = alive_new
+        coll_edges[name] = (new_src, new_dst, is_goal, table)
+
+    achieved = bool(out["pre_holds"].any())
+    out["achieved_pre"] = np.array([achieved])
+
+    # --- prototype bits on the collapsed consequent (ops/proto.py)
+    v = post.v
+    asrc, adst, is_goal_p, table_p = coll_edges["post"]
+    alive2 = alive_clean["post"]
+    ok = alive2[asrc] & alive2[adst]
+    asrc, adst = asrc[ok], adst[ok]
+
+    def scat_any_p(at, vals):
+        return np.bincount(at[vals], minlength=v) > 0
+
+    def bfs_any(start, forward: bool) -> "np.ndarray":
+        """Nodes reachable from `start` in >= 1 hop; exact fix point."""
+        s, d = (asrc, adst) if forward else (adst, asrc)
+        reach = np.zeros(v, dtype=bool)
+        frontier = start
+        while True:
+            nxt = scat_any_p(d, frontier[s]) & ~reach
+            if not nxt.any():
+                return reach
+            reach |= nxt
+            frontier = nxt
+
+    indeg2 = scat_any_p(adst, np.ones(len(adst), dtype=bool))
+    root2 = is_goal_p & alive2 & ~indeg2
+    is_rule2 = ~is_goal_p & alive2
+    reach = bfs_any(root2, forward=True)
+    rule_desc = bfs_any(is_rule2, forward=False)
+    rule_anc = bfs_any(is_rule2 & reach, forward=True)
+    qualify = is_rule2 & reach & (rule_desc | rule_anc) & achieved
+
+    depth = np.full(v, DEPTH_INF, dtype=np.int64)
+    depth[root2] = 0
+    frontier, d = root2, 0
+    while frontier.any():
+        d += 1
+        nxt = scat_any_p(adst, frontier[asrc]) & (depth == DEPTH_INF)
+        depth[nxt] = d
+        frontier = nxt
+    rule_depth = (depth + 1) // 2
+
+    bits = np.zeros(num_tables, dtype=bool)
+    min_depth = np.full(num_tables, DEPTH_INF, dtype=np.int64)
+    qt = np.clip(table_p[qualify], 0, num_tables - 1)
+    qok = table_p[qualify] >= 0
+    bits[qt[qok]] = True
+    np.minimum.at(min_depth, qt[qok], rule_depth[qualify][qok])
+    present = np.zeros(num_tables, dtype=bool)
+    pm = is_rule2 & (table_p >= 0)
+    present[np.clip(table_p[pm], 0, num_tables - 1)] = True
+
+    out["proto_bits"] = bits[None]
+    out["proto_min_depth"] = min_depth.astype(np.int32)[None]
+    out["proto_present"] = present[None]
+    return out
