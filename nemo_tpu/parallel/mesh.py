@@ -70,20 +70,36 @@ def run_step_sharded(
     differential-provenance.go:26) is needed by all shards; XLA inserts the
     broadcast of that slice plus the all-reduces for the prototype
     intersection/union automatically from the sharding annotations.
-    """
+
+    pack_out (VERDICT r4 task 3): the transfer folding WORKS under
+    sharding — jnp.packbits of the concatenated summary ravel makes GSPMD
+    all-gather the (tiny, bit-packed) shards into one replicated vector,
+    so the host still pays ONE device->host copy per step instead of one
+    per output array; the run-axis un-pad happens host-side after the
+    unpack (the padded batch size is the unpack's b), which is why the
+    old in-jit layout couldn't be row-sliced directly.  The static dict's
+    pack_out flag is honored; only closure_impl is overridden (GSPMD
+    cannot shard through a Mosaic pallas_call)."""
     pre_s, n_real = pad_batch_rows(pre, mesh.devices.size)
     post_s, _ = pad_batch_rows(post, mesh.devices.size)
+    b_pad = pre_s.is_goal.shape[0]
     pre_s = shard_arrays(mesh, pre_s, spec)
     post_s = shard_arrays(mesh, post_s, spec)
-    # closure_impl is pinned to the partitionable XLA einsum chain: GSPMD
-    # cannot shard through a Mosaic pallas_call, so the fused pallas closure
-    # is single-device-only (ops/adjacency.py:closure).  pack_out is forced
-    # OFF: the transfer folding targets a serialized device tunnel, which
-    # the multi-chip path doesn't have, and the un-pad slice below would
-    # corrupt a 1-D packed vector (it assumes a leading run axis).
-    out = analysis_step(
-        pre_s, post_s, **{**static, "closure_impl": "xla", "pack_out": False}
-    )
+    pack_out = bool(static.get("pack_out", False))
+    out = analysis_step(pre_s, post_s, **{**static, "closure_impl": "xla"})
+    if pack_out and "packed_summary" in out:
+        from nemo_tpu.backend.jax_backend import _unpack_summary
+
+        out = dict(out)
+        out.update(
+            _unpack_summary(
+                out.pop("packed_summary"),
+                b=b_pad,
+                v=int(static["v"]),
+                t=int(static["num_tables"]),
+                with_diff=bool(static.get("with_diff", True)),
+            )
+        )
     # Un-pad only the outputs whose leading axis is the run axis; corpus-level
     # outputs (proto_inter/proto_union over the table axis) pass through.
     corpus_level = {"proto_inter", "proto_union"}
