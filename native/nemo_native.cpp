@@ -78,6 +78,25 @@ class JsonParser {
  private:
   const std::string& t_;
   size_t p_ = 0;
+  int depth_ = 0;
+  // Recursion guard: the parser is recursive-descent, so adversarial
+  // nesting ("[[[[..." at megabyte scale) would otherwise overflow the C
+  // stack — a crash, not a clean RuntimeError, on the trust boundary.
+  // 256 is ~10x deeper than any real Molly output.  DELIBERATE one-sided
+  // strictness vs the Python loader (like the int32 iteration bound):
+  // json.loads accepts up to ~sys.getrecursionlimit() (~1000, and
+  // caller-stack-dependent), so depths 257..~1000 are a loud native
+  // reject where Python happens to accept — pinned by
+  // tests/test_native_malformed.py:test_depth_limit_divergence_is_loud.
+  static constexpr int kMaxDepth = 256;
+
+  struct DepthGuard {
+    JsonParser* p;
+    explicit DepthGuard(JsonParser* parser) : p(parser) {
+      if (++p->depth_ > kMaxDepth) p->fail("nesting too deep");
+    }
+    ~DepthGuard() { --p->depth_; }
+  };
 
   [[noreturn]] void fail(const char* msg) {
     throw std::runtime_error("JSON parse error at byte " + std::to_string(p_) + ": " + msg);
@@ -121,12 +140,28 @@ class JsonParser {
   }
 
   JVal number() {
+    // Strict JSON grammar -?(0|[1-9]\d*)(\.\d+)?([eE][+-]?\d+)?, matching
+    // json.loads: the earlier lenient scan accepted "3-", "1.2.3", "01" —
+    // inputs the Python loader rejects (trust-boundary parity).
     size_t start = p_;
     if (peek() == '-') ++p_;
-    while (p_ < t_.size() && (std::isdigit((unsigned char)t_[p_]) || t_[p_] == '.' ||
-                              t_[p_] == 'e' || t_[p_] == 'E' || t_[p_] == '+' || t_[p_] == '-'))
+    if (p_ >= t_.size() || !std::isdigit((unsigned char)t_[p_])) fail("bad number");
+    if (t_[p_] == '0') {
       ++p_;
-    if (p_ == start) fail("bad number");
+    } else {
+      while (p_ < t_.size() && std::isdigit((unsigned char)t_[p_])) ++p_;
+    }
+    if (p_ < t_.size() && t_[p_] == '.') {
+      ++p_;
+      if (p_ >= t_.size() || !std::isdigit((unsigned char)t_[p_])) fail("bad number");
+      while (p_ < t_.size() && std::isdigit((unsigned char)t_[p_])) ++p_;
+    }
+    if (p_ < t_.size() && (t_[p_] == 'e' || t_[p_] == 'E')) {
+      ++p_;
+      if (p_ < t_.size() && (t_[p_] == '+' || t_[p_] == '-')) ++p_;
+      if (p_ >= t_.size() || !std::isdigit((unsigned char)t_[p_])) fail("bad number");
+      while (p_ < t_.size() && std::isdigit((unsigned char)t_[p_])) ++p_;
+    }
     JVal v;
     v.type = JVal::NUM;
     v.s = t_.substr(start, p_ - start);
@@ -140,6 +175,7 @@ class JsonParser {
       if (p_ >= t_.size()) fail("unterminated string");
       char c = t_[p_++];
       if (c == '"') break;
+      if ((unsigned char)c < 0x20) fail("control character in string");
       if (c == '\\') {
         if (p_ >= t_.size()) fail("bad escape");
         char e = t_[p_++];
@@ -154,6 +190,8 @@ class JsonParser {
           case 't': out += '\t'; break;
           case 'u': {
             if (p_ + 4 > t_.size()) fail("bad \\u escape");
+            for (size_t h = 0; h < 4; ++h)
+              if (!std::isxdigit((unsigned char)t_[p_ + h])) fail("bad \\u escape");
             unsigned cp = (unsigned)std::strtoul(t_.substr(p_, 4).c_str(), nullptr, 16);
             p_ += 4;
             // Surrogate pair.
@@ -195,6 +233,7 @@ class JsonParser {
   static constexpr size_t kObjIndexThreshold = 16;
 
   JVal object() {
+    DepthGuard guard(this);
     expect('{');
     JVal v;
     v.type = JVal::OBJ;
@@ -240,6 +279,7 @@ class JsonParser {
   }
 
   JVal array() {
+    DepthGuard guard(this);
     expect('[');
     JVal v;
     v.type = JVal::ARR;
@@ -455,108 +495,112 @@ void append_jval(std::string& out, const JVal& v) {
 // bits; leading zeros/'+' normalized away).  Tokens with '.'/'e'/'E' go
 // through strtod + truncation toward zero, matching int(float) for every
 // value a double represents exactly.  BOOL -> 0/1, absent/other -> dflt.
+// Untrusted bytes destined for an error message: decoded strings can hold
+// WTF-8 (lone \u surrogates) or get cut mid-codepoint, and the Python side
+// decodes the error buffer as UTF-8 — so ship printable ASCII only.
+std::string err_snippet(const std::string& s, size_t max_len = 40) {
+  std::string out;
+  for (size_t i = 0; i < s.size() && out.size() < max_len; ++i) {
+    unsigned char c = (unsigned char)s[i];
+    out += (c >= 0x20 && c < 0x7F) ? (char)c : '?';
+  }
+  return out;
+}
+
+[[noreturn]] void py_reject(const std::string& what) {
+  // Mirrors a Python-loader exception (TypeError/ValueError/OverflowError
+  // in the datatypes from_json path): the packed-first ETL must reject
+  // exactly the inputs the object path rejects (VERDICT r4 task 4).
+  throw std::runtime_error("schema error (python-loader parity): " + what);
+}
+
 std::string coerce_int_str(const JVal* v, long dflt) {
-  if (v && (v->type == JVal::NUM || v->type == JVal::STR)) {
-    // Python int(str) strips whitespace and allows single underscores
-    // between digits; mirror the ASCII-whitespace strip and underscores
-    // for string values.  (JSON NUM tokens can contain neither.)
-    // Remaining known divergences, both Python-accepted forms this
-    // rejects to the default: non-ASCII unicode digits and
-    // unicode-whitespace padding (e.g. NBSP) — schema-invalid for Molly
-    // (Go json marshaling never emits them) and out of parity scope.
-    std::string s = v->s;
-    size_t b = 0, e2 = s.size();
-    while (b < e2 && std::isspace((unsigned char)s[b])) ++b;
-    while (e2 > b && std::isspace((unsigned char)s[e2 - 1])) --e2;
-    s = s.substr(b, e2 - b);
-    size_t i = 0;
-    bool neg = false;
-    if (i < s.size() && (s[i] == '+' || s[i] == '-')) neg = s[i++] == '-';
-    std::string digits;
-    bool ok = i < s.size();
-    bool prev_digit = false;
-    for (; i < s.size(); ++i) {
-      if (std::isdigit((unsigned char)s[i])) {
-        digits += s[i];
-        prev_digit = true;
-      } else if (s[i] == '_' && prev_digit && i + 1 < s.size() &&
-                 std::isdigit((unsigned char)s[i + 1])) {
-        prev_digit = false;  // single separator between digits
-      } else {
-        ok = false;
-        break;
-      }
-    }
-    if (ok && !digits.empty()) {
-      size_t nz = 0;
-      while (nz + 1 < digits.size() && digits[nz] == '0') ++nz;  // keep lone "0"
-      std::string out = digits.substr(nz);
-      if (neg && out != "0") out.insert(out.begin(), '-');
-      return out;
-    }
-    // Gate strtod behind a JSON-decimal shape check: strtod also accepts
-    // hex ("0x10"), "inf"/"nan" — forms Python int() rejects.  Where
-    // Python raises (non-numeric strings, hex), the packed path is
-    // deliberately LENIENT and emits the default instead of failing the
-    // whole corpus; that divergence is one-sided (the object path crashes,
-    // so there is no reference output to mismatch).
-    bool decimal = true;
-    {
-      size_t j = 0;
-      if (j < s.size() && (s[j] == '+' || s[j] == '-')) ++j;
-      bool any = false;
-      while (j < s.size() && std::isdigit((unsigned char)s[j])) { ++j; any = true; }
-      if (j < s.size() && s[j] == '.') {
-        ++j;
-        while (j < s.size() && std::isdigit((unsigned char)s[j])) { ++j; any = true; }
-      }
-      if (any && j < s.size() && (s[j] == 'e' || s[j] == 'E')) {
-        ++j;
-        if (j < s.size() && (s[j] == '+' || s[j] == '-')) ++j;
-        bool exp_digit = false;
-        while (j < s.size() && std::isdigit((unsigned char)s[j])) { ++j; exp_digit = true; }
-        if (!exp_digit) decimal = false;
-      }
-      if (!any || j != s.size()) decimal = false;
-    }
-    // Locale-independent parse with full-consumption check: strtod honors
-    // LC_NUMERIC (a host app setting de_DE would stop at '.'), while
-    // from_chars always uses the JSON radix.  FP from_chars needs
-    // libstdc++ >= GCC 11; older toolchains (this library self-compiles on
-    // the user's machine) fall back to strtod with the radix character
-    // swapped to whatever the active locale expects.
-    double d = 0.0;
-    bool parsed = false;
-    if (decimal) {
-      // Neither parser accepts a leading '+' the way Python float() does.
-      std::string t = (!s.empty() && s[0] == '+') ? s.substr(1) : s;
-#if defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L
-      auto res = std::from_chars(t.data(), t.data() + t.size(), d,
-                                 std::chars_format::general);
-      parsed = res.ec == std::errc() && res.ptr == t.data() + t.size();
-#else
-      const char* radix = std::localeconv()->decimal_point;
-      if (radix && radix[0] && radix[0] != '.')
-        for (char& ch : t)
-          if (ch == '.') ch = radix[0];
-      char* end = nullptr;
-      d = std::strtod(t.c_str(), &end);
-      parsed = end == t.c_str() + t.size();
-#endif
-    }
-    if (parsed && std::isfinite(d)) {
-      // %.0f prints the double's exact integer value at any magnitude
-      // (doubles >= 2^53 are integral), matching Python int(float) even
-      // beyond the long long range where a cast would be UB.
-      double t = std::trunc(d);
-      char buf[512];
-      std::snprintf(buf, sizeof buf, "%.0f", t);
-      // %.0f spells negative zero "-0"; Python int(-0.4) prints "0".
-      return (buf[0] == '-' && buf[1] == '0' && buf[2] == '\0') ? "0" : buf;
+  if (!v) return std::to_string(dflt);
+  if (v->type == JVal::BOOL) return v->b ? "1" : "0";  // int(True) == 1
+  if (v->type != JVal::NUM && v->type != JVal::STR)
+    py_reject("int() of a null/array/object value");
+  // Integer-shaped fast path, shared by NUM and STR: Python int(str)
+  // strips ASCII whitespace and allows single underscores between digits
+  // (JSON NUM tokens can contain neither, so the extra leniency is
+  // STR-only in practice).  Pure-integer tokens pass through
+  // digit-for-digit — arbitrary precision, matching Python ints beyond
+  // 64 bits; leading zeros/'+' normalized away.  Known divergences,
+  // both Python-accepted forms this rejects: non-ASCII unicode digits
+  // and unicode-whitespace padding — schema-invalid for Molly (Go json
+  // marshaling never emits them) and out of parity scope.
+  std::string s = v->s;
+  size_t b = 0, e2 = s.size();
+  while (b < e2 && std::isspace((unsigned char)s[b])) ++b;
+  while (e2 > b && std::isspace((unsigned char)s[e2 - 1])) --e2;
+  s = s.substr(b, e2 - b);
+  size_t i = 0;
+  bool neg = false;
+  if (i < s.size() && (s[i] == '+' || s[i] == '-')) neg = s[i++] == '-';
+  std::string digits;
+  bool ok = i < s.size();
+  bool prev_digit = false;
+  for (; i < s.size(); ++i) {
+    if (std::isdigit((unsigned char)s[i])) {
+      digits += s[i];
+      prev_digit = true;
+    } else if (s[i] == '_' && prev_digit && i + 1 < s.size() &&
+               std::isdigit((unsigned char)s[i + 1])) {
+      prev_digit = false;  // single separator between digits
+    } else {
+      ok = false;
+      break;
     }
   }
-  if (v && v->type == JVal::BOOL) return v->b ? "1" : "0";
-  return std::to_string(dflt);
+  if (ok && !digits.empty()) {
+    size_t nz = 0;
+    while (nz + 1 < digits.size() && digits[nz] == '0') ++nz;  // keep lone "0"
+    std::string out = digits.substr(nz);
+    if (neg && out != "0") out.insert(out.begin(), '-');
+    return out;
+  }
+  // Python int(str) accepts ONLY the integer shape above — int("1.5") and
+  // int("0x10") raise ValueError.
+  if (v->type == JVal::STR)
+    py_reject("int() of non-integer string " + err_snippet(v->s));
+  // A non-integer NUM token is float-shaped by the strict number() grammar
+  // (digits with '.'/exponent, no hex/inf/nan) -> Python int(float)
+  // truncation.  Locale-independent parse with full-consumption check:
+  // strtod honors LC_NUMERIC (a host app setting de_DE would stop at '.'),
+  // while from_chars always uses the JSON radix.  FP from_chars needs
+  // libstdc++ >= GCC 11; older toolchains (this library self-compiles on
+  // the user's machine) fall back to strtod with the radix character
+  // swapped to whatever the active locale expects.
+  double d = 0.0;
+  bool parsed = false;
+  {
+    std::string t = s;
+#if defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L
+    auto res = std::from_chars(t.data(), t.data() + t.size(), d,
+                               std::chars_format::general);
+    parsed = res.ec == std::errc() && res.ptr == t.data() + t.size();
+#else
+    const char* radix = std::localeconv()->decimal_point;
+    if (radix && radix[0] && radix[0] != '.')
+      for (char& ch : t)
+        if (ch == '.') ch = radix[0];
+    char* end = nullptr;
+    d = std::strtod(t.c_str(), &end);
+    parsed = end == t.c_str() + t.size();
+#endif
+  }
+  if (parsed && std::isfinite(d)) {
+    // %.0f prints the double's exact integer value at any magnitude
+    // (doubles >= 2^53 are integral), matching Python int(float) even
+    // beyond the long long range where a cast would be UB.
+    double t = std::trunc(d);
+    char buf[512];
+    std::snprintf(buf, sizeof buf, "%.0f", t);
+    // %.0f spells negative zero "-0"; Python int(-0.4) prints "0".
+    return (buf[0] == '-' && buf[1] == '0' && buf[2] == '\0') ? "0" : buf;
+  }
+  // A grammar-valid NUM token that didn't parse finite is an overflow
+  // ("1e999" -> inf): Python's int(float) raises OverflowError there.
+  py_reject("int() overflow on numeric token " + err_snippet(v->s));
 }
 
 // Python iteration over a non-array JSON value: string -> its characters
@@ -587,24 +631,44 @@ bool py_iter_items(const JVal& v, std::vector<JVal>& items) {
   return false;
 }
 
-// Python `list(v)` then json.dumps; non-iterables emit null (Python raises
-// TypeError there — no parity to match).
+// Python `list(v)` then json.dumps; non-iterables raise TypeError in the
+// Python loader, so they reject here too (trust-boundary parity).
 void append_pylist(std::string& out, const JVal& v) {
   if (v.type == JVal::ARR) {  // list(arr) passthrough, no element copies
     append_jval(out, v);
     return;
   }
   std::vector<JVal> items;
-  if (!py_iter_items(v, items)) {
-    out += "null";
-    return;
-  }
+  if (!py_iter_items(v, items)) py_reject("list() of a non-iterable value");
   out += '[';
   for (size_t i = 0; i < items.size(); ++i) {
     if (i) out += ", ";
     append_jval(out, items[i]);
   }
   out += ']';
+}
+
+bool jval_falsy(const JVal* v);  // defined below (RawGraph section)
+
+// Mirror of Python `for x in <container>`: arrays iterate in place;
+// strings/objects iterate as characters/keys (py_iter_items); everything
+// else raises TypeError in Python -> py_reject here.  or_empty mirrors the
+// `d.get(key) or []` idiom (falsy values collapse to the empty list).
+const std::vector<JVal>* py_elements(const JVal* v, std::vector<JVal>& scratch,
+                                     bool or_empty, const char* what) {
+  static const std::vector<JVal> kEmpty;
+  if (!v) return &kEmpty;
+  if (or_empty && jval_falsy(v)) return &kEmpty;
+  if (v->type == JVal::ARR) return &v->arr;
+  scratch.clear();
+  if (!py_iter_items(*v, scratch))
+    py_reject(std::string(what) + " is not iterable");
+  return &scratch;
+}
+
+// Python `<element>.get(...)` requires a dict element.
+void require_obj(const JVal& v, const char* what) {
+  if (v.type != JVal::OBJ) py_reject(std::string(what) + " entry is not an object");
 }
 
 // Canonical head fragment of one debugging.json run entry — the five
@@ -630,6 +694,9 @@ std::string build_run_head(const JVal& r) {
   if (!fs || fs->type == JVal::NUL) {
     out += "null";
   } else {
+    // FailureSpec.from_json(d["failureSpec"]) does .get on it: non-dict
+    // values raise AttributeError in the Python loader.
+    require_obj(*fs, "failureSpec");
     out += "{\"eot\": ";
     out += coerce_int_str(fs->get("eot"), 0);
     out += ", \"eff\": ";
@@ -643,13 +710,16 @@ std::string build_run_head(const JVal& r) {
     else append_pylist(out, *nodes);
     out += ", \"crashes\": ";
     const JVal* crashes = fs->get("crashes");
+    std::vector<JVal> cr_scratch;
     if (!crashes || crashes->type == JVal::NUL) {
       out += "null";
     } else {
+      const auto& cr_items = *py_elements(crashes, cr_scratch, false, "crashes");
       out += '[';
-      for (size_t i = 0; i < crashes->arr.size(); ++i) {
+      for (size_t i = 0; i < cr_items.size(); ++i) {
         if (i) out += ", ";
-        const JVal& cr = crashes->arr[i];
+        const JVal& cr = cr_items[i];
+        require_obj(cr, "crashes");
         out += "{\"node\": ";
         const JVal* n = cr.get("node");
         if (!n) out += "\"\"";
@@ -662,13 +732,16 @@ std::string build_run_head(const JVal& r) {
     }
     out += ", \"omissions\": ";
     const JVal* om = fs->get("omissions");
+    std::vector<JVal> om_scratch;
     if (!om || om->type == JVal::NUL) {
       out += "null";
     } else {
+      const auto& om_items = *py_elements(om, om_scratch, false, "omissions");
       out += '[';
-      for (size_t i = 0; i < om->arr.size(); ++i) {
+      for (size_t i = 0; i < om_items.size(); ++i) {
         if (i) out += ", ";
-        const JVal& o = om->arr[i];
+        const JVal& o = om_items[i];
+        require_obj(o, "omissions");
         out += "{\"from\": ";
         const JVal* f = o.get("from");
         if (!f) out += "\"\"";
@@ -692,11 +765,16 @@ std::string build_run_head(const JVal& r) {
   } else {
     // Model.from_json reads ONLY "tables" (missing -> {}); everything else
     // in the raw model object is dropped by the schema, and each table row
-    // is normalized via Python list(r).
+    // is normalized via Python list(r).  Non-dict model -> .get raises;
+    // present non-dict tables -> .items() raises (both AttributeError in
+    // the Python loader).
+    require_obj(*model, "model");
     out += "{\"tables\": ";
     const JVal* tables = model->get("tables");
-    if (!tables || tables->type != JVal::OBJ) {
+    if (!tables) {
       out += "{}";
+    } else if (tables->type != JVal::OBJ) {
+      py_reject("model tables is not an object");
     } else {
       out += '{';
       for (size_t ti = 0; ti < tables->obj.size(); ++ti) {
@@ -715,16 +793,14 @@ std::string build_run_head(const JVal& r) {
           out += ']';
         } else {
           std::vector<JVal> elems;
-          if (!py_iter_items(rows, elems)) {
-            out += "null";
-          } else {
-            out += '[';
-            for (size_t ri = 0; ri < elems.size(); ++ri) {
-              if (ri) out += ", ";
-              append_pylist(out, elems[ri]);
-            }
-            out += ']';
+          if (!py_iter_items(rows, elems))
+            py_reject("model table rows are not iterable");
+          out += '[';
+          for (size_t ri = 0; ri < elems.size(); ++ri) {
+            if (ri) out += ", ";
+            append_pylist(out, elems[ri]);
           }
+          out += ']';
         }
       }
       out += '}';
@@ -733,10 +809,14 @@ std::string build_run_head(const JVal& r) {
   }
   out += ", \"messages\": [";
   const JVal* msgs = r.get("messages");
-  if (msgs && msgs->type == JVal::ARR) {
-    for (size_t i = 0; i < msgs->arr.size(); ++i) {
+  std::vector<JVal> msg_scratch;
+  {
+    const auto& m_items = *py_elements(msgs, msg_scratch, /*or_empty=*/true,
+                                       "messages");
+    for (size_t i = 0; i < m_items.size(); ++i) {
       if (i) out += ", ";
-      const JVal& m = msgs->arr[i];
+      const JVal& m = m_items[i];
+      require_obj(m, "messages");
       out += "{\"table\": ";
       const JVal* tb = m.get("table");
       if (!tb) out += "\"\"";
@@ -794,12 +874,46 @@ int32_t type_id_of(const std::string& t) {
   return 0;
 }
 
+// Strict UTF-8 validation (RFC 3629 ranges incl. surrogate/overlong
+// rejection): the Python loader reads these files in text mode, so invalid
+// bytes raise UnicodeDecodeError there — the native path must reject the
+// same inputs instead of passing raw bytes through (trust-boundary parity).
+void validate_utf8(const std::string& s, const std::string& path) {
+  const unsigned char* p = (const unsigned char*)s.data();
+  size_t n = s.size(), i = 0;
+  while (i < n) {
+    unsigned char c = p[i];
+    if (c < 0x80) { ++i; continue; }
+    size_t len;
+    unsigned lo = 0x80, hi = 0xBF;
+    if (c >= 0xC2 && c <= 0xDF) len = 2;
+    else if (c == 0xE0) { len = 3; lo = 0xA0; }
+    else if (c >= 0xE1 && c <= 0xEC) len = 3;
+    else if (c == 0xED) { len = 3; hi = 0x9F; }  // no surrogates
+    else if (c == 0xEE || c == 0xEF) len = 3;
+    else if (c == 0xF0) { len = 4; lo = 0x90; }
+    else if (c >= 0xF1 && c <= 0xF3) len = 4;
+    else if (c == 0xF4) { len = 4; hi = 0x8F; }
+    else throw std::runtime_error(path + ": invalid UTF-8 at byte " + std::to_string(i));
+    if (i + len > n)
+      throw std::runtime_error(path + ": truncated UTF-8 at byte " + std::to_string(i));
+    if (p[i + 1] < lo || p[i + 1] > hi)
+      throw std::runtime_error(path + ": invalid UTF-8 at byte " + std::to_string(i));
+    for (size_t k = 2; k < len; ++k)
+      if (p[i + k] < 0x80 || p[i + k] > 0xBF)
+        throw std::runtime_error(path + ": invalid UTF-8 at byte " + std::to_string(i));
+    i += len;
+  }
+}
+
 std::string read_file(const std::string& path) {
   std::ifstream f(path, std::ios::binary);
   if (!f) throw std::runtime_error("cannot open " + path);
   std::ostringstream ss;
   ss << f.rdbuf();
-  return ss.str();
+  std::string out = ss.str();
+  validate_utf8(out, path);
+  return out;
 }
 
 // Python str() of a JSON value fetched via d.get(key, "") — the coercion
@@ -833,14 +947,25 @@ RawGraph parse_prov(const std::string& path, long iteration, const char* cond) {
   js.reserve(4096);
   js += "{\"goals\": [";
 
-  if (goals && goals->type == JVal::ARR) {
+  std::vector<JVal> g_scratch;
+  {
     bool first = true;
-    for (const JVal& jg : goals->arr) {
+    for (const JVal& jg : *py_elements(goals, g_scratch, false, "goals")) {
+      require_obj(jg, "goals");
+      // _namespace_prov does prefix + goal.id: a non-string id raises
+      // TypeError in the Python loader.
+      const JVal* idv = jg.get("id");
+      if (idv && idv->type != JVal::STR) py_reject("goal id is not a string");
       std::string id = jg.get_str("id");
       std::string table = jg.get_str("table");
       std::string label = jg.get_str("label");
       std::string time = py_str_of(jg.get("time"));
       if (table == "clock") {  // molly.go:76-89: wild first, two-number wins
+        // The Python loader regex-searches goal.label here; a non-string
+        // label raises TypeError for clock goals (and only there).
+        const JVal* lv = jg.get("label");
+        if (lv && lv->type != JVal::STR)
+          py_reject("clock goal label is not a string");
         std::string t;
         if (match_clock_wild(label, t)) time = t;
         if (match_clock_two(label, t)) time = t;
@@ -880,9 +1005,13 @@ RawGraph parse_prov(const std::string& path, long iteration, const char* cond) {
   }
   g.n_goals = (int32_t)g.ids.size();
   js += "], \"rules\": [";
-  if (rules && rules->type == JVal::ARR) {
+  std::vector<JVal> r_scratch;
+  {
     bool first = true;
-    for (const JVal& jr : rules->arr) {
+    for (const JVal& jr : *py_elements(rules, r_scratch, false, "rules")) {
+      require_obj(jr, "rules");
+      const JVal* idv = jr.get("id");
+      if (idv && idv->type != JVal::STR) py_reject("rule id is not a string");
       std::string id = jr.get_str("id");
       slot[id] = (int32_t)g.ids.size();  // last occurrence wins (packed.py pack_graph)
       g.ids.push_back(prefix + id);
@@ -906,9 +1035,15 @@ RawGraph parse_prov(const std::string& path, long iteration, const char* cond) {
     }
   }
   js += "], \"edges\": [";
-  if (edges && edges->type == JVal::ARR) {
+  std::vector<JVal> e_scratch;
+  {
     bool first = true;
-    for (const JVal& je : edges->arr) {
+    for (const JVal& je : *py_elements(edges, e_scratch, false, "edges")) {
+      require_obj(je, "edges");
+      const JVal* fv = je.get("from");
+      const JVal* tv = je.get("to");
+      if ((fv && fv->type != JVal::STR) || (tv && tv->type != JVal::STR))
+        py_reject("edge endpoint is not a string");
       std::string esrc = je.get_str("from");
       std::string edst = je.get_str("to");
       auto si = slot.find(esrc);
@@ -1100,8 +1235,18 @@ Corpus* ingest(const std::string& dir, bool with_heads) {
   post_graphs.reserve(c->n_runs);
   for (int64_t i = 0; i < c->n_runs; ++i) {
     const JVal& r = runs.arr[i];
-    long iter = r.get_int("iteration");
-    c->iteration.push_back((int32_t)iter);
+    require_obj(r, "runs.json run");
+    // Python int(d.get("iteration", 0)) semantics (coerce_int_str), then a
+    // loud int32 range check: Python would accept an astronomically large
+    // iteration (arbitrary-precision int), but the packed arrays are
+    // int32 — rejecting beats silently truncating the run namespace.
+    std::string it_str = coerce_int_str(r.get("iteration"), 0);
+    int32_t iter32 = 0;
+    auto itp = std::from_chars(it_str.data(), it_str.data() + it_str.size(), iter32);
+    if (itp.ec != std::errc() || itp.ptr != it_str.data() + it_str.size())
+      throw std::runtime_error("runs.json: iteration out of int32 range: " + it_str);
+    long iter = (long)iter32;
+    c->iteration.push_back(iter32);
     c->success.push_back(r.get_str("status") == "success");  // molly.go:53
     // Head fragments are only reachable through a live handle
     // (nemo_run_head_json); bench/prewarm ingests that drop the handle

@@ -295,9 +295,17 @@ def test_run_head_json_parity_exotic_metadata(tmp_path):
                 {},  # all defaults
             ],
         },
-        {  # minimal: schema keys absent entirely
-            "iteration": 123456789012345678901234567890,  # beyond 64 bits
+        {  # minimal-ish: schema keys mostly absent; int32-max iteration
+            # (beyond-int32 iterations are now a LOUD native reject — the
+            # packed run-id arrays are int32 and silent truncation would
+            # corrupt the run namespace; beyond-64-bit coverage for the
+            # digit-passthrough coercion moved to eot/time below)
+            "iteration": 2147483647,
             "status": "success",
+            "failureSpec": {
+                "eot": 123456789012345678901234567890,  # beyond 64 bits
+                "crashes": [{"node": "n", "time": 987654321098765432109876543210}],
+            },
         },
         {  # nulls where objects are expected
             "iteration": 1,

@@ -1,0 +1,264 @@
+"""Hostile-input fuzzing of the native ETL's parse path (VERDICT r4 task 4).
+
+The C++ JSON parser (native/nemo_native.cpp) sits on the trust boundary —
+it ingests whatever the external fault injector wrote.  Every corruption
+here must surface as a clean RuntimeError through ingest/native.py (never a
+crash), and the ACCEPT/REJECT decision must agree with the pure-Python
+loader (load_molly_output), which is the parity oracle: json.loads
+strictness for the syntax classes, and the datatypes from_json coercion
+exceptions (TypeError/ValueError/OverflowError/AttributeError/
+UnicodeDecodeError) for the structural classes.
+
+Known, deliberate one-sided divergence (asserted below, not swept under):
+an `iteration` beyond int32 is a LOUD native reject while the Python
+object path accepts — the packed run-id arrays are int32 and silent
+truncation would corrupt the run namespace.
+
+Reference discipline being mirrored: the reference verifies inserted counts
+at runtime and fails the pipeline on mismatch
+(graphing/pre-post-prov.go:84-86); this repo's equivalent trust boundary is
+the native parser, so the verification lives here.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+import random
+import shutil
+
+import pytest
+
+from nemo_tpu.graphs.packed import CorpusVocab, pack_graph
+from nemo_tpu.ingest.molly import load_molly_output
+from nemo_tpu.ingest.native import ingest_native, native_available
+from nemo_tpu.models.case_studies import write_case_study
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native ETL unavailable (no toolchain)"
+)
+
+#: Minimum generated corruptions per fixture file (the VERDICT criterion).
+MIN_PER_FILE = 50
+
+#: Wrong-type substitutes: all decisively rejected or accepted identically
+#: by both loaders (avoiding Python's quirky empty-iterable acceptances is
+#: NOT needed — "" and {} are mirrored too, so they are included).
+TYPE_SWAPS = [42, True, None, "x", [1], {"a": 1}, "", {}, []]
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    d = tmp_path_factory.mktemp("malformed_base")
+    return write_case_study("pb_asynchronous", n_runs=2, seed=11, out_dir=str(d))
+
+
+def _fixture_files(corpus_dir):
+    return sorted(
+        f for f in os.listdir(corpus_dir)
+        if f == "runs.json" or f.endswith("_provenance.json")
+    )
+
+
+def _corrupt_bytes(data: bytes, rng: random.Random):
+    """Yield (label, corrupted_bytes) syntactic corruptions."""
+    n = len(data)
+    for i in range(12):  # truncations (incl. mid-string/mid-token cuts)
+        cut = rng.randrange(n) if i else 0
+        yield f"truncate@{cut}", data[:cut]
+    for _ in range(10):  # invalid UTF-8 / raw control bytes inserted
+        pos = rng.randrange(n)
+        bad = rng.choice([b"\xff", b"\xfe", b"\x01", b"\xc0\x80", b"\xed\xa0\x80"])
+        yield f"badbytes@{pos}", data[:pos] + bad + data[pos:]
+    for _ in range(10):  # single byte deleted
+        pos = rng.randrange(n)
+        yield f"delete@{pos}", data[:pos] + data[pos + 1 :]
+    for _ in range(10):  # single byte replaced with random printable
+        pos = rng.randrange(n)
+        ch = bytes([rng.randrange(0x20, 0x7F)])
+        yield f"replace@{pos}", data[:pos] + ch + data[pos + 1 :]
+    yield "deep-array", b"[" * 5000
+    yield "deep-object", b'{"a":' * 5000
+    yield "deep-balanced", b"[" * 4000 + b"1" + b"]" * 4000
+    yield "trailing-garbage", data + b"} extra ["
+    yield "empty", b""
+    yield "bom", b"\xef\xbb\xbf" + data
+    yield "unterminated-string", data[: n - 4] + b'"abc'
+    yield "bad-escape", data[:1] + b'"\\q"' + data[1:] if data[:1] == b"[" else b'{"a": "\\q"}'
+    yield "bad-u-escape", b'[{"id": "\\uzzzz"}]'
+    # Targets the strict number grammar specifically (the pre-r5 scanner
+    # accepted "0-"/"1.2.3"/"01" that json.loads rejects): inject a
+    # malformed number token right after the first structural '{' — the
+    # key is unknown to both schemas, so rejection can only come from the
+    # number grammar itself.  The assert keeps this from rotting into a
+    # silent no-op if a fixture ever stops containing '{'.
+    brace = data.find(b"{")
+    assert brace >= 0, "fixture has no object to corrupt"
+    for bad in (b"0-", b"1.2.3", b"01", b".5"):
+        yield f"lenient-number-{bad.decode()}", (
+            data[: brace + 1] + b'"__bad": ' + bad + b", " + data[brace + 1 :]
+        )
+
+
+def _structural_swaps(doc, is_runs: bool):
+    """Yield (label, corrupted_json_text) wrong-type field swaps."""
+    if is_runs:
+        paths = [
+            ("iteration",),
+            ("failureSpec",),
+            ("failureSpec", "eot"),
+            ("failureSpec", "nodes"),
+            ("failureSpec", "crashes"),
+            ("failureSpec", "omissions"),
+            ("model",),
+            ("model", "tables"),
+            ("messages",),
+        ]
+        # Element-level: first crash / first message become non-objects.
+        extra = [("crash-elem",), ("message-elem",)]
+    else:
+        paths = [("goals",), ("rules",), ("edges",)]
+        extra = [("goal-elem",), ("rule-elem",), ("edge-elem",),
+                 ("goal-id",), ("edge-from",)]
+    for path in paths:
+        for swap in TYPE_SWAPS:
+            d = copy.deepcopy(doc)
+            tgt = d[0] if is_runs else d
+            ok = True
+            for key in path[:-1]:
+                tgt = tgt.get(key) if isinstance(tgt, dict) else None
+                if not isinstance(tgt, dict):
+                    ok = False
+                    break
+            if not ok:
+                continue
+            tgt[path[-1]] = swap
+            yield f"{'.'.join(path)}={swap!r}", json.dumps(d)
+    for (label,) in extra:
+        for swap in TYPE_SWAPS:
+            d = copy.deepcopy(doc)
+            try:
+                if label == "crash-elem":
+                    d[0]["failureSpec"]["crashes"] = [swap]
+                elif label == "message-elem":
+                    d[0]["messages"] = [swap]
+                elif label == "goal-elem":
+                    d["goals"] = [swap]
+                elif label == "rule-elem":
+                    d["rules"] = [swap]
+                elif label == "edge-elem":
+                    d["edges"] = [swap]
+                elif label == "goal-id":
+                    d["goals"][0]["id"] = swap
+                elif label == "edge-from":
+                    d["edges"][0]["from"] = swap
+            except (KeyError, IndexError, TypeError):
+                continue
+            yield f"{label}={swap!r}", json.dumps(d)
+
+
+def _probe(corpus_dir, fname, content: bytes, tmp_root, idx):
+    """Write a corpus copy with `fname` replaced; return (native_ok, py_ok,
+    native_err)."""
+    d = os.path.join(tmp_root, f"c{idx}")
+    os.mkdir(d)
+    for f in os.listdir(corpus_dir):
+        if f == fname:
+            continue
+        os.link(os.path.join(corpus_dir, f), os.path.join(d, f))
+    with open(os.path.join(d, fname), "wb") as fh:
+        fh.write(content)
+    native_ok, native_err = True, None
+    try:
+        nc = ingest_native(d, with_node_ids=False, keep_handle=True)
+        # Touch every head so lazy head failures can't hide acceptance.
+        for i in range(nc.n_runs):
+            nc.run_head_json(i)
+        if nc.handle is not None:
+            nc.handle.close()
+    except RuntimeError as ex:  # the ONLY acceptable failure signal
+        native_ok, native_err = False, str(ex)
+    py_ok = True
+    try:
+        # The native engine replaces the Python LOAD + PACK path (it emits
+        # packed arrays directly), so the parity oracle is both stages:
+        # load_molly_output's coercions plus pack_graph's slot/edge
+        # resolution (unknown edge endpoints KeyError there).
+        molly = load_molly_output(d)
+        vocab = CorpusVocab()
+        for run in molly.runs:
+            pack_graph(run.pre_prov, vocab)
+            pack_graph(run.post_prov, vocab)
+    except Exception:
+        py_ok = False
+    shutil.rmtree(d)
+    return native_ok, py_ok, native_err
+
+
+def test_malformed_corpus_agreement(corpus, tmp_path):
+    """>= MIN_PER_FILE corruptions of EVERY fixture file: native must never
+    crash (RuntimeError only) and must accept/reject exactly like the
+    Python loader."""
+    rng = random.Random(2025)
+    total = 0
+    for fname in _fixture_files(corpus):
+        with open(os.path.join(corpus, fname), "rb") as fh:
+            data = fh.read()
+        cases = list(_corrupt_bytes(data, rng))
+        doc = json.loads(data)
+        cases += list(_structural_swaps(doc, is_runs=fname == "runs.json"))
+        assert len(cases) >= MIN_PER_FILE, (fname, len(cases))
+        mismatches = []
+        for i, (label, content) in enumerate(cases):
+            content = content if isinstance(content, bytes) else content.encode()
+            native_ok, py_ok, err = _probe(corpus, fname, content, tmp_path, f"{fname}.{i}")
+            if native_ok != py_ok:
+                mismatches.append((label, native_ok, py_ok, err))
+        assert not mismatches, f"{fname}: {mismatches[:8]} (+{max(0, len(mismatches)-8)} more)"
+        total += len(cases)
+    assert total >= 3 * MIN_PER_FILE
+
+
+def test_iteration_int32_overflow_is_loud(corpus, tmp_path):
+    """The documented one-sided strictness: iteration beyond int32 is a
+    loud native reject (packed run ids are int32; truncation would corrupt
+    the run namespace) while the Python object path accepts."""
+    with open(os.path.join(corpus, "runs.json")) as fh:
+        doc = json.load(fh)
+    doc[0]["iteration"] = 2**40
+    native_ok, py_ok, err = _probe(
+        corpus, "runs.json", json.dumps(doc).encode(), tmp_path, "int32"
+    )
+    assert not native_ok and "int32" in err
+    assert py_ok
+
+
+def test_depth_limit_divergence_is_loud(corpus, tmp_path):
+    """The documented one-sided strictness twin of the int32 case: a
+    300-deep value is accepted by json.loads (C scanner allows up to
+    ~sys.getrecursionlimit()) but is a loud native reject at kMaxDepth=256
+    — rejecting beats crashing into the C stack for depths Python cannot
+    reach either."""
+    with open(os.path.join(corpus, "runs.json")) as fh:
+        doc = json.load(fh)
+    deep = [1]
+    for _ in range(299):
+        deep = [deep]
+    doc[0]["status"] = deep  # status accepts any type in both loaders
+    native_ok, py_ok, err = _probe(
+        corpus, "runs.json", json.dumps(doc).encode(), tmp_path, "d300"
+    )
+    assert not native_ok and "nesting too deep" in err
+    assert py_ok
+
+
+def test_depth_guard_rejects_cleanly(corpus, tmp_path):
+    """Adversarial nesting far past the guard must be a RuntimeError, not a
+    stack overflow (the recursive-descent parser's kMaxDepth backstop)."""
+    for blob in (b"[" * 200_000, b'{"k":[' * 100_000):
+        native_ok, py_ok, err = _probe(
+            corpus, "runs.json", blob, tmp_path, f"deep{len(blob)}"
+        )
+        assert not native_ok and not py_ok
+        assert "nesting" in err or "JSON parse error" in err
