@@ -344,3 +344,38 @@ def test_live_neo4j_round_trip():
         with pytest.raises(BoltError):
             conn.run("THIS IS NOT CYPHER")
         assert conn.run("RETURN 2 AS m")[1] == [[2]]  # recovered
+
+
+def test_combinators_reproduce_hand_literals():
+    """The spec-rule combinators (bolt_wire_fixtures.py, added for the
+    transcript test) must reproduce every hand-assembled literal in the
+    fixtures module byte-for-byte — each literal was derived rule-by-rule
+    from the public spec, so a combinator that deviates transcribed a rule
+    wrongly."""
+    import bolt_wire_fixtures as fx
+
+    assert fx.msg_init("nemo-tpu/bolt-python", {"scheme": "none"}) == fx.CLIENT_INIT
+    assert (
+        fx.msg_init(
+            "nemo-tpu/bolt-python",
+            {"scheme": "basic", "principal": "neo4j", "credentials": "s3cr3t"},
+        )
+        == fx.CLIENT_INIT_BASIC
+    )
+    assert fx.msg_success({"server": "Neo4j/3.3.3"}) == fx.SERVER_INIT_SUCCESS
+    assert fx.msg_run("RETURN 1 AS n", {}) == fx.CLIENT_RUN
+    assert fx.msg_pull_all() == fx.CLIENT_PULL_ALL
+    assert fx.msg_success({"fields": ["n"]}) == fx.SERVER_RUN_SUCCESS
+    assert fx.msg_record([1]) == fx.SERVER_RECORD_1
+    assert fx.msg_success({}) == fx.SERVER_STREAM_SUCCESS
+    assert (
+        fx.chunked_frames(
+            fx.ps_struct(
+                0x7F,
+                [{"code": "Neo.ClientError.Statement.SyntaxError", "message": "bad"}],
+            )
+        )
+        == fx.SERVER_FAILURE
+    )
+    assert fx.chunked_frames(fx.ps_struct(0x7E, [])) == fx.SERVER_IGNORED
+    assert fx.chunked_frames(fx.ps_struct(0x0E, [])) == fx.CLIENT_ACK_FAILURE
