@@ -637,6 +637,53 @@ def child_main() -> None:
     except Exception as ex:  # giant stress must never sink the bench
         log(f"giant path skipped: {type(ex).__name__}: {ex}")
 
+    # Full-figure report cost (VERDICT r4 task 6): the e2e tiers render
+    # figures="sample:8" while the reference renders EVERY figure for every
+    # run (main.go:251-289) — quantify what "all" would add.  Measured as
+    # the (figures=all − figures=none) wall delta per family on a bounded
+    # warm sub-corpus (everything is compiled by now), then extrapolated
+    # linearly to the full corpus: figure cost is per-run host work (DOT
+    # materialization + in-tree layout + native SVG), so runs/s is flat in
+    # corpus size.
+    figures = None
+    try:
+        figs_runs = int(os.environ.get("NEMO_BENCH_FIGS_RUNS", "256"))
+        tot_delta = tot_figs = 0.0
+        per_run_cost = {}
+        for name in families:
+            fdir = write_case_study(
+                name, n_runs=figs_runs, seed=13, out_dir=os.path.join(tmp, "figs")
+            )
+            walls = {}
+            for pol in ("none", "all"):
+                t0 = time.perf_counter()
+                res = run_debug(fdir, os.path.join(tmp, f"figs_{pol}"), JaxBackend(),
+                                figures=pol)
+                walls[pol] = time.perf_counter() - t0
+            n_svg = len([
+                f for f in os.listdir(os.path.join(res.report_dir, "figures"))
+                if f.endswith(".svg")
+            ])
+            delta = max(1e-9, walls["all"] - walls["none"])
+            tot_delta += delta
+            tot_figs += n_svg
+            per_run_cost[name] = delta / figs_runs
+        extrapolated = sum(per_run_cost[n] * per_family for n in families)
+        figures = {
+            "measured_runs_per_family": figs_runs,
+            "figs_per_sec": round(tot_figs / tot_delta, 1),
+            "figure_cost_s_at_measured_scale": round(tot_delta, 2),
+            # What figures="all" adds on top of the e2e warm wall at the
+            # full corpus scale (per-run figure cost x full per-family runs).
+            "all_policy_extra_s_at_full_scale": round(extrapolated, 1),
+            "e2e_warm_all_figures_s": round(
+                e2e["warm"]["wall_s"] + extrapolated, 1
+            ) if isinstance(e2e.get("warm"), dict) else None,
+        }
+        log(f"full-figure cost: {json.dumps(figures)}")
+    except Exception as ex:  # figure costing must never sink the bench
+        log(f"figure costing skipped: {type(ex).__name__}: {ex}")
+
     result = {
         "metric": METRIC
         if len(family_batches) > 1
@@ -663,6 +710,7 @@ def child_main() -> None:
         else round(value / neo4j_graphs_per_sec, 1),
         "single_dir_overlap": overlap,
         "giant": giant,
+        "figures": figures,
         "e2e": {
             "runs": total_runs,
             "figures": "sample:8",
