@@ -205,6 +205,7 @@ def child_main() -> None:
     base_mollys = []
     total_runs = 0
     t_gen = t_pack = t_linear_check = 0.0
+    total_upload_mb = 0.0
     tmp = tempfile.mkdtemp(prefix="nemo_bench_")
     import atexit
 
@@ -239,11 +240,21 @@ def child_main() -> None:
         b = int(pre.is_goal.shape[0])
         total_runs += b
         family_batches.append((name, pre, post, static))
+        # Host->device upload volume for this family's fused inputs: on the
+        # tunnel (~MB/s-class bandwidth) this is a candidate for the
+        # unexplained e2e wall, so the bench records it (r5 task 5).
+        upload_mb = sum(
+            getattr(ba, f).nbytes  # .nbytes is metadata — NO device copy
+            for ba in (pre, post)
+            for f in ("edge_src", "edge_dst", "edge_mask", "is_goal",
+                      "table_id", "label_id", "type_id", "node_mask")
+        ) / 1e6
         big_dirs.append((name, big_dir))
         log(
             f"  {name}: {b} distinct runs, bucket V={static['v']}, "
             f"linear_chains={static['comp_linear']}"
         )
+        total_upload_mb += upload_mb
     graphs = 2 * total_runs  # pre + post provenance per run
     log(
         f"stress corpus: {len(family_batches)} families, {total_runs} distinct runs, "
@@ -480,7 +491,7 @@ def child_main() -> None:
     # End-to-end pipeline at stress scale (VERDICT r1 item 2): the FULL CLI
     # semantics — ingest -> kernels -> debugging.json + policy-bounded
     # figures — over every family's distinct-run corpus, via run_debug.
-    from nemo_tpu.analysis.pipeline import run_debug
+    from nemo_tpu.analysis.pipeline import run_debug, run_debug_dirs
     from nemo_tpu.backend.jax_backend import JaxBackend
 
     # Two passes over the same corpora: the cold pass pays every jit
@@ -523,8 +534,16 @@ def child_main() -> None:
             phases: dict[str, float] = {}
             results_root = os.path.join(tmp, f"results_{label}")
             t0 = time.perf_counter()
-            for name, d in big_dirs:
-                res = run_debug(d, results_root, JaxBackend(), figures="sample:8")
+            # Overlapped multi-corpus driver (VERDICT r4 task 5): family
+            # k+1's C++ ingest parses on a worker thread (GIL released)
+            # while family k analyzes — on the tunnel the parse hides
+            # under device dispatch/transfer waits, taking the ingest
+            # phase off the e2e critical path.
+            ress = run_debug_dirs(
+                [d for _, d in big_dirs], results_root, JaxBackend,
+                figures="sample:8",
+            )
+            for res in ress:
                 for k, v in res.timings.items():
                     phases[k] = phases.get(k, 0.0) + v
             wall = time.perf_counter() - t0
@@ -695,6 +714,7 @@ def child_main() -> None:
         "platform": jax.devices()[0].platform,
         "distinct_runs": total_runs,
         "sweep_ms": round(t_step * 1e3, 1),
+        "fused_input_upload_mb": round(total_upload_mb, 1),
         "linear_check_ms": round(t_linear_check * 1e3, 1),
         "p50_diff_ms": None if np.isnan(p50_routed) else round(p50_routed, 4),
         "p50_diff_ms_device": None if np.isnan(p50_tpu) else round(p50_tpu, 3),

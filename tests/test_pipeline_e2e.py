@@ -75,3 +75,33 @@ def test_cli_smoke(corpus_dir, tmp_path, capsys):
     out = capsys.readouterr().out
     assert "All done!" in out
     assert "ingest" in out  # timings table
+
+
+def test_run_debug_dirs_overlap_parity(tmp_path):
+    """The overlapped multi-corpus driver (prefetching corpus k+1's C++
+    ingest under corpus k's analysis) must produce byte-identical reports
+    to the sequential loop it replaces."""
+    import filecmp
+    import os
+
+    from nemo_tpu.analysis.pipeline import run_debug_dirs
+    from nemo_tpu.backend.jax_backend import JaxBackend
+    from nemo_tpu.models.case_studies import write_case_study
+
+    dirs = [
+        write_case_study(fam, n_runs=6, seed=21, out_dir=str(tmp_path / "corp"))
+        for fam in ("pb_asynchronous", "ZK-1270-racing-sent-flag")
+    ]
+    seq = run_debug_dirs(dirs, str(tmp_path / "seq"), JaxBackend,
+                         prefetch=False, figures="failed")
+    ovl = run_debug_dirs(dirs, str(tmp_path / "ovl"), JaxBackend,
+                         prefetch=True, figures="failed")
+    assert len(seq) == len(ovl) == 2
+    for a, b in zip(seq, ovl):
+        da, db = a.report_dir, b.report_dir
+        for root, _dirs, files in os.walk(da):
+            rel = os.path.relpath(root, da)
+            for f in files:
+                pa = os.path.join(root, f)
+                pb = os.path.join(db, rel, f)
+                assert filecmp.cmp(pa, pb, shallow=False), (rel, f)
