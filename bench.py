@@ -243,11 +243,22 @@ def child_main() -> None:
         # Host->device upload volume for this family's fused inputs: on the
         # tunnel (~MB/s-class bandwidth) this is a candidate for the
         # unexplained e2e wall, so the bench records it (r5 task 5).
+        # Computed ARITHMETICALLY from shapes (no .astype, no device
+        # touch) with the deployment's narrowing applied
+        # (backend/jax_backend.py:_narrow_fused_arrays): edge/table planes
+        # ship int8/int16 by bound, type int8, label a [1,1] stub
+        # (with_diff=0), masks 1-byte bool.
+        def _w(bound):
+            return 1 if bound <= 127 else (2 if bound <= 32767 else 4)
+
         upload_mb = sum(
-            getattr(ba, f).nbytes  # .nbytes is metadata — NO device copy
+            ba.edge_src.size * _w(static["v"]) * 2  # src + dst
+            + ba.edge_mask.size  # bool
+            + ba.is_goal.size + ba.node_mask.size  # bool
+            + ba.table_id.size * _w(static["num_tables"])
+            + ba.type_id.size * 1
+            + 1  # label [1,1] int8 stub
             for ba in (pre, post)
-            for f in ("edge_src", "edge_dst", "edge_mask", "is_goal",
-                      "table_id", "label_id", "type_id", "node_mask")
         ) / 1e6
         big_dirs.append((name, big_dir))
         log(

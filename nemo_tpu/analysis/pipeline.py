@@ -154,6 +154,28 @@ def _choose_packed_ingest(backend: GraphBackend, save_corpus_path: str | None) -
     return native_available()
 
 
+def _resolve_ingest_mode(backend, ingest: str, save_corpus_path=None) -> bool:
+    """ingest mode -> use_packed, with validation (single definition shared
+    by run_debug and run_debug_dirs so the policy cannot drift)."""
+    if ingest == "auto":
+        return _choose_packed_ingest(backend, save_corpus_path)
+    if ingest == "native":
+        if not getattr(backend, "supports_packed_ingest", False):
+            raise ValueError(
+                "ingest='native' requires a packed-ingest backend (jax/service); "
+                f"{type(backend).__name__} consumes provenance objects"
+            )
+        if save_corpus_path:
+            raise ValueError(
+                "ingest='native' is incompatible with --save-corpus "
+                "(corpus bundling packs from the Python object tree)"
+            )
+        return True
+    if ingest == "python":
+        return False
+    raise ValueError(f"unknown ingest mode {ingest!r} (expected auto, native, python)")
+
+
 def _ingest(fault_inj_out: str, use_packed: bool):
     if use_packed:
         from nemo_tpu.ingest.native import load_molly_output_packed
@@ -185,12 +207,14 @@ def run_debug_dirs(
 
     if not dirs:
         return []
-    backends = [make_backend() for _ in dirs]
-    ingest_mode = kwargs.get("ingest", "auto")
-    if ingest_mode == "auto":
-        use_packed = _choose_packed_ingest(backends[0], kwargs.get("save_corpus_path"))
-    else:
-        use_packed = ingest_mode == "native"
+    # Backends are constructed lazily, one per iteration, and dropped after
+    # their corpus completes — retaining them all would keep every corpus's
+    # parsed runs and cached device results alive at once (O(dirs) memory
+    # where the sequential loop is O(1)).  The probe instance only answers
+    # the ingest-mode policy.
+    use_packed = _resolve_ingest_mode(
+        make_backend(), kwargs.get("ingest", "auto"), kwargs.get("save_corpus_path")
+    )
 
     results: list[DebugResult] = []
     prefetched: list = [None, None]  # (molly, exception) of the NEXT dir
@@ -217,7 +241,7 @@ def run_debug_dirs(
             )
             th.start()
         results.append(
-            run_debug(d, results_root, backends[k], molly=molly, **kwargs)
+            run_debug(d, results_root, make_backend(), molly=molly, **kwargs)
         )
         molly = None
     return results
@@ -251,27 +275,10 @@ def run_debug(
         trace_ctx = jax.profiler.trace(profile_dir)
     timer = PhaseTimer()
 
-    if ingest == "auto":
-        use_packed = _choose_packed_ingest(backend, save_corpus_path)
-    elif ingest == "native":
-        # Fail fast with the reason, not deep in the pipeline: RawProv
-        # placeholders crash object backends/--save-corpus only after the
-        # full native ingest already ran.
-        if not getattr(backend, "supports_packed_ingest", False):
-            raise ValueError(
-                "ingest='native' requires a packed-ingest backend (jax/service); "
-                f"{type(backend).__name__} consumes provenance objects"
-            )
-        if save_corpus_path:
-            raise ValueError(
-                "ingest='native' is incompatible with --save-corpus "
-                "(corpus bundling packs from the Python object tree)"
-            )
-        use_packed = True
-    elif ingest == "python":
-        use_packed = False
-    else:
-        raise ValueError(f"unknown ingest mode {ingest!r} (expected auto, native, python)")
+    # Fail fast with the reason, not deep in the pipeline: RawProv
+    # placeholders crash object backends/--save-corpus only after the
+    # full native ingest already ran.
+    use_packed = _resolve_ingest_mode(backend, ingest, save_corpus_path)
 
     with timer.phase("ingest"):
         # `molly` pre-supplied: the caller ingested out-of-band (the

@@ -427,6 +427,41 @@ def _diff_host_work_budget() -> int:
     return int(os.environ.get("NEMO_DIFF_HOST_WORK", "2000000"))
 
 
+def _narrow_fused_arrays(
+    arrays: dict, v: int, num_tables: int, with_diff: bool
+) -> dict:
+    """Shrink the host->device upload of the fused verb's integer planes
+    (models/pipeline_model.py:widen_batch casts back inside the compiled
+    program): edge indices are < v, table ids < num_tables (-1 pad), type
+    ids <= 3 — int8/int16 carries them at 1/4 / 1/2 the bytes of int32.
+    On the TPU tunnel the upload is bandwidth-priced, so at stress scale
+    (hundreds of MB of packed planes) this is wall time off the e2e
+    critical path; the same narrowing shrinks the Kernel RPC payloads
+    (service codec is dtype-generic).  With the diff tail off, the label
+    plane is replaced by a [1,1] stub — the trace never reads it, so only
+    its bytes disappear."""
+    def narrow(a: np.ndarray, bound: int) -> np.ndarray:
+        if bound <= 127:
+            return a.astype(np.int8)
+        if bound <= 32767:
+            return a.astype(np.int16)
+        return a
+
+    out = dict(arrays)
+    for prefix in ("pre", "post"):
+        for name, bound in (
+            ("edge_src", v),
+            ("edge_dst", v),
+            ("table_id", num_tables),
+            ("type_id", 8),
+        ):
+            key = f"{prefix}_{name}"
+            out[key] = narrow(np.asarray(out[key]), bound)
+        if not with_diff:
+            out[f"{prefix}_label_id"] = np.zeros((1, 1), dtype=np.int8)
+    return out
+
+
 def _verb_arrays(pre_b: PackedBatch, post_b: PackedBatch) -> dict[str, np.ndarray]:
     """The fused/giant verbs' named-array inputs for one (pre, post) bucket."""
     return {
@@ -797,7 +832,12 @@ class JaxBackend(GraphBackend):
                     linear = pair_chains_linear(pre_b, post_b)
                 res = self.executor.run(
                     "fused",
-                    _verb_arrays(pre_b, post_b),
+                    _narrow_fused_arrays(
+                        _verb_arrays(pre_b, post_b),
+                        v=pre_b.v,
+                        num_tables=params_common["num_tables"],
+                        with_diff=False,
+                    ),
                     dict(
                         v=pre_b.v,
                         max_depth=bucket_size(max(pre_b.max_depth, post_b.max_depth), min_d),
@@ -921,20 +961,29 @@ class JaxBackend(GraphBackend):
         (ordered qualifying tables per run, all present rule tables per run)."""
         ordered: dict[int, list[str]] = {}
         present: dict[int, set[str]] = {}
+        names = np.asarray(self.vocab.tables.strings, dtype=object)
         for _, post_b, res in self._fused():
             bits, min_depth, present_bits = (
-                res["proto_bits"],
-                res["proto_min_depth"],
-                res["proto_present"],
+                np.asarray(res["proto_bits"]),
+                np.asarray(res["proto_min_depth"]),
+                np.asarray(res["proto_present"]),
             )
+            # Vectorized per-bucket extraction (the per-row Python loop was
+            # host-linear at stress scale — ~seconds over 102k runs): one
+            # lexsort orders qualifying (row, depth, name) triples exactly
+            # like the old per-row sorted(tabs) — depth first, table name
+            # as tiebreak — then row boundaries split the flat list.
+            nm = names[: bits.shape[1]]
+            rows, ts = np.nonzero(bits & (min_depth < DEPTH_INF))
+            order = np.lexsort((nm[ts], min_depth[rows, ts], rows))
+            rows_o, names_o = rows[order], nm[ts[order]]
+            starts = np.searchsorted(rows_o, np.arange(bits.shape[0] + 1))
+            p_rows, p_ts = np.nonzero(present_bits)
+            p_starts = np.searchsorted(p_rows, np.arange(bits.shape[0] + 1))
+            p_names = nm[p_ts]
             for row, rid in enumerate(post_b.run_ids):
-                tabs = [
-                    (int(min_depth[row, t]), self.vocab.tables[t])
-                    for t in np.nonzero(bits[row])[0]
-                    if min_depth[row, t] < DEPTH_INF
-                ]
-                ordered[rid] = [name for _, name in sorted(tabs)]
-                present[rid] = {self.vocab.tables[t] for t in np.nonzero(present_bits[row])[0]}
+                ordered[rid] = list(names_o[starts[row] : starts[row + 1]])
+                present[rid] = set(p_names[p_starts[row] : p_starts[row + 1]])
         return ordered, present
 
     def create_prototypes(

@@ -195,6 +195,31 @@ def analysis_step(
     )
 
 
+def widen_batch(ba: BatchArrays) -> BatchArrays:
+    """Cast narrow integer planes back to int32 INSIDE the compiled
+    program.  The dispatch boundary may ship edge indices / table ids /
+    type ids as int8/int16 (and an unused label plane as a [1,1] stub) to
+    cut host->device upload bytes — on the TPU tunnel the upload of the
+    packed planes is bandwidth-priced, so halving/quartering the bytes is
+    wall time off the e2e critical path; the widening here costs one fused
+    element-wise pass on device.  int32 callers are untouched (the cast is
+    a no-op that XLA folds away; jit caches key on input dtypes, so each
+    scheme compiles once)."""
+    import dataclasses
+
+    def w(a):
+        return a.astype(jnp.int32) if a.dtype in (jnp.int8, jnp.int16) else a
+
+    return dataclasses.replace(
+        ba,
+        edge_src=w(ba.edge_src),
+        edge_dst=w(ba.edge_dst),
+        table_id=w(ba.table_id),
+        label_id=w(ba.label_id),
+        type_id=w(ba.type_id),
+    )
+
+
 # pre_tid/post_tid are traced scalars, NOT statics: they only feed
 # elementwise comparisons (ops/condition.py), and keeping them out of the
 # cache key lets corpora with different vocab interning orders share one
@@ -228,6 +253,8 @@ def _analysis_step_jit(
 ) -> dict[str, jnp.ndarray]:
     """The full fused pipeline for one run batch.  Returns per-run and
     corpus-level results; everything stays on device."""
+    pre = widen_batch(pre)
+    post = widen_batch(post)
     adj_pre = build_adjacency(pre.edge_src, pre.edge_dst, pre.edge_mask, v)
     adj_post = build_adjacency(post.edge_src, post.edge_dst, post.edge_mask, v)
 

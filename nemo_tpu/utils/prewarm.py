@@ -98,7 +98,25 @@ def stress_signature(name: str, n_probe: int, b_pad: int):
             node_mask=grow(ba.node_mask, v, False),
         )
 
-    return pad_arrays(pre), pad_arrays(post), static
+    # The deployment dispatch narrows the upload dtypes and stubs the
+    # unused label plane (backend/jax_backend.py:_narrow_fused_arrays);
+    # dtype and shape are both part of the jit signature, so prewarm must
+    # mirror them or it compiles a program nobody runs.
+    from dataclasses import replace
+
+    from nemo_tpu.backend.jax_backend import _narrow_fused_arrays
+
+    pre_p, post_p = pad_arrays(pre), pad_arrays(post)
+    arrays = _narrow_fused_arrays(
+        {f"pre_{f}": getattr(pre_p, f) for f in BatchArrays.FIELDS}
+        | {f"post_{f}": getattr(post_p, f) for f in BatchArrays.FIELDS},
+        v=v,
+        num_tables=static["num_tables"],
+        with_diff=False,
+    )
+    pre_p = replace(pre_p, **{f: arrays[f"pre_{f}"] for f in BatchArrays.FIELDS})
+    post_p = replace(post_p, **{f: arrays[f"post_{f}"] for f in BatchArrays.FIELDS})
+    return pre_p, post_p, static
 
 
 def chunk_signature(name: str, n_probe: int, chunk_runs: int):

@@ -97,11 +97,20 @@ def test_run_debug_dirs_overlap_parity(tmp_path):
     ovl = run_debug_dirs(dirs, str(tmp_path / "ovl"), JaxBackend,
                          prefetch=True, figures="failed")
     assert len(seq) == len(ovl) == 2
+    def tree_files(root):
+        return {
+            os.path.join(os.path.relpath(r, root), f)
+            for r, _d, fs in os.walk(root)
+            for f in fs
+        }
+
     for a, b in zip(seq, ovl):
         da, db = a.report_dir, b.report_dir
-        for root, _dirs, files in os.walk(da):
-            rel = os.path.relpath(root, da)
-            for f in files:
-                pa = os.path.join(root, f)
-                pb = os.path.join(db, rel, f)
-                assert filecmp.cmp(pa, pb, shallow=False), (rel, f)
+        # File SETS must match both ways (a stray overlapped-only artifact
+        # would otherwise pass a one-directional walk), then every byte.
+        rels = tree_files(da)
+        assert rels == tree_files(db)
+        for rel in rels:
+            assert filecmp.cmp(
+                os.path.join(da, rel), os.path.join(db, rel), shallow=False
+            ), rel
