@@ -403,8 +403,14 @@ def run_debug(
             # provenance exists only as a C++-serialized byte string
             # (ingest/native.py:RawProv) spliced in verbatim — byte-identical
             # to what the object path would have encoded (tests/test_fast_ingest.py).
+            # Streamed, not ", ".join(...): the join would materialize the
+            # whole multi-hundred-MB document a second time at stress scale
+            # before the single write; identical bytes either way.
             fh.write("[")
-            fh.write(", ".join(_run_json_str(r, good_iter) for r in runs))
+            for j, r in enumerate(runs):
+                if j:
+                    fh.write(", ")
+                fh.write(_run_json_str(r, good_iter))
             fh.write("]")
 
         reporter.generate_figures(fig_iters, "spacetime", hazard_dots)
