@@ -427,6 +427,28 @@ def _diff_host_work_budget() -> int:
     return int(os.environ.get("NEMO_DIFF_HOST_WORK", "2000000"))
 
 
+def _narrow_xfer_default() -> int:
+    """Whether the fused dispatch narrows its upload dtypes: yes on device
+    backends (the bytes cross a bandwidth-priced transfer), no on CPU
+    where "transfer" is a pointer handoff and the astype copies + the
+    in-program widening pass are pure cost (measured ~1 s of the 8 s CPU
+    warm e2e at 1x).  Same platform logic and spelling rules as
+    NEMO_PACK_XFER one function down; NEMO_NARROW_XFER=0/1 overrides
+    (tests pin =1 so the narrow path stays covered on the CPU suite)."""
+    env = os.environ.get("NEMO_NARROW_XFER", "").strip().lower()
+    if env:
+        if env in ("1", "true", "yes", "on"):
+            return 1
+        if env in ("0", "false", "no", "off"):
+            return 0
+        warnings.warn(
+            f"NEMO_NARROW_XFER={env!r} is not a recognized boolean; "
+            "using the backend default",
+            stacklevel=2,
+        )
+    return int(jax.default_backend() != "cpu")
+
+
 def _narrow_fused_arrays(
     arrays: dict, v: int, num_tables: int, with_diff: bool
 ) -> dict:
@@ -440,6 +462,9 @@ def _narrow_fused_arrays(
     (service codec is dtype-generic).  With the diff tail off, the label
     plane is replaced by a [1,1] stub — the trace never reads it, so only
     its bytes disappear."""
+    if not _narrow_xfer_default():
+        return arrays
+
     def narrow(a: np.ndarray, bound: int) -> np.ndarray:
         if bound <= 127:
             return a.astype(np.int8)

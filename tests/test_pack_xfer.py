@@ -183,3 +183,49 @@ def test_pack_out_default_env_parsing(monkeypatch):
         warnings.simplefilter("always")
         assert _pack_out_default() == default
     assert any("NEMO_PACK_XFER" in str(x.message) for x in w)
+
+
+def test_narrowed_dispatch_parity(tmp_path, monkeypatch):
+    """NEMO_NARROW_XFER=1 (the device-backend default, forced on here so
+    the CPU suite covers the narrow path): int8/int16 upload planes + the
+    [1,1] label stub must produce bit-identical fused outputs to the
+    int32 dispatch."""
+    from nemo_tpu.backend.jax_backend import _verb_arrays, _narrow_fused_arrays
+    from nemo_tpu.graphs.packed import CorpusVocab, bucket_size, pack_batch, pack_graph
+    from nemo_tpu.ingest.molly import load_molly_output
+    from nemo_tpu.models.case_studies import write_case_study
+
+    d = write_case_study("MR-3858-hadoop", n_runs=3, seed=6, out_dir=str(tmp_path))
+    molly = load_molly_output(d)
+    vocab = CorpusVocab()
+    pre_g = [pack_graph(r.pre_prov, vocab) for r in molly.runs]
+    post_g = [pack_graph(r.post_prov, vocab) for r in molly.runs]
+    v = bucket_size(max(g.n_nodes for g in pre_g + post_g))
+    e = bucket_size(max(1, *(len(g.edges) for g in pre_g + post_g)))
+    ids = [r.iteration for r in molly.runs]
+    pre_b, post_b = pack_batch(ids, pre_g, v, e), pack_batch(ids, post_g, v, e)
+    params = dict(
+        v=v,
+        pre_tid=vocab.tables.lookup("pre"),
+        post_tid=vocab.tables.lookup("post"),
+        num_tables=bucket_size(len(vocab.tables), 8),
+        num_labels=8,
+        max_depth=max(pre_b.max_depth, post_b.max_depth),
+        with_diff=0,
+        pack_out=0,
+    )
+    ex = LocalExecutor()
+    wide = ex.run("fused", _verb_arrays(pre_b, post_b), params)
+    monkeypatch.setenv("NEMO_NARROW_XFER", "1")
+    arrays = _narrow_fused_arrays(
+        _verb_arrays(pre_b, post_b),
+        v=v, num_tables=params["num_tables"], with_diff=False,
+    )
+    assert arrays["pre_edge_src"].dtype == np.int8  # the gate engaged
+    assert arrays["pre_label_id"].shape == (1, 1)
+    narrow = ex.run("fused", arrays, params)
+    assert sorted(wide) == sorted(narrow)
+    for name in wide:
+        np.testing.assert_array_equal(
+            np.asarray(wide[name]), np.asarray(narrow[name]), err_msg=name
+        )
