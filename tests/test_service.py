@@ -277,3 +277,27 @@ def test_uniform_spans_degenerate_sizes():
             assert all((e - s) + (1 if s > 0 else 0) <= pad_to for s, e in spans)
         if chunk_runs <= 1 or n <= chunk_runs:
             assert pad_to == 0
+
+
+def test_service_backend_narrowed_dispatch_matches_oracle(
+    sidecar, corpus_dir, tmp_path, monkeypatch
+):
+    """NEMO_NARROW_XFER=1 forced on the CLIENT (the device-backend default
+    the CPU suite would otherwise skip): the ServiceBackend's fused
+    dispatch ships int8/int16 planes + the [1,1] label stub through the
+    Kernel RPC codec, the server widens inside the compiled program, and
+    the report stays byte-identical to the in-process oracle."""
+    import json
+    import os
+
+    from nemo_tpu.analysis.pipeline import run_debug
+    from nemo_tpu.backend.python_ref import PythonBackend
+    from nemo_tpu.backend.service_backend import ServiceBackend
+
+    monkeypatch.setenv("NEMO_NARROW_XFER", "1")
+    oracle = run_debug(corpus_dir, str(tmp_path / "py"), PythonBackend())
+    remote = run_debug(corpus_dir, str(tmp_path / "svc"), ServiceBackend(target=sidecar))
+    with open(os.path.join(oracle.report_dir, "debugging.json")) as f:
+        want = json.load(f)
+    with open(os.path.join(remote.report_dir, "debugging.json")) as f:
+        assert json.load(f) == want
