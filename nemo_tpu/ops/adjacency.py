@@ -29,7 +29,14 @@ def build_adjacency(
 
 def bool_matmul(x: jax.Array, y: jax.Array) -> jax.Array:
     """Boolean matrix product on the MXU: bf16 multiply, f32 accumulate,
-    threshold.  Exact because entries are 0/1 and accumulation is f32."""
+    threshold.  Exact because entries are 0/1 and accumulation is f32.
+
+    bf16 is kept on the CPU fallback too (r5, measured): isolated 8-hop
+    chains run 3x faster in f32 on XLA:CPU (bf16 matmul is emulated), but
+    the production fused step shows NO e2e difference (sweep 2.34 s bf16
+    vs 2.54 s f32 at the 1x stress shape) — its CPU wall lives in the
+    scatter/one-hot passes, not the hop einsums, so a platform-split
+    dtype would churn every compiled signature for nothing."""
     prod = jnp.einsum(
         "...ik,...kj->...ij",
         x.astype(jnp.bfloat16),
