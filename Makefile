@@ -5,7 +5,7 @@ NATIVE_LIB := native/build/libnemo_native.so
 REPORT_SRC := native/nemo_report.cpp
 REPORT_LIB := native/build/libnemo_report.so
 
-.PHONY: all native test bench prewarm clean reset proto neo4j-up neo4j-validate neo4j-down
+.PHONY: all native test bench prewarm validate clean reset proto neo4j-up neo4j-validate neo4j-down
 
 all: native
 
@@ -20,6 +20,14 @@ $(REPORT_LIB): $(REPORT_SRC)
 
 test:
 	python -m pytest tests/ -x -q
+
+# Everything a reviewer needs in one command: the full suite, the driver's
+# multi-chip dry run (8 virtual CPU devices), and a CLI smoke whose jax
+# report is byte-compared against the Python oracle backend.
+validate: test
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+		python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+	python -m nemo_tpu.utils.validate_smoke
 
 bench:
 	python bench.py

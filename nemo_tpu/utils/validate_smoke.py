@@ -1,0 +1,37 @@
+"""`make validate` tail: a CLI-shaped smoke on a synthetic corpus with the
+jax backend's report byte-compared against the Python oracle's."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+
+def main() -> int:
+    from nemo_tpu.analysis.pipeline import run_debug
+    from nemo_tpu.backend.jax_backend import JaxBackend
+    from nemo_tpu.backend.python_ref import PythonBackend
+    from nemo_tpu.models.synth import SynthSpec, write_corpus
+    from nemo_tpu.utils.jax_config import pin_platform
+
+    pin_platform("cpu")  # never touch a (possibly tunneled) device here
+    with tempfile.TemporaryDirectory(prefix="nemo_validate_") as tmp:
+        corpus = write_corpus(SynthSpec(n_runs=6, seed=3), tmp)
+        jx = run_debug(corpus, os.path.join(tmp, "jx"), JaxBackend())
+        py = run_debug(corpus, os.path.join(tmp, "py"), PythonBackend())
+        with open(os.path.join(jx.report_dir, "debugging.json")) as f:
+            a = json.load(f)
+        with open(os.path.join(py.report_dir, "debugging.json")) as f:
+            b = json.load(f)
+        if a != b:
+            print("validate: jax report DIVERGES from the oracle", file=sys.stderr)
+            return 1
+        n_figs = len(os.listdir(os.path.join(jx.report_dir, "figures")))
+        print(f"validate: ok — oracle-identical report, {n_figs} figures")
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
