@@ -398,6 +398,23 @@ def _giant_impl_default() -> str:
     return impl
 
 
+def _max_batch_default() -> int | None:
+    """Run-axis dispatch bound when the backend was constructed without an
+    explicit max_batch: None (one dispatch per joint bucket) on device
+    backends — fewer tunnel RTTs, and the TPU executes the big padded
+    batch flat out — but 2048 on CPU, where XLA:CPU degrades ~5x on the
+    giant power-of-two-padded buffers (measured, B=17000 family padded to
+    [32768,64,64]: 50.6 s single-dispatch vs 10.1 s in 2048-run batches —
+    cache locality, not RAM: the host had 100+ GB free).  Resolved at
+    init_graph_db, after the entry point's watchdog pinned a platform.
+    NEMO_MAX_BATCH overrides (0 = unbounded)."""
+    env = os.environ.get("NEMO_MAX_BATCH", "").strip()
+    if env:
+        n = int(env)
+        return None if n == 0 else n
+    return 2048 if jax.default_backend() == "cpu" else None
+
+
 def _giant_impl_env() -> str:
     """Parse + validate NEMO_GIANT_IMPL (shared by the in-process and
     service backends so the accepted spellings can never diverge)."""
@@ -540,6 +557,9 @@ class JaxBackend(GraphBackend):
 
     def __init__(self, max_batch: int | None = None, executor=None) -> None:
         self.max_batch = max_batch
+        #: resolved dispatch bound; finalized in init_graph_db (the platform
+        #: default needs jax.default_backend(), unsafe before the watchdog).
+        self._max_batch = max_batch
         # The device boundary.  LocalExecutor runs kernels in-process; the
         # ServiceBackend passes a RemoteExecutor that sends each call to the
         # gRPC sidecar instead (north-star two-process architecture).
@@ -576,6 +596,11 @@ class JaxBackend(GraphBackend):
         # iteration -> parse-time linearity flag (AND over colliding rows).
         self._lin_by_iter: dict[int, bool] = {}
 
+    def _resolve_max_batch(self) -> int | None:
+        """Platform-default run-axis dispatch bound (see _max_batch_default);
+        ServiceBackend overrides — its device lives in the sidecar."""
+        return _max_batch_default()
+
     def _resolve_giant_impl(self) -> str:
         """Giant crossover routing hook: the in-process backend resolves
         "auto" against the local device platform (_giant_impl_default);
@@ -591,6 +616,9 @@ class JaxBackend(GraphBackend):
         # build_figures can never disagree within one corpus.
         self._giant_v = _giant_threshold()
         self._giant_impl = self._resolve_giant_impl()
+        self._max_batch = (
+            self.max_batch if self.max_batch is not None else self._resolve_max_batch()
+        )
         self._diff_host_work = _diff_host_work_budget()
         #: impl the last _fused giant dispatch actually took (None = no
         #: giant runs in the corpus) — surfaced in the bench giant row.
@@ -830,7 +858,7 @@ class JaxBackend(GraphBackend):
                     self._corpus_graphs,
                     rows,
                     self._corpus.iteration,
-                    self.max_batch,
+                    self._max_batch,
                     min_v=min_v,
                     min_e=min_e,
                 )
@@ -838,7 +866,7 @@ class JaxBackend(GraphBackend):
                 pre = [self.packed[(i, "pre")] for i in run_ids]
                 post = [self.packed[(i, "post")] for i in run_ids]
                 batches = bucketize_pairs(
-                    run_ids, pre, post, self.max_batch, min_v=min_v, min_e=min_e
+                    run_ids, pre, post, self._max_batch, min_v=min_v, min_e=min_e
                 )
             from nemo_tpu.ops.simplify import pair_chains_linear
 

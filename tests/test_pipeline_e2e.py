@@ -114,3 +114,28 @@ def test_run_debug_dirs_overlap_parity(tmp_path):
             assert filecmp.cmp(
                 os.path.join(da, rel), os.path.join(db, rel), shallow=False
             ), rel
+
+
+def test_bounded_dispatch_matches_oracle(tmp_path, monkeypatch):
+    """NEMO_MAX_BATCH splits the joint buckets into bounded run-axis
+    dispatches (the CPU-tier default is 2048 — XLA:CPU degrades ~5x on
+    giant padded batches); a bound far below the corpus size must produce
+    the oracle's byte-identical report."""
+    import json
+    import os
+
+    from nemo_tpu.analysis.pipeline import run_debug
+    from nemo_tpu.backend.jax_backend import JaxBackend
+    from nemo_tpu.backend.python_ref import PythonBackend
+    from nemo_tpu.models.case_studies import write_case_study
+
+    d = write_case_study("pb_asynchronous", n_runs=30, seed=9, out_dir=str(tmp_path))
+    monkeypatch.setenv("NEMO_MAX_BATCH", "8")  # forces >=4 batches
+    be = JaxBackend()
+    jx = run_debug(d, str(tmp_path / "jx"), be)
+    assert be._max_batch == 8
+    py = run_debug(d, str(tmp_path / "py"), PythonBackend())
+    with open(os.path.join(jx.report_dir, "debugging.json")) as f:
+        a = json.load(f)
+    with open(os.path.join(py.report_dir, "debugging.json")) as f:
+        assert a == json.load(f)
