@@ -408,11 +408,32 @@ def _max_batch_default() -> int | None:
     cache locality, not RAM: the host had 100+ GB free).  Resolved at
     init_graph_db, after the entry point's watchdog pinned a platform.
     NEMO_MAX_BATCH overrides (0 = unbounded)."""
-    env = os.environ.get("NEMO_MAX_BATCH", "").strip()
-    if env:
-        n = int(env)
-        return None if n == 0 else n
+    override = _max_batch_env()
+    if override is not _NO_OVERRIDE:
+        return override
     return 2048 if jax.default_backend() == "cpu" else None
+
+
+#: Sentinel distinguishing "no NEMO_MAX_BATCH set" from "=0 (unbounded)".
+_NO_OVERRIDE = object()
+
+
+def _max_batch_env():
+    """Parse + validate NEMO_MAX_BATCH (shared by the in-process and
+    service backends so the semantics can never diverge): _NO_OVERRIDE
+    when unset, None for 0 (unbounded), else a positive bound."""
+    env = os.environ.get("NEMO_MAX_BATCH", "").strip()
+    if not env:
+        return _NO_OVERRIDE
+    try:
+        n = int(env)
+    except ValueError:
+        raise ValueError(
+            f"NEMO_MAX_BATCH={env!r} is not an integer (0 = unbounded)"
+        ) from None
+    if n < 0:
+        raise ValueError(f"NEMO_MAX_BATCH={n} must be >= 0 (0 = unbounded)")
+    return None if n == 0 else n
 
 
 def _giant_impl_env() -> str:

@@ -47,15 +47,13 @@ class ServiceBackend(JaxBackend):
     def _resolve_max_batch(self):
         """The sidecar owns the accelerator, so the client's platform says
         nothing about the right dispatch bound: keep single-dispatch on
-        auto (NEMO_MAX_BATCH still overrides via the base resolver when the
-        operator knows the sidecar is CPU-bound)."""
-        import os
+        auto; an explicit NEMO_MAX_BATCH (shared parser, so the semantics
+        cannot diverge from the in-process backend) still bounds the
+        dispatches when the operator knows the sidecar is CPU-bound."""
+        from nemo_tpu.backend.jax_backend import _NO_OVERRIDE, _max_batch_env
 
-        env = os.environ.get("NEMO_MAX_BATCH", "").strip()
-        if env:
-            n = int(env)
-            return None if n == 0 else n
-        return None
+        override = _max_batch_env()
+        return None if override is _NO_OVERRIDE else override
 
     def _resolve_giant_impl(self) -> str:
         """Giant crossover routing (VERDICT r4 task 2): "auto" keeps the
