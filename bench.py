@@ -210,6 +210,18 @@ def child_main() -> None:
     import atexit
 
     atexit.register(shutil.rmtree, tmp, ignore_errors=True)
+    # The report layer's persistent SVG cache defaults under ~/.cache; the
+    # bench must neither leak state into nor warm-start from the user's
+    # cache, so default it into the bench tmp (an operator-pinned
+    # NEMO_SVG_CACHE still wins).  The all-figures section below swaps in
+    # its own cold/warm cache dirs.
+    os.environ.setdefault("NEMO_SVG_CACHE", os.path.join(tmp, "svg_cache_e2e"))
+    # Whether the fused dispatch narrows its upload dtypes ON THIS RUN
+    # (platform-gated; ADVICE r5 #2): the recorded upload volume must
+    # describe the bytes the benched dispatches actually shipped.
+    from nemo_tpu.backend.jax_backend import _narrow_xfer_default
+
+    narrow_active = bool(_narrow_xfer_default())
     for name in families:
         t0 = time.perf_counter()
         big_dir = write_case_study(
@@ -244,20 +256,30 @@ def child_main() -> None:
         # tunnel (~MB/s-class bandwidth) this is a candidate for the
         # unexplained e2e wall, so the bench records it (r5 task 5).
         # Computed ARITHMETICALLY from shapes (no .astype, no device
-        # touch) with the deployment's narrowing applied
+        # touch) with the narrowing THIS RUN actually applies (ADVICE r5
+        # #2: narrowing is platform-gated off on CPU, where the planes
+        # ship at their packed widths and the label plane ships in full
+        # instead of the [1,1] stub).  When active
         # (backend/jax_backend.py:_narrow_fused_arrays): edge/table planes
         # ship int8/int16 by bound, type int8, label a [1,1] stub
         # (with_diff=0), masks 1-byte bool.
-        def _w(bound):
+        def _w(a, bound):
+            if not narrow_active:
+                return np.asarray(a).dtype.itemsize
             return 1 if bound <= 127 else (2 if bound <= 32767 else 4)
 
         upload_mb = sum(
-            ba.edge_src.size * _w(static["v"]) * 2  # src + dst
+            ba.edge_src.size * _w(ba.edge_src, static["v"])
+            + ba.edge_dst.size * _w(ba.edge_dst, static["v"])
             + ba.edge_mask.size  # bool
             + ba.is_goal.size + ba.node_mask.size  # bool
-            + ba.table_id.size * _w(static["num_tables"])
-            + ba.type_id.size * 1
-            + 1  # label [1,1] int8 stub
+            + ba.table_id.size * _w(ba.table_id, static["num_tables"])
+            + ba.type_id.size * _w(ba.type_id, 8)
+            + (
+                1  # label [1,1] int8 stub (with_diff=0)
+                if narrow_active
+                else ba.label_id.size * np.asarray(ba.label_id).dtype.itemsize
+            )
             for ba in (pre, post)
         ) / 1e6
         big_dirs.append((name, big_dir))
@@ -667,48 +689,103 @@ def child_main() -> None:
     except Exception as ex:  # giant stress must never sink the bench
         log(f"giant path skipped: {type(ex).__name__}: {ex}")
 
-    # Full-figure report cost (VERDICT r4 task 6): the e2e tiers render
-    # figures="sample:8" while the reference renders EVERY figure for every
-    # run (main.go:251-289) — quantify what "all" would add.  Measured as
-    # the (figures=all − figures=none) wall delta per family on a bounded
-    # warm sub-corpus (everything is compiled by now), then extrapolated
-    # linearly to the full corpus: figure cost is per-run host work (DOT
-    # materialization + in-tree layout + native SVG), so runs/s is flat in
-    # corpus size.
+    # Full-figure report cost (VERDICT r4 task 6; ISSUE 1 tentpole): the
+    # e2e tiers render figures="sample:8" while the reference renders EVERY
+    # figure for every run (main.go:251-289).  r5 put the "all" policy at
+    # +56.3 s EXTRAPOLATED from a 256-run sub-corpus (serial per-figure
+    # rendering); the dedup + cache + worker-pool pipeline
+    # (report/render.py) makes full-scale "all" cheap enough to measure
+    # DIRECTLY, so these are walls over the full distinct-run corpus via
+    # the overlapped multi-corpus driver (the production path):
+    #   all_w1      NEMO_RENDER_WORKERS=1, cold SVG cache — the dedup-only
+    #               win (every unique figure renders once, inline)
+    #   all         default workers, cold cache — a first-run deployment
+    #   all_cached  default workers, warm cache — a re-report: rendering
+    #               is skipped entirely, only dot-materialize + fan-out
     figures = None
     try:
-        figs_runs = int(os.environ.get("NEMO_BENCH_FIGS_RUNS", "256"))
-        tot_delta = tot_figs = 0.0
-        per_run_cost = {}
-        for name in families:
-            fdir = write_case_study(
-                name, n_runs=figs_runs, seed=13, out_dir=os.path.join(tmp, "figs")
-            )
-            walls = {}
-            for pol in ("none", "all"):
+        warm_wall = e2e["warm"]["wall_s"]
+        prev_cache = os.environ.get("NEMO_SVG_CACHE")
+        prev_workers = os.environ.get("NEMO_RENDER_WORKERS")
+        passes: dict = {}
+        fstats: dict = {}
+        try:
+            for flabel, workers, cache_dir in (
+                ("all_w1", "1", os.path.join(tmp, "svg_cache_w1")),
+                ("all", None, os.path.join(tmp, "svg_cache_full")),
+                ("all_cached", None, os.path.join(tmp, "svg_cache_full")),
+            ):
+                os.environ["NEMO_SVG_CACHE"] = cache_dir
+                if workers is None:
+                    os.environ.pop("NEMO_RENDER_WORKERS", None)
+                else:
+                    os.environ["NEMO_RENDER_WORKERS"] = workers
                 t0 = time.perf_counter()
-                res = run_debug(fdir, os.path.join(tmp, f"figs_{pol}"), JaxBackend(),
-                                figures=pol)
-                walls[pol] = time.perf_counter() - t0
-            n_svg = len([
-                f for f in os.listdir(os.path.join(res.report_dir, "figures"))
-                if f.endswith(".svg")
-            ])
-            delta = max(1e-9, walls["all"] - walls["none"])
-            tot_delta += delta
-            tot_figs += n_svg
-            per_run_cost[name] = delta / figs_runs
-        extrapolated = sum(per_run_cost[n] * per_family for n in families)
+                ress = run_debug_dirs(
+                    [d for _, d in big_dirs],
+                    os.path.join(tmp, f"results_{flabel}"),
+                    JaxBackend,
+                    figures="all",
+                )
+                passes[flabel] = time.perf_counter() - t0
+                fstats[flabel] = ress[-1].figure_stats or {}
+                log(
+                    f"all-figures [{flabel}] ({total_runs} runs): "
+                    f"{passes[flabel]:.1f}s wall, {json.dumps(fstats[flabel])}"
+                )
+        finally:
+            for var, prev in (
+                ("NEMO_SVG_CACHE", prev_cache),
+                ("NEMO_RENDER_WORKERS", prev_workers),
+            ):
+                if prev is None:
+                    os.environ.pop(var, None)
+                else:
+                    os.environ[var] = prev
+        s = fstats["all"]
         figures = {
-            "measured_runs_per_family": figs_runs,
-            "figs_per_sec": round(tot_figs / tot_delta, 1),
-            "figure_cost_s_at_measured_scale": round(tot_delta, 2),
-            # What figures="all" adds on top of the e2e warm wall at the
-            # full corpus scale (per-run figure cost x full per-family runs).
-            "all_policy_extra_s_at_full_scale": round(extrapolated, 1),
-            "e2e_warm_all_figures_s": round(
-                e2e["warm"]["wall_s"] + extrapolated, 1
-            ) if isinstance(e2e.get("warm"), dict) else None,
+            "figures_total": s.get("figures"),
+            "unique_figures": s.get("unique_figures"),
+            "dedup_ratio": s.get("dedup_ratio"),
+            "figure_cache_hits": fstats["all_cached"].get("figure_cache_hits"),
+            "render_workers": s.get("render_workers"),
+            # Pure rendering seconds per pass vs what the pre-dedup serial
+            # loop would have spent rendering (measured per-unique render
+            # time x fan-out width, from the workers=1 pass): the realized
+            # render win is serial est / render — >= the dedup ratio at
+            # workers=1 by construction, 0 renders on the cached pass.
+            "render_s": s.get("render_s"),
+            "render_w1_s": fstats["all_w1"].get("render_s"),
+            "render_cached_s": fstats["all_cached"].get("render_s"),
+            "serial_render_est_s": fstats["all_w1"].get("serial_render_est_s"),
+            # Within-THIS-capture estimate of the pre-dedup serial loop's
+            # all-figures wall: the cached pass re-does everything except
+            # rendering (dot materialization + all file creates), so adding
+            # the measured serial render cost back reconstructs the old
+            # path's wall under today's machine/filesystem conditions —
+            # cross-round wall comparisons are weather (the 9p file-create
+            # floor and host contention swing 3x between captures), the
+            # render components above are the invariant win.
+            "serial_all_figures_est_s": round(
+                passes["all_cached"]
+                + (fstats["all_w1"].get("serial_render_est_s") or 0.0),
+                1,
+            ),
+            # Measured walls at full corpus scale (kernels warm), and what
+            # the "all" policy adds over the sample:8 warm wall:
+            "e2e_warm_all_figures_s": round(passes["all"], 1),
+            "e2e_warm_all_figures_w1_s": round(passes["all_w1"], 1),
+            "e2e_warm_all_figures_cached_s": round(passes["all_cached"], 1),
+            "all_policy_extra_s": round(max(0.0, passes["all"] - warm_wall), 1),
+            "all_policy_extra_cached_s": round(
+                max(0.0, passes["all_cached"] - warm_wall), 1
+            ),
+            # null when the all-figures wall did not exceed the warm wall
+            # (separate captures on a contended host can invert) — a
+            # clamped denominator would print a nonsense ~1e12 rate.
+            "figs_per_sec": round(s.get("figures", 0) / (passes["all"] - warm_wall), 1)
+            if passes["all"] - warm_wall > 0.5
+            else None,
         }
         log(f"full-figure cost: {json.dumps(figures)}")
     except Exception as ex:  # figure costing must never sink the bench

@@ -38,6 +38,12 @@ class DebugResult:
     molly: MollyOutput
     report_dir: str
     timings: dict[str, float]
+    #: RenderScheduler.stats() snapshot for the figure pipeline that produced
+    #: this report (dedup ratio, cache hits, workers...); None when the
+    #: caller owns the scheduler and drains it after several corpora
+    #: (run_debug_dirs fills it in post-drain) or when a legacy sequential
+    #: Reporter was passed in.
+    figure_stats: dict | None = None
 
 
 def _prov_json_str(prov) -> str:
@@ -199,12 +205,44 @@ def run_debug_dirs(
     calls).  This is the in-process twin of the sidecar's
     analyze_dir_pipelined (service/client.py).
 
+    Figure rendering is ALSO overlapped: one shared RenderScheduler spans
+    all directories, so corpus k's unique SVGs render in the worker pool
+    while corpus k+1's kernels dispatch; everything drains (and the SVG
+    files land) before this returns, with the aggregate stats attached to
+    every result's figure_stats and the drain wall in
+    figure_stats["drain_wall_s"].
+
     `make_backend` is called once per directory (a GraphBackend instance
     per corpus, like the sequential loop it replaces).  kwargs flow to
     run_debug.  With prefetch=False this is exactly the sequential loop.
+
+    Corpus directories must have DISTINCT basenames (rejected loudly
+    otherwise): each report writes to results_root/<run_name> where
+    run_name is the directory basename, and a duplicate basename would
+    make the later report's prepare() silently delete the earlier report
+    (any of its figures still pending in the shared scheduler would then
+    land in the later report's directory).  save_corpus_path is rejected
+    for the same shared-kwargs reason: every corpus would overwrite the
+    same .npz bundle (ADVICE r5).
     """
     import threading
 
+    if kwargs.get("save_corpus_path"):
+        raise ValueError(
+            "save_corpus_path is not supported by run_debug_dirs: kwargs are "
+            "shared across directories, so every corpus would overwrite the "
+            "same .npz bundle; call run_debug per directory with distinct "
+            "paths instead"
+        )
+    basenames = [os.path.basename(os.path.normpath(d)) for d in dirs]
+    dupes = {b for b in basenames if basenames.count(b) > 1}
+    if dupes:
+        raise ValueError(
+            f"corpus directories share basenames {sorted(dupes)}: each report "
+            "writes to results_root/<basename>, so the later corpus would "
+            "silently delete the earlier report; rename the directories or "
+            "use separate results roots"
+        )
     if not dirs:
         return []
     # Backends are constructed lazily, one per iteration, and dropped after
@@ -225,25 +263,55 @@ def run_debug_dirs(
         except BaseException as ex:  # re-raised on the consuming thread
             prefetched[1] = ex
 
+    from nemo_tpu.report.render import RenderScheduler
+
     th: "threading.Thread | None" = None
     molly = None
-    for k, d in enumerate(dirs):
-        if th is not None:
-            th.join()
-            if prefetched[1] is not None:
-                raise prefetched[1]
-            molly = prefetched[0]
-            prefetched[0] = prefetched[1] = None
-        th = None
-        if prefetch and k + 1 < len(dirs):
-            th = threading.Thread(
-                target=prefetch_next, args=(dirs[k + 1],), daemon=True
+    scheduler = RenderScheduler()
+    try:
+        for k, d in enumerate(dirs):
+            if th is not None:
+                th.join()
+                if prefetched[1] is not None:
+                    raise prefetched[1]
+                molly = prefetched[0]
+                prefetched[0] = prefetched[1] = None
+            th = None
+            if prefetch and k + 1 < len(dirs):
+                th = threading.Thread(
+                    target=prefetch_next, args=(dirs[k + 1],), daemon=True
+                )
+                th.start()
+            results.append(
+                run_debug(
+                    d,
+                    results_root,
+                    make_backend(),
+                    molly=molly,
+                    render_scheduler=scheduler,
+                    **kwargs,
+                )
             )
-            th.start()
-        results.append(
-            run_debug(d, results_root, make_backend(), molly=molly, **kwargs)
-        )
-        molly = None
+            molly = None
+        # Settle the figure pipeline: whatever didn't finish under the
+        # analysis overlap renders/writes now, so every SVG exists before
+        # this returns — the same contract as the sequential loop.
+        import time as _time
+
+        t0 = _time.perf_counter()
+        stats = scheduler.drain()
+        stats["drain_wall_s"] = round(_time.perf_counter() - t0, 3)
+    finally:
+        # Best-effort settle even when a later corpus failed mid-loop: the
+        # reports already completed must keep their SVGs (the sequential
+        # loop's contract); the original exception stays the one raised.
+        try:
+            scheduler.drain()
+        except Exception:
+            pass
+        scheduler.close()
+    for r in results:
+        r.figure_stats = stats
     return results
 
 
@@ -258,6 +326,7 @@ def run_debug(
     figures: str = "all",
     ingest: str = "auto",
     molly=None,
+    render_scheduler=None,
 ) -> DebugResult:
     """Full debug pipeline.  With profile_dir set, the analysis phases run
     under jax.profiler.trace — open the directory with TensorBoard or
@@ -265,7 +334,15 @@ def run_debug(
     tracing story).  `figures` is the figure materialization policy
     (select_figure_iters).  `ingest` selects the ETL: "python" (object
     loader), "native" (packed-first C++ loader, array backends only), or
-    "auto" (native when the backend supports it and the library builds)."""
+    "auto" (native when the backend supports it and the library builds).
+
+    Figure SVGs render through the dedup/cache/parallel pipeline
+    (report/render.py) by default, drained inside the report phase.  With
+    `render_scheduler` supplied the figures are submitted to it and NOT
+    drained — the caller overlaps rendering with its own later work and
+    drains when ready (run_debug_dirs).  An explicitly passed `reporter`
+    whose .scheduler is None keeps the sequential per-figure render loop —
+    the byte-parity oracle path."""
     import contextlib
 
     trace_ctx: contextlib.AbstractContextManager = contextlib.nullcontext()
@@ -388,8 +465,17 @@ def run_debug(
         run.union_proto_missing = union_miss[j]
 
     # Reporting (main.go:239-292).
+    fig_stats: dict | None = None
     with timer.phase("report"):
-        reporter = reporter or Reporter()
+        own_scheduler = None
+        if reporter is None:
+            if render_scheduler is None:
+                from nemo_tpu.report.render import RenderScheduler
+
+                render_scheduler = own_scheduler = RenderScheduler()
+            reporter = Reporter(scheduler=render_scheduler)
+        elif render_scheduler is not None:
+            reporter.scheduler = render_scheduler
         this_results_dir = os.path.join(results_root, molly.run_name)
         reporter.prepare(results_root, this_results_dir)
 
@@ -413,13 +499,28 @@ def run_debug(
                 fh.write(_run_json_str(r, good_iter))
             fh.write("]")
 
-        reporter.generate_figures(fig_iters, "spacetime", hazard_dots)
-        reporter.generate_figures(fig_iters, "pre_prov", pre_dots)
-        reporter.generate_figures(fig_iters, "post_prov", post_dots)
-        reporter.generate_figures(fig_iters, "pre_prov_clean", pre_clean_dots)
-        reporter.generate_figures(fig_iters, "post_prov_clean", post_clean_dots)
-        diff_fig_iters = fig_failed if diff_dots else []
-        reporter.generate_figures(diff_fig_iters, "diff_post_prov-diff", diff_dots)
-        reporter.generate_figures(diff_fig_iters, "diff_post_prov-failed", failed_dots)
+        try:
+            reporter.generate_figures(fig_iters, "spacetime", hazard_dots)
+            reporter.generate_figures(fig_iters, "pre_prov", pre_dots)
+            reporter.generate_figures(fig_iters, "post_prov", post_dots)
+            reporter.generate_figures(fig_iters, "pre_prov_clean", pre_clean_dots)
+            reporter.generate_figures(fig_iters, "post_prov_clean", post_clean_dots)
+            diff_fig_iters = fig_failed if diff_dots else []
+            reporter.generate_figures(diff_fig_iters, "diff_post_prov-diff", diff_dots)
+            reporter.generate_figures(diff_fig_iters, "diff_post_prov-failed", failed_dots)
 
-    return DebugResult(molly=molly, report_dir=this_results_dir, timings=timer.as_dict())
+            if own_scheduler is not None:
+                # Internally owned pipeline: settle it here so the report
+                # phase keeps its meaning (all figures on disk when the
+                # phase closes).
+                fig_stats = own_scheduler.drain()
+        finally:
+            if own_scheduler is not None:
+                own_scheduler.close()
+
+    return DebugResult(
+        molly=molly,
+        report_dir=this_results_dir,
+        timings=timer.as_dict(),
+        figure_stats=fig_stats,
+    )

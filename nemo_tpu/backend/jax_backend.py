@@ -421,7 +421,16 @@ _NO_OVERRIDE = object()
 def _max_batch_env():
     """Parse + validate NEMO_MAX_BATCH (shared by the in-process and
     service backends so the semantics can never diverge): _NO_OVERRIDE
-    when unset, None for 0 (unbounded), else a positive bound."""
+    when unset, None for 0 (unbounded), else a positive bound.
+
+    Deliberately LOUD on junk, unlike the warn-and-default boolean knobs
+    (NEMO_PACK_XFER / NEMO_NARROW_XFER, ADVICE r5 #4): those toggle
+    between two correct, measured configurations, so any junk spelling
+    safely degrades to the platform default.  A batch bound has no such
+    safe reading — a typo ("20 48", "2O48") silently falling back to the
+    platform default would change dispatch granularity, program count,
+    and peak memory in exactly the dimension the operator was explicitly
+    trying to control, so it raises at init_graph_db instead."""
     env = os.environ.get("NEMO_MAX_BATCH", "").strip()
     if not env:
         return _NO_OVERRIDE
@@ -465,14 +474,10 @@ def _diff_host_work_budget() -> int:
     return int(os.environ.get("NEMO_DIFF_HOST_WORK", "2000000"))
 
 
-def _narrow_xfer_default() -> int:
-    """Whether the fused dispatch narrows its upload dtypes: yes on device
-    backends (the bytes cross a bandwidth-priced transfer), no on CPU
-    where "transfer" is a pointer handoff and the astype copies + the
-    in-program widening pass are pure cost (measured ~1 s of the 8 s CPU
-    warm e2e at 1x).  Same platform logic and spelling rules as
-    NEMO_PACK_XFER one function down; NEMO_NARROW_XFER=0/1 overrides
-    (tests pin =1 so the narrow path stays covered on the CPU suite)."""
+def _narrow_xfer_env() -> int | None:
+    """Explicit NEMO_NARROW_XFER override: 1/0 when set to a recognized
+    boolean spelling, None when unset (junk warns and counts as unset —
+    the same warn-and-default policy as NEMO_PACK_XFER)."""
     env = os.environ.get("NEMO_NARROW_XFER", "").strip().lower()
     if env:
         if env in ("1", "true", "yes", "on"):
@@ -484,11 +489,30 @@ def _narrow_xfer_default() -> int:
             "using the backend default",
             stacklevel=2,
         )
+    return None
+
+
+def _narrow_xfer_default() -> int:
+    """Whether the fused dispatch narrows its upload dtypes: yes on device
+    backends (the bytes cross a bandwidth-priced transfer), no on CPU
+    where "transfer" is a pointer handoff and the astype copies + the
+    in-program widening pass are pure cost (measured ~1 s of the 8 s CPU
+    warm e2e at 1x).  Same platform logic and spelling rules as
+    NEMO_PACK_XFER one function down; NEMO_NARROW_XFER=0/1 overrides
+    (tests pin =1 so the narrow path stays covered on the CPU suite).
+
+    This is the LOCAL-process resolution, correct only when this process
+    owns the device; ServiceBackend overrides _resolve_narrow_xfer instead
+    (ADVICE r5 #1) — its upload crosses the Kernel RPC, which is
+    bandwidth-priced regardless of the client's own jax platform."""
+    override = _narrow_xfer_env()
+    if override is not None:
+        return override
     return int(jax.default_backend() != "cpu")
 
 
 def _narrow_fused_arrays(
-    arrays: dict, v: int, num_tables: int, with_diff: bool
+    arrays: dict, v: int, num_tables: int, with_diff: bool, narrow: bool | None = None
 ) -> dict:
     """Shrink the host->device upload of the fused verb's integer planes
     (models/pipeline_model.py:widen_batch casts back inside the compiled
@@ -499,11 +523,16 @@ def _narrow_fused_arrays(
     critical path; the same narrowing shrinks the Kernel RPC payloads
     (service codec is dtype-generic).  With the diff tail off, the label
     plane is replaced by a [1,1] stub — the trace never reads it, so only
-    its bytes disappear."""
-    if not _narrow_xfer_default():
+    its bytes disappear.
+
+    `narrow` is the backend's resolved gate (_resolve_narrow_xfer — the
+    in-process and sidecar deployments resolve it differently); None
+    falls back to the local-process default for standalone callers
+    (prewarm mirrors the in-process deployment this way)."""
+    if not (_narrow_xfer_default() if narrow is None else narrow):
         return arrays
 
-    def narrow(a: np.ndarray, bound: int) -> np.ndarray:
+    def _narrow_plane(a: np.ndarray, bound: int) -> np.ndarray:
         if bound <= 127:
             return a.astype(np.int8)
         if bound <= 32767:
@@ -519,7 +548,7 @@ def _narrow_fused_arrays(
             ("type_id", 8),
         ):
             key = f"{prefix}_{name}"
-            out[key] = narrow(np.asarray(out[key]), bound)
+            out[key] = _narrow_plane(np.asarray(out[key]), bound)
         if not with_diff:
             out[f"{prefix}_label_id"] = np.zeros((1, 1), dtype=np.int8)
     return out
@@ -606,6 +635,7 @@ class JaxBackend(GraphBackend):
         # jax.default_backend(), which may touch the device — only safe
         # after the entry point's watchdog has pinned a platform.
         self._giant_impl = None
+        self._narrow_xfer: bool | None = None
         self._diff_host_work = _diff_host_work_budget()
         #: impl the last _fused giant dispatch actually took (None = no
         #: giant runs in the corpus) — surfaced in the bench giant row.
@@ -629,6 +659,13 @@ class JaxBackend(GraphBackend):
         client's platform is the wrong signal."""
         return _giant_impl_default()
 
+    def _resolve_narrow_xfer(self) -> bool:
+        """Upload-dtype narrowing gate: in-process, the local platform
+        decides (narrow when the bytes cross a real device transfer);
+        ServiceBackend overrides — its upload crosses the Kernel RPC, so
+        the client's own platform is the wrong signal (ADVICE r5 #1)."""
+        return bool(_narrow_xfer_default())
+
     # ------------------------------------------------------------------ setup
 
     def init_graph_db(self, conn: str, molly: MollyOutput) -> None:
@@ -637,6 +674,7 @@ class JaxBackend(GraphBackend):
         # build_figures can never disagree within one corpus.
         self._giant_v = _giant_threshold()
         self._giant_impl = self._resolve_giant_impl()
+        self._narrow_xfer = self._resolve_narrow_xfer()
         self._max_batch = (
             self.max_batch if self.max_batch is not None else self._resolve_max_batch()
         )
@@ -911,6 +949,7 @@ class JaxBackend(GraphBackend):
                         v=pre_b.v,
                         num_tables=params_common["num_tables"],
                         with_diff=False,
+                        narrow=self._narrow_xfer,
                     ),
                     dict(
                         v=pre_b.v,

@@ -112,6 +112,25 @@ def main(argv: list[str] | None = None) -> int:
         "figure dominates wall clock",
     )
     parser.add_argument(
+        "--render-workers",
+        type=int,
+        default=None,
+        metavar="N",
+        help="figure-render worker processes (default $NEMO_RENDER_WORKERS "
+        "or cpu count; 1 renders inline).  Unique figures only: figures "
+        "are deduplicated by render content and served from the "
+        "persistent SVG cache before any worker runs",
+    )
+    parser.add_argument(
+        "--svg-cache",
+        default=None,
+        metavar="DIR",
+        help="persistent SVG cache directory (default $NEMO_SVG_CACHE or "
+        "~/.cache/nemo_tpu/svg; 'off' disables).  Keyed by (render "
+        "content hash, renderer version), so warm re-reports skip "
+        "rendering entirely",
+    )
+    parser.add_argument(
         "--platform",
         default=None,
         metavar="NAME",
@@ -161,6 +180,12 @@ def main(argv: list[str] | None = None) -> int:
         # asked otherwise, so stray jax imports can't block on tunnel health.
         pin_platform(args.platform if args.platform not in (None, "", "auto") else "cpu")
     enable_compilation_cache()
+    # The render knobs travel as env so the resolution is identical across
+    # the CLI, the bench, and run_debug_dirs (report/render.py reads them).
+    if args.render_workers is not None:
+        os.environ["NEMO_RENDER_WORKERS"] = str(args.render_workers)
+    if args.svg_cache is not None:
+        os.environ["NEMO_SVG_CACHE"] = args.svg_cache
     backend = make_backend(args.graph_backend)
     result = run_debug(
         args.fault_inj_out,
@@ -176,6 +201,14 @@ def main(argv: list[str] | None = None) -> int:
     if args.timings:
         for phase, secs in result.timings.items():
             print(f"{phase:>22s}  {secs * 1e3:9.1f} ms")
+        fs = result.figure_stats
+        if fs and fs.get("figures"):
+            print(
+                f"figures: {fs['figures']} rendered as {fs['unique_figures']} "
+                f"unique (dedup {fs['dedup_ratio']}x), "
+                f"{fs['figure_cache_hits']} cache hits, "
+                f"{fs['render_workers']} render workers"
+            )
 
     print(f"All done! Find the debug report here: {os.path.join(result.report_dir, 'index.html')}")
 

@@ -27,6 +27,11 @@ _LIB = os.path.join(os.path.dirname(__file__), "..", "..", "native", "build", "l
 
 _INVIS, _DASHED, _BOLD = 1, 2, 4
 
+#: ABI version the compiled library must report.  Also part of the
+#: persistent SVG cache key (report/render.py:renderer_version), since an
+#: ABI bump accompanies any change to the native engine's output.
+REPORT_ABI_VERSION = 2
+
 
 def _bind(lib: ctypes.CDLL) -> None:
     lib.nemo_render_svg.restype = ctypes.c_void_p  # owned char*, freed below
@@ -51,7 +56,7 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.nemo_report_free.argtypes = [ctypes.c_void_p]
 
 
-_native = NativeLib(_SRC, _LIB, _bind, "nemo_report_abi_version", 2)
+_native = NativeLib(_SRC, _LIB, _bind, "nemo_report_abi_version", REPORT_ABI_VERSION)
 
 
 def build_native(force: bool = False) -> str:

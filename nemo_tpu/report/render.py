@@ -1,0 +1,376 @@
+"""Deduplicated, cached, parallel DOT -> SVG rendering.
+
+The reference renders one figure at a time by shelling out to graphviz
+(report/webpage.go:65); at stress scale (10k+ runs x 7 figure families) a
+serial render loop dominates the end-to-end wall (BENCH_r05: +56.3 s at full
+scale, pure host work).  This module replaces it with a three-stage pipeline:
+
+1. **Dedup.**  Fault-injection runs within a family draw from one protocol
+   template, so their figures are overwhelmingly isomorphic — but their DOT
+   *text* is not: node ids embed the run iteration (``run_<iter>_...``).
+   The renderer, however, never draws node ids — only labels, colors,
+   shapes, style flags, and node/edge/cluster ORDER (report/svg.py).  So
+   figures are deduplicated by a *render key*: a content hash over exactly
+   the renderer's inputs, under which two renamed-but-isomorphic figures
+   collide and render ONCE, the SVG fanned out to every path that shares it
+   (measured: 394 figures -> 58 unique at 64 runs/family, and the unique
+   count is corpus-size-independent, so the ratio grows with scale).
+
+2. **Persistent cache.**  Unique SVGs are stored content-addressed on disk,
+   keyed by (render key, renderer version) next to the jit-artifact cache
+   (``~/.cache/nemo_tpu/svg``; ``NEMO_SVG_CACHE`` overrides/disables), so a
+   warm re-run or re-report skips rendering entirely.
+
+3. **Parallel workers.**  Cache misses drain through a ``NEMO_RENDER_WORKERS``
+   process pool (default ``os.cpu_count()``; 1 = inline, no pool).  Workers
+   are spawned (never forked — the parent holds a live JAX runtime whose
+   threads are not fork-safe) and import only the report layer, so they are
+   light.  The scheduler's submit/drain split is what the orchestrator's
+   multi-corpus driver (analysis/pipeline.py:run_debug_dirs) overlaps:
+   family A's figures render in the pool while family B's kernels dispatch.
+
+Output is byte-identical to the sequential per-figure render loop by
+construction: the render key covers every input the renderer reads (the
+parity suite in tests/test_render_pipeline.py pins this), and the C++/Python
+engine parity (report/svg.py vs native/nemo_report.cpp) is unchanged —
+whichever engine render_svg_auto picks produces the same bytes.
+
+Any change to the renderer's layout or attribute vocabulary MUST bump
+RENDER_FORMAT_VERSION in report/svg.py (and the native ABI version in
+lockstep, as always): the version is part of the cache key, so stale SVGs
+from an older layout can never be served.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import tempfile
+import time
+import warnings
+
+from .dot import DotGraph
+
+
+def render_workers_default() -> int:
+    """Worker-pool width: NEMO_RENDER_WORKERS when set (>=1; junk warns and
+    falls through — same warn-and-default policy as NEMO_PACK_XFER /
+    NEMO_NARROW_XFER), else os.cpu_count().  1 means render inline in the
+    submitting process, no pool."""
+    env = os.environ.get("NEMO_RENDER_WORKERS", "").strip()
+    if env:
+        try:
+            n = int(env)
+        except ValueError:
+            n = 0
+        if n >= 1:
+            return n
+        warnings.warn(
+            f"NEMO_RENDER_WORKERS={env!r} is not a positive integer; "
+            "using os.cpu_count()",
+            stacklevel=2,
+        )
+    return os.cpu_count() or 1
+
+
+def svg_cache_dir() -> str | None:
+    """Resolve the persistent SVG store's root: NEMO_SVG_CACHE when set
+    (0/off/none/false disables -> None), else ``~/.cache/nemo_tpu/svg``
+    beside the jit-artifact cache (utils/jax_config.py)."""
+    env = os.environ.get("NEMO_SVG_CACHE")
+    if env is not None:
+        env = env.strip()
+        if env.lower() in ("", "0", "off", "none", "false"):
+            return None
+        return env
+    return os.path.join(os.path.expanduser("~"), ".cache", "nemo_tpu", "svg")
+
+
+def renderer_version() -> str:
+    """Cache-key version component: the Python layout version and the native
+    engine's ABI version (the two engines are byte-identical by contract, so
+    one bumps only with the other)."""
+    from .native import REPORT_ABI_VERSION
+    from .svg import RENDER_FORMAT_VERSION
+
+    return f"svg{RENDER_FORMAT_VERSION}-abi{REPORT_ABI_VERSION}"
+
+
+def render_key(g: DotGraph) -> str:
+    """Content hash of exactly the renderer's inputs (report/svg.py /
+    report/native.py): per-node (resolved label, shape, style, stroke, fill,
+    fontcolor) in node order, per-edge (src index, dst index, color, style)
+    in edge order over edges whose endpoints exist, and per-cluster
+    (resolved label, member indices) in cluster order.  Node NAMES enter
+    only through the label/lookup defaults — so renamed-but-isomorphic
+    figures (the ``run_<iter>_`` id namespaces) collide, which is the whole
+    dedup win.  Graph name and graph-level attrs are not rendered and are
+    deliberately excluded."""
+    index = {n.name: i for i, n in enumerate(g.nodes)}
+    nodes = tuple(
+        (
+            n.attrs.get("label", n.name),
+            n.attrs.get("shape", "ellipse"),
+            n.attrs.get("style", ""),
+            n.attrs.get("color", "black"),
+            n.attrs.get("fillcolor", "white"),
+            n.attrs.get("fontcolor", "black"),
+        )
+        for n in g.nodes
+    )
+    edges = tuple(
+        (index[e.src], index[e.dst], e.attrs.get("color", "#444"), e.attrs.get("style", ""))
+        for e in g.edges
+        if e.src in index and e.dst in index
+    )
+    clusters = tuple(
+        (
+            c.attrs.get("label", c.name),
+            tuple(index[m] for m in c.nodes if m in index),
+        )
+        for c in g.clusters
+    )
+    payload = repr(("rk1", nodes, edges, clusters)).encode("utf-8")
+    return hashlib.sha256(payload).hexdigest()
+
+
+class SvgCache:
+    """On-disk content-addressed SVG store: one file per (render key,
+    renderer version), written atomically (temp + rename) so concurrent
+    pipelines — or pool workers in a future design — can never serve a torn
+    read.  ``root=None`` disables (every get misses, puts are no-ops)."""
+
+    def __init__(self, root: str | None = None) -> None:
+        self.root = svg_cache_dir() if root is None else (root or None)
+        self.hits = 0
+        self.misses = 0
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, renderer_version(), key[:2], f"{key}.svg")
+
+    def get(self, key: str) -> str | None:
+        if self.root is None:
+            self.misses += 1
+            return None
+        try:
+            with open(self._path(key), "r", encoding="utf-8") as f:
+                svg = f.read()
+        except OSError:
+            self.misses += 1
+            return None
+        self.hits += 1
+        return svg
+
+    def put(self, key: str, svg: str) -> None:
+        if self.root is None:
+            return
+        path = self._path(key)
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            fd, tmp = tempfile.mkstemp(suffix=".svg", dir=os.path.dirname(path))
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                f.write(svg)
+            os.replace(tmp, path)
+        except OSError as ex:  # a read-only cache degrades, never fails a report
+            warnings.warn(f"SVG cache write failed ({ex}); continuing uncached", stacklevel=2)
+
+
+def _render_job(g: DotGraph) -> tuple[str, float]:
+    """Pool worker body: render one DotGraph, returning (svg, render
+    seconds).  Lives at module top level for picklability; imports the
+    engine lazily so spawned workers never touch jax (this module's import
+    chain is jax-free by design)."""
+    from .native import render_svg_auto
+
+    t0 = time.perf_counter()
+    svg = render_svg_auto(g)
+    return svg, time.perf_counter() - t0
+
+
+class _Entry:
+    """One unique render key's lifetime state."""
+
+    __slots__ = ("svg", "graph", "future", "pending_paths", "render_dt", "count", "link_src")
+
+    def __init__(self) -> None:
+        self.svg: str | None = None  # resolved SVG text
+        self.graph: DotGraph | None = None  # held for inline render at drain
+        self.future = None  # in-flight pool render
+        self.pending_paths: list[str] = []  # fan-out targets not yet written
+        self.render_dt = 0.0  # seconds ONE render of this figure costs
+        self.count = 0  # total submissions (fan-out width)
+        #: per-directory already-written path, the hardlink source for
+        #: further fan-out targets in the same directory (links never cross
+        #: report directories, so each report stays self-contained).
+        self.link_src: dict[str, str] = {}
+
+
+class RenderScheduler:
+    """The dedup + cache + worker-pool figure renderer.
+
+    ``submit(dot, svg_path)`` is cheap and non-blocking: it computes the
+    render key, consults the persistent cache on first sight of a key, and
+    hands cache misses to the worker pool immediately — so renders overlap
+    whatever the caller does next (the next family's analysis, in
+    run_debug_dirs).  ``drain()`` resolves all in-flight renders, fans each
+    unique SVG out to every submitted path, feeds the cache, and returns a
+    stats snapshot.  Entries persist across drains, so a key re-submitted by
+    a later corpus is served from memory without re-render or cache I/O.
+
+    With workers == 1 no pool ever exists: misses render inline at drain, in
+    submission order — the sequential fallback, byte-identical by the parity
+    contract above.
+    """
+
+    def __init__(self, workers: int | None = None, cache: SvgCache | None = None) -> None:
+        self.workers = render_workers_default() if workers is None else max(1, int(workers))
+        self.cache = SvgCache() if cache is None else cache
+        self._entries: dict[str, _Entry] = {}
+        self._order: list[str] = []  # submission order, for deterministic drains
+        self._pool = None
+        self._pool_broken = False
+        self.figures = 0  # total figures submitted
+        self.rendered = 0  # unique keys actually rendered this session
+        self.render_s = 0.0  # pure rendering seconds (sum over unique renders)
+        self.render_wall_s = 0.0  # wall spent inside drain resolving/writing
+
+    def _ensure_pool(self):
+        if self._pool is None and self.workers > 1 and not self._pool_broken:
+            import concurrent.futures
+            import multiprocessing
+
+            # Build the native renderer ONCE here, before any worker
+            # exists: each spawn worker's first render would otherwise
+            # kick off its own identical g++ compile (correct but wasted
+            # N-1 times over).  After this, every worker's build() is a
+            # stat-and-return; a toolchain-less environment just means the
+            # workers use the Python renderer, as always.
+            try:
+                from .native import build_native
+
+                build_native()
+            except Exception:
+                pass
+
+            # spawn, not fork: the submitting process holds a live JAX
+            # runtime (threads + device handles) that is not fork-safe.
+            self._pool = concurrent.futures.ProcessPoolExecutor(
+                max_workers=self.workers,
+                mp_context=multiprocessing.get_context("spawn"),
+            )
+        return self._pool
+
+    def submit(self, dot: DotGraph, svg_path: str) -> None:
+        """Register one figure: svg_path will receive the rendered SVG at the
+        next drain().  Dedup, cache lookup, and pool handoff all happen here."""
+        self.figures += 1
+        key = render_key(dot)
+        ent = self._entries.get(key)
+        if ent is None:
+            ent = self._entries[key] = _Entry()
+            self._order.append(key)
+            ent.svg = self.cache.get(key)
+            if ent.svg is None:
+                # The graph is retained until the SVG resolves even when a
+                # pool render is in flight: it is the inline-fallback input
+                # if the pool dies (see drain).
+                ent.graph = dot
+                pool = self._ensure_pool()
+                if pool is not None:
+                    ent.future = pool.submit(_render_job, dot)
+        ent.count += 1
+        ent.pending_paths.append(svg_path)
+
+    def _fan_out(self, ent: _Entry, path: str) -> None:
+        """Materialize one fan-out target.  The first target per directory
+        is a real write; further targets in the same directory hardlink it —
+        identical bytes at a fraction of the cost (measured on this repo's
+        9p-backed filesystem: ~150us/link vs ~880us/create+write), with a
+        plain write as the fallback wherever links are unsupported.  Links
+        never cross report directories, so each report stays a
+        self-contained file set."""
+        d = os.path.dirname(path)
+        src = ent.link_src.get(d)
+        if src is not None:
+            try:
+                if os.path.lexists(path):
+                    os.unlink(path)
+                os.link(src, path)
+                return
+            except OSError:
+                pass  # src vanished / links unsupported: fall through
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(ent.svg)
+        ent.link_src[d] = path
+
+    def drain(self) -> dict:
+        """Resolve every pending render, write all fan-out SVGs, and return
+        stats().  Idempotent: a drain with nothing pending only snapshots."""
+        t0 = time.perf_counter()
+        for key in self._order:
+            ent = self._entries[key]
+            if not ent.pending_paths:
+                continue
+            if ent.svg is None:
+                if ent.future is not None:
+                    try:
+                        ent.svg, ent.render_dt = ent.future.result()
+                    except Exception as ex:
+                        # A dead pool (unpicklable __main__, OOM-killed
+                        # worker...) degrades to inline rendering — byte-
+                        # identical output, just serial.  Warn once.
+                        if not self._pool_broken:
+                            self._pool_broken = True
+                            warnings.warn(
+                                f"figure render pool failed ({type(ex).__name__}: "
+                                f"{ex}); rendering inline",
+                                stacklevel=2,
+                            )
+                    ent.future = None
+                if ent.svg is None:
+                    ent.svg, ent.render_dt = _render_job(ent.graph)
+                ent.graph = None
+                self.rendered += 1
+                self.render_s += ent.render_dt
+                self.cache.put(key, ent.svg)
+            for path in ent.pending_paths:
+                self._fan_out(ent, path)
+            ent.pending_paths = []
+        self.render_wall_s += time.perf_counter() - t0
+        return self.stats()
+
+    def stats(self) -> dict:
+        """The bench/report metrics: totals are scheduler-lifetime.
+
+        render_s is PURE rendering time (sum over the unique renders);
+        serial_render_est_s is what the pre-dedup serial loop would have
+        spent rendering (each unique figure's measured render time times
+        its fan-out width) — their ratio is the realized dedup win;
+        render_wall_s is the drain wall (renders + cache I/O + fan-out
+        writes/links)."""
+        unique = len(self._entries)
+        serial_est = sum(
+            e.render_dt * e.count for e in self._entries.values() if e.render_dt
+        )
+        return {
+            "figures": self.figures,
+            "unique_figures": unique,
+            "dedup_ratio": round(self.figures / unique, 2) if unique else 1.0,
+            "figure_cache_hits": self.cache.hits,
+            "rendered": self.rendered,
+            "render_workers": self.workers,
+            "render_s": round(self.render_s, 3),
+            "serial_render_est_s": round(serial_est, 3),
+            "render_wall_s": round(self.render_wall_s, 3),
+        }
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+
+    def __enter__(self) -> "RenderScheduler":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -14,6 +14,13 @@ import html
 
 from .dot import DotGraph
 
+#: Layout/format version of this renderer.  Part of the persistent SVG
+#: cache key (report/render.py:renderer_version): bump it on ANY change to
+#: the layout algorithm, the attribute vocabulary, or the emitted SVG text —
+#: and change native/nemo_report.cpp in lockstep (the byte-parity contract),
+#: bumping its ABI version — or stale cached SVGs will be served as current.
+RENDER_FORMAT_VERSION = 1
+
 _CHAR_W = 7.2  # approx px per character at font-size 12
 _NODE_H = 36
 _LAYER_GAP = 70

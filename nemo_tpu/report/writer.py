@@ -7,6 +7,13 @@ names files run_<iter>_<name>, webpage.go:79-99).  Rendering uses the built-in
 SVG layout engine instead of shelling out to graphviz: the native C++ engine
 (native/nemo_report.cpp) when available, the Python renderer otherwise —
 report/native.py:render_svg_auto dispatches.
+
+With a RenderScheduler attached (report/render.py — the pipeline attaches
+one by default), SVG rendering is deduplicated, served from the persistent
+SVG cache, and spread over a worker pool; the SVG files land at the
+scheduler's drain().  Without one, every figure renders inline, one at a
+time — the sequential oracle path the parity tests compare against.  The
+.dot files are written synchronously either way.
 """
 
 from __future__ import annotations
@@ -16,14 +23,17 @@ import shutil
 
 from .dot import DotGraph
 from .native import render_svg_auto as render_svg
+from .render import RenderScheduler
 
 ASSETS_DIR = os.path.join(os.path.dirname(__file__), "assets")
 
 
 class Reporter:
-    def __init__(self) -> None:
+    def __init__(self, scheduler: RenderScheduler | None = None) -> None:
         self.res_dir = ""
         self.figures_dir = ""
+        #: Optional dedup/cache/parallel render pipeline; None = sequential.
+        self.scheduler = scheduler
 
     def prepare(self, all_results_dir: str, this_results_dir: str) -> None:
         """Copy the report template and create the figures directory
@@ -37,10 +47,15 @@ class Reporter:
         os.makedirs(self.figures_dir, exist_ok=True)
 
     def generate_figure(self, file_name: str, dot: DotGraph) -> None:
-        """Write <name>.dot and <name>.svg (reference: report/webpage.go:53-76)."""
+        """Write <name>.dot and <name>.svg (reference: report/webpage.go:53-76).
+        The .svg is deferred to the scheduler's drain() when one is attached."""
         with open(os.path.join(self.figures_dir, f"{file_name}.dot"), "w", encoding="utf-8") as f:
             f.write(dot.to_string())
-        with open(os.path.join(self.figures_dir, f"{file_name}.svg"), "w", encoding="utf-8") as f:
+        svg_path = os.path.join(self.figures_dir, f"{file_name}.svg")
+        if self.scheduler is not None:
+            self.scheduler.submit(dot, svg_path)
+            return
+        with open(svg_path, "w", encoding="utf-8") as f:
             f.write(render_svg(dot))
 
     def generate_figures(self, iters: list[int], name: str, dots: list[DotGraph]) -> None:

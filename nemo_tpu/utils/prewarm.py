@@ -101,7 +101,12 @@ def stress_signature(name: str, n_probe: int, b_pad: int):
     # The deployment dispatch narrows the upload dtypes and stubs the
     # unused label plane (backend/jax_backend.py:_narrow_fused_arrays);
     # dtype and shape are both part of the jit signature, so prewarm must
-    # mirror them or it compiles a program nobody runs.
+    # mirror them or it compiles a program nobody runs.  The default
+    # resolution here (local platform) matches both deployments: the
+    # in-process backend resolves the same default from the same process,
+    # and RemoteExecutor clients now narrow unconditionally (ADVICE r5 #1,
+    # ServiceBackend._resolve_narrow_xfer) — matching a prewarm run on the
+    # device-owning sidecar, whose platform resolves narrowing ON.
     from dataclasses import replace
 
     from nemo_tpu.backend.jax_backend import _narrow_fused_arrays

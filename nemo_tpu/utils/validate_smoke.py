@@ -1,5 +1,17 @@
 """`make validate` tail: a CLI-shaped smoke on a synthetic corpus with the
-jax backend's report byte-compared against the Python oracle's."""
+jax backend's report byte-compared against the Python oracle's.
+
+Covers the figure-render pipeline end to end (report/render.py) with an
+all-figures smoke: the production report renders every figure
+(figures="all") through the deduplicated / cached / parallel scheduler and
+must be byte-identical — every .dot, every .svg, debugging.json — to the
+same backend rendering sequentially (explicit Reporter, no scheduler: the
+oracle render path).  A second pass must then serve every unique figure
+from the persistent SVG cache (zero renders) and still match.  Backend
+analysis parity stays what it was: the jax debugging.json equals the
+Python oracle backend's (figure node ORDER differs across backends by
+construction, so figure files are only byte-compared within one backend).
+"""
 
 from __future__ import annotations
 
@@ -9,27 +21,94 @@ import sys
 import tempfile
 
 
+def _tree(root: str) -> dict[str, bytes]:
+    out: dict[str, bytes] = {}
+    for dirpath, _, files in os.walk(root):
+        for f in files:
+            p = os.path.join(dirpath, f)
+            with open(p, "rb") as fh:
+                out[os.path.relpath(p, root)] = fh.read()
+    return out
+
+
 def main() -> int:
     from nemo_tpu.analysis.pipeline import run_debug
     from nemo_tpu.backend.jax_backend import JaxBackend
     from nemo_tpu.backend.python_ref import PythonBackend
     from nemo_tpu.models.synth import SynthSpec, write_corpus
+    from nemo_tpu.report.writer import Reporter
     from nemo_tpu.utils.jax_config import pin_platform
 
     pin_platform("cpu")  # never touch a (possibly tunneled) device here
     with tempfile.TemporaryDirectory(prefix="nemo_validate_") as tmp:
+        # Hermetic SVG cache: cold for the first pass, warm for the second,
+        # never the user's ~/.cache.
+        os.environ["NEMO_SVG_CACHE"] = os.path.join(tmp, "svg_cache")
+        os.environ.pop("NEMO_RENDER_WORKERS", None)
         corpus = write_corpus(SynthSpec(n_runs=6, seed=3), tmp)
-        jx = run_debug(corpus, os.path.join(tmp, "jx"), JaxBackend())
-        py = run_debug(corpus, os.path.join(tmp, "py"), PythonBackend())
+
+        # 1. Render-pipeline parity: pipeline (dedup+cache+workers) vs the
+        # sequential per-figure oracle, same backend, full figure set.
+        jx = run_debug(corpus, os.path.join(tmp, "jx"), JaxBackend(), figures="all")
+        seq = run_debug(
+            corpus,
+            os.path.join(tmp, "seq"),
+            JaxBackend(),
+            reporter=Reporter(),  # no scheduler: the sequential oracle path
+            figures="all",
+        )
+        a, b = _tree(jx.report_dir), _tree(seq.report_dir)
+        if a.keys() != b.keys():
+            print(
+                "validate: report file sets DIVERGE: "
+                f"{sorted(a.keys() ^ b.keys())[:10]}",
+                file=sys.stderr,
+            )
+            return 1
+        bad = sorted(k for k in a if a[k] != b[k])
+        if bad:
+            print(
+                "validate: pipeline-rendered report DIVERGES from the "
+                f"sequential renderer in {len(bad)} file(s), e.g. {bad[:5]}",
+                file=sys.stderr,
+            )
+            return 1
+
+        # 2. Cache-warm re-report: zero renders, identical bytes.
+        jx2 = run_debug(corpus, os.path.join(tmp, "jx2"), JaxBackend(), figures="all")
+        s = jx2.figure_stats or {}
+        if s.get("rendered") != 0 or s.get("figure_cache_hits") != s.get("unique_figures"):
+            print(f"validate: SVG cache not warm on the second pass: {s}", file=sys.stderr)
+            return 1
+        warm = _tree(jx2.report_dir)
+        bad2 = sorted(k for k in a if warm.get(k) != a[k])
+        if bad2:
+            print(
+                f"validate: cache-warm report DIVERGES in {len(bad2)} file(s), "
+                f"e.g. {bad2[:5]}",
+                file=sys.stderr,
+            )
+            return 1
+
+        # 3. Backend analysis parity: jax debugging.json == oracle's.
+        py = run_debug(
+            corpus, os.path.join(tmp, "py"), PythonBackend(), figures="none"
+        )
         with open(os.path.join(jx.report_dir, "debugging.json")) as f:
-            a = json.load(f)
+            dbg_jx = json.load(f)
         with open(os.path.join(py.report_dir, "debugging.json")) as f:
-            b = json.load(f)
-        if a != b:
+            dbg_py = json.load(f)
+        if dbg_jx != dbg_py:
             print("validate: jax report DIVERGES from the oracle", file=sys.stderr)
             return 1
-        n_figs = len(os.listdir(os.path.join(jx.report_dir, "figures")))
-        print(f"validate: ok — oracle-identical report, {n_figs} figures")
+
+        n_figs = len([f for f in a if f.startswith("figures")])
+        fs = jx.figure_stats or {}
+        print(
+            "validate: ok — oracle-identical report "
+            f"({len(a)} files, {n_figs} figure files, dedup {fs.get('dedup_ratio')}x, "
+            "sequential-parity + cache-warm re-report identical)"
+        )
         return 0
 
 

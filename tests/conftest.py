@@ -10,6 +10,12 @@ hardware instead.  Must run before jax imports.
 
 import os
 
+# Figure-pipeline defaults for the suite: render inline (no worker-pool
+# spawn per run_debug) and never touch the user's persistent SVG cache —
+# the render-pipeline tests opt back in per-test via monkeypatch.
+os.environ.setdefault("NEMO_RENDER_WORKERS", "1")
+os.environ.setdefault("NEMO_SVG_CACHE", "off")
+
 _platform = os.environ.get("NEMO_TEST_PLATFORM", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:

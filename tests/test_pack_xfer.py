@@ -229,3 +229,30 @@ def test_narrowed_dispatch_parity(tmp_path, monkeypatch):
         np.testing.assert_array_equal(
             np.asarray(wide[name]), np.asarray(narrow[name]), err_msg=name
         )
+
+
+def test_narrow_xfer_resolution_split_deployment(monkeypatch):
+    """Narrowing is resolved by the backend that OWNS the transfer
+    boundary (ADVICE r5 #1): the in-process backend follows the local
+    platform default, while a ServiceBackend client narrows by default —
+    its upload crosses the bandwidth-priced Kernel RPC regardless of the
+    client's own (often CPU-only) jax platform — keeping the dispatch
+    signature aligned with a device-side prewarm.  An explicit
+    NEMO_NARROW_XFER still wins for both."""
+    import jax
+
+    from nemo_tpu.backend.jax_backend import JaxBackend as _JB
+    from nemo_tpu.backend.service_backend import ServiceBackend
+
+    monkeypatch.delenv("NEMO_NARROW_XFER", raising=False)
+    local_default = jax.default_backend() != "cpu"
+    assert _JB()._resolve_narrow_xfer() == local_default
+    assert ServiceBackend()._resolve_narrow_xfer() is True  # RPC always priced
+
+    monkeypatch.setenv("NEMO_NARROW_XFER", "0")
+    assert _JB()._resolve_narrow_xfer() is False
+    assert ServiceBackend()._resolve_narrow_xfer() is False
+
+    monkeypatch.setenv("NEMO_NARROW_XFER", "1")
+    assert _JB()._resolve_narrow_xfer() is True
+    assert ServiceBackend()._resolve_narrow_xfer() is True

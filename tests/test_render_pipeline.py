@@ -1,0 +1,213 @@
+"""Figure-render pipeline (report/render.py): dedup by render key,
+persistent SVG cache, worker-pool rendering — all byte-identical to the
+sequential per-figure render loop (the parity oracle)."""
+
+from __future__ import annotations
+
+import os
+import warnings
+
+import pytest
+
+from nemo_tpu.analysis.pipeline import run_debug, run_debug_dirs
+from nemo_tpu.backend.jax_backend import JaxBackend
+from nemo_tpu.report.dot import DotGraph
+from nemo_tpu.report.native import render_svg_auto
+from nemo_tpu.report.render import (
+    RenderScheduler,
+    SvgCache,
+    render_key,
+    render_workers_default,
+    renderer_version,
+)
+from nemo_tpu.report.writer import Reporter
+
+
+def _tree(root: str) -> dict[str, bytes]:
+    out = {}
+    for dirpath, _, files in os.walk(root):
+        for f in files:
+            p = os.path.join(dirpath, f)
+            with open(p, "rb") as fh:
+                out[os.path.relpath(p, root)] = fh.read()
+    return out
+
+
+def _graph(prefix: str, label_suffix: str = "") -> DotGraph:
+    """A small styled DAG whose node NAMES are namespaced by prefix (the
+    run_<iter>_ shape) but whose rendered content is prefix-independent."""
+    g = DotGraph(name="dataflow")
+    g.graph_attrs["bgcolor"] = "transparent"
+    attrs = {"label": f"goal{label_suffix}", "shape": "ellipse", "style": "filled, solid",
+             "color": "black", "fillcolor": "white", "fontcolor": "black"}
+    g.add_node(f"{prefix}_a", dict(attrs))
+    g.add_node(f"{prefix}_b", {**attrs, "label": "rule", "shape": "rect"})
+    g.add_edge(f"{prefix}_a", f"{prefix}_b", {"color": "black"})
+    return g
+
+
+# --------------------------------------------------------------- render key
+
+
+def test_render_key_collides_renamed_isomorphic_graphs():
+    """Node ids embed run iterations; the key must not see them."""
+    assert render_key(_graph("run_3_post")) == render_key(_graph("run_999_post"))
+
+
+def test_render_key_separates_rendered_content():
+    base = render_key(_graph("p"))
+    assert render_key(_graph("p", label_suffix="X")) != base  # label renders
+    g = _graph("p")
+    g.nodes[0].attrs["fillcolor"] = "firebrick"
+    assert render_key(g) != base  # color renders
+    g2 = _graph("p")
+    g2.graph_attrs["rankdir"] = "LR"  # graph attrs are never rendered
+    assert render_key(g2) == base
+
+
+def test_render_key_matches_svg_bytes():
+    """The key's contract: equal keys <=> the renderer produces equal bytes
+    (for renamed isomorphic inputs)."""
+    a, b = _graph("run_1_pre"), _graph("run_2_pre")
+    assert render_key(a) == render_key(b)
+    assert render_svg_auto(a) == render_svg_auto(b)
+
+
+# ------------------------------------------------------------- scheduler
+
+
+def test_scheduler_dedups_shared_sources(tmp_path):
+    sched = RenderScheduler(workers=1, cache=SvgCache(""))  # cache disabled
+    p1, p2 = str(tmp_path / "a.svg"), str(tmp_path / "b.svg")
+    sched.submit(_graph("run_1_post"), p1)
+    sched.submit(_graph("run_2_post"), p2)
+    stats = sched.drain()
+    sched.close()
+    assert stats["figures"] == 2
+    assert stats["unique_figures"] == 1
+    assert stats["rendered"] == 1  # rendered exactly once, fanned out
+    assert stats["dedup_ratio"] == 2.0
+    with open(p1, "rb") as f1, open(p2, "rb") as f2:
+        assert f1.read() == f2.read() == render_svg_auto(_graph("run_1_post")).encode()
+
+
+def test_scheduler_inline_fallback_never_builds_pool(tmp_path):
+    sched = RenderScheduler(workers=1, cache=SvgCache(""))
+    sched.submit(_graph("x"), str(tmp_path / "x.svg"))
+    sched.drain()
+    assert sched._pool is None
+    assert sched.stats()["render_workers"] == 1
+    sched.close()
+
+
+def test_scheduler_cache_hits_across_instances(tmp_path):
+    cache_dir = str(tmp_path / "cache")
+    s1 = RenderScheduler(workers=1, cache=SvgCache(cache_dir))
+    s1.submit(_graph("r1"), str(tmp_path / "one.svg"))
+    st1 = s1.drain()
+    s1.close()
+    assert st1["rendered"] == 1 and st1["figure_cache_hits"] == 0
+    # The cache file is keyed under the renderer version.
+    versioned = os.path.join(cache_dir, renderer_version())
+    assert os.path.isdir(versioned)
+
+    s2 = RenderScheduler(workers=1, cache=SvgCache(cache_dir))
+    s2.submit(_graph("r2"), str(tmp_path / "two.svg"))  # same render key
+    st2 = s2.drain()
+    s2.close()
+    assert st2["rendered"] == 0 and st2["figure_cache_hits"] == 1
+    with open(tmp_path / "one.svg", "rb") as a, open(tmp_path / "two.svg", "rb") as b:
+        assert a.read() == b.read()
+
+
+def test_render_workers_env_policy(monkeypatch):
+    monkeypatch.setenv("NEMO_RENDER_WORKERS", "3")
+    assert render_workers_default() == 3
+    monkeypatch.setenv("NEMO_RENDER_WORKERS", "bogus")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert render_workers_default() == (os.cpu_count() or 1)
+    assert any("NEMO_RENDER_WORKERS" in str(x.message) for x in w)
+    monkeypatch.setenv("NEMO_RENDER_WORKERS", "0")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert render_workers_default() == (os.cpu_count() or 1)
+    assert any("NEMO_RENDER_WORKERS" in str(x.message) for x in w)
+
+
+# ------------------------------------------------------- end-to-end parity
+
+
+def test_pipeline_parity_and_cache_on_corpus(corpus_dir, tmp_path, monkeypatch):
+    """run_debug through the parallel+cached pipeline vs the sequential
+    Reporter: every report file byte-identical; a second invocation serves
+    every unique figure from the cache and stays identical."""
+    monkeypatch.setenv("NEMO_SVG_CACHE", str(tmp_path / "svg_cache"))
+    monkeypatch.setenv("NEMO_RENDER_WORKERS", "2")
+    res = run_debug(corpus_dir, str(tmp_path / "pipe"), JaxBackend(), figures="all")
+    stats = res.figure_stats
+    assert stats is not None and stats["figures"] > stats["unique_figures"]
+    assert stats["rendered"] == stats["unique_figures"]  # cold cache
+
+    seq = run_debug(
+        corpus_dir,
+        str(tmp_path / "seq"),
+        JaxBackend(),
+        reporter=Reporter(),  # sequential oracle
+        figures="all",
+    )
+    assert seq.figure_stats is None
+    a, b = _tree(res.report_dir), _tree(seq.report_dir)
+    assert a.keys() == b.keys()
+    assert [k for k in a if a[k] != b[k]] == []
+
+    warm = run_debug(corpus_dir, str(tmp_path / "warm"), JaxBackend(), figures="all")
+    ws = warm.figure_stats
+    assert ws["rendered"] == 0
+    assert ws["figure_cache_hits"] == ws["unique_figures"] == stats["unique_figures"]
+    c = _tree(warm.report_dir)
+    assert [k for k in a if c.get(k) != a[k]] == []
+
+
+def test_multi_family_dirs_parity(tmp_path, monkeypatch):
+    """run_debug_dirs (shared scheduler, render overlapped with the next
+    family's analysis) matches per-directory sequential rendering byte for
+    byte on a multi-family corpus."""
+    from nemo_tpu.models.case_studies import write_case_study
+
+    d1 = write_case_study(
+        "CA-2083-hinted-handoff", n_runs=6, seed=11, out_dir=str(tmp_path / "m")
+    )
+    d2 = write_case_study(
+        "MR-3858-hadoop", n_runs=6, seed=11, out_dir=str(tmp_path / "m")
+    )
+    monkeypatch.setenv("NEMO_SVG_CACHE", str(tmp_path / "svg_cache"))
+    monkeypatch.setenv("NEMO_RENDER_WORKERS", "2")
+    ress = run_debug_dirs([d1, d2], str(tmp_path / "par"), JaxBackend, figures="all")
+    assert all(r.figure_stats is not None for r in ress)
+    assert ress[0].figure_stats["drain_wall_s"] >= 0.0
+
+    for d in (d1, d2):
+        run_debug(d, str(tmp_path / "seq"), JaxBackend(), reporter=Reporter(), figures="all")
+    a, b = _tree(str(tmp_path / "par")), _tree(str(tmp_path / "seq"))
+    assert a.keys() == b.keys()
+    assert [k for k in a if a[k] != b[k]] == []
+
+
+def test_run_debug_dirs_rejects_save_corpus_path(tmp_path):
+    with pytest.raises(ValueError, match="save_corpus_path"):
+        run_debug_dirs(
+            [str(tmp_path)], str(tmp_path / "r"), JaxBackend,
+            save_corpus_path=str(tmp_path / "c.npz"),
+        )
+
+
+def test_run_debug_dirs_rejects_duplicate_basenames(tmp_path):
+    """Two corpus dirs with one basename would silently overwrite one
+    report (and cross-wire pending figures in the shared scheduler)."""
+    a = tmp_path / "x" / "corpus"
+    b = tmp_path / "y" / "corpus"
+    a.mkdir(parents=True)
+    b.mkdir(parents=True)
+    with pytest.raises(ValueError, match="basename"):
+        run_debug_dirs([str(a), str(b)], str(tmp_path / "r"), JaxBackend)
