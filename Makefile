@@ -5,7 +5,7 @@ NATIVE_LIB := native/build/libnemo_native.so
 REPORT_SRC := native/nemo_report.cpp
 REPORT_LIB := native/build/libnemo_report.so
 
-.PHONY: all native test bench prewarm validate clean reset proto neo4j-up neo4j-validate neo4j-down
+.PHONY: all native test bench prewarm validate trace-smoke clean reset proto neo4j-up neo4j-validate neo4j-down
 
 all: native
 
@@ -28,6 +28,14 @@ validate: test
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 		python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 	python -m nemo_tpu.utils.validate_smoke
+
+# Observability smoke (also the tail of `make validate`): a traced
+# two-family pipeline run + one sidecar RPC, whose emitted Chrome-trace
+# JSON must be Perfetto-loadable and contain nested phase spans, a
+# child-process render-worker span, and RPC client+server spans sharing
+# one propagated trace id (nemo_tpu/obs).
+trace-smoke:
+	python -m nemo_tpu.utils.validate_smoke --trace-smoke
 
 bench:
 	python bench.py

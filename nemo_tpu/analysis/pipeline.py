@@ -14,6 +14,7 @@ import os
 import sys
 from dataclasses import dataclass
 
+from nemo_tpu import obs
 from nemo_tpu.backend.base import GraphBackend, NoSuccessfulRunError
 from nemo_tpu.ingest.molly import MollyOutput, load_molly_output
 from nemo_tpu.report.writer import Reporter
@@ -44,6 +45,34 @@ class DebugResult:
     #: (run_debug_dirs fills it in post-drain) or when a legacy sequential
     #: Reporter was passed in.
     figure_stats: dict | None = None
+
+
+#: Report files that are per-run wall-clock telemetry — inherently
+#: nondeterministic across byte-identical reports.  Every byte-parity
+#: harness (validate_smoke, the parity tests) skips exactly this set; add
+#: here, not in each walker, if another such artifact ever appears.
+NONDETERMINISTIC_REPORT_FILES = frozenset({"telemetry.json"})
+
+
+def _write_telemetry(report_dir: str, timings: dict, figure_stats: dict | None) -> None:
+    """Write the report's "Run telemetry" data (telemetry.json next to
+    debugging.json): the phase walls, the figure pipeline's dedup/cache
+    stats, and the process metrics snapshot.  The frontend renders it when
+    present and hides the section otherwise, so pre-obs reports stay valid;
+    parity harnesses exclude this file (it is per-run wall-clock telemetry,
+    inherently nondeterministic across byte-identical reports).  Best
+    effort: telemetry must never fail a report."""
+    doc = {
+        "timings": {k: round(v, 6) for k, v in timings.items()},
+        "figure_stats": figure_stats,
+        "metrics": obs.metrics.snapshot(),
+        "trace_id": obs.trace_id(),
+    }
+    try:
+        with open(os.path.join(report_dir, "telemetry.json"), "w", encoding="utf-8") as fh:
+            json.dump(doc, fh, indent=1)
+    except OSError as ex:
+        print(f"warning: telemetry.json not written: {ex}", file=sys.stderr)
 
 
 def _prov_json_str(prov) -> str:
@@ -259,7 +288,11 @@ def run_debug_dirs(
 
     def prefetch_next(d: str) -> None:
         try:
-            prefetched[0] = _ingest(d, use_packed)
+            # The span makes the ingest/compute overlap VISIBLE: it lives on
+            # the prefetch thread's track, riding under the previous
+            # corpus's analysis phases on the main thread.
+            with obs.span("ingest:prefetch", dir=os.path.basename(d)):
+                prefetched[0] = _ingest(d, use_packed)
         except BaseException as ex:  # re-raised on the consuming thread
             prefetched[1] = ex
 
@@ -312,6 +345,10 @@ def run_debug_dirs(
         scheduler.close()
     for r in results:
         r.figure_stats = stats
+        # The telemetry written during each run_debug predates the shared
+        # scheduler's drain (figure_stats was None then); refresh it with
+        # the aggregate figure stats and the now-complete metrics.
+        _write_telemetry(r.report_dir, r.timings, stats)
     return results
 
 
@@ -518,9 +555,11 @@ def run_debug(
             if own_scheduler is not None:
                 own_scheduler.close()
 
+    timings = timer.as_dict()
+    _write_telemetry(this_results_dir, timings, fig_stats)
     return DebugResult(
         molly=molly,
         report_dir=this_results_dir,
-        timings=timer.as_dict(),
+        timings=timings,
         figure_stats=fig_stats,
     )

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from nemo_tpu import obs
 from nemo_tpu.analysis.corrections import synthesize_corrections, synthesize_extensions
 from nemo_tpu.analysis.protos import intersect_proto, missing_from, union_proto, wrap_code
 from nemo_tpu.analysis.queries import (
@@ -157,6 +158,37 @@ def _k_fused(*args):
     )
 
 
+def _device_annotation(name: str):
+    """A ``jax.profiler.TraceAnnotation`` bracketing one kernel dispatch, so
+    a jax.profiler device capture running alongside (CLI --profile, sidecar
+    --profiler-port) carries the same labels as the obs host spans and the
+    two traces line up in one Perfetto view.  No-op where the API is absent
+    (older jax) — host-side obs spans don't depend on it."""
+    ann = getattr(jax.profiler, "TraceAnnotation", None)
+    if ann is None:
+        import contextlib
+
+        return contextlib.nullcontext()
+    return ann(name)
+
+
+def _jit_cache_size(verb: str, fn) -> int:
+    """In-memory jit-cache entry count for a verb's underlying compiled
+    function, or -1 when unknowable (the giant verb jits inside a closure).
+    A dispatch that grows this count paid a trace+compile (or a persistent-
+    cache disk load); one that doesn't was an in-memory cache hit — the
+    compile-vs-execute boundary the obs metrics record."""
+    if verb == "fused":
+        from nemo_tpu.models.pipeline_model import _analysis_step_jit as fn
+    elif verb == "giant":
+        return -1
+    cs = getattr(fn, "_cache_size", None)
+    try:
+        return cs() if cs is not None else -1
+    except Exception:
+        return -1
+
+
 class LocalExecutor:
     """The backend's device boundary: named kernels over named numpy arrays
     and static int params ("fused" and "diff" carry the production pipeline;
@@ -255,6 +287,27 @@ class LocalExecutor:
         fn, array_names, param_names, out_names = self.VERBS[verb]
         if verb in ("fused", "giant") and "pack_out" not in params:
             params = dict(params, pack_out=_pack_out_default())
+        # Host->device transfer volume of this dispatch, as the bytes the
+        # inputs occupy on entry (post-narrowing: _narrow_fused_arrays has
+        # already run by here) — the single home for the "upload bytes"
+        # number bench.py used to re-derive arithmetically.  .nbytes via
+        # getattr, NEVER np.asarray: an input that is already a device
+        # array must not be pulled host-side just to be counted.
+        upload = 0
+        for a in arrays.values():
+            if a is not None:
+                nb = getattr(a, "nbytes", None)
+                upload += int(nb) if nb is not None else np.asarray(a).nbytes
+        # Batch width only for the batched verbs: the per-graph verbs'
+        # is_goal is a [V] node vector, whose length is a node count, not
+        # a batch size — observing it would corrupt the histogram.
+        span_attrs = {"upload_bytes": upload}
+        if verb in ("fused", "giant") and arrays.get("pre_is_goal") is not None:
+            rows = int(np.shape(arrays["pre_is_goal"])[0])
+            obs.metrics.observe("kernel.batch_rows", rows)
+            span_attrs["rows"] = rows
+        obs.metrics.inc(f"kernel.dispatches.{verb}")
+        obs.metrics.inc("kernel.upload_bytes", upload)
         args = [
             (jnp.asarray(arrays[n]) if arrays.get(n) is not None else None)
             if n in self.OPTIONAL_ARRAYS
@@ -267,7 +320,22 @@ class LocalExecutor:
             int(params.get(n, 0)) if n in self.OPTIONAL_PARAMS else int(params[n])
             for n in param_names
         ]
-        out = fn(*args, *statics)
+        # The span brackets trace+compile+dispatch (device execution is
+        # async; jax.profiler owns the device timeline).  The jit-cache
+        # delta labels the compile-vs-execute boundary: a grown cache means
+        # this dispatch paid trace/compile, an unchanged one was served
+        # from the in-memory program cache.
+        cs_before = _jit_cache_size(verb, fn)
+        with obs.span(f"kernel:{verb}", **span_attrs) as sp:
+            with _device_annotation(f"nemo:{verb}"):
+                out = fn(*args, *statics)
+            if cs_before >= 0:
+                compiled = _jit_cache_size(verb, fn) > cs_before
+                obs.metrics.inc(
+                    "kernel.compiles" if compiled else "kernel.cache_hits"
+                )
+                if sp is not None:
+                    sp.set(compiled=compiled)
         if isinstance(out, dict):
             _prefetch_to_host(o for n, o in out.items() if n not in self.ON_DEVICE)
             res = {

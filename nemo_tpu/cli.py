@@ -17,7 +17,8 @@ import argparse
 import os
 import sys
 
-from nemo_tpu.analysis.pipeline import run_debug
+from nemo_tpu.analysis.pipeline import run_debug, run_debug_dirs
+from nemo_tpu.obs import trace as obs_trace
 from nemo_tpu.utils.jax_config import (
     PlatformUnavailableError,
     enable_compilation_cache,
@@ -58,7 +59,12 @@ def main(argv: list[str] | None = None) -> int:
         "--fault-inj-out",
         dest="fault_inj_out",
         required=True,
-        help="file system path to output directory of fault injector",
+        action="append",
+        help="file system path to output directory of fault injector.  "
+        "Repeatable: several corpus directories analyze in ONE run through "
+        "the overlapped multi-corpus driver (corpus k+1's ingest and the "
+        "figure pipeline ride under corpus k's analysis), one report per "
+        "directory under --results-dir",
     )
     parser.add_argument(
         "-graphDBConn",
@@ -100,6 +106,15 @@ def main(argv: list[str] | None = None) -> int:
         default=None,
         help="capture a jax.profiler trace of the analysis phases into DIR "
         "(view with TensorBoard/xprof)",
+    )
+    parser.add_argument(
+        "--trace",
+        metavar="FILE",
+        default=None,
+        help="write a Chrome-trace-event JSON of host spans (pipeline "
+        "phases, kernel dispatches, render workers, RPC client+server) to "
+        "FILE — open it at ui.perfetto.dev.  Equivalent env: NEMO_TRACE.  "
+        "Near-zero overhead when off",
     )
     parser.add_argument(
         "--figures",
@@ -158,8 +173,28 @@ def main(argv: list[str] | None = None) -> int:
     )
     args = parser.parse_args(argv)
 
-    if not os.path.isdir(args.fault_inj_out):
-        parser.error(f"fault injector output directory not found: {args.fault_inj_out}")
+    dirs = args.fault_inj_out
+    for d in dirs:
+        if not os.path.isdir(d):
+            parser.error(f"fault injector output directory not found: {d}")
+    if len(dirs) > 1 and args.save_corpus:
+        parser.error(
+            "--save-corpus is incompatible with multiple -faultInjOut "
+            "directories (every corpus would overwrite the same bundle); "
+            "run per directory with distinct paths"
+        )
+
+    # Tracing: the flag wins, NEMO_TRACE is the env equivalent.  The trace
+    # is written explicitly before the final prints below (so the path is
+    # announced), with an atexit backstop for crash paths.  The env is NOT
+    # mutated: main() may run many times in one process (tests).
+    if args.trace:
+        import atexit
+
+        obs_trace.start_trace(args.trace)
+        atexit.register(obs_trace.finish)
+    else:
+        obs_trace.configure_from_env()
 
     if args.graph_backend == "jax":
         # The only backend that touches the accelerator in-process; resolve
@@ -186,21 +221,50 @@ def main(argv: list[str] | None = None) -> int:
         os.environ["NEMO_RENDER_WORKERS"] = str(args.render_workers)
     if args.svg_cache is not None:
         os.environ["NEMO_SVG_CACHE"] = args.svg_cache
-    backend = make_backend(args.graph_backend)
-    result = run_debug(
-        args.fault_inj_out,
-        args.results_dir,
-        backend,
-        conn=args.graph_db_conn,
-        save_corpus_path=args.save_corpus,
-        profile_dir=args.profile,
-        figures=args.figures,
-        ingest=args.ingest,
-    )
+    # The tracer is finished in the finally: a pipeline failure must still
+    # write the partial trace (a trace of a failed run is exactly when you
+    # want one) AND disable the global tracer — main() may run again in
+    # this process, and a stale enabled tracer would silently swallow the
+    # next run's spans into the old file.
+    try:
+        if len(dirs) == 1:
+            result = run_debug(
+                dirs[0],
+                args.results_dir,
+                make_backend(args.graph_backend),
+                conn=args.graph_db_conn,
+                save_corpus_path=args.save_corpus,
+                profile_dir=args.profile,
+                figures=args.figures,
+                ingest=args.ingest,
+            )
+            results = [result]
+        else:
+            results = run_debug_dirs(
+                dirs,
+                args.results_dir,
+                lambda: make_backend(args.graph_backend),
+                conn=args.graph_db_conn,
+                profile_dir=args.profile,
+                figures=args.figures,
+                ingest=args.ingest,
+            )
+            result = results[-1]
+    except BaseException:
+        trace_path = obs_trace.finish()
+        if trace_path:
+            print(
+                f"obs trace (partial, run failed) written to {trace_path}",
+                file=sys.stderr,
+            )
+        raise
 
     if args.timings:
-        for phase, secs in result.timings.items():
-            print(f"{phase:>22s}  {secs * 1e3:9.1f} ms")
+        for res in results:
+            if len(results) > 1:
+                print(f"--- {res.molly.run_name}")
+            for phase, secs in res.timings.items():
+                print(f"{phase:>22s}  {secs * 1e3:9.1f} ms")
         fs = result.figure_stats
         if fs and fs.get("figures"):
             print(
@@ -210,14 +274,23 @@ def main(argv: list[str] | None = None) -> int:
                 f"{fs['render_workers']} render workers"
             )
 
-    print(f"All done! Find the debug report here: {os.path.join(result.report_dir, 'index.html')}")
+    trace_path = obs_trace.finish()
+    if trace_path:
+        print(f"obs trace written to {trace_path} (open at ui.perfetto.dev)")
+
+    for res in results:
+        print(f"All done! Find the debug report here: {os.path.join(res.report_dir, 'index.html')}")
 
     if args.serve:
         import functools
         import http.server
 
+        # Multiple corpora: serve the results ROOT so every report is
+        # reachable (results/<run_name>/index.html); a single corpus keeps
+        # the report itself as the document root, as before.
+        serve_dir = result.report_dir if len(results) == 1 else args.results_dir
         handler = functools.partial(
-            http.server.SimpleHTTPRequestHandler, directory=result.report_dir
+            http.server.SimpleHTTPRequestHandler, directory=serve_dir
         )
         with http.server.ThreadingHTTPServer(("127.0.0.1", args.serve), handler) as httpd:
             print(f"Serving the report at http://127.0.0.1:{httpd.server_address[1]}/ (Ctrl-C to stop)")

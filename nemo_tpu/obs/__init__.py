@@ -1,0 +1,58 @@
+"""Structured observability: span tracing + a metrics registry.
+
+The reference's only observability is printf phase banners
+(graphing/pre-post-prov.go:249); this subsystem gives the reproduction the
+two primitives a sharded / two-process / worker-pool deployment needs:
+
+* **Span tracing** (`obs.trace`): nested, thread-aware `span(name, **attrs)`
+  context managers recording Chrome-trace-event JSON that Perfetto loads
+  directly (ui.perfetto.dev -> Open trace file).  Enabled by `NEMO_TRACE` or
+  the CLI's `--trace out.json`; when disabled, `span()` returns a shared
+  null context manager — one global read and one attribute call per use, no
+  allocation — so instrumented hot paths stay hot.  Spans cross process
+  boundaries in-band: render-pool workers and the gRPC sidecar return their
+  spans to the tracing process (report/render.py, service/client.py), which
+  adopts them under the worker's real pid so the Perfetto timeline shows
+  pool overlap and RPC service time where they actually happened.
+
+* **Metrics** (`obs.metrics`): counters / gauges / histograms with a
+  `snapshot()` dict — the single home for the run statistics that were
+  previously scattered and re-derived per layer (compile-cache hits, figure
+  dedup, SVG-cache hits, upload bytes, batch sizes, RPC retries/latency).
+  `bench.py` and the report's telemetry section consume the snapshot
+  instead of recomputing.
+
+Import cost is deliberately tiny (stdlib only, no jax/numpy) so every layer
+can depend on it unconditionally.
+"""
+
+from __future__ import annotations
+
+from .metrics import Metrics, metrics
+from .trace import (
+    Tracer,
+    add_span,
+    configure_from_env,
+    enabled,
+    export,
+    finish,
+    span,
+    start_trace,
+    trace_id,
+    tracer,
+)
+
+__all__ = [
+    "Metrics",
+    "Tracer",
+    "add_span",
+    "configure_from_env",
+    "enabled",
+    "export",
+    "finish",
+    "metrics",
+    "span",
+    "start_trace",
+    "trace_id",
+    "tracer",
+]

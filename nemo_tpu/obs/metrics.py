@@ -1,0 +1,124 @@
+"""Metrics registry: counters / gauges / histograms with a snapshot() dict.
+
+One process-wide registry (`obs.metrics`) is the single home for run
+statistics that were previously scattered across layers and re-derived by
+every consumer: compile-cache hits and upload bytes (backend/jax_backend.py),
+figure dedup and SVG-cache hits (report/render.py), RPC retries and latency
+(service/client.py, service/server.py), dispatch batch sizes.  `bench.py`
+reads `snapshot()` deltas instead of recomputing; the sidecar surfaces its
+snapshot through the Health RPC so operators see device-side state without
+SSH.
+
+Naming convention: dotted lowercase, layer-first — e.g.
+``kernel.dispatches``, ``kernel.compiles``, ``render.figures``,
+``rpc.retries``.  Breakdown by label rides the name
+(``kernel.dispatches.fused``) — a flat dict snapshot stays trivially
+JSON-able for the Health RPC and the report's telemetry section.
+
+Histograms keep count/sum/min/max (mean derives) — enough for latency and
+batch-size distributions without a binning policy to version.
+"""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = ["Metrics", "metrics"]
+
+
+class Metrics:
+    """Thread-safe registry.  All mutators are cheap (one lock, dict ops);
+    none allocate on the hot path beyond first sight of a name."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: dict[str, float] = {}
+        self._gauges: dict[str, float] = {}
+        self._hists: dict[str, list[float]] = {}  # [count, sum, min, max]
+
+    # ------------------------------------------------------------- mutators
+
+    def inc(self, name: str, value: float = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + value
+
+    def gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def observe(self, name: str, value: float) -> None:
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                self._hists[name] = [1, value, value, value]
+            else:
+                h[0] += 1
+                h[1] += value
+                if value < h[2]:
+                    h[2] = value
+                if value > h[3]:
+                    h[3] = value
+
+    # ------------------------------------------------------------ snapshots
+
+    def snapshot(self) -> dict:
+        """Point-in-time copy: {"counters": {...}, "gauges": {...},
+        "histograms": {name: {count, sum, min, max, mean}}}.  Plain JSON-able
+        types only (the Health RPC and telemetry.json ship it verbatim)."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = {k: list(v) for k, v in self._hists.items()}
+        return {
+            "counters": counters,
+            "gauges": gauges,
+            "histograms": {
+                k: {
+                    "count": int(c),
+                    "sum": s,
+                    "min": lo,
+                    "max": hi,
+                    "mean": s / c if c else 0.0,
+                }
+                for k, (c, s, lo, hi) in hists.items()
+            },
+        }
+
+    @staticmethod
+    def delta(after: dict, before: dict) -> dict:
+        """Counter-wise `after - before` of two snapshot() dicts — what ONE
+        measured pass contributed to the process-cumulative registry.
+        Gauges keep `after`'s value (a gauge is a level, not a flow);
+        histograms difference count/sum (mean derives) and keep `after`'s
+        min/max, which are lifetime extremes — flagged by key name."""
+        out: dict = {"counters": {}, "gauges": dict(after.get("gauges", {})), "histograms": {}}
+        b = before.get("counters", {})
+        for k, v in after.get("counters", {}).items():
+            d = v - b.get(k, 0)
+            if d:
+                out["counters"][k] = d
+        bh = before.get("histograms", {})
+        for k, h in after.get("histograms", {}).items():
+            p = bh.get(k, {"count": 0, "sum": 0.0})
+            dc = h["count"] - p["count"]
+            if dc:
+                ds = h["sum"] - p["sum"]
+                out["histograms"][k] = {
+                    "count": dc,
+                    "sum": ds,
+                    "mean": ds / dc,
+                    "lifetime_min": h["min"],
+                    "lifetime_max": h["max"],
+                }
+        return out
+
+    def reset(self) -> None:
+        """Drop everything (tests and bench passes that want a clean zero)."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+
+
+#: The process-wide registry every layer records into.
+metrics = Metrics()

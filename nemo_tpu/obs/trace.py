@@ -1,0 +1,357 @@
+"""Span tracer: nested, thread-aware spans -> Chrome-trace-event JSON.
+
+Format: the Chrome Trace Event "JSON Object Format" — a top-level object
+with a ``traceEvents`` array of complete ("ph": "X") events plus process /
+thread name metadata ("ph": "M") events.  Perfetto's UI and trace_processor
+load it directly, and it merges cleanly with ``jax.profiler`` device traces
+captured alongside (host spans here, device annotations there, one shared
+wall clock).
+
+Clock: event timestamps are microseconds of CLOCK_MONOTONIC
+(``time.perf_counter_ns() // 1000``).  On Linux CLOCK_MONOTONIC is
+system-wide, so spans recorded by OTHER processes on the same machine
+(render-pool workers, a local sidecar) land on the same timeline with no
+skew correction; the exporter normalizes to the earliest event.  Spans
+adopted from a REMOTE machine carry that machine's monotonic timestamps —
+``Tracer.adopt`` tags every adopted span with a ``span_origin`` arg so the
+foreign clock domain stays identifiable, and the exporter re-bases any
+origin domain whose clock is implausibly far from ours (>1 h) onto the
+local time origin — no clock sync is attempted, so a cross-host trace
+shows correct durations and ordering within each process with an
+arbitrary (but navigable) offset between hosts.
+
+Disabled-mode cost: ``span()`` reads one module global and returns a shared
+null context manager — no allocation, no string work.  The <3% hot-loop
+guard in tests/test_obs.py pins this.
+
+Thread safety: spans are appended under a lock (contention is negligible
+next to the work a span brackets); thread ids are attributed via
+``threading.get_ident`` with the thread's name exported as Perfetto
+thread-name metadata.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = [
+    "Tracer",
+    "add_span",
+    "configure_from_env",
+    "enabled",
+    "export",
+    "finish",
+    "span",
+    "start_trace",
+    "trace_id",
+    "tracer",
+]
+
+
+def _now_us() -> int:
+    return time.perf_counter_ns() // 1000
+
+
+class Tracer:
+    """Collects completed spans; exports Chrome trace events."""
+
+    def __init__(self, path: str | None = None, trace_id: str | None = None) -> None:
+        import uuid  # deferred: only a live tracer needs it, not the import chain
+
+        self.path = path
+        #: Propagated over process boundaries (gRPC metadata, worker-pool
+        #: job payloads) so every participant tags spans with one run id.
+        self.trace_id = trace_id or uuid.uuid4().hex[:16]
+        self.pid = os.getpid()
+        self._lock = threading.Lock()
+        self._events: list[dict] = []
+        self._thread_names: dict[tuple[int, int], str] = {}
+        self._process_names: dict[int, str] = {self.pid: _process_name_default()}
+
+    # ------------------------------------------------------------ recording
+
+    def add_span(
+        self,
+        name: str,
+        start_us: int,
+        dur_us: int,
+        args: dict | None = None,
+        pid: int | None = None,
+        tid: int | None = None,
+        thread_name: str | None = None,
+    ) -> None:
+        """Record one completed span.  pid/tid default to the calling
+        process/thread; pass them explicitly when adopting spans recorded by
+        another process (render workers, the sidecar)."""
+        if pid is None:
+            pid = self.pid
+        if tid is None:
+            tid = threading.get_ident()
+            if thread_name is None:
+                thread_name = threading.current_thread().name
+        ev = {"name": name, "ph": "X", "ts": start_us, "dur": dur_us, "pid": pid, "tid": tid}
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self._events.append(ev)
+            if thread_name is not None:
+                self._thread_names.setdefault((pid, tid), thread_name)
+
+    def set_process_name(self, pid: int, name: str) -> None:
+        with self._lock:
+            self._process_names[pid] = name
+
+    def adopt(self, spans: list[dict], process_name: str | None = None) -> None:
+        """Merge spans serialized by another process (`Tracer.drain_spans`
+        wire shape: name/ts/dur/pid/tid[/args][/thread_name]).  Only spans
+        from the same machine share our monotonic clock; every adopted span
+        is tagged ``span_origin`` so a remote clock domain stays
+        identifiable in the Perfetto view (see module doc).
+
+        Spans claiming OUR pid are skipped: any span recorded in this
+        process is already in this tracer (an in-process sidecar hands back
+        spans that were recorded directly), and adopting them would
+        duplicate events."""
+        origin = process_name or "remote"
+        for s in spans:
+            pid = int(s["pid"])
+            if pid == self.pid:
+                continue
+            self.add_span(
+                s["name"],
+                int(s["ts"]),
+                int(s["dur"]),
+                args={**(s.get("args") or {}), "span_origin": origin},
+                pid=pid,
+                tid=int(s.get("tid", 0)),
+                thread_name=s.get("thread_name"),
+            )
+            if process_name is not None:
+                self.set_process_name(pid, process_name)
+
+    @staticmethod
+    def _serialize(events: list[dict], names: dict[tuple[int, int], str]) -> list[dict]:
+        out = []
+        for ev in events:
+            s = dict(ev)
+            s.pop("ph", None)
+            tn = names.get((ev["pid"], ev["tid"]))
+            if tn:
+                s["thread_name"] = tn
+            out.append(s)
+        return out
+
+    def drain_spans(self) -> list[dict]:
+        """Take every recorded span as plain dicts (the cross-process wire
+        shape consumed by `adopt`), clearing the buffer."""
+        with self._lock:
+            events, self._events = self._events, []
+            names = dict(self._thread_names)
+        return self._serialize(events, names)
+
+    def mark(self) -> int:
+        """Current span count — pass to spans_since to serialize only what
+        one request recorded (the sidecar's per-RPC span collection)."""
+        with self._lock:
+            return len(self._events)
+
+    def spans_since(self, mark: int) -> list[dict]:
+        """Serialize spans recorded after `mark` WITHOUT clearing (used when
+        this tracer also owns its own trace file and must keep them)."""
+        with self._lock:
+            events = list(self._events[mark:])
+            names = dict(self._thread_names)
+        return self._serialize(events, names)
+
+    # ------------------------------------------------------------ exporting
+
+    #: An adopted clock domain whose origin is further than this from ours
+    #: (1 hour, in µs) is treated as a foreign CLOCK_MONOTONIC and re-based;
+    #: same-machine adoption skew is ~0, nowhere near it.
+    _FOREIGN_CLOCK_US = 3_600_000_000
+
+    def export(self, path: str | None = None) -> str:
+        """Write the trace file; returns the path written."""
+        path = path or self.path
+        if not path:
+            raise ValueError("no trace output path configured")
+        with self._lock:
+            events = list(self._events)
+            thread_names = dict(self._thread_names)
+            process_names = dict(self._process_names)
+        # Normalize to OUR earliest event, then re-base any adopted clock
+        # domain (span_origin-tagged) whose origin is implausibly far from
+        # ours: a remote host's CLOCK_MONOTONIC differs by the machines'
+        # uptime delta, and a single global min would shove the local spans
+        # days off-screen.  Same-machine adoptions (render workers, a local
+        # sidecar) share our clock and stay exactly aligned.
+        def _origin(e: dict) -> str | None:
+            return (e.get("args") or {}).get("span_origin")
+
+        local_ts = [e["ts"] for e in events if _origin(e) is None]
+        base = min(local_ts, default=min((e["ts"] for e in events), default=0))
+        domain_min: dict[str, int] = {}
+        for e in events:
+            o = _origin(e)
+            if o is not None:
+                domain_min[o] = min(domain_min.get(o, e["ts"]), e["ts"])
+        shift = {
+            o: m - base
+            for o, m in domain_min.items()
+            if abs(m - base) > self._FOREIGN_CLOCK_US
+        }
+        out = []
+        for pid, name in sorted(process_names.items()):
+            out.append(
+                {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+                 "args": {"name": name}}
+            )
+        for (pid, tid), name in sorted(thread_names.items()):
+            out.append(
+                {"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+                 "args": {"name": name}}
+            )
+        for e in events:
+            e = dict(e)
+            e["ts"] -= base + shift.get(_origin(e), 0)
+            out.append(e)
+        doc = {
+            "traceEvents": out,
+            "displayTimeUnit": "ms",
+            "otherData": {"trace_id": self.trace_id, "tool": "nemo-tpu obs"},
+        }
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+        return path
+
+
+def _process_name_default() -> str:
+    import sys
+
+    argv0 = os.path.basename(sys.argv[0]) if sys.argv and sys.argv[0] else "python"
+    return f"{argv0} (pid {os.getpid()})"
+
+
+class _NullSpan:
+    """Shared no-op context manager: the entire disabled-mode cost."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullSpan()
+
+
+class _Span:
+    """Live span context manager (only ever built when tracing is on)."""
+
+    __slots__ = ("_tracer", "_name", "_args", "_start")
+
+    def __init__(self, tracer: Tracer, name: str, args: dict) -> None:
+        self._tracer = tracer
+        self._name = name
+        self._args = args
+
+    def set(self, **attrs) -> None:
+        """Attach attributes discovered mid-span (e.g. whether a dispatch
+        compiled) — merged into the span's args at exit."""
+        self._args.update(attrs)
+
+    def __enter__(self):
+        self._start = _now_us()
+        return self
+
+    def __exit__(self, *exc):
+        self._tracer.add_span(
+            self._name, self._start, _now_us() - self._start, self._args or None
+        )
+        return False
+
+
+# Module-level tracer state: None = disabled (the common case).
+_TRACER: Tracer | None = None
+
+
+def enabled() -> bool:
+    return _TRACER is not None
+
+
+def tracer() -> Tracer | None:
+    """The active tracer, or None when tracing is disabled."""
+    return _TRACER
+
+
+def trace_id() -> str | None:
+    t = _TRACER
+    return t.trace_id if t is not None else None
+
+
+def span(name: str, **attrs):
+    """Context manager bracketing one unit of work.  Nested uses on one
+    thread render as a nested flame in Perfetto (complete events nest by
+    containment).  Near-free when tracing is disabled."""
+    t = _TRACER
+    if t is None:
+        return _NULL
+    return _Span(t, name, attrs)
+
+
+def add_span(name: str, start_us: int, dur_us: int, args: dict | None = None) -> None:
+    """Record an already-measured interval (e.g. a phase timer's own
+    measurement, so the span and the timing are the SAME numbers)."""
+    t = _TRACER
+    if t is not None:
+        t.add_span(name, start_us, dur_us, args)
+
+
+def start_trace(path: str | None, trace_id_: str | None = None) -> Tracer:
+    """Enable tracing for this process; spans land in `path` at finish().
+    path=None makes a pathless collector: spans are only ever drained by a
+    remote parent (the sidecar serving a tracing client)."""
+    global _TRACER
+    _TRACER = Tracer(path, trace_id_)
+    return _TRACER
+
+
+def finish() -> str | None:
+    """Export and disable; returns the written path (None if disabled or
+    pathless — a pathless tracer exists only to collect spans for a remote
+    parent, which drains it explicitly)."""
+    global _TRACER
+    t, _TRACER = _TRACER, None
+    if t is None or not t.path:
+        return None
+    return t.export()
+
+
+def export(path: str | None = None) -> str | None:
+    """Export without disabling (mid-run snapshots)."""
+    t = _TRACER
+    if t is None:
+        return None
+    return t.export(path)
+
+
+def configure_from_env() -> Tracer | None:
+    """Enable tracing when NEMO_TRACE names an output file; the trace is
+    written at interpreter exit (atexit) unless finish() ran earlier.  The
+    sidecar and other long-lived entry points call this at startup so an
+    operator can capture traces with nothing but an env var."""
+    path = os.environ.get("NEMO_TRACE", "").strip()
+    if not path or _TRACER is not None:
+        return _TRACER
+    t = start_trace(path)
+    import atexit
+
+    atexit.register(finish)
+    return t

@@ -25,6 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from nemo_tpu.models.pipeline_model import BatchArrays
 from nemo_tpu.parallel.mesh import run_step_sharded
+from nemo_tpu.utils.jax_config import distributed_is_initialized
 
 DCN_AXIS = "dcn"
 ICI_AXIS = "ici"
@@ -43,7 +44,7 @@ def init_distributed(
     supported cluster environment that jax.distributed auto-detects).  A
     plain single-process run is left untouched — calling this is always safe.
     """
-    if jax.distributed.is_initialized():
+    if distributed_is_initialized():
         return jax.process_count() > 1
     coordinator_address = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
     env_procs = os.environ.get("JAX_NUM_PROCESSES")

@@ -21,6 +21,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from nemo_tpu.utils.jax_config import axis_size, pcast_varying, shard_map
+
 from .mesh import NODE_AXIS
 
 
@@ -35,13 +37,14 @@ def make_node_mesh(n_devices: int | None = None) -> Mesh:
 def _ring_step_body(frontier_chunk, adj_shard, axis_name):
     """One full ring rotation: accumulate new-frontier contributions for this
     device's node block from every frontier chunk passing by."""
-    n_dev = lax.axis_size(axis_name)
+    n_dev = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     chunk = frontier_chunk  # [Vb] bool, row-block (axis_index) of the frontier
     # Mark the accumulator as device-varying so the ring loop's carry type is
-    # stable under shard_map's varying-axes checks.
-    acc = lax.pcast(
-        jnp.zeros((adj_shard.shape[1],), dtype=jnp.float32), (axis_name,), to="varying"
+    # stable under shard_map's varying-axes checks (a no-op on jax versions
+    # without the check — utils/jax_config.py:pcast_varying).
+    acc = pcast_varying(
+        jnp.zeros((adj_shard.shape[1],), dtype=jnp.float32), axis_name
     )
 
     def body(i, carry):
@@ -96,7 +99,7 @@ def ring_reach(mesh: Mesh, adjacency: jnp.ndarray, start: jnp.ndarray, steps: in
         raise ValueError(f"V={v} not divisible by mesh size {n_dev}")
 
     @partial(
-        jax.shard_map,
+        shard_map(),
         mesh=mesh,
         in_specs=(P(None, NODE_AXIS), P(NODE_AXIS)),
         out_specs=P(NODE_AXIS),

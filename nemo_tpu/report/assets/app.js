@@ -159,7 +159,74 @@ function runSection(run, goodIter) {
   return details;
 }
 
+function telemetryTable(title, rows) {
+  // rows: [label, value] pairs; value pre-formatted.
+  const wrap = el("div", { class: "telemetry-block" });
+  wrap.append(el("h4", {}, title));
+  const table = el("table", { class: "telemetry-table" });
+  const tbody = el("tbody", {});
+  for (const [k, v] of rows) {
+    tbody.append(el("tr", {}, el("td", {}, k), el("td", { class: "num" }, String(v))));
+  }
+  table.append(tbody);
+  wrap.append(table);
+  return wrap;
+}
+
+async function telemetry() {
+  // Run telemetry (analysis/pipeline.py: telemetry.json — phase walls,
+  // figure-pipeline stats, obs metrics snapshot).  Reports written before
+  // the obs subsystem have no such file: keep the section hidden.
+  let data;
+  try {
+    const resp = await fetch("telemetry.json");
+    if (!resp.ok) return;
+    data = await resp.json();
+  } catch (e) {
+    return;
+  }
+  const body = document.getElementById("telemetry-body");
+
+  const phases = Object.entries(data.timings || {});
+  if (phases.length) {
+    body.append(
+      telemetryTable(
+        "Pipeline phases",
+        phases.map(([k, s]) => [k, `${(s * 1e3).toFixed(1)} ms`])
+      )
+    );
+  }
+
+  const fs = data.figure_stats;
+  if (fs && fs.figures) {
+    body.append(
+      telemetryTable("Figure pipeline", [
+        ["figures", fs.figures],
+        ["unique figures", fs.unique_figures],
+        ["dedup ratio", `${fs.dedup_ratio}×`],
+        ["SVG cache hits", fs.figure_cache_hits],
+        ["rendered", fs.rendered],
+        ["render workers", fs.render_workers],
+        ["render time", `${(fs.render_s * 1e3).toFixed(1)} ms`],
+      ])
+    );
+  }
+
+  const counters = (data.metrics || {}).counters || {};
+  const rows = Object.entries(counters)
+    .sort()
+    .map(([k, v]) => [k, Number.isInteger(v) ? v : v.toFixed(3)]);
+  if (rows.length) {
+    body.append(telemetryTable("Counters", rows));
+  }
+  if (data.trace_id) {
+    body.append(el("p", { class: "empty-note" }, `trace id ${data.trace_id}`));
+  }
+  document.getElementById("telemetry").hidden = false;
+}
+
 async function main() {
+  telemetry(); // independent of the run data; never blocks the report
   const resp = await fetch("debugging.json");
   const runs = await resp.json();
 

@@ -49,6 +49,8 @@ import tempfile
 import time
 import warnings
 
+from nemo_tpu import obs
+
 from .dot import DotGraph
 
 
@@ -151,14 +153,17 @@ class SvgCache:
     def get(self, key: str) -> str | None:
         if self.root is None:
             self.misses += 1
+            obs.metrics.inc("render.svg_cache_misses")
             return None
         try:
             with open(self._path(key), "r", encoding="utf-8") as f:
                 svg = f.read()
         except OSError:
             self.misses += 1
+            obs.metrics.inc("render.svg_cache_misses")
             return None
         self.hits += 1
+        obs.metrics.inc("render.svg_cache_hits")
         return svg
 
     def put(self, key: str, svg: str) -> None:
@@ -175,16 +180,40 @@ class SvgCache:
             warnings.warn(f"SVG cache write failed ({ex}); continuing uncached", stacklevel=2)
 
 
-def _render_job(g: DotGraph) -> tuple[str, float]:
+def _render_job(g: DotGraph, collect_spans: bool = False) -> tuple:
     """Pool worker body: render one DotGraph, returning (svg, render
-    seconds).  Lives at module top level for picklability; imports the
-    engine lazily so spawned workers never touch jax (this module's import
-    chain is jax-free by design)."""
+    seconds, spans).  Lives at module top level for picklability; imports
+    the engine lazily so spawned workers never touch jax (this module's
+    import chain is jax-free by design).
+
+    `collect_spans` is set by a tracing parent: the worker then records a
+    ``render:svg`` span with its OWN pid/tid (the wire shape of
+    obs.trace.Tracer.adopt) so the parent's Perfetto timeline shows the
+    pool's overlap with analysis where it actually ran.  Worker and parent
+    share CLOCK_MONOTONIC (same machine by construction — a spawned pool),
+    so no clock reconciliation is needed."""
     from .native import render_svg_auto
 
+    start_us = time.perf_counter_ns() // 1000
     t0 = time.perf_counter()
     svg = render_svg_auto(g)
-    return svg, time.perf_counter() - t0
+    dt = time.perf_counter() - t0
+    spans = None
+    if collect_spans:
+        import threading
+
+        spans = [
+            {
+                "name": "render:svg",
+                "ts": start_us,
+                "dur": time.perf_counter_ns() // 1000 - start_us,
+                "pid": os.getpid(),
+                "tid": threading.get_ident(),
+                "thread_name": "render-worker",
+                "args": {"nodes": len(g.nodes), "edges": len(g.edges)},
+            }
+        ]
+    return svg, dt, spans
 
 
 class _Entry:
@@ -264,11 +293,13 @@ class RenderScheduler:
         """Register one figure: svg_path will receive the rendered SVG at the
         next drain().  Dedup, cache lookup, and pool handoff all happen here."""
         self.figures += 1
+        obs.metrics.inc("render.figures")
         key = render_key(dot)
         ent = self._entries.get(key)
         if ent is None:
             ent = self._entries[key] = _Entry()
             self._order.append(key)
+            obs.metrics.inc("render.unique_figures")
             ent.svg = self.cache.get(key)
             if ent.svg is None:
                 # The graph is retained until the SVG resolves even when a
@@ -277,7 +308,10 @@ class RenderScheduler:
                 ent.graph = dot
                 pool = self._ensure_pool()
                 if pool is not None:
-                    ent.future = pool.submit(_render_job, dot)
+                    # A tracing parent asks workers to record their render
+                    # spans; they come back through the future's result and
+                    # are adopted at drain.
+                    ent.future = pool.submit(_render_job, dot, obs.enabled())
         ent.count += 1
         ent.pending_paths.append(svg_path)
 
@@ -307,35 +341,43 @@ class RenderScheduler:
         """Resolve every pending render, write all fan-out SVGs, and return
         stats().  Idempotent: a drain with nothing pending only snapshots."""
         t0 = time.perf_counter()
-        for key in self._order:
-            ent = self._entries[key]
-            if not ent.pending_paths:
-                continue
-            if ent.svg is None:
-                if ent.future is not None:
-                    try:
-                        ent.svg, ent.render_dt = ent.future.result()
-                    except Exception as ex:
-                        # A dead pool (unpicklable __main__, OOM-killed
-                        # worker...) degrades to inline rendering — byte-
-                        # identical output, just serial.  Warn once.
-                        if not self._pool_broken:
-                            self._pool_broken = True
-                            warnings.warn(
-                                f"figure render pool failed ({type(ex).__name__}: "
-                                f"{ex}); rendering inline",
-                                stacklevel=2,
-                            )
-                    ent.future = None
+        with obs.span("render:drain", pending=len(self._order)):
+            for key in self._order:
+                ent = self._entries[key]
+                if not ent.pending_paths:
+                    continue
                 if ent.svg is None:
-                    ent.svg, ent.render_dt = _render_job(ent.graph)
-                ent.graph = None
-                self.rendered += 1
-                self.render_s += ent.render_dt
-                self.cache.put(key, ent.svg)
-            for path in ent.pending_paths:
-                self._fan_out(ent, path)
-            ent.pending_paths = []
+                    if ent.future is not None:
+                        try:
+                            ent.svg, ent.render_dt, w_spans = ent.future.result()
+                            if w_spans:
+                                t = obs.tracer()
+                                if t is not None:
+                                    t.adopt(w_spans, process_name="nemo render worker")
+                        except Exception as ex:
+                            # A dead pool (unpicklable __main__, OOM-killed
+                            # worker...) degrades to inline rendering — byte-
+                            # identical output, just serial.  Warn once.
+                            if not self._pool_broken:
+                                self._pool_broken = True
+                                warnings.warn(
+                                    f"figure render pool failed ({type(ex).__name__}: "
+                                    f"{ex}); rendering inline",
+                                    stacklevel=2,
+                                )
+                        ent.future = None
+                    if ent.svg is None:
+                        with obs.span("render:svg", inline=True):
+                            ent.svg, ent.render_dt, _ = _render_job(ent.graph)
+                    ent.graph = None
+                    self.rendered += 1
+                    self.render_s += ent.render_dt
+                    obs.metrics.inc("render.rendered")
+                    obs.metrics.inc("render.render_s", ent.render_dt)
+                    self.cache.put(key, ent.svg)
+                for path in ent.pending_paths:
+                    self._fan_out(ent, path)
+                ent.pending_paths = []
         self.render_wall_s += time.perf_counter() - t0
         return self.stats()
 

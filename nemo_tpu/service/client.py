@@ -8,12 +8,14 @@ exponential backoff on UNAVAILABLE, and chunk ordinals verified on receipt.
 
 from __future__ import annotations
 
+import json as _json
 import time
 from dataclasses import dataclass
 
 import grpc
 import numpy as np
 
+from nemo_tpu import obs
 from nemo_tpu.service import codec
 from nemo_tpu.service.proto import nemo_service_pb2 as pb
 from nemo_tpu.service.server import SERVICE
@@ -21,6 +23,65 @@ from nemo_tpu.service.server import SERVICE
 
 class SidecarError(RuntimeError):
     pass
+
+
+def _trace_metadata() -> tuple | None:
+    """Outgoing gRPC metadata propagating this process's trace context, or
+    None when tracing is off.  The sidecar answers a traced request with
+    its own spans in 'nemo-spans-bin' trailing metadata (service/server.py)
+    — collected by _adopt_remote below — so one client trace file shows
+    both sides of every RPC under one trace id."""
+    tid = obs.trace_id()
+    if tid is None:
+        return None
+    return (("nemo-trace-id", tid),)
+
+
+def _metadata_value(md, key: str):
+    for k, v in md or ():
+        if k == key:
+            return v
+    return None
+
+
+def _drive_stream(
+    stream_callable, requests_iter, timeout: float, target: str, out: list
+) -> None:
+    """Drive one AnalyzeStream call under the trace contract shared by
+    analyze_chunks and the pipelined producer paths: one rpc:AnalyzeStream
+    span, trace metadata attached only when tracing (untraced calls keep
+    the bare signature — test fakes and old stubs stay compatible),
+    per-chunk ordinal checks filling `out`, and the sidecar's spans adopted
+    from trailing metadata once the stream completes."""
+    n = len(out)
+    with obs.span("rpc:AnalyzeStream", target=target, chunks=n):
+        md = _trace_metadata()
+        stream = stream_callable(
+            requests_iter, timeout=timeout, **({"metadata": md} if md else {})
+        )
+        for resp in stream:
+            if not 0 <= resp.chunk < n:
+                raise SidecarError(f"bad chunk ordinal {resp.chunk}")
+            out[resp.chunk] = codec.outputs_from_pb(resp)
+        _adopt_remote(stream)
+
+
+def _adopt_remote(call) -> None:
+    """Merge the sidecar's spans (trailing metadata) into the local trace."""
+    t = obs.tracer()
+    if t is None:
+        return
+    try:
+        raw = _metadata_value(call.trailing_metadata(), "nemo-spans-bin")
+    except Exception:
+        return
+    if not raw:
+        return
+    try:
+        spans = _json.loads(raw.decode("utf-8") if isinstance(raw, bytes) else raw)
+    except (ValueError, UnicodeDecodeError):
+        return
+    t.adopt(spans, process_name="nemo-sidecar")
 
 
 @dataclass
@@ -37,6 +98,10 @@ class RemoteAnalyzer:
             options=[
                 ("grpc.max_receive_message_length", 1 << 30),
                 ("grpc.max_send_message_length", 1 << 30),
+                # The sidecar's span trailing metadata (traced runs) can
+                # reach ~1 MB (server _SpanCollection.MAX_BYTES); the
+                # default metadata cap is 8 KB.
+                ("grpc.max_metadata_size", 2 << 20),
             ],
         )
         self._health = self._channel.unary_unary(
@@ -72,12 +137,25 @@ class RemoteAnalyzer:
     # ------------------------------------------------------------- health
 
     def health(self, timeout: float = 10.0) -> dict:
-        resp = self._call(self._health, pb.HealthRequest(), timeout)
-        return {
+        resp, call = self._call(self._health, pb.HealthRequest(), timeout, name="Health")
+        out = {
             "platform": resp.platform,
             "device_count": resp.device_count,
             "version": resp.version,
         }
+        # The sidecar ships its obs metrics snapshot in trailing metadata
+        # (no proto change needed), so operators see device-side state —
+        # dispatch counts, compile-cache hits, step latencies — through any
+        # client's health() without SSH-ing to the sidecar host.
+        try:
+            raw = _metadata_value(call.trailing_metadata(), "nemo-metrics-bin")
+            if raw:
+                out["metrics"] = _json.loads(
+                    raw.decode("utf-8") if isinstance(raw, bytes) else raw
+                )
+        except Exception:
+            pass  # an old server without the metadata is still healthy
+        return out
 
     def wait_ready(self, deadline: float = 30.0) -> dict:
         """Poll Health until the sidecar answers (startup gate).  Single
@@ -97,14 +175,34 @@ class RemoteAnalyzer:
                 time.sleep(0.2)
         raise SidecarError(f"sidecar not ready after {deadline}s: {last}")
 
-    def _call(self, method, request, timeout: float | None = None):
+    def _call(self, method, request, timeout: float | None = None, name: str = "rpc"):
+        """One unary RPC with bounded UNAVAILABLE retries; returns
+        (response, call) — with_call so trailing metadata (sidecar spans,
+        metrics) is readable.  Every attempt gets a span and a latency
+        observation; retries/backoffs land in the metrics registry so a
+        benchmark that silently absorbed reconnects shows it."""
         delay = 0.2
+        md = _trace_metadata()
         for attempt in range(self.retries):
             try:
-                return method(request, timeout=timeout or self.timeout)
+                t0 = time.perf_counter()
+                with obs.span(
+                    f"rpc:{name}", target=self.target, attempt=attempt,
+                    trace_id=obs.trace_id(),
+                ):
+                    resp, call = method.with_call(
+                        request, timeout=timeout or self.timeout, metadata=md
+                    )
+                obs.metrics.inc(f"rpc.calls.{name}")
+                obs.metrics.observe(f"rpc.latency_s.{name}", time.perf_counter() - t0)
+                _adopt_remote(call)
+                return resp, call
             except grpc.RpcError as ex:
                 if ex.code() != grpc.StatusCode.UNAVAILABLE or attempt == self.retries - 1:
+                    obs.metrics.inc("rpc.errors")
                     raise
+                obs.metrics.inc("rpc.retries")
+                obs.metrics.inc("rpc.backoff_s", delay)
                 time.sleep(delay)
                 delay *= 2
         raise SidecarError("unreachable")
@@ -114,7 +212,10 @@ class RemoteAnalyzer:
     def kernel(self, verb: str, arrays: dict, params: dict) -> dict[str, np.ndarray]:
         """One named device-kernel call on the sidecar (ServiceBackend path)."""
         req = codec.kernel_request_to_pb(verb, arrays, params)
-        return codec.kernel_response_from_pb(self._call(self._kernel, req))
+        obs.metrics.inc("rpc.bytes_sent", req.ByteSize())
+        resp, _ = self._call(self._kernel, req, name="Kernel")
+        obs.metrics.inc("rpc.bytes_received", resp.ByteSize())
+        return codec.kernel_response_from_pb(resp)
 
     # ------------------------------------------------------------ analyze
 
@@ -125,7 +226,10 @@ class RemoteAnalyzer:
             post=codec.batch_arrays_to_pb(post),
         )
         req.static.CopyFrom(codec.static_to_pb(static))
-        return codec.outputs_from_pb(self._call(self._analyze, req))
+        obs.metrics.inc("rpc.bytes_sent", req.ByteSize())
+        resp, _ = self._call(self._analyze, req, name="Analyze")
+        obs.metrics.inc("rpc.bytes_received", resp.ByteSize())
+        return codec.outputs_from_pb(resp)
 
     def analyze_chunks(
         self, chunks: list[tuple[object, object, dict]]
@@ -144,10 +248,7 @@ class RemoteAnalyzer:
                 yield req
 
         out: list[dict[str, np.ndarray] | None] = [None] * len(chunks)
-        for resp in self._analyze_stream(requests(), timeout=self.timeout):
-            if not 0 <= resp.chunk < len(chunks):
-                raise SidecarError(f"bad chunk ordinal {resp.chunk}")
-            out[resp.chunk] = codec.outputs_from_pb(resp)
+        _drive_stream(self._analyze_stream, requests(), self.timeout, self.target, out)
         missing = [i for i, o in enumerate(out) if o is None]
         if missing:
             raise SidecarError(f"missing responses for chunks {missing}")
@@ -286,10 +387,9 @@ def _stream_pipelined(
         with RemoteAnalyzer(target=target) as client:
             client.wait_ready(ready_deadline)
             t0 = time.perf_counter()
-            for resp in client._analyze_stream(requests(), timeout=client.timeout):
-                if not 0 <= resp.chunk < n_chunks:
-                    raise SidecarError(f"bad chunk ordinal {resp.chunk}")
-                results[resp.chunk] = codec.outputs_from_pb(resp)
+            _drive_stream(
+                client._analyze_stream, requests(), client.timeout, target, results
+            )
             timings["stream_s"] = time.perf_counter() - t0
     except BaseException as ex:
         if prod_exc:
@@ -357,7 +457,8 @@ def analyze_dirs(
 
         for i, d in enumerate(molly_dirs):
             t0 = time.perf_counter()
-            pre, post, static = pack_molly_dir(d)
+            with obs.span("pack:dir", ordinal=i):
+                pre, post, static = pack_molly_dir(d)
             timings["pack_s"] += time.perf_counter() - t0
             if not emit((i, pre, post, static)):
                 return
@@ -588,12 +689,13 @@ def analyze_dir_pipelined(
         def body(emit) -> None:
             for ci, (s, e) in enumerate(spans):
                 t0 = time.perf_counter()
-                chunk = (
-                    ci,
-                    _chunk_rows(corpus.pre, s, e, with_baseline=ci > 0, pad_to=pad_to),
-                    _chunk_rows(corpus.post, s, e, with_baseline=ci > 0, pad_to=pad_to),
-                    static,
-                )
+                with obs.span("pack:chunk", chunk=ci):
+                    chunk = (
+                        ci,
+                        _chunk_rows(corpus.pre, s, e, with_baseline=ci > 0, pad_to=pad_to),
+                        _chunk_rows(corpus.post, s, e, with_baseline=ci > 0, pad_to=pad_to),
+                        static,
+                    )
                 timings["pack_s"] += time.perf_counter() - t0
                 if not emit(chunk):
                     return

@@ -17,12 +17,16 @@ Run:  python -m nemo_tpu.service.server --port 50051
 from __future__ import annotations
 
 import argparse
+import json
 import logging
+import threading
 import time
 from concurrent import futures
 
 import grpc
 
+from nemo_tpu import obs
+from nemo_tpu.obs import trace as obs_trace
 from nemo_tpu.service import codec
 from nemo_tpu.service.proto import nemo_service_pb2 as pb
 
@@ -32,18 +36,120 @@ VERSION = "1"
 log = logging.getLogger("nemo.sidecar")
 
 
+#: Traced requests sharing the lazily-created PATHLESS collector tracer.
+#: When the count returns to zero the collector is torn down, so a
+#: long-lived sidecar serving untraced traffic records no spans at all —
+#: the collector exists only while a traced request is in flight.
+_collector_lock = threading.Lock()
+_collector_refs = [0]
+
+
+class _SpanCollection:
+    """Per-request span-collection state.
+
+    A tracing client sends its trace id in 'nemo-trace-id' request
+    metadata; the handler records its spans under that id and returns them
+    in 'nemo-spans-bin' trailing metadata, which the client stitches into
+    its own trace file — one Perfetto view, both processes.  Collection is
+    best-effort: with several concurrently tracing clients, spans may ride
+    home on the wrong response (they still belong to the same sidecar
+    timeline); the metrics counters are exact regardless.
+
+    Lifecycle: acquire on construction (lazily enabling a pathless
+    collector tracer unless the operator set NEMO_TRACE — an operator's
+    file tracer is only copied from, never drained), serialize with
+    trailing(), and ALWAYS release() (handlers do it in a finally) so the
+    pathless collector is torn down when the last traced request finishes.
+    """
+
+    #: One response's span payload cap.  gRPC refuses oversized metadata
+    #: (make_server/RemoteAnalyzer raise grpc.max_metadata_size above
+    #: this); a huge streamed corpus keeps its NEWEST spans.
+    MAX_BYTES = 1 << 20
+
+    def __init__(self, context) -> None:
+        md = dict(context.invocation_metadata() or ())
+        self.tid = md.get("nemo-trace-id")
+        self._owned = False
+        self._tracer = None
+        self._mark = 0
+        if self.tid is None:
+            return
+        with _collector_lock:
+            t = obs.tracer()
+            if t is None:
+                t = obs_trace.start_trace(None)
+            if not t.path:
+                _collector_refs[0] += 1
+                self._owned = True
+            self._tracer = t
+            self._mark = t.mark()
+
+    def trailing(self) -> tuple:
+        """Trailing-metadata entries carrying the spans this request
+        recorded (capped at MAX_BYTES, oldest dropped first)."""
+        t = self._tracer
+        if t is None:
+            return ()
+        spans = t.spans_since(self._mark) if t.path else t.drain_spans()
+        payload = b""
+        while spans:
+            payload = json.dumps(spans).encode("utf-8")
+            if len(payload) <= self.MAX_BYTES:
+                break
+            # Keep the newest spans: for a streamed corpus they cover the
+            # most recent chunks, and the client's own rpc span still
+            # brackets the whole call.
+            spans = spans[max(1, len(spans) // 4):]
+        if not spans or len(payload) > self.MAX_BYTES:
+            return ()
+        return (("nemo-spans-bin", payload),)
+
+    def release(self) -> None:
+        if not self._owned:
+            return
+        self._owned = False
+        with _collector_lock:
+            _collector_refs[0] -= 1
+            t = obs.tracer()
+            if _collector_refs[0] == 0 and t is not None and not t.path:
+                # finish() on a pathless tracer writes nothing — it just
+                # disables collection until the next traced request.
+                obs_trace.finish()
+
+
 class _Impl:
-    """Method implementations; one fused-step jit cache per process."""
+    """Method implementations; one fused-step jit cache per process.
+
+    Trace-context propagation is per request via _SpanCollection; every
+    handler acquires one and releases it in a finally.
+    """
 
     def health(self, request: pb.HealthRequest, context) -> pb.HealthResponse:
-        import jax
+        col = _SpanCollection(context)
+        try:
+            with obs.span("serve:Health", trace_id=col.tid):
+                import jax
 
-        devs = jax.devices()
-        return pb.HealthResponse(
-            platform=devs[0].platform, device_count=len(devs), version=VERSION
-        )
+                devs = jax.devices()
+                resp = pb.HealthResponse(
+                    platform=devs[0].platform, device_count=len(devs), version=VERSION
+                )
+            # The metrics snapshot rides every Health response (trailing
+            # metadata — no proto bump): operators inspect sidecar state
+            # (dispatch counts, compile-cache hits, step latencies) through
+            # any client's health() without SSH.
+            context.set_trailing_metadata(
+                (("nemo-metrics-bin", json.dumps(obs.metrics.snapshot()).encode("utf-8")),)
+                + col.trailing()
+            )
+            return resp
+        finally:
+            col.release()
 
-    def _analyze_one(self, request: pb.AnalyzeRequest) -> pb.AnalyzeResponse:
+    def _analyze_one(
+        self, request: pb.AnalyzeRequest, trace_id: str | None = None
+    ) -> pb.AnalyzeResponse:
         import jax
 
         from nemo_tpu.models.pipeline_model import analysis_step
@@ -53,6 +159,7 @@ class _Impl:
         pre = codec.batch_arrays_from_pb(request.pre)
         post = codec.batch_arrays_from_pb(request.post)
         static = codec.static_from_pb(request.static)
+        b = int(pre.is_goal.shape[0])
         t0 = time.perf_counter()
         # The server owns the device, so it decides the transfer folding
         # (like LocalExecutor.run): with pack_out the program's bool
@@ -61,9 +168,15 @@ class _Impl:
         # codec (which bit-packs bools again for transport).  Clients are
         # unaffected; this static never comes from the request.
         static = dict(static, pack_out=bool(_pack_out_default()))
-        out = analysis_step(pre, post, **static)
-        out = jax.block_until_ready(out)
+        with obs.span(
+            "serve:analysis_step", chunk=int(request.chunk), rows=b, trace_id=trace_id
+        ):
+            out = analysis_step(pre, post, **static)
+            out = jax.block_until_ready(out)
         dt = time.perf_counter() - t0
+        obs.metrics.inc("serve.analyze_chunks")
+        obs.metrics.observe("serve.step_s", dt)
+        obs.metrics.observe("serve.batch_rows", b)
         if "packed_summary" in out:
             out = dict(out)
             out.update(
@@ -81,13 +194,28 @@ class _Impl:
         return codec.outputs_to_pb(out, chunk=request.chunk, step_seconds=dt)
 
     def analyze(self, request: pb.AnalyzeRequest, context) -> pb.AnalyzeResponse:
-        return self._analyze_one(request)
+        col = _SpanCollection(context)
+        try:
+            resp = self._analyze_one(request, trace_id=col.tid)
+            md = col.trailing()
+            if md:
+                context.set_trailing_metadata(md)
+            return resp
+        finally:
+            col.release()
 
     def analyze_stream(self, request_iterator, context):
         # Sequential device dispatch preserves chunk arrival order; gRPC's
         # flow control provides the backpressure (SURVEY.md §7 hard part 6).
-        for request in request_iterator:
-            yield self._analyze_one(request)
+        col = _SpanCollection(context)
+        try:
+            for request in request_iterator:
+                yield self._analyze_one(request, trace_id=col.tid)
+            md = col.trailing()
+            if md:
+                context.set_trailing_metadata(md)
+        finally:
+            col.release()
 
     def kernel(self, request: pb.KernelRequest, context) -> pb.KernelResponse:
         """Named device-kernel dispatch for the ServiceBackend: the request's
@@ -96,17 +224,27 @@ class _Impl:
         device code."""
         from nemo_tpu.backend.jax_backend import LocalExecutor
 
-        verb, arrays, params = codec.kernel_request_from_pb(request)
-        if verb not in LocalExecutor.VERBS:
-            context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"unknown kernel verb {verb!r}")
-        t0 = time.perf_counter()
+        col = _SpanCollection(context)
         try:
-            # LocalExecutor is stateless; the jit caches live on the
-            # module-level kernel functions.
-            out = LocalExecutor().run(verb, arrays, params)
-        except KeyError as ex:
-            context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"missing kernel input: {ex}")
-        return codec.kernel_response_to_pb(out, step_seconds=time.perf_counter() - t0)
+            verb, arrays, params = codec.kernel_request_from_pb(request)
+            if verb not in LocalExecutor.VERBS:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"unknown kernel verb {verb!r}")
+            t0 = time.perf_counter()
+            try:
+                # LocalExecutor is stateless; the jit caches live on the
+                # module-level kernel functions.  Its own kernel:<verb> span
+                # rides home in the trailing metadata.
+                with obs.span("serve:Kernel", verb=verb, trace_id=col.tid):
+                    out = LocalExecutor().run(verb, arrays, params)
+            except KeyError as ex:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"missing kernel input: {ex}")
+            obs.metrics.inc("serve.kernel_calls")
+            md = col.trailing()
+            if md:
+                context.set_trailing_metadata(md)
+            return codec.kernel_response_to_pb(out, step_seconds=time.perf_counter() - t0)
+        finally:
+            col.release()
 
 
 def make_server(port: int = 0, max_workers: int = 4) -> tuple[grpc.Server, int]:
@@ -139,6 +277,9 @@ def make_server(port: int = 0, max_workers: int = 4) -> tuple[grpc.Server, int]:
         options=[
             ("grpc.max_receive_message_length", 1 << 30),
             ("grpc.max_send_message_length", 1 << 30),
+            # Span trailing metadata (traced clients) can reach
+            # _SpanCollection.MAX_BYTES; default metadata limits are 8 KB.
+            ("grpc.max_metadata_size", 2 << 20),
         ],
     )
     server.add_generic_rpc_handlers((grpc.method_handlers_generic_handler(SERVICE, handlers),))
@@ -185,6 +326,11 @@ def main(argv: list[str] | None = None) -> int:
         return 2
     log.info("jax platform: %s", platform)
     enable_compilation_cache()
+    # NEMO_TRACE=<file> makes the sidecar write its OWN Perfetto trace at
+    # shutdown; traced clients additionally collect per-RPC spans in-band
+    # either way (obs/trace.py).
+    if obs_trace.configure_from_env() is not None:
+        log.info("obs tracing -> %s", obs.tracer().path)
     if args.profiler_port:
         import jax
 

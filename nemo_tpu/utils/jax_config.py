@@ -159,6 +159,60 @@ def ensure_platform(
     return info["platform"]
 
 
+# --------------------------------------------------------------------------
+# Version-compat shims.  The repo targets the newest jax API surface
+# (jax.shard_map, lax.pcast varying-axes marking, lax.axis_size,
+# jax.distributed.is_initialized); this environment pins jax 0.4.37 where
+# those names live elsewhere or don't exist yet.  Every caller goes through
+# these shims so the compat policy has exactly one home.
+
+
+def shard_map():
+    """The shard_map entry point: ``jax.shard_map`` where it exists (jax
+    >= 0.5), else ``jax.experimental.shard_map.shard_map``."""
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm
+
+
+def pcast_varying(x, axis_name: str):
+    """Mark ``x`` device-varying over ``axis_name`` where shard_map enforces
+    varying-axes typing (``lax.pcast``, jax >= 0.6); earlier versions have
+    no such check and the value passes through untouched."""
+    from jax import lax
+
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, (axis_name,), to="varying")
+    return x
+
+
+def axis_size(axis_name: str):
+    """``lax.axis_size`` (jax >= 0.5), else the classic ``psum(1, axis)``
+    idiom — XLA constant-folds the literal reduction to the axis size."""
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def distributed_is_initialized() -> bool:
+    """``jax.distributed.is_initialized`` (jax >= 0.5); on older versions
+    the runtime's global state records the coordinator address once
+    initialize() has run."""
+    import jax
+
+    if hasattr(jax.distributed, "is_initialized"):
+        return jax.distributed.is_initialized()
+    from jax._src import distributed as _dist
+
+    return getattr(_dist.global_state, "coordinator_address", None) is not None
+
+
 def enable_compilation_cache() -> None:
     """Persist jitted kernels across process invocations (first TPU compile
     is tens of seconds; repeat invocations then load from disk).  Opt out

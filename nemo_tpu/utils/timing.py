@@ -1,15 +1,25 @@
-"""Structured per-phase timing.
+"""Structured per-phase timing, span-backed.
 
 The reference's only observability is printf phase banners
 (e.g. graphing/pre-post-prov.go:249); here every pipeline phase gets a wall
 timer so the benchmark metrics (provenance-graphs/sec, per-phase p50) are
 first-class (SURVEY.md §5 'Tracing / profiling').
+
+Since the obs subsystem landed, PhaseTimer is a thin adapter over span
+tracing: each phase measures ONE interval and feeds the same numbers to
+both the `timings` dict (the long-standing bench/CLI contract — name ->
+accumulated seconds) and, when tracing is enabled, a ``phase:<name>`` span
+in the trace file.  The dict is thereby *derived from* the spans — the two
+can never disagree, which tests/test_obs.py pins (timings == span
+durations exactly).
 """
 
 from __future__ import annotations
 
 import contextlib
 import time
+
+from nemo_tpu.obs import trace as _trace
 
 
 class PhaseTimer:
@@ -18,11 +28,15 @@ class PhaseTimer:
 
     @contextlib.contextmanager
     def phase(self, name: str):
-        start = time.perf_counter()
+        start_ns = time.perf_counter_ns()
         try:
             yield
         finally:
-            self._timings[name] = self._timings.get(name, 0.0) + time.perf_counter() - start
+            dur_ns = time.perf_counter_ns() - start_ns
+            # One measurement, two consumers: the span's microsecond duration
+            # and the dict's float seconds derive from the SAME interval.
+            _trace.add_span(f"phase:{name}", start_ns // 1000, dur_ns // 1000)
+            self._timings[name] = self._timings.get(name, 0.0) + dur_ns / 1e9
 
     def as_dict(self) -> dict[str, float]:
         return dict(self._timings)

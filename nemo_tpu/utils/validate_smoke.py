@@ -1,5 +1,10 @@
 """`make validate` tail: a CLI-shaped smoke on a synthetic corpus with the
-jax backend's report byte-compared against the Python oracle's.
+jax backend's report byte-compared against the Python oracle's, plus the
+observability smoke (`make trace-smoke` / --trace-smoke): a traced
+two-family pipeline run whose emitted Chrome-trace JSON must parse and
+contain the three span categories the obs contract promises — nested
+pipeline-phase spans, a render-worker span from a child process, and RPC
+client+server spans sharing one propagated trace id.
 
 Covers the figure-render pipeline end to end (report/render.py) with an
 all-figures smoke: the production report renders every figure
@@ -22,13 +27,219 @@ import tempfile
 
 
 def _tree(root: str) -> dict[str, bytes]:
+    from nemo_tpu.analysis.pipeline import NONDETERMINISTIC_REPORT_FILES
+
     out: dict[str, bytes] = {}
     for dirpath, _, files in os.walk(root):
         for f in files:
+            if f in NONDETERMINISTIC_REPORT_FILES:
+                continue  # wall-clock telemetry: never byte-comparable
             p = os.path.join(dirpath, f)
             with open(p, "rb") as fh:
                 out[os.path.relpath(p, root)] = fh.read()
     return out
+
+
+def _validate_trace_events(doc: dict) -> list[dict]:
+    """Structural Chrome-trace-event validation; returns the event list."""
+    if not isinstance(doc, dict) or not isinstance(doc.get("traceEvents"), list):
+        raise ValueError("not a Chrome-trace JSON object (no traceEvents array)")
+    events = doc["traceEvents"]
+    if not events:
+        raise ValueError("trace has no events")
+    for ev in events:
+        if ev.get("ph") not in ("X", "M"):
+            raise ValueError(f"unexpected event phase {ev.get('ph')!r}")
+        for k in ("name", "pid", "tid"):
+            if k not in ev:
+                raise ValueError(f"event missing {k!r}: {ev}")
+        if ev["ph"] == "X" and not (
+            isinstance(ev.get("ts"), int) and isinstance(ev.get("dur"), int)
+        ):
+            raise ValueError(f"complete event without int ts/dur: {ev}")
+    return events
+
+
+def trace_smoke() -> int:
+    """Run a tiny traced pipeline over TWO case-study families (overlapped
+    driver, 2-worker render pool) plus one RPC against a sidecar
+    SUBPROCESS, then validate the emitted trace file.
+
+    The RPC leg needs grpcio; like the service tests (importorskip), it is
+    skipped — loudly — where grpc is absent, and the pipeline/worker-span
+    validation still runs."""
+    import importlib.util
+    import subprocess
+    import sys as _sys
+
+    from nemo_tpu.obs import trace as obs_trace
+    from nemo_tpu.utils.jax_config import pin_platform
+
+    have_grpc = importlib.util.find_spec("grpc") is not None
+    pin_platform("cpu")
+    with tempfile.TemporaryDirectory(prefix="nemo_trace_smoke_") as tmp:
+        os.environ["NEMO_SVG_CACHE"] = os.path.join(tmp, "svg_cache")
+        os.environ["NEMO_RENDER_WORKERS"] = "2"
+        trace_path = os.path.join(tmp, "trace.json")
+        t = obs_trace.start_trace(trace_path)
+        tid = t.trace_id
+
+        from nemo_tpu.analysis.pipeline import run_debug_dirs
+        from nemo_tpu.backend.jax_backend import JaxBackend
+        from nemo_tpu.models.case_studies import write_case_study
+
+        dirs = [
+            write_case_study(fam, n_runs=4, seed=7, out_dir=os.path.join(tmp, "corp"))
+            for fam in ("pb_asynchronous", "ZK-1270-racing-sent-flag")
+        ]
+        run_debug_dirs(dirs, os.path.join(tmp, "results"), JaxBackend, figures="failed")
+
+        # RPC spans against a REAL second process: spawn a CPU sidecar and
+        # push one fused step through it (trace context propagates out via
+        # gRPC metadata; the server's spans ride home in trailing metadata).
+        if not have_grpc:
+            print(
+                "trace-smoke: grpcio not installed; skipping the sidecar RPC "
+                "leg (pipeline + worker spans still validated)",
+                file=sys.stderr,
+            )
+            return _check_trace(obs_trace.finish(), tid, expect_rpc=False)
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        sidecar_log = os.path.join(tmp, "sidecar.log")
+        log_fh = open(sidecar_log, "w")
+        proc = subprocess.Popen(
+            [_sys.executable, "-m", "nemo_tpu.service.server",
+             "--port", str(port), "--platform", "cpu"],
+            stdout=log_fh,
+            stderr=subprocess.STDOUT,
+        )
+        try:
+            from nemo_tpu.ingest.molly import load_molly_output
+            from nemo_tpu.models.pipeline_model import pack_molly_for_step
+            from nemo_tpu.service.client import RemoteAnalyzer
+
+            pre, post, static = pack_molly_for_step(load_molly_output(dirs[0]))
+            # Wait for the LISTENING socket before creating the channel:
+            # this environment's grpc wedges a channel whose first connect
+            # raced the server's bind ("FD Shutdown" timeouts survive every
+            # reconnect backoff), so the Health polling alone never recovers.
+            import time as _time
+
+            deadline = _time.monotonic() + 120.0
+            while True:
+                try:
+                    socket.create_connection(("127.0.0.1", port), 2.0).close()
+                    break
+                except OSError:
+                    if _time.monotonic() > deadline or proc.poll() is not None:
+                        raise RuntimeError(
+                            f"sidecar never listened on port {port} "
+                            f"(rc={proc.poll()})"
+                        )
+                    _time.sleep(0.5)
+            try:
+                with RemoteAnalyzer(target=f"127.0.0.1:{port}") as client:
+                    client.wait_ready(deadline=90.0)
+                    client.analyze(pre, post, static)
+                    health = client.health()
+            except Exception:
+                if os.path.exists(sidecar_log):
+                    with open(sidecar_log, "r", encoding="utf-8") as fh:
+                        print(
+                            "trace-smoke: sidecar log tail:\n" + fh.read()[-3000:],
+                            file=sys.stderr,
+                        )
+                raise
+            if "metrics" not in health or "counters" not in health["metrics"]:
+                print(
+                    f"trace-smoke: health() carries no sidecar metrics snapshot: {health}",
+                    file=sys.stderr,
+                )
+                return 1
+            if not health["metrics"]["counters"].get("serve.analyze_chunks"):
+                print(
+                    "trace-smoke: sidecar metrics did not count the Analyze RPC: "
+                    f"{health['metrics']['counters']}",
+                    file=sys.stderr,
+                )
+                return 1
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                # A sidecar wedged in native/jax code can ignore SIGTERM;
+                # the smoke must still report ITS result, not a cleanup
+                # traceback, and must not orphan the process.
+                proc.kill()
+                proc.wait(timeout=15)
+            log_fh.close()
+
+        return _check_trace(obs_trace.finish(), tid, expect_rpc=True)
+
+
+def _check_trace(out: str, tid: str, expect_rpc: bool) -> int:
+    """Validate the emitted trace file's structure and span categories."""
+    with open(out, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    try:
+        events = _validate_trace_events(doc)
+    except ValueError as ex:
+        print(f"trace-smoke: invalid trace: {ex}", file=sys.stderr)
+        return 1
+
+    spans = [e for e in events if e["ph"] == "X"]
+    me = os.getpid()
+
+    def named(prefix):
+        return [e for e in spans if e["name"].startswith(prefix)]
+
+    phases = named("phase:")
+    kernels = named("kernel:")
+    nested = any(
+        p["pid"] == k["pid"] and p["tid"] == k["tid"]
+        and p["ts"] <= k["ts"] and k["ts"] + k["dur"] <= p["ts"] + p["dur"]
+        for k in kernels
+        for p in phases
+    )
+    worker = [e for e in named("render:svg") if e["pid"] != me]
+    rpc = [
+        e for e in named("rpc:")
+        if (e.get("args") or {}).get("trace_id") == tid
+    ]
+    serve = [
+        e for e in named("serve:")
+        if (e.get("args") or {}).get("trace_id") == tid and e["pid"] != me
+    ]
+    problems = []
+    distinct_phases = {e["name"] for e in phases}
+    if len(distinct_phases) < 3:
+        problems.append(
+            f"expected >=3 distinct phase names, got {len(distinct_phases)} "
+            f"across {len(phases)} phase spans"
+        )
+    if not nested:
+        problems.append("no kernel span nested inside a phase span")
+    if not worker:
+        problems.append("no render-worker span from a child process")
+    if expect_rpc and not rpc:
+        problems.append("no client rpc span carrying the trace id")
+    if expect_rpc and not serve:
+        problems.append("no sidecar serve span sharing the propagated trace id")
+    if problems:
+        print("trace-smoke: " + "; ".join(problems), file=sys.stderr)
+        return 1
+    print(
+        f"trace-smoke: ok — {len(spans)} spans across "
+        f"{len({e['pid'] for e in spans})} processes "
+        f"({len(phases)} phase, {len(kernels)} kernel, {len(worker)} "
+        f"worker, {len(rpc)} rpc, {len(serve)} sidecar), trace id {tid}"
+    )
+    return 0
 
 
 def main() -> int:
@@ -109,8 +320,13 @@ def main() -> int:
             f"({len(a)} files, {n_figs} figure files, dedup {fs.get('dedup_ratio')}x, "
             "sequential-parity + cache-warm re-report identical)"
         )
-        return 0
+    # The observability smoke rides the same validate path: a traced
+    # two-family run must produce a loadable Perfetto trace with the three
+    # promised span categories (also standalone: make trace-smoke).
+    return trace_smoke()
 
 
 if __name__ == "__main__":
+    if "--trace-smoke" in sys.argv:
+        sys.exit(trace_smoke())
     sys.exit(main())

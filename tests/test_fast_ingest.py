@@ -24,9 +24,13 @@ pytestmark = pytest.mark.skipif(
 
 
 def _tree_bytes(root: str) -> dict[str, bytes]:
+    from nemo_tpu.analysis.pipeline import NONDETERMINISTIC_REPORT_FILES
+
     out = {}
     for dirpath, _dirnames, filenames in os.walk(root):
         for f in filenames:
+            if f in NONDETERMINISTIC_REPORT_FILES:
+                continue  # wall-clock telemetry: never byte-comparable
             p = os.path.join(dirpath, f)
             with open(p, "rb") as fh:
                 out[os.path.relpath(p, root)] = fh.read()

@@ -1,0 +1,361 @@
+"""Observability subsystem (nemo_tpu/obs): tracer contract, metrics
+registry, disabled-mode overhead, cross-process span collection, and the
+span-derived DebugResult.timings."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from nemo_tpu import obs
+from nemo_tpu.obs import trace as obs_trace
+
+
+@pytest.fixture
+def traced(tmp_path):
+    """Enable tracing into a tmp file for one test; always disabled after,
+    so trace state can never leak into the rest of the suite."""
+    path = str(tmp_path / "trace.json")
+    tracer = obs_trace.start_trace(path)
+    try:
+        yield tracer, path
+    finally:
+        obs_trace.finish()
+
+
+def _events(path: str) -> list[dict]:
+    with open(path, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    assert isinstance(doc["traceEvents"], list)
+    return doc["traceEvents"]
+
+
+# ------------------------------------------------------------------ tracer
+
+
+def test_disabled_span_is_shared_null_context():
+    assert not obs.enabled()
+    a = obs.span("x")
+    b = obs.span("y", attr=1)
+    assert a is b  # the shared null context: no allocation when disabled
+    with a as sp:
+        assert sp is None
+
+
+def test_disabled_mode_overhead_under_3_percent():
+    """The tentpole's overhead guard: instrumenting a hot loop with
+    disabled spans must cost <3% wall against a realistic span-scale work
+    unit (a 64 KiB hash, ~60us — the pipeline's per-figure / per-graph
+    grain).
+
+    Measured DIRECTLY — disabled-span cost per call (span loop minus bare
+    loop) over the work's per-iteration cost — rather than racing two
+    full work loops against each other: on this contended host (the TPU
+    tunnel's service shares one core) loop-vs-loop wall clocks jitter by
+    more than the 3% being asserted, while the two components of this
+    ratio are each min-of-repeats stable.  A real fast-path regression
+    (allocation, locking, string work in span()) inflates the numerator
+    tenfold and fails loudly."""
+    assert not obs.enabled()
+    payload = b"x" * 65536
+    n = 300
+
+    def work() -> None:
+        for _ in range(n):
+            hashlib.sha256(payload).digest()
+
+    def span_loop() -> None:
+        for _ in range(n):
+            with obs.span("hot", step=1):
+                pass
+
+    def bare_loop() -> None:
+        for _ in range(n):
+            pass
+
+    t_work = min(_timed(work) for _ in range(5))
+    t_span = min(_timed(span_loop) for _ in range(9))
+    t_bare = min(_timed(bare_loop) for _ in range(9))
+    per_span_s = max(0.0, t_span - t_bare) / n
+    ratio = per_span_s / (t_work / n)
+    assert ratio <= 0.03, (
+        f"disabled-span overhead {ratio:.2%} "
+        f"({per_span_s * 1e6:.2f} us/span vs {t_work / n * 1e6:.1f} us work unit)"
+    )
+    # Absolute backstop: the null path must stay allocation-light even if
+    # the work unit above ever gets cheaper.
+    assert per_span_s < 2e-6, f"disabled span costs {per_span_s * 1e6:.2f} us"
+
+
+def _timed(fn) -> float:
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
+def test_span_nesting_and_thread_attribution(traced):
+    tracer, path = traced
+    with obs.span("outer", layer="test"):
+        with obs.span("inner"):
+            time.sleep(0.002)
+
+    def other_thread():
+        with obs.span("threaded"):
+            time.sleep(0.001)
+
+    th = threading.Thread(target=other_thread, name="obs-test-worker")
+    th.start()
+    th.join()
+
+    assert obs_trace.finish() == path
+    events = _events(path)
+    spans = {e["name"]: e for e in events if e["ph"] == "X"}
+    outer, inner, threaded = spans["outer"], spans["inner"], spans["threaded"]
+    # Nesting: same thread, inner contained in outer (how Perfetto nests
+    # complete events).
+    assert inner["tid"] == outer["tid"]
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"]
+    assert outer["args"] == {"layer": "test"}
+    # Thread attribution: distinct tid plus thread-name metadata.
+    assert threaded["tid"] != outer["tid"]
+    names = {
+        (e["pid"], e["tid"]): e["args"]["name"]
+        for e in events
+        if e["ph"] == "M" and e["name"] == "thread_name"
+    }
+    assert names.get((threaded["pid"], threaded["tid"])) == "obs-test-worker"
+
+
+def test_trace_file_is_valid_chrome_trace(traced, corpus_dir, tmp_path):
+    """A real pipeline run emits a structurally valid Chrome-trace file
+    with the phase spans nested under no-one and kernel spans inside
+    phases."""
+    from nemo_tpu.analysis.pipeline import run_debug
+    from nemo_tpu.backend.jax_backend import JaxBackend
+    from nemo_tpu.utils.validate_smoke import _validate_trace_events
+
+    tracer, path = traced
+    run_debug(corpus_dir, str(tmp_path / "res"), JaxBackend(), figures="none")
+    assert obs_trace.finish() == path
+    with open(path, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    events = _validate_trace_events(doc)
+    spans = [e for e in events if e["ph"] == "X"]
+    phase_names = {e["name"] for e in spans if e["name"].startswith("phase:")}
+    assert {"phase:ingest", "phase:load_raw_provenance", "phase:report"} <= phase_names
+    kernels = [e for e in spans if e["name"].startswith("kernel:")]
+    assert kernels, "no kernel spans from the jax backend"
+    phases = [e for e in spans if e["name"].startswith("phase:")]
+    assert any(
+        p["tid"] == k["tid"]
+        and p["ts"] <= k["ts"]
+        and k["ts"] + k["dur"] <= p["ts"] + p["dur"]
+        for k in kernels
+        for p in phases
+    ), "kernel spans must nest inside phase spans"
+
+
+def test_cross_process_worker_span_collection(traced, tmp_path):
+    """Render-pool workers (spawn processes) hand their spans back through
+    the job result; the parent trace must contain a child-pid span."""
+    from nemo_tpu.report.dot import DotGraph
+    from nemo_tpu.report.render import RenderScheduler, SvgCache
+
+    def graph(label: str) -> DotGraph:
+        g = DotGraph(name="t")
+        g.add_node("a", {"label": label, "shape": "ellipse"})
+        g.add_node("b", {"label": "rule", "shape": "rect"})
+        g.add_edge("a", "b", {"color": "black"})
+        return g
+
+    tracer, path = traced
+    sched = RenderScheduler(workers=2, cache=SvgCache(root=""))
+    try:
+        sched.submit(graph("goalA"), str(tmp_path / "a.svg"))
+        sched.submit(graph("goalB"), str(tmp_path / "b.svg"))
+        sched.drain()
+    finally:
+        sched.close()
+    assert obs_trace.finish() == path
+    worker_spans = [
+        e
+        for e in _events(path)
+        if e["ph"] == "X" and e["name"] == "render:svg" and e["pid"] != os.getpid()
+    ]
+    assert worker_spans, "no render:svg span adopted from a pool worker"
+    assert all("nodes" in (e.get("args") or {}) for e in worker_spans)
+
+
+def test_timings_derive_from_spans(traced):
+    """DebugResult.timings compatibility: the PhaseTimer dict is DERIVED
+    from the phase spans — same keys, accumulate-on-repeat, and values
+    equal to the span durations (the one measurement feeds both)."""
+    from nemo_tpu.utils.timing import PhaseTimer
+
+    tracer, path = traced
+    t = PhaseTimer()
+    with t.phase("ingest"):
+        time.sleep(0.002)
+    with t.phase("simplify"):
+        time.sleep(0.001)
+    with t.phase("simplify"):  # repeat accumulates, like the pre-span timer
+        time.sleep(0.001)
+    timings = t.as_dict()
+    assert set(timings) == {"ingest", "simplify"}
+    assert obs_trace.finish() == path
+    spans = [e for e in _events(path) if e["ph"] == "X"]
+    by_name: dict[str, list[int]] = {}
+    for e in spans:
+        by_name.setdefault(e["name"], []).append(e["dur"])
+    assert len(by_name["phase:ingest"]) == 1
+    assert len(by_name["phase:simplify"]) == 2
+    for name, secs in timings.items():
+        # Same interval, two encodings: float seconds vs floor-µs span
+        # durations — equal to within 1 µs per span.
+        dur_us = sum(by_name[f"phase:{name}"])
+        assert abs(secs * 1e6 - dur_us) <= len(by_name[f"phase:{name}"]), (
+            name,
+            secs,
+            dur_us,
+        )
+
+
+def test_phase_timer_untraced_still_times():
+    from nemo_tpu.utils.timing import PhaseTimer
+
+    assert not obs.enabled()
+    t = PhaseTimer()
+    with t.phase("p"):
+        time.sleep(0.001)
+    assert 0 < t.as_dict()["p"] < 1
+
+
+def test_export_rebases_foreign_clock_domains(tmp_path):
+    """Spans adopted from a remote machine carry that machine's
+    CLOCK_MONOTONIC; export re-bases any origin domain implausibly far
+    (>1h) from the local clock onto the local time origin, while
+    same-machine adoptions (render workers) stay exactly aligned."""
+    t = obs_trace.Tracer(path=str(tmp_path / "t.json"))
+    t.add_span("local", 10_000_000_000, 500)
+    t.adopt(
+        [{"name": "serve:x", "ts": 1_000, "dur": 200, "pid": 99999, "tid": 1}],
+        process_name="nemo-sidecar",  # remote host, clock near boot
+    )
+    t.adopt(
+        [{"name": "render:svg", "ts": 10_000_000_500, "dur": 100, "pid": 88888, "tid": 1}],
+        process_name="nemo render worker",  # same machine: shared clock
+    )
+    path = t.export()
+    evs = {e["name"]: e for e in _events(path) if e["ph"] == "X"}
+    assert evs["local"]["ts"] == 0
+    assert evs["serve:x"]["ts"] == 0  # foreign domain re-based to local origin
+    assert evs["render:svg"]["ts"] == 500  # same-clock adoption untouched
+    assert evs["serve:x"]["args"]["span_origin"] == "nemo-sidecar"
+
+
+# ----------------------------------------------------------------- metrics
+
+
+def test_metrics_counters_gauges_histograms():
+    m = obs.Metrics()
+    m.inc("a")
+    m.inc("a", 2)
+    m.gauge("g", 7.5)
+    for v in (1.0, 3.0, 2.0):
+        m.observe("h", v)
+    snap = m.snapshot()
+    assert snap["counters"] == {"a": 3}
+    assert snap["gauges"] == {"g": 7.5}
+    h = snap["histograms"]["h"]
+    assert h == {"count": 3, "sum": 6.0, "min": 1.0, "max": 3.0, "mean": 2.0}
+    # Snapshot is JSON-able as-is (the Health RPC ships it verbatim).
+    json.dumps(snap)
+
+
+def test_metrics_delta():
+    m = obs.Metrics()
+    m.inc("c", 5)
+    m.observe("h", 2.0)
+    before = m.snapshot()
+    m.inc("c", 3)
+    m.inc("new")
+    m.observe("h", 4.0)
+    d = obs.Metrics.delta(m.snapshot(), before)
+    assert d["counters"] == {"c": 3, "new": 1}
+    assert d["histograms"]["h"]["count"] == 1
+    assert d["histograms"]["h"]["sum"] == 4.0
+
+
+def test_telemetry_json_written(tmp_path, corpus_dir):
+    """Every report carries telemetry.json: phase walls + figure stats +
+    metrics snapshot (the report frontend's 'Run telemetry' section)."""
+    from nemo_tpu.analysis.pipeline import run_debug
+    from nemo_tpu.backend.python_ref import PythonBackend
+
+    res = run_debug(corpus_dir, str(tmp_path / "res"), PythonBackend(), figures="none")
+    with open(os.path.join(res.report_dir, "telemetry.json"), encoding="utf-8") as fh:
+        doc = json.load(fh)
+    assert set(doc["timings"]) == set(res.timings)
+    for k, v in res.timings.items():
+        assert doc["timings"][k] == pytest.approx(v, abs=1e-6)
+    assert "counters" in doc["metrics"]
+
+
+# ------------------------------------------------------------------- RPC
+
+
+def test_rpc_trace_propagation_and_health_metrics(sidecar, corpus_dir, tmp_path):
+    """Client and sidecar spans share the propagated trace id, and health()
+    surfaces the sidecar's metrics snapshot."""
+    pytest.importorskip("grpc")
+    from nemo_tpu.ingest.molly import load_molly_output
+    from nemo_tpu.models.pipeline_model import pack_molly_for_step
+    from nemo_tpu.service.client import RemoteAnalyzer
+
+    pre, post, static = pack_molly_for_step(load_molly_output(corpus_dir))
+    path = str(tmp_path / "trace.json")
+    obs_trace.start_trace(path)
+    try:
+        tid = obs.trace_id()
+        with RemoteAnalyzer(target=sidecar) as client:
+            client.wait_ready()
+            client.analyze(pre, post, static)
+            health = client.health()
+    finally:
+        assert obs_trace.finish() == path
+    spans = [e for e in _events(path) if e["ph"] == "X"]
+    rpc = [e for e in spans if e["name"] == "rpc:Analyze"]
+    serve = [e for e in spans if e["name"] == "serve:analysis_step"]
+    assert rpc and serve
+    assert rpc[0]["args"]["trace_id"] == tid
+    assert serve[0]["args"]["trace_id"] == tid
+    # The sidecar's metrics snapshot rides the Health response.
+    assert health["metrics"]["counters"]["serve.analyze_chunks"] >= 1
+    assert "serve.step_s" in health["metrics"]["histograms"]
+
+
+def test_rpc_retry_counted_in_metrics():
+    """A dead target burns the retry budget and the registry records it."""
+    pytest.importorskip("grpc")
+    import grpc
+
+    from nemo_tpu.service.client import RemoteAnalyzer
+    from nemo_tpu.service.proto import nemo_service_pb2 as pb
+
+    before = obs.metrics.snapshot()
+    client = RemoteAnalyzer(target="127.0.0.1:1", timeout=2.0, retries=2)
+    try:
+        with pytest.raises(grpc.RpcError):
+            client._call(client._health, pb.HealthRequest(), timeout=1.0, name="Health")
+    finally:
+        client.close()
+    d = obs.Metrics.delta(obs.metrics.snapshot(), before)["counters"]
+    assert d.get("rpc.retries") == 1  # retries - 1 sleeps before the final raise
+    assert d.get("rpc.errors") == 1
+    assert d.get("rpc.backoff_s") == pytest.approx(0.2)

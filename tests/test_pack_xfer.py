@@ -60,10 +60,14 @@ def test_pipeline_byte_parity_packed_vs_not(tmp_path, monkeypatch):
     monkeypatch.setenv("NEMO_PACK_XFER", "1")
     r_on = run_debug(d, str(tmp_path / "on"), JaxBackend(), figures="sample:2")
 
+    from nemo_tpu.analysis.pipeline import NONDETERMINISTIC_REPORT_FILES
+
     def tree(root):
         out = {}
         for dirpath, _dirs, files in os.walk(root):
             for f in files:
+                if f in NONDETERMINISTIC_REPORT_FILES:
+                    continue  # wall-clock telemetry: never byte-comparable
                 p = os.path.join(dirpath, f)
                 out[os.path.relpath(p, root)] = open(p, "rb").read()
         return out

@@ -24,9 +24,13 @@ from nemo_tpu.report.writer import Reporter
 
 
 def _tree(root: str) -> dict[str, bytes]:
+    from nemo_tpu.analysis.pipeline import NONDETERMINISTIC_REPORT_FILES
+
     out = {}
     for dirpath, _, files in os.walk(root):
         for f in files:
+            if f in NONDETERMINISTIC_REPORT_FILES:
+                continue  # wall-clock telemetry: never byte-comparable
             p = os.path.join(dirpath, f)
             with open(p, "rb") as fh:
                 out[os.path.relpath(p, root)] = fh.read()

@@ -26,6 +26,12 @@ def main() -> int:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    # Cross-process collectives on the CPU backend need the gloo
+    # implementation (jax >= 0.5); without it this worker fails with
+    # "Multiprocess computations aren't implemented on the CPU backend"
+    # (the invoking test skips itself on such versions).
+    if hasattr(jax.config, "jax_cpu_collectives_implementation"):
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
     import numpy as np
 
