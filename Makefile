@@ -5,7 +5,7 @@ NATIVE_LIB := native/build/libnemo_native.so
 REPORT_SRC := native/nemo_report.cpp
 REPORT_LIB := native/build/libnemo_report.so
 
-.PHONY: all native test bench prewarm validate trace-smoke clean reset proto neo4j-up neo4j-validate neo4j-down
+.PHONY: all native test bench bench-watch prewarm validate trace-smoke clean reset proto neo4j-up neo4j-validate neo4j-down
 
 all: native
 
@@ -39,6 +39,13 @@ trace-smoke:
 
 bench:
 	python bench.py
+
+# Standing device-capture watcher (tools/bench_watch.py): probe the tunnel
+# device periodically; on the first healthy window run the full bench tier
+# set (+ the gated 10x stress row) and save the raw logs + result JSON
+# under bench_watch/<stamp>/.  Run under nohup/tmux and walk away.
+bench-watch:
+	python tools/bench_watch.py --with-10x
 
 # Compile the stress-floor bucket programs into the persistent jax cache so
 # a first stress run loads from disk instead of compiling (utils/prewarm.py).

@@ -253,8 +253,17 @@ def run_debug_dirs(
     land in the later report's directory).  save_corpus_path is rejected
     for the same shared-kwargs reason: every corpus would overwrite the
     same .npz bundle (ADVICE r5).
+
+    On an effectively 1-core host the prefetch thread is skipped even with
+    prefetch=True (utils.effective_cpu_count): a producer thread cannot
+    overlap with the consumer on one core, so the GIL handoffs are pure
+    overhead — ingest runs inline, exactly the sequential loop.
     """
     import threading
+
+    from nemo_tpu.utils import effective_cpu_count
+
+    prefetch = prefetch and effective_cpu_count() > 1
 
     if kwargs.get("save_corpus_path"):
         raise ValueError(

@@ -459,9 +459,14 @@ def _giant_impl_default() -> str:
     size-threshold term because every giant run is past NEMO_GIANT_V by
     definition.  NEMO_GIANT_IMPL={auto,host,device} overrides (device on
     CPU keeps the dense path testable; host on TPU serves a tunnel-less
-    degraded mode)."""
+    degraded mode); with NEMO_GIANT_IMPL unset, an explicit
+    NEMO_ANALYSIS_IMPL umbrella (sparse -> host, dense -> device) covers
+    the giant verb too, so one knob forces a whole route."""
     impl = _giant_impl_env()
     if impl == "auto":
+        umbrella = _analysis_impl_env()
+        if umbrella != "auto":
+            return "host" if umbrella == "sparse" else "device"
         return "host" if jax.default_backend() == "cpu" else "device"
     return impl
 
@@ -522,6 +527,45 @@ def _giant_impl_env() -> str:
             f"NEMO_GIANT_IMPL={impl!r} (expected auto, host, or device)"
         )
     return impl
+
+
+def _analysis_impl_env() -> str:
+    """Parse + validate NEMO_ANALYSIS_IMPL (shared by the in-process and
+    service backends so the accepted spellings can never diverge): the
+    single knob selecting the batched analysis route — "dense" (the fused
+    XLA dispatch), "sparse" (the batched CSR host engine,
+    ops/sparse_host.py), or "auto" (resolved by the process that owns the
+    device; see _resolve_analysis_impl / the ServiceBackend override).
+    Loud on junk for the same reason NEMO_GIANT_IMPL is: a typo silently
+    falling back to auto would change which algorithm analyzes the corpus
+    in exactly the dimension the operator was trying to pin."""
+    impl = os.environ.get("NEMO_ANALYSIS_IMPL", "auto").strip().lower()
+    if impl not in ("auto", "dense", "sparse"):
+        raise ValueError(
+            f"NEMO_ANALYSIS_IMPL={impl!r} (expected auto, dense, or sparse)"
+        )
+    return impl
+
+
+def _analysis_host_work_budget() -> int:
+    """Per-bucket crossover for the batched analysis route under
+    NEMO_ANALYSIS_IMPL=auto on a DEVICE backend: buckets whose
+    B x (V + E) work is at or below this run on the sparse CSR host engine
+    instead of paying a device dispatch; larger buckets keep the fused
+    dense dispatch the TPU eats flat out.  (On a CPU backend the platform
+    is the whole signal — every bucket routes sparse; see
+    _resolve_analysis_impl.)
+
+    The default follows the measured diff-crossover economics one budget
+    up (_diff_host_work_budget): a tunnel device dispatch pays ~70 ms RTT
+    plus per-signature compiles, while the sparse engine's full verb set
+    costs ~1 us per work unit at the stress shapes (BENCH: 6-family 1x
+    sweep, sparse tier) — so ~10^5 work units is where one dispatch's
+    fixed cost still dominates.  The fused dispatch carries ~8x more
+    device work per unit than the diff verb but also ~8x more host sweeps,
+    so the same order of magnitude holds; NEMO_ANALYSIS_HOST_WORK
+    overrides for directly-attached devices (no RTT tax: lower it)."""
+    return int(os.environ.get("NEMO_ANALYSIS_HOST_WORK", "100000"))
 
 
 def _diff_host_work_budget() -> int:
@@ -705,9 +749,16 @@ class JaxBackend(GraphBackend):
         self._giant_impl = None
         self._narrow_xfer: bool | None = None
         self._diff_host_work = _diff_host_work_budget()
+        # Batched-analysis route knobs; resolved in init_graph_db ("auto"
+        # reads jax.default_backend(), unsafe before the watchdog).
+        self._analysis_impl: str | None = None
+        self._analysis_host_work = _analysis_host_work_budget()
         #: impl the last _fused giant dispatch actually took (None = no
         #: giant runs in the corpus) — surfaced in the bench giant row.
         self.giant_impl_used = None
+        #: per-dispatch route records (verb/route/rows/v/e/work/reason) for
+        #: the last corpus — the bench JSON and route tests read these.
+        self.analysis_routes: list[dict] = []
         # Packed-first ingest state (native corpus arrays; else None/empty).
         self._corpus = None
         self._corpus_graphs: CorpusGraphs | None = None
@@ -727,6 +778,57 @@ class JaxBackend(GraphBackend):
         client's platform is the wrong signal."""
         return _giant_impl_default()
 
+    def _resolve_analysis_impl(self) -> str:
+        """Batched-analysis route (ISSUE 3 tentpole), resolved by the
+        process that OWNS the device: an explicit NEMO_ANALYSIS_IMPL wins;
+        "auto" on a CPU backend routes EVERY dense bucket to the sparse
+        CSR host engine (measured: the dense XLA:CPU kernels run the wrong
+        algorithm for the platform — the giant-row precedent showed the
+        sparse host analysis ~34x faster than the sequential oracle where
+        the dense path was 5-6x slower, and the 10x stress put 127 of
+        162 s in the dense CPU kernels); "auto" on a device backend stays
+        per-bucket: the measured-crossover work budget decides in
+        _analysis_route.  ServiceBackend overrides — its device lives in
+        the sidecar (the narrowing/giant precedents)."""
+        impl = _analysis_impl_env()
+        if impl == "auto" and jax.default_backend() == "cpu":
+            return "sparse"
+        return impl
+
+    def _analysis_route(self, rows: int, v: int, e: int) -> tuple[str, str, int]:
+        """Per-bucket route decision: (route, reason, work).  `work` is the
+        sparse engine's cost model B x (V + E) — the crossover input the
+        route records expose (analysis.route spans, bench JSON)."""
+        work = rows * (v + e)
+        impl = self._analysis_impl
+        if impl in ("sparse", "dense"):
+            return impl, "forced" if _analysis_impl_env() != "auto" else "platform", work
+        # auto on a device backend: sparse only below the measured budget
+        # (a device dispatch's fixed RTT/compile cost dominates tiny
+        # buckets; the big padded batches belong on the accelerator).
+        if work <= self._analysis_host_work:
+            return "sparse", "crossover", work
+        return "dense", "crossover", work
+
+    def _record_route(
+        self, verb: str, route: str, rows: int, v: int, e: int, work: int, reason: str
+    ) -> dict:
+        """One analysis.route record: a metrics counter per (verb, route),
+        an entry in self.analysis_routes, and the attr dict the caller
+        wraps the routed execution's span with."""
+        obs.metrics.inc(f"analysis.route.{verb}.{route}")
+        rec = {
+            "verb": verb,
+            "route": route,
+            "rows": int(rows),
+            "v": int(v),
+            "e": int(e),
+            "work": int(work),
+            "reason": reason,
+        }
+        self.analysis_routes.append(rec)
+        return rec
+
     def _resolve_narrow_xfer(self) -> bool:
         """Upload-dtype narrowing gate: in-process, the local platform
         decides (narrow when the bytes cross a real device transfer);
@@ -742,6 +844,9 @@ class JaxBackend(GraphBackend):
         # build_figures can never disagree within one corpus.
         self._giant_v = _giant_threshold()
         self._giant_impl = self._resolve_giant_impl()
+        self._analysis_impl = self._resolve_analysis_impl()
+        self._analysis_host_work = _analysis_host_work_budget()
+        self.analysis_routes = []
         self._narrow_xfer = self._resolve_narrow_xfer()
         self._max_batch = (
             self.max_batch if self.max_batch is not None else self._resolve_max_batch()
@@ -980,21 +1085,27 @@ class JaxBackend(GraphBackend):
                 num_labels=8,  # unused without the diff tail
                 with_diff=0,
             )
-            if self._corpus is not None:
-                batches = bucketize_pairs_corpus(
-                    self._corpus_graphs,
-                    rows,
-                    self._corpus.iteration,
-                    self._max_batch,
-                    min_v=min_v,
-                    min_e=min_e,
-                )
-            else:
-                pre = [self.packed[(i, "pre")] for i in run_ids]
-                post = [self.packed[(i, "post")] for i in run_ids]
-                batches = bucketize_pairs(
-                    run_ids, pre, post, self._max_batch, min_v=min_v, min_e=min_e
-                )
+            # The pack span splits load_raw_provenance's wall into bucket
+            # construction vs routed analysis (the ISSUE 3 profiling ask):
+            # at 1x the phase was 5-7 s of the 9.2 s e2e wall, and the
+            # span shows the analysis dispatch — not this packing — is the
+            # dominant term, which is what the sparse route removes.
+            with obs.span("analysis:pack", runs=n_dense):
+                if self._corpus is not None:
+                    batches = bucketize_pairs_corpus(
+                        self._corpus_graphs,
+                        rows,
+                        self._corpus.iteration,
+                        self._max_batch,
+                        min_v=min_v,
+                        min_e=min_e,
+                    )
+                else:
+                    pre = [self.packed[(i, "pre")] for i in run_ids]
+                    post = [self.packed[(i, "post")] for i in run_ids]
+                    batches = bucketize_pairs(
+                        run_ids, pre, post, self._max_batch, min_v=min_v, min_e=min_e
+                    )
             from nemo_tpu.ops.simplify import pair_chains_linear
 
             out = []
@@ -1010,22 +1121,53 @@ class JaxBackend(GraphBackend):
                     linear = all(self._lin_by_iter[i] for i in pre_b.run_ids)
                 else:
                     linear = pair_chains_linear(pre_b, post_b)
-                res = self.executor.run(
-                    "fused",
-                    _narrow_fused_arrays(
-                        _verb_arrays(pre_b, post_b),
-                        v=pre_b.v,
-                        num_tables=params_common["num_tables"],
-                        with_diff=False,
-                        narrow=self._narrow_xfer,
-                    ),
-                    dict(
-                        v=pre_b.v,
-                        max_depth=bucket_size(max(pre_b.max_depth, post_b.max_depth), min_d),
-                        comp_linear=int(linear),
-                        **params_common,
-                    ),
+                # Batched-analysis crossover (ISSUE 3 tentpole): per joint
+                # bucket, the SAME analyses run either as the fused dense
+                # device dispatch or as O(B*(V+E)) CSR scatters on the host
+                # (ops/sparse_host.py) — the giant/diff crossover pattern
+                # generalized to every dense bucket.  Decided per bucket,
+                # recorded as analysis.route metrics + a span wrapping the
+                # routed execution (the bench JSON surfaces both).
+                n_rows = len(pre_b.run_ids)
+                route, reason, work = self._analysis_route(
+                    n_rows, pre_b.v, pre_b.e
                 )
+                rec = self._record_route(
+                    "fused", route, n_rows, pre_b.v, pre_b.e, work, reason
+                )
+                if route == "sparse":
+                    from nemo_tpu.ops.sparse_host import sparse_analysis_step
+
+                    with obs.span("analysis:route", **rec):
+                        with obs.span("kernel:fused", impl="sparse_host", rows=n_rows):
+                            res = sparse_analysis_step(
+                                pre_b,
+                                post_b,
+                                v=pre_b.v,
+                                pre_tid=params_common["pre_tid"],
+                                post_tid=params_common["post_tid"],
+                                num_tables=params_common["num_tables"],
+                                comp_linear=linear,
+                            )
+                    out.append((pre_b, post_b, res))
+                    continue
+                with obs.span("analysis:route", **rec):
+                    res = self.executor.run(
+                        "fused",
+                        _narrow_fused_arrays(
+                            _verb_arrays(pre_b, post_b),
+                            v=pre_b.v,
+                            num_tables=params_common["num_tables"],
+                            with_diff=False,
+                            narrow=self._narrow_xfer,
+                        ),
+                        dict(
+                            v=pre_b.v,
+                            max_depth=bucket_size(max(pre_b.max_depth, post_b.max_depth), min_d),
+                            comp_linear=int(linear),
+                            **params_common,
+                        ),
+                    )
                 out.append((pre_b, post_b, res))
             if giant_ids:
                 from nemo_tpu.parallel.giant import giant_plan, pad_comp_labels
@@ -1059,38 +1201,52 @@ class JaxBackend(GraphBackend):
                     lin_post, depth_post, lab_post = giant_plan(gpost)
                     pre_labels = pad_comp_labels(lab_pre, gpre.n_nodes, v_g)
                     post_labels = pad_comp_labels(lab_post, gpost.n_nodes, v_g)
+                    # Route record for the giant verb: "host" is the sparse
+                    # side of this crossover, "device" the dense one — one
+                    # uniform sparse/dense vocabulary across all verbs.
+                    rec = self._record_route(
+                        "giant",
+                        "sparse" if self._giant_impl == "host" else "dense",
+                        1,
+                        v_g,
+                        e_g,
+                        v_g + e_g,
+                        "giant_impl",
+                    )
                     if self._giant_impl == "host":
                         from nemo_tpu.parallel.giant import giant_analysis_host
 
-                        res = giant_analysis_host(
-                            pre_b,
-                            post_b,
-                            pre_tid=params_common["pre_tid"],
-                            post_tid=params_common["post_tid"],
-                            num_tables=params_common["num_tables"],
-                            pre_labels=pre_labels,
-                            post_labels=post_labels,
-                        )
+                        with obs.span("analysis:route", **rec):
+                            res = giant_analysis_host(
+                                pre_b,
+                                post_b,
+                                pre_tid=params_common["pre_tid"],
+                                post_tid=params_common["post_tid"],
+                                num_tables=params_common["num_tables"],
+                                pre_labels=pre_labels,
+                                post_labels=post_labels,
+                            )
                         out.append((pre_b, post_b, res))
                         continue
                     arrays = _verb_arrays(pre_b, post_b)
                     arrays["pre_comp_labels"] = pre_labels
                     arrays["post_comp_labels"] = post_labels
-                    res = self.executor.run(
-                        "giant",
-                        arrays,
-                        dict(
-                            v=v_g,
-                            pre_tid=params_common["pre_tid"],
-                            post_tid=params_common["post_tid"],
-                            num_tables=params_common["num_tables"],
-                            max_depth=bucket_size(
-                                max(pre_b.max_depth, post_b.max_depth), 4
+                    with obs.span("analysis:route", **rec):
+                        res = self.executor.run(
+                            "giant",
+                            arrays,
+                            dict(
+                                v=v_g,
+                                pre_tid=params_common["pre_tid"],
+                                post_tid=params_common["post_tid"],
+                                num_tables=params_common["num_tables"],
+                                max_depth=bucket_size(
+                                    max(pre_b.max_depth, post_b.max_depth), 4
+                                ),
+                                comp_linear=int(lin_pre and lin_post),
+                                proto_depth=bucket_size(max(depth_pre, depth_post), 8),
                             ),
-                            comp_linear=int(lin_pre and lin_post),
-                            proto_depth=bucket_size(max(depth_pre, depth_post), 8),
-                        ),
-                    )
+                        )
                     out.append((pre_b, post_b, res))
             self._fused_out = out
         return self._fused_out
@@ -1236,8 +1392,33 @@ class JaxBackend(GraphBackend):
         # host path (dense V^3 closure prohibitive); small jobs TAKE it
         # because it wins — below the measured work crossover a single
         # tunnel dispatch costs more than the whole exact host computation.
+        # An EXPLICIT NEMO_ANALYSIS_IMPL forces the verb both ways (the
+        # parity suites drive both sides through one knob).  On auto, a
+        # backend whose route resolved to sparse (the CPU fallback) sends
+        # diff host-side regardless of size — the dense diff dispatch on
+        # XLA:CPU is the same wrong-algorithm case as the fused buckets;
+        # otherwise the measured NEMO_DIFF_HOST_WORK crossover decides —
+        # diff's own device-vs-host economics.
         host_work = len(failed_iters) * (good.n_nodes + len(good.edges))
-        use_host = good.n_nodes > self._giant_v or host_work <= self._diff_host_work
+        umbrella = _analysis_impl_env()
+        if good.n_nodes > self._giant_v:
+            use_host, route_reason = True, "giant"
+        elif umbrella != "auto":
+            use_host, route_reason = umbrella == "sparse", "forced"
+        elif self._analysis_impl == "sparse":
+            use_host, route_reason = True, "platform"
+        else:
+            use_host = host_work <= self._diff_host_work
+            route_reason = "crossover"
+        rec = self._record_route(
+            "diff",
+            "sparse" if use_host else "dense",
+            len(failed_iters),
+            good.n_nodes,
+            len(good.edges),
+            host_work,
+            route_reason,
+        )
         sparse_edges = None
         if failed_iters and use_host:
             # Sparse host diff: O(F * (V + E)) on the packed edge list and
@@ -1253,24 +1434,26 @@ class JaxBackend(GraphBackend):
             # Only the real failed-run rows: the padding rows exist for the
             # dense path's compile sharing, which the host path doesn't
             # have — an all-false row would cost a full-graph diff each.
-            node_keep, edge_keep, frontier_rule, missing_goal = diff_masks_host(
-                good.edges, gb.v, padded_goal, padded_label, bits[: len(failed_iters)]
-            )
+            with obs.span("analysis:route", **rec):
+                node_keep, edge_keep, frontier_rule, missing_goal = diff_masks_host(
+                    good.edges, gb.v, padded_goal, padded_label, bits[: len(failed_iters)]
+                )
             sparse_edges = good.edges
         elif failed_iters:
-            out = self.executor.run(
-                "diff",
-                {
-                    "edge_src": gb.edge_src,
-                    "edge_dst": gb.edge_dst,
-                    "edge_mask": gb.edge_mask,
-                    "is_goal": gb.is_goal[0],
-                    "node_mask": gb.node_mask[0],
-                    "label_id": gb.label_id[0],
-                    "fail_bits": bits,
-                },
-                {"v": gb.v, "max_depth": bucket_size(gb.max_depth, 4)},
-            )
+            with obs.span("analysis:route", **rec):
+                out = self.executor.run(
+                    "diff",
+                    {
+                        "edge_src": gb.edge_src,
+                        "edge_dst": gb.edge_dst,
+                        "edge_mask": gb.edge_mask,
+                        "is_goal": gb.is_goal[0],
+                        "node_mask": gb.node_mask[0],
+                        "label_id": gb.label_id[0],
+                        "fail_bits": bits,
+                    },
+                    {"v": gb.v, "max_depth": bucket_size(gb.max_depth, 4)},
+                )
             node_keep, edge_keep, frontier_rule, missing_goal = (
                 out["node_keep"],
                 out["edge_keep"],

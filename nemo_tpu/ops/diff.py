@@ -83,88 +83,92 @@ def diff_masks_host(
     label_id,  # [V] int
     fail_bits,  # [B,L] bool
 ):
-    """Sparse host-side diff_masks for ONE giant good run.
+    """Sparse host-side diff_masks for the good run vs B failed runs.
 
     Semantics identical to diff_masks, but O(B * (V + E)) on the packed
     edge list instead of dense [V,V] device arrays: a 10k-node good graph's
     dense closure is V^3-prohibitive, while its real edge count is ~V (the
     giant-graph path, backend/jax_backend.py NEMO_GIANT_V dispatch).
 
+    Implementation rides the batched sparse-CSR engine's shared prep
+    (ops/sparse_host.py, ISSUE 3): the good graph's edge list is offset
+    into one flat [B*V] node space (edges never cross run copies) so ALL
+    failed runs batch through each CSR frontier push and one vectorized
+    Kahn longest-path wave — no per-run Python adjacency lists or BFS
+    stacks (the pre-r6 shape, measured ~5x slower at the stress failed-run
+    counts).
+
     Returns (node_keep [B,V], edge_keep_mask [B,E] — a mask over `edges`
     rather than a dense [V,V] — frontier_rule [B,V], missing_goal [B,V]).
     """
     import numpy as np
 
+    from nemo_tpu.ops.sparse_host import _expand, bfs_any, build_csr
+
     v = n_nodes
     e = len(edges)
-    src = edges[:, 0] if e else np.zeros(0, dtype=np.int64)
-    dst = edges[:, 1] if e else np.zeros(0, dtype=np.int64)
     b = fail_bits.shape[0]
+    n = b * v
     num_labels = fail_bits.shape[-1]
+    label_id = np.asarray(label_id)
+    is_goal = np.asarray(is_goal, dtype=bool)
     lid = np.clip(label_id, 0, num_labels - 1)
 
-    out_adj: list[list[int]] = [[] for _ in range(v)]
-    in_adj: list[list[int]] = [[] for _ in range(v)]
-    for s, d in zip(src.tolist(), dst.tolist()):
-        out_adj[s].append(d)
-        in_adj[d].append(s)
+    # Per-run ok-goal masks, then everything batches in the flat space.
+    in_failed = np.asarray(fail_bits, dtype=bool)[:, lid] & (label_id >= 0)[None, :]
+    okf = (is_goal[None, :] & ~in_failed).ravel()
+    goal_f = np.tile(is_goal, b)
 
-    def reach(start_mask, adj):
-        seen = start_mask.copy()
-        stack = list(np.nonzero(start_mask)[0])
-        while stack:
-            u = stack.pop()
-            for w in adj[u]:
-                if not seen[w]:
-                    seen[w] = True
-                    stack.append(w)
-        return seen
+    if e:
+        src = np.asarray(edges[:, 0], dtype=np.int64)
+        dst = np.asarray(edges[:, 1], dtype=np.int64)
+        base = np.repeat(np.arange(b, dtype=np.int64) * v, e)
+        fsrc = base + np.tile(src, b)
+        fdst = base + np.tile(dst, b)
+    else:
+        fsrc = fdst = np.zeros(0, dtype=np.int64)
+    fwd = build_csr(fsrc, fdst, n)
+    bwd = build_csr(fdst, fsrc, n)
 
-    node_keep = np.zeros((b, v), dtype=bool)
-    edge_keep = np.zeros((b, e), dtype=bool)
-    frontier_rule = np.zeros((b, v), dtype=bool)
-    missing_goal = np.zeros((b, v), dtype=bool)
-    for j in range(b):
-        in_failed = fail_bits[j][lid] & (label_id >= 0)
-        ok = is_goal & ~in_failed
-        fwd = reach(ok, out_adj)  # >=0 hops from an ok goal
-        bwd = reach(ok, in_adj)  # >=0 hops to an ok goal
-        keep = fwd & bwd
-        node_keep[j] = keep
-        ek = keep[src] & keep[dst] if e else edge_keep[j]
-        edge_keep[j] = ek
+    # >=0-hop reach from / to an ok goal (start | >=1-hop push).
+    keepf = (okf | bfs_any(*fwd, okf)) & (okf | bfs_any(*bwd, okf))
+    ekf = keepf[fsrc] & keepf[fdst]
+    ks, kd = fsrc[ekf], fdst[ekf]
 
-        indeg = np.zeros(v, dtype=np.int64)
-        outdeg = np.zeros(v, dtype=np.int64)
-        np.add.at(indeg, dst[ek], 1)
-        np.add.at(outdeg, src[ek], 1)
-        root = is_goal & keep & (indeg == 0)
-        leaf = is_goal & keep & (outdeg == 0)
+    indeg = np.bincount(kd, minlength=n)
+    outdeg = np.bincount(ks, minlength=n)
+    root = goal_f & keepf & (indeg == 0)
+    leaf = goal_f & keepf & (outdeg == 0)
 
-        # Longest path from roots by topological relaxation over kept edges.
-        dist = np.where(root, 0, NEG_INF)
-        kout: list[list[int]] = [[] for _ in range(v)]
-        for s, d in zip(src[ek].tolist(), dst[ek].tolist()):
-            kout[s].append(d)
-        deg = indeg.copy()
-        stack = [u for u in range(v) if keep[u] and deg[u] == 0]
-        while stack:
-            u = stack.pop()
-            du = dist[u]
-            for w in kout[u]:
-                if du + 1 > dist[w]:
-                    dist[w] = du + 1
-                deg[w] -= 1
-                if deg[w] == 0:
-                    stack.append(w)
+    # Longest path from roots: vectorized Kahn waves over the kept edges.
+    # A node enters the frontier only when its kept in-degree hits zero, so
+    # its dist is final when its out-edges relax — the exact topological
+    # relaxation the per-run loop performed.
+    kptr, knbr = build_csr(ks, kd, n)
+    dist = np.where(root, 0, NEG_INF)
+    deg = indeg.copy()
+    frontier = np.nonzero(keepf & (deg == 0))[0]
+    while frontier.size:
+        targets, cnt = _expand(kptr, knbr, frontier, return_counts=True)
+        if not targets.size:
+            break
+        np.maximum.at(dist, targets, np.repeat(dist[frontier], cnt) + 1)
+        np.subtract.at(deg, targets, 1)
+        uniq = np.unique(targets)
+        frontier = uniq[deg[uniq] == 0]
 
-        leaf_dist = np.where(leaf & (dist >= 1), dist, NEG_INF)
-        max_len = leaf_dist.max() if v else NEG_INF
-        deepest_leaf = leaf & (dist == max_len)
-        to_deepest = np.zeros(v, dtype=bool)
-        np.logical_or.at(to_deepest, src[ek], deepest_leaf[dst[ek]])
-        frontier_rule[j] = ~is_goal & keep & (dist + 1 == max_len) & to_deepest
-        from_frontier = np.zeros(v, dtype=bool)
-        np.logical_or.at(from_frontier, dst[ek], frontier_rule[j][src[ek]])
-        missing_goal[j] = is_goal & keep & from_frontier
-    return node_keep, edge_keep, frontier_rule, missing_goal
+    leaf_dist = np.where(leaf & (dist >= 1), dist, NEG_INF).reshape(b, v)
+    max_len = leaf_dist.max(axis=1) if v else np.full(b, NEG_INF)
+    deepest_leaf = (leaf.reshape(b, v) & (dist.reshape(b, v) == max_len[:, None])).ravel()
+    to_deepest = np.bincount(ks[deepest_leaf[kd]], minlength=n) > 0
+    frontier_rule = (
+        ~goal_f & keepf & (dist + 1 == np.repeat(max_len, v)) & to_deepest
+    )
+    from_frontier = np.bincount(kd[frontier_rule[ks]], minlength=n) > 0
+    missing_goal = goal_f & keepf & from_frontier
+    return (
+        keepf.reshape(b, v),
+        ekf.reshape(b, e),
+        frontier_rule.reshape(b, v),
+        missing_goal.reshape(b, v),
+    )

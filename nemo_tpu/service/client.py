@@ -317,32 +317,90 @@ class RemoteExecutor:
 def _stream_pipelined(
     target: str,
     n_chunks: int,
-    producer_body,
+    chunk_iter,
     timings: dict[str, float],
     queue_depth: int = 2,
     ready_deadline: float = 30.0,
+    threaded: bool = True,
 ) -> list[dict[str, np.ndarray]]:
     """Producer/consumer core of the pipelined analysis paths.
 
-    `producer_body(emit)` runs in a daemon thread and calls
-    `emit((i, pre, post, static))` per chunk; emit blocks with backpressure
-    (bounded queue) and returns False once the consumer has aborted — the
-    producer must then stop.  The bidi AnalyzeStream RPC consumes from the
+    `chunk_iter` yields (i, pre, post, static) packed on demand.  With
+    threaded=True a daemon producer thread consumes it into a bounded
     queue, so chunk k+1 packs on the host WHILE chunk k executes on the
-    sidecar's device.
+    sidecar's device; queue_depth bounds host memory (backpressure).
+    threaded=False (the callers' 1-core gate, ISSUE 3 satellite) skips the
+    thread entirely: the gRPC request generator pulls each chunk lazily
+    from the iterator, so packing serializes with the stream — on one
+    effective core the thread cannot overlap anyway, and the GIL handoffs
+    and queue traffic are pure overhead — while the bounded-memory
+    contract still holds (at most one packed chunk in flight).
 
     Failure contract (ADVICE r2): if the stream dies mid-flight, the abort
     event is set and the queue drained so the producer can never block
     forever in a full queue (leaking the thread and packed batches), and a
-    producer exception is re-raised chained (not swallowed into a generic
-    RpcError).
+    producer/packing exception is re-raised chained (not swallowed into a
+    generic RpcError) on either path.
     """
     import queue
     import threading
 
+    prod_exc: list[BaseException] = []
+    results: list[dict[str, np.ndarray] | None] = [None] * n_chunks
+
+    def _request_of(item):
+        i, pre, post, static = item
+        req = pb.AnalyzeRequest(
+            pre=codec.batch_arrays_to_pb(pre),
+            post=codec.batch_arrays_to_pb(post),
+            chunk=i,
+        )
+        req.static.CopyFrom(codec.static_to_pb(static))
+        return req
+
+    def _finish() -> list[dict[str, np.ndarray]]:
+        if prod_exc:
+            # The stream itself completed, but the producer still failed
+            # (e.g. after its last emitted chunk was consumed).  Don't drop
+            # it: a clean-looking result from a failed producer is a
+            # silent-corruption hazard (ADVICE r3 #2).
+            raise SidecarError(
+                f"producer failed after streaming completed: {prod_exc[0]!r}"
+            ) from prod_exc[0]
+        missing = [i for i, o in enumerate(results) if o is None]
+        if missing:
+            raise SidecarError(f"missing responses for chunks {missing}")
+        return results  # type: ignore[return-value]
+
+    if not threaded:
+
+        def requests_inline():
+            try:
+                for item in chunk_iter:
+                    yield _request_of(item)
+            except BaseException as ex:  # surfaced after the stream ends
+                prod_exc.append(ex)
+                return
+
+        try:
+            with RemoteAnalyzer(target=target) as client:
+                client.wait_ready(ready_deadline)
+                t0 = time.perf_counter()
+                _drive_stream(
+                    client._analyze_stream, requests_inline(), client.timeout,
+                    target, results,
+                )
+                timings["stream_s"] = time.perf_counter() - t0
+        except BaseException as ex:
+            if prod_exc:
+                raise SidecarError(
+                    f"producer failed while streaming: {prod_exc[0]!r}"
+                ) from prod_exc[0]
+            raise ex
+        return _finish()
+
     q: queue.Queue = queue.Queue(maxsize=max(1, queue_depth))
     abort = threading.Event()
-    prod_exc: list[BaseException] = []
     _END = object()
 
     def emit(item) -> bool:
@@ -356,7 +414,9 @@ def _stream_pipelined(
 
     def producer() -> None:
         try:
-            producer_body(emit)
+            for item in chunk_iter:
+                if not emit(item):
+                    return
         except BaseException as ex:  # surface in the consumer
             prod_exc.append(ex)
             emit(ex)
@@ -373,16 +433,8 @@ def _stream_pipelined(
                 return
             if isinstance(item, BaseException):
                 raise item
-            i, pre, post, static = item
-            req = pb.AnalyzeRequest(
-                pre=codec.batch_arrays_to_pb(pre),
-                post=codec.batch_arrays_to_pb(post),
-                chunk=i,
-            )
-            req.static.CopyFrom(codec.static_to_pb(static))
-            yield req
+            yield _request_of(item)
 
-    results: list[dict[str, np.ndarray] | None] = [None] * n_chunks
     try:
         with RemoteAnalyzer(target=target) as client:
             client.wait_ready(ready_deadline)
@@ -421,18 +473,7 @@ def _stream_pipelined(
             "producer thread still running after streaming completed "
             "(join timed out); result discarded as unverifiable"
         )
-    if prod_exc:
-        # The stream itself completed, but the producer still failed (e.g.
-        # after its last emitted chunk was consumed).  Don't drop it: a
-        # clean-looking result from a failed producer is a silent-corruption
-        # hazard (ADVICE r3 #2).
-        raise SidecarError(
-            f"producer failed after streaming completed: {prod_exc[0]!r}"
-        ) from prod_exc[0]
-    missing = [i for i, o in enumerate(results) if o is None]
-    if missing:
-        raise SidecarError(f"missing responses for chunks {missing}")
-    return results  # type: ignore[return-value]
+    return _finish()
 
 
 def analyze_dirs(
@@ -445,14 +486,19 @@ def analyze_dirs(
     and feeds a bounded queue; the bidi AnalyzeStream RPC consumes from the
     queue, so directory k+1 is parsing/packing on the host WHILE directory
     k executes on the sidecar's device.  queue_depth bounds host memory
-    (backpressure).  Returns (per-directory outputs, timing dict with
-    pack_s, stream_s, wall_s — overlap win = pack_s + stream_s - wall_s
-    when positive).
+    (backpressure).  On a 1-core host the producer thread is skipped
+    (pack inline, then stream — utils.effective_cpu_count) and the timing
+    dict says so.  Returns (per-directory outputs, timing dict with
+    pack_s, stream_s, wall_s, overlap — overlap win = pack_s + stream_s -
+    wall_s when overlap is True and the win is positive).
     """
-    t_wall0 = time.perf_counter()
-    timings = {"pack_s": 0.0, "stream_s": 0.0, "wall_s": 0.0}
+    from nemo_tpu.utils import effective_cpu_count
 
-    def body(emit) -> None:
+    t_wall0 = time.perf_counter()
+    overlap = effective_cpu_count() > 1
+    timings = {"pack_s": 0.0, "stream_s": 0.0, "wall_s": 0.0, "overlap": overlap}
+
+    def chunks():
         from nemo_tpu.ingest.native import pack_molly_dir
 
         for i, d in enumerate(molly_dirs):
@@ -460,10 +506,11 @@ def analyze_dirs(
             with obs.span("pack:dir", ordinal=i):
                 pre, post, static = pack_molly_dir(d)
             timings["pack_s"] += time.perf_counter() - t0
-            if not emit((i, pre, post, static)):
-                return
+            yield (i, pre, post, static)
 
-    results = _stream_pipelined(target, len(molly_dirs), body, timings, queue_depth)
+    results = _stream_pipelined(
+        target, len(molly_dirs), chunks(), timings, queue_depth, threaded=overlap
+    )
     timings["wall_s"] = time.perf_counter() - t_wall0
     return results, timings
 
@@ -646,8 +693,10 @@ def analyze_dir_pipelined(
     buckets; _merge_chunk_outputs pads and recombines them into the exact
     unchunked result.
 
-    Returns (merged outputs, timings with pack_s / stream_s / wall_s —
-    overlap win = pack_s + stream_s - wall_s when positive)."""
+    Returns (merged outputs, timings with pack_s / stream_s / wall_s /
+    overlap — overlap win = pack_s + stream_s - wall_s when overlap is
+    True and the win is positive; overlap=False means the 1-core gate
+    packed inline and no win should be derived)."""
     import json
     import os
 
@@ -657,8 +706,15 @@ def analyze_dir_pipelined(
     from nemo_tpu.ingest.native import native_available
     from nemo_tpu.models.pipeline_model import graphs_to_step
 
+    from nemo_tpu.utils import effective_cpu_count
+
     t_wall0 = time.perf_counter()
-    timings = {"pack_s": 0.0, "stream_s": 0.0, "wall_s": 0.0}
+    # 1-core gate (ISSUE 3 satellite): with no second core the producer
+    # thread cannot overlap the stream — pack inline, stream after, and
+    # record overlap=False so the bench row reports the machinery as
+    # disabled instead of shipping a negative overlap win.
+    overlap = effective_cpu_count() > 1
+    timings = {"pack_s": 0.0, "stream_s": 0.0, "wall_s": 0.0, "overlap": overlap}
 
     with open(os.path.join(molly_dir, "runs.json"), "r", encoding="utf-8") as f:
         raw_runs = json.load(f)
@@ -686,7 +742,7 @@ def analyze_dir_pipelined(
             )
         timings["pack_s"] += time.perf_counter() - t0
 
-        def body(emit) -> None:
+        def chunks():
             for ci, (s, e) in enumerate(spans):
                 t0 = time.perf_counter()
                 with obs.span("pack:chunk", chunk=ci):
@@ -697,14 +753,13 @@ def analyze_dir_pipelined(
                         static,
                     )
                 timings["pack_s"] += time.perf_counter() - t0
-                if not emit(chunk):
-                    return
+                yield chunk
 
     else:
         vocab = CorpusVocab()
         good: dict = {}  # filled by chunk 0: {"rid", "pre", "post"}
 
-        def body(emit) -> None:
+        def chunks():
             for ci, (s, e) in enumerate(spans):
                 t0 = time.perf_counter()
                 rids, pres, posts = [], [], []
@@ -728,10 +783,11 @@ def analyze_dir_pipelined(
                     posts.append(good["post"])
                 pre_b, post_b, static = graphs_to_step(rids, pres, posts, vocab)
                 timings["pack_s"] += time.perf_counter() - t0
-                if not emit((ci, pre_b, post_b, static)):
-                    return
+                yield (ci, pre_b, post_b, static)
 
-    results = _stream_pipelined(target, len(spans), body, timings, queue_depth)
+    results = _stream_pipelined(
+        target, len(spans), chunks(), timings, queue_depth, threaded=overlap
+    )
     merged = _merge_chunk_outputs(spans, results, pad_to=pad_to)
     timings["wall_s"] = time.perf_counter() - t_wall0
     return merged, timings

@@ -1,0 +1,24 @@
+"""Shared utility helpers."""
+
+from __future__ import annotations
+
+import os
+
+
+def effective_cpu_count() -> int:
+    """Cores THIS process may actually run on: the scheduling affinity set
+    when the platform exposes it (containers/cgroups pin processes to a
+    subset of os.cpu_count()), else os.cpu_count().
+
+    This is the overlap-machinery gate (ISSUE 3 satellite): on a 1-core
+    host a producer/prefetch thread cannot overlap with the consumer — the
+    GIL handoffs and queue traffic are pure overhead, and benched
+    "overlap" rows came out negative (BENCH_r05 single_dir_overlap:
+    overlap_win_s -0.03 on the 1-core bench host) — so run_debug_dirs and
+    the pipelined sidecar clients skip the producer thread entirely below
+    2 cores (and say so, instead of shipping a negative win).
+    """
+    try:
+        return len(os.sched_getaffinity(0)) or 1
+    except (AttributeError, OSError):  # non-Linux platforms
+        return os.cpu_count() or 1

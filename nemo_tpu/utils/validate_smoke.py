@@ -6,6 +6,12 @@ contain the three span categories the obs contract promises — nested
 pipeline-phase spans, a render-worker span from a child process, and RPC
 client+server spans sharing one propagated trace id.
 
+Also the analysis-route gate (ISSUE 3): a forced NEMO_ANALYSIS_IMPL=sparse
+pipeline must byte-reproduce the forced-dense report end to end, and each
+routed run must record an analysis.route metric for every verb the smoke
+dispatches (fused + diff) — the CI assertion that the crossover's routes
+both exist and agree.
+
 Covers the figure-render pipeline end to end (report/render.py) with an
 all-figures smoke: the production report renders every figure
 (figures="all") through the deduplicated / cached / parallel scheduler and
@@ -313,12 +319,73 @@ def main() -> int:
             print("validate: jax report DIVERGES from the oracle", file=sys.stderr)
             return 1
 
+        # 4. Analysis-route crossover (ISSUE 3): each forced route must
+        # record an analysis.route decision for EVERY verb this smoke
+        # dispatches (fused + diff — the corpus has failed runs), and the
+        # two routes' full report trees must be byte-identical: the sparse
+        # CSR host engine is a drop-in for the dense dispatch end to end.
+        from nemo_tpu import obs
+
+        route_trees: dict[str, dict[str, bytes]] = {}
+        prior_impl = os.environ.get("NEMO_ANALYSIS_IMPL")
+        for impl in ("sparse", "dense"):
+            os.environ["NEMO_ANALYSIS_IMPL"] = impl
+            try:
+                m0 = obs.metrics.snapshot()
+                r = run_debug(
+                    corpus, os.path.join(tmp, f"route_{impl}"), JaxBackend(),
+                    figures="all",
+                )
+                mc = obs.Metrics.delta(obs.metrics.snapshot(), m0)["counters"]
+            finally:
+                # Restore the operator's own pin (if any) — this step must
+                # not change how the rest of the process routes.
+                if prior_impl is None:
+                    del os.environ["NEMO_ANALYSIS_IMPL"]
+                else:
+                    os.environ["NEMO_ANALYSIS_IMPL"] = prior_impl
+            missing = [
+                verb
+                for verb in ("fused", "diff")
+                if not mc.get(f"analysis.route.{verb}.{impl}")
+            ]
+            if missing:
+                print(
+                    f"validate: NEMO_ANALYSIS_IMPL={impl} run recorded no "
+                    f"analysis.route for verb(s) {missing}: "
+                    f"{ {k: v for k, v in mc.items() if k.startswith('analysis.route')} }",
+                    file=sys.stderr,
+                )
+                return 1
+            route_trees[impl] = _tree(r.report_dir)
+        if route_trees["sparse"].keys() != route_trees["dense"].keys():
+            print(
+                "validate: sparse/dense route report file sets DIVERGE: "
+                f"{sorted(route_trees['sparse'].keys() ^ route_trees['dense'].keys())[:10]}",
+                file=sys.stderr,
+            )
+            return 1
+        bad3 = sorted(
+            k
+            for k in route_trees["sparse"]
+            if route_trees["sparse"][k] != route_trees["dense"][k]
+        )
+        if bad3:
+            print(
+                "validate: sparse-routed report DIVERGES from the dense route "
+                f"in {len(bad3)} file(s), e.g. {bad3[:5]}",
+                file=sys.stderr,
+            )
+            return 1
+
         n_figs = len([f for f in a if f.startswith("figures")])
         fs = jx.figure_stats or {}
         print(
             "validate: ok — oracle-identical report "
             f"({len(a)} files, {n_figs} figure files, dedup {fs.get('dedup_ratio')}x, "
-            "sequential-parity + cache-warm re-report identical)"
+            "sequential-parity + cache-warm re-report identical, "
+            "sparse/dense analysis routes byte-identical with every verb's "
+            "route recorded)"
         )
     # The observability smoke rides the same validate path: a traced
     # two-family run must produce a loadable Perfetto trace with the three

@@ -9,6 +9,15 @@ import pytest
 from nemo_tpu.backend.jax_backend import JaxBackend
 
 
+@pytest.fixture(autouse=True)
+def _dense_route(monkeypatch):
+    """This module pins DEVICE program signatures, so the analysis must
+    actually dispatch: on the CPU suite the auto route sends every bucket
+    to the sparse host engine (ISSUE 3), which never compiles a program —
+    force the dense route the signatures describe."""
+    monkeypatch.setenv("NEMO_ANALYSIS_IMPL", "dense")
+
+
 class SpyExecutor:
     """Records EVERY dispatch's full compile signature, returning shaped
     stub outputs so the backend walks all buckets (an abort-on-first spy

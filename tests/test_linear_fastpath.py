@@ -10,7 +10,7 @@ from nemo_tpu.graphs.packed import CorpusVocab, pack_batch, pack_graph
 from nemo_tpu.ops.simplify import chains_linear_host
 
 
-def _outputs(corpus_dir, force_linear: bool):
+def _outputs(corpus_dir, force_linear: bool, impl: str = "auto"):
     import json
     import os
     import tempfile
@@ -20,7 +20,8 @@ def _outputs(corpus_dir, force_linear: bool):
     from nemo_tpu.backend.jax_backend import JaxBackend
 
     out_dir = tempfile.mkdtemp()
-    with mock.patch(
+    env = mock.patch.dict(os.environ, {"NEMO_ANALYSIS_IMPL": impl})
+    with env, mock.patch(
         "nemo_tpu.ops.simplify.chains_linear_host", return_value=force_linear
     ):
         res = run_debug(corpus_dir, out_dir, JaxBackend(), figures="all", ingest="python")
@@ -34,11 +35,14 @@ def _outputs(corpus_dir, force_linear: bool):
     return report, figs
 
 
-def test_doubling_matches_closure_end_to_end(tmp_path):
+@pytest.mark.parametrize("impl", ["dense", "sparse"])
+def test_doubling_matches_closure_end_to_end(tmp_path, impl):
     """Same corpus through comp_linear=1 (doubling) and comp_linear=0
     (closure): every output byte identical.  The corpus's chains really are
     linear (asserted), so forcing the flag matches what the auto check
-    would decide."""
+    would decide.  Parametrized over the analysis route (ISSUE 3): the
+    dense device step's doubling-vs-closure labels AND the sparse host
+    engine's doubling-vs-min-relaxation labels both collapse identically."""
     from nemo_tpu.ingest.molly import load_molly_output
     from nemo_tpu.models.case_studies import write_case_study
 
@@ -50,8 +54,8 @@ def test_doubling_matches_closure_end_to_end(tmp_path):
     assert chains_linear_host(
         b.is_goal, b.node_mask, b.type_id, b.edge_src, b.edge_dst, b.edge_mask
     )
-    lin = _outputs(d, force_linear=True)
-    clo = _outputs(d, force_linear=False)
+    lin = _outputs(d, force_linear=True, impl=impl)
+    clo = _outputs(d, force_linear=False, impl=impl)
     assert lin == clo
 
 

@@ -55,6 +55,10 @@ def test_pipeline_byte_parity_packed_vs_not(tmp_path, monkeypatch):
     from nemo_tpu.models.synth import SynthSpec, write_corpus
 
     d = write_corpus(SynthSpec(n_runs=8, seed=13), str(tmp_path))
+    # Transfer packing only exists on the DEVICE dispatch; keep the e2e
+    # coverage by pinning the dense route (the CPU suite's auto route
+    # would send every bucket to the sparse host engine, ISSUE 3).
+    monkeypatch.setenv("NEMO_ANALYSIS_IMPL", "dense")
     monkeypatch.setenv("NEMO_PACK_XFER", "0")
     r_off = run_debug(d, str(tmp_path / "off"), JaxBackend(), figures="sample:2")
     monkeypatch.setenv("NEMO_PACK_XFER", "1")

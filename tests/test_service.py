@@ -227,12 +227,12 @@ def test_producer_failure_after_stream_completes_raises(monkeypatch):
 
     monkeypatch.setattr(client_mod, "RemoteAnalyzer", FakeAnalyzer)
 
-    def body(emit):
-        emit((0, None, None, {}))
+    def chunks():
+        yield (0, None, None, {})
         raise RuntimeError("late producer failure")
 
     with pytest.raises(SidecarError, match="after streaming completed") as ei:
-        _stream_pipelined("ignored:0", 1, body, {}, queue_depth=2)
+        _stream_pipelined("ignored:0", 1, chunks(), {}, queue_depth=2)
     assert isinstance(ei.value.__cause__, RuntimeError)
 
 
@@ -245,22 +245,28 @@ def test_stream_abort_unblocks_producer():
     from nemo_tpu.service.client import SidecarError, _stream_pipelined
 
     started = threading.Event()
-    stopped = threading.Event()
 
-    def body(emit):
+    def chunks():  # endless: the producer can only stop via the abort
         started.set()
         i = 0
-        while emit((i, None, None, {})):  # queue_depth=1: blocks immediately
+        while True:
+            yield (i, None, None, {})
             i += 1
-        stopped.set()
 
     timings = {"stream_s": 0.0}
     with pytest.raises(SidecarError):
         # Unreachable target: wait_ready fails while the producer is
         # already blocked on the bounded queue.
-        _stream_pipelined("127.0.0.1:1", 4, body, timings, queue_depth=1, ready_deadline=1.0)
+        _stream_pipelined(
+            "127.0.0.1:1", 4, chunks(), timings, queue_depth=1, ready_deadline=1.0
+        )
     assert started.wait(1.0)
-    assert stopped.wait(5.0), "producer still blocked after stream failure"
+    deadline = _time.monotonic() + 5.0
+    while any(
+        t.name == "nemo-pack" and t.is_alive() for t in threading.enumerate()
+    ):
+        assert _time.monotonic() < deadline, "producer still blocked after stream failure"
+        _time.sleep(0.05)
 
 
 def test_uniform_spans_degenerate_sizes():

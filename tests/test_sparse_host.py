@@ -1,0 +1,381 @@
+"""Batched sparse-CSR host analysis tier (ISSUE 3): the sparse engine must
+reproduce the dense fused step bit-for-bit on every output plane, across
+every case-study family and the generative stress shapes (deep chains,
+non-linear zigzag members, all-failed corpora) — and the backend's
+crossover routing must be forceable both ways with byte-identical reports
+against the Python oracle, with every routed verb recorded."""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from nemo_tpu.ingest.molly import load_molly_output
+from nemo_tpu.models.case_studies import CASE_STUDIES, write_case_study
+from nemo_tpu.models.pipeline_model import analysis_step, pack_molly_for_step
+from nemo_tpu.models.synth import SynthSpec, write_corpus
+from nemo_tpu.ops.sparse_host import sparse_analysis_step
+
+
+def _assert_step_parity(pre, post, static, label):
+    dense = analysis_step(pre, post, with_diff=False, **static)
+    sparse = sparse_analysis_step(pre, post, **static)
+    assert sorted(dense) == sorted(sparse), label
+    for k in sorted(dense):
+        np.testing.assert_array_equal(
+            np.asarray(dense[k]), np.asarray(sparse[k]), err_msg=f"{label}: {k}"
+        )
+
+
+# ------------------------------------------------------- per-verb parity
+
+
+@pytest.mark.parametrize("name", sorted(CASE_STUDIES))
+def test_sparse_matches_dense_case_studies(name, tmp_path):
+    """Every output key of the fused step, every case-study family."""
+    d = write_case_study(name, n_runs=8, seed=11, out_dir=str(tmp_path))
+    pre, post, static = pack_molly_for_step(load_molly_output(d))
+    _assert_step_parity(pre, post, static, name)
+
+
+@pytest.mark.parametrize(
+    "spec",
+    [
+        SynthSpec(n_runs=8, seed=2, eot=6),  # all four run kinds
+        SynthSpec(n_runs=3, seed=5, eot=60, name="deep"),  # deep chains
+        SynthSpec(n_runs=6, seed=7, fail_all_fraction=0.9, name="failall"),
+        SynthSpec(n_runs=5, seed=4, first_run_kind="fail", name="badfirst"),
+    ],
+    ids=lambda s: s.name + f"_s{s.seed}",
+)
+def test_sparse_matches_dense_synth(spec, tmp_path):
+    """Generative stress models: the sparse engine tracks the dense step
+    through every corpus shape the synth generator produces."""
+    d = write_corpus(spec, str(tmp_path))
+    pre, post, static = pack_molly_for_step(load_molly_output(d))
+    _assert_step_parity(pre, post, static, spec.name)
+
+
+def test_sparse_matches_dense_zigzag(tmp_path):
+    """Non-linear member structure (comp_linear=False): the fix-point
+    min-label relaxation must agree with the dense all-pairs closure
+    labels — the structure where bounded propagation historically broke."""
+    from tests.test_giant_nonlinear import _zigzag_prov
+
+    d = tmp_path / "zigzag"
+    d.mkdir()
+    with open(d / "runs.json", "w") as f:
+        json.dump([{"iteration": 0, "status": "success"}], f)
+    for cond in ("pre", "post"):
+        with open(d / f"run_0_{cond}_provenance.json", "w") as f:
+            json.dump(_zigzag_prov(cond), f)
+    pre, post, static = pack_molly_for_step(load_molly_output(str(d)))
+    assert not static["comp_linear"], "zigzag must reject the linear fast path"
+    _assert_step_parity(pre, post, static, "zigzag")
+
+
+def test_sparse_rejects_with_diff():
+    """The engine has no differential tail — asking for one must fail
+    loudly, not silently drop the diff keys."""
+    with pytest.raises(ValueError, match="with_diff"):
+        sparse_analysis_step(
+            None, None, v=16, pre_tid=0, post_tid=1, num_tables=8, with_diff=True
+        )
+
+
+# -------------------------------------------------- routing + e2e parity
+
+
+def _report(res):
+    with open(os.path.join(res.report_dir, "debugging.json")) as f:
+        return json.load(f)
+
+
+@pytest.fixture(scope="module")
+def route_corpus(tmp_path_factory):
+    return write_corpus(
+        SynthSpec(n_runs=8, seed=2, eot=6), str(tmp_path_factory.mktemp("route"))
+    )
+
+
+@pytest.fixture(scope="module")
+def oracle_report(route_corpus, tmp_path_factory):
+    from nemo_tpu.analysis.pipeline import run_debug
+    from nemo_tpu.backend.python_ref import PythonBackend
+
+    res = run_debug(
+        route_corpus,
+        str(tmp_path_factory.mktemp("py")),
+        PythonBackend(),
+        figures="none",
+    )
+    return _report(res)
+
+
+@pytest.mark.parametrize("impl", ["sparse", "dense"])
+def test_forced_routes_match_oracle(impl, route_corpus, oracle_report, tmp_path, monkeypatch):
+    """Both sides of the crossover, forced through the single
+    NEMO_ANALYSIS_IMPL knob (fused AND diff verbs), produce the oracle's
+    byte-identical report — and the backend records what ran."""
+    from nemo_tpu.analysis.pipeline import run_debug
+    from nemo_tpu.backend.jax_backend import JaxBackend
+
+    monkeypatch.setenv("NEMO_ANALYSIS_IMPL", impl)
+    be = JaxBackend()
+    res = run_debug(route_corpus, str(tmp_path / impl), be, figures="none")
+    assert _report(res) == oracle_report
+    routed = {(r["verb"], r["route"]) for r in be.analysis_routes}
+    assert routed == {("fused", impl), ("diff", impl)}
+    assert all(r["reason"] == "forced" for r in be.analysis_routes)
+
+
+def test_auto_on_cpu_routes_sparse(route_corpus, oracle_report, tmp_path, monkeypatch):
+    """The whole CPU fallback rides the sparse engine on auto (the suite
+    pins jax to CPU): every fused bucket routes sparse with the platform
+    reason, the report equals the oracle, and the analysis.route metrics
+    record every verb."""
+    from nemo_tpu import obs
+    from nemo_tpu.analysis.pipeline import run_debug
+    from nemo_tpu.backend.jax_backend import JaxBackend
+
+    monkeypatch.delenv("NEMO_ANALYSIS_IMPL", raising=False)
+    m0 = obs.metrics.snapshot()
+    be = JaxBackend()
+    res = run_debug(route_corpus, str(tmp_path / "auto"), be, figures="none")
+    assert _report(res) == oracle_report
+    fused = [r for r in be.analysis_routes if r["verb"] == "fused"]
+    assert fused and all(r["route"] == "sparse" for r in fused)
+    assert all(r["reason"] == "platform" for r in fused)
+    # The diff verb follows the platform resolution on auto too: a
+    # sparse-resolved (CPU) backend never dispatches the dense diff.
+    diff = [r for r in be.analysis_routes if r["verb"] == "diff"]
+    assert diff and diff[0]["route"] == "sparse" and diff[0]["reason"] == "platform"
+    mc = obs.Metrics.delta(obs.metrics.snapshot(), m0)["counters"]
+    assert mc.get("analysis.route.fused.sparse", 0) >= len(fused)
+    assert mc.get("analysis.route.diff.sparse", 0) >= 1
+
+
+def test_crossover_work_budget_decides_on_device(monkeypatch):
+    """The per-bucket decision under auto on a DEVICE backend: at or below
+    NEMO_ANALYSIS_HOST_WORK the bucket routes sparse, above it dense —
+    unit-tested against the routing function directly (the suite has no
+    real device to resolve auto against)."""
+    from nemo_tpu.backend.jax_backend import JaxBackend
+
+    be = JaxBackend()
+    be._analysis_impl = "auto"  # what a device backend resolves auto to
+    be._analysis_host_work = 1000
+    be.analysis_routes = []
+    assert be._analysis_route(10, 50, 50)[0] == "sparse"  # work 1000 <= 1000
+    assert be._analysis_route(11, 50, 50)[0] == "dense"  # work 1100 > 1000
+    route, reason, work = be._analysis_route(4, 16, 16)
+    assert (route, reason, work) == ("sparse", "crossover", 128)
+
+
+def test_analysis_impl_env_validation(monkeypatch):
+    from nemo_tpu.backend.jax_backend import _analysis_impl_env
+
+    for v in ("auto", "dense", "sparse", " SPARSE "):
+        monkeypatch.setenv("NEMO_ANALYSIS_IMPL", v)
+        assert _analysis_impl_env() == v.strip().lower()
+    monkeypatch.setenv("NEMO_ANALYSIS_IMPL", "fast")
+    with pytest.raises(ValueError, match="NEMO_ANALYSIS_IMPL"):
+        _analysis_impl_env()
+
+
+def test_umbrella_forces_giant_route(monkeypatch):
+    """NEMO_ANALYSIS_IMPL covers the giant verb too when NEMO_GIANT_IMPL
+    is unset, and an explicit NEMO_GIANT_IMPL still wins."""
+    from nemo_tpu.backend.jax_backend import _giant_impl_default
+
+    monkeypatch.delenv("NEMO_GIANT_IMPL", raising=False)
+    monkeypatch.setenv("NEMO_ANALYSIS_IMPL", "dense")
+    assert _giant_impl_default() == "device"
+    monkeypatch.setenv("NEMO_ANALYSIS_IMPL", "sparse")
+    assert _giant_impl_default() == "host"
+    monkeypatch.setenv("NEMO_GIANT_IMPL", "device")
+    assert _giant_impl_default() == "device"  # specific knob wins
+
+
+def test_service_backend_resolution(monkeypatch):
+    """RemoteExecutor clients keep the Kernel RPC on auto (the sidecar
+    owns the device); the explicit umbrella still routes client-side."""
+    from nemo_tpu.backend.service_backend import ServiceBackend
+
+    be = ServiceBackend()
+    monkeypatch.delenv("NEMO_ANALYSIS_IMPL", raising=False)
+    assert be._resolve_analysis_impl() == "dense"
+    monkeypatch.setenv("NEMO_ANALYSIS_IMPL", "sparse")
+    assert be._resolve_analysis_impl() == "sparse"
+    monkeypatch.delenv("NEMO_GIANT_IMPL", raising=False)
+    assert be._resolve_giant_impl() == "host"  # umbrella covers giant too
+
+
+# ------------------------------------------------- oracle per-verb parity
+
+
+def test_sparse_backend_per_verb_oracle_parity(tmp_path, monkeypatch):
+    """The sparse-routed JaxBackend against the Python oracle, verb by
+    verb (the test_jax_parity battery under NEMO_ANALYSIS_IMPL=sparse):
+    condition holds, simplified graphs, prototypes, diff missing events."""
+    from nemo_tpu.backend.jax_backend import JaxBackend
+    from nemo_tpu.backend.python_ref import CLEAN_OFFSET, PythonBackend
+
+    monkeypatch.setenv("NEMO_ANALYSIS_IMPL", "sparse")
+    d = write_case_study(
+        "ZK-1270-racing-sent-flag", n_runs=6, seed=9, out_dir=str(tmp_path)
+    )
+    molly = load_molly_output(d)
+    oracle, jaxed = PythonBackend(), JaxBackend()
+    for b in (oracle, jaxed):
+        b.init_graph_db("", molly)
+        b.load_raw_provenance()
+        b.simplify_prov(molly.runs_iters)
+    for run in molly.runs:
+        for cond in ("pre", "post"):
+            o = oracle.graphs[(run.iteration, cond)]
+            j = jaxed.raw[(run.iteration, cond)]
+            assert {n.id: n.cond_holds for n in o.goals()} == {
+                n.id: n.cond_holds for n in j.goals()
+            }, (run.iteration, cond, "condition")
+            oc = oracle.graphs[(CLEAN_OFFSET + run.iteration, cond)]
+            jc = jaxed.clean[(CLEAN_OFFSET + run.iteration, cond)]
+            o_sig = (
+                {(n.id, n.is_goal, n.label, n.table, n.type) for n in oc.nodes.values()},
+                set(oc.edge_order),
+            )
+            j_sig = (
+                {(n.id, n.is_goal, n.label, n.table, n.type) for n in jc.nodes.values()},
+                set(jc.edge_order),
+            )
+            assert o_sig == j_sig, (run.iteration, cond, "simplify")
+    s, f = molly.success_runs_iters, molly.failed_runs_iters
+    assert oracle.create_prototypes(s, f) == jaxed.create_prototypes(s, f)
+    _, post_dots, _, _ = oracle.pull_pre_post_prov()
+    o_missing = oracle.create_naive_diff_prov(False, f, post_dots[0])[2]
+    j_missing = jaxed.create_naive_diff_prov(False, f, post_dots[0])[2]
+    for om, jm in zip(o_missing, j_missing):
+        assert [m.to_json() for m in om] == [m.to_json() for m in jm]
+    for b in (oracle, jaxed):
+        b.close_db()
+
+
+# ------------------------------------------------------ 1-core overlap gate
+
+
+def _spy_thread_targets(monkeypatch) -> list[str]:
+    """Record the target-function name of every thread started while the
+    patch is active."""
+    import threading
+
+    started: list[str] = []
+    orig_start = threading.Thread.start
+
+    def spy_start(self):
+        target = getattr(self, "_target", None)
+        started.append(getattr(target, "__name__", self.name or ""))
+        return orig_start(self)
+
+    monkeypatch.setattr(threading.Thread, "start", spy_start)
+    return started
+
+
+def test_run_debug_dirs_skips_prefetch_on_one_core(tmp_path, monkeypatch):
+    """The overlap machinery gates on effective core count (ISSUE 3
+    satellite): on a 1-core host run_debug_dirs must not start its ingest
+    prefetch thread — ingest runs inline, results unchanged — while a
+    multi-core host keeps the overlap."""
+    import nemo_tpu.analysis.pipeline as pipeline
+    from nemo_tpu.backend.jax_backend import JaxBackend
+
+    dirs = [
+        write_corpus(SynthSpec(n_runs=3, seed=s, name=f"ov{s}"), str(tmp_path))
+        for s in (1, 2)
+    ]
+    monkeypatch.setattr("nemo_tpu.utils.effective_cpu_count", lambda: 1)
+    started = _spy_thread_targets(monkeypatch)
+    res1 = pipeline.run_debug_dirs(
+        dirs, str(tmp_path / "res1"), JaxBackend, figures="none"
+    )
+    assert len(res1) == 2
+    assert "prefetch_next" not in started, started
+
+    monkeypatch.setattr("nemo_tpu.utils.effective_cpu_count", lambda: 8)
+    started2 = _spy_thread_targets(monkeypatch)
+    res2 = pipeline.run_debug_dirs(
+        dirs, str(tmp_path / "res2"), JaxBackend, figures="none"
+    )
+    assert "prefetch_next" in started2, started2
+    for a, b in zip(res1, res2):
+        with open(os.path.join(a.report_dir, "debugging.json")) as fa, open(
+            os.path.join(b.report_dir, "debugging.json")
+        ) as fb:
+            assert json.load(fa) == json.load(fb)
+
+
+def test_stream_pipelined_inline_on_one_core(monkeypatch):
+    """_stream_pipelined(threaded=False) — the 1-core gate's core — must
+    run the producer inline (no nemo-pack thread) and deliver the same
+    chunk traffic to the stream."""
+    pytest.importorskip("grpc")
+    from nemo_tpu.models.pipeline_model import BatchArrays
+    from nemo_tpu.service import client as sc
+
+    def tiny():
+        z = np.zeros((1, 4), dtype=np.int32)
+        zb = np.zeros((1, 4), dtype=bool)
+        return BatchArrays(
+            edge_src=z, edge_dst=z, edge_mask=zb, is_goal=zb,
+            table_id=z, label_id=z, type_id=z, node_mask=zb,
+        )
+
+    class FakeClient:
+        timeout = 5.0
+
+        def __init__(self, *a, **k):
+            pass
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+        def wait_ready(self, deadline=30.0):
+            return {}
+
+        _analyze_stream = None
+
+    events: list = []
+
+    def fake_drive(method, requests, timeout, target, results):
+        for i, _req in enumerate(requests):
+            events.append(f"send{i}")
+            results[i] = {"ok": np.ones(1)}
+
+    monkeypatch.setattr(sc, "RemoteAnalyzer", FakeClient)
+    monkeypatch.setattr(sc, "_drive_stream", fake_drive)
+
+    def chunks():
+        for i in range(3):
+            events.append(f"pack{i}")
+            yield (i, tiny(), tiny(), {"v": 4})
+
+    for threaded, expect_thread in ((False, False), (True, True)):
+        events.clear()
+        started = _spy_thread_targets(monkeypatch)
+        timings = {"pack_s": 0.0, "stream_s": 0.0, "wall_s": 0.0}
+        out = sc._stream_pipelined("t", 3, chunks(), timings, threaded=threaded)
+        assert len(out) == 3 and events.count("send2") == 1
+        assert ("producer" in started) == expect_thread, (threaded, started)
+        if not threaded:
+            # Lazy pull: each chunk packs right before its send — at most
+            # ONE packed chunk in flight (the bounded-memory contract the
+            # 1-core gate must keep).
+            assert events == [
+                "pack0", "send0", "pack1", "send1", "pack2", "send2"
+            ], events

@@ -1,0 +1,140 @@
+"""Standing device-capture tooling: `make bench-watch` (ISSUE 3 satellite).
+
+The TPU here rides a tunnel whose outages make jax.devices() HANG, so
+device bench captures keep getting deferred to "whenever the tunnel is
+healthy" — and then missed (the round-5 VERDICT's capture debt).  This
+watcher turns that into a fire-and-forget job:
+
+  1. every --interval seconds, probe the device platform out-of-process
+     under a hard watchdog timeout (utils/jax_config.py:
+     probe_default_platform — the same probe bench.py's parent uses);
+  2. on the FIRST healthy window (a non-CPU platform answered), run the
+     full bench tier set (`python bench.py`, which itself re-probes and
+     falls back loudly if the window closes mid-run) plus — when
+     requested — the gated 10x stress row, saving the raw logs:
+
+       <out-dir>/probe_log.txt   every probe attempt with timestamps
+       <out-dir>/bench.stderr    the bench's full progress stream
+       <out-dir>/BENCH.json      the single result line bench.py prints
+
+  3. exit 0 on a captured result, 3 if --max-wait expired with no healthy
+     window (the probe log records what the tunnel did the whole time).
+
+Run it under nohup/tmux before walking away:
+
+    nohup make bench-watch &        # or:
+    python tools/bench_watch.py --interval 300 --max-wait 86400 --with-10x
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def _stamp() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--interval", type=float, default=300.0,
+                    help="seconds between device probes (default 300)")
+    ap.add_argument("--probe-timeout", type=float, default=60.0,
+                    help="watchdog seconds per probe attempt (default 60)")
+    ap.add_argument("--max-wait", type=float, default=24 * 3600.0,
+                    help="give up after this many seconds (default 1 day)")
+    ap.add_argument("--out-dir", default=None,
+                    help="log/result directory (default bench_watch/<UTC stamp>)")
+    ap.add_argument("--with-10x", action="store_true",
+                    help="also capture the gated 10x stress row (NEMO_BENCH_10X=1)")
+    ap.add_argument("--runs", type=int, default=None,
+                    help="override NEMO_BENCH_RUNS for the capture")
+    ap.add_argument("--once", action="store_true",
+                    help="probe exactly once, then run or exit 3 (for tests/cron)")
+    args = ap.parse_args()
+
+    out_dir = args.out_dir or os.path.join(
+        REPO_ROOT, "bench_watch",
+        datetime.datetime.now(datetime.timezone.utc).strftime("%Y%m%d_%H%M%S"),
+    )
+    os.makedirs(out_dir, exist_ok=True)
+    probe_log_path = os.path.join(out_dir, "probe_log.txt")
+
+    from nemo_tpu.utils.jax_config import probe_default_platform
+
+    def plog(msg: str) -> None:
+        line = f"[{_stamp()}] {msg}"
+        print(line, file=sys.stderr, flush=True)
+        with open(probe_log_path, "a", encoding="utf-8") as fh:
+            fh.write(line + "\n")
+
+    plog(f"bench-watch started; logs in {out_dir}")
+    deadline = time.monotonic() + args.max_wait
+    healthy = None
+    while True:
+        info = probe_default_platform(args.probe_timeout, retries=1, log=plog)
+        if info is not None and info.get("platform") != "cpu":
+            healthy = info
+            plog(f"healthy window: {info['platform']} x{info['n']}")
+            break
+        plog(
+            "no healthy device window "
+            f"({'cpu-only' if info else 'probe timed out'}); "
+            f"next probe in {args.interval:.0f}s"
+        )
+        if args.once or time.monotonic() + args.interval > deadline:
+            plog("max wait exceeded; giving up (exit 3)")
+            return 3
+        time.sleep(args.interval)
+
+    # Healthy window: run the full bench tier set, raw logs preserved.
+    env = dict(os.environ)
+    if args.with_10x:
+        env["NEMO_BENCH_10X"] = "1"
+    if args.runs is not None:
+        env["NEMO_BENCH_RUNS"] = str(args.runs)
+    stderr_path = os.path.join(out_dir, "bench.stderr")
+    plog(f"running bench tier set (stderr -> {stderr_path})")
+    with open(stderr_path, "w", encoding="utf-8") as err_fh:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "bench.py")],
+            stdout=subprocess.PIPE,
+            stderr=err_fh,
+            text=True,
+            env=env,
+            cwd=REPO_ROOT,
+        )
+    lines = (proc.stdout or "").strip().splitlines()
+    result_path = os.path.join(out_dir, "BENCH.json")
+    if not lines:
+        plog(f"bench produced no result line (rc={proc.returncode}); see {stderr_path}")
+        return 1
+    with open(result_path, "w", encoding="utf-8") as fh:
+        fh.write(lines[-1] + "\n")
+    try:
+        result = json.loads(lines[-1])
+        summary = {
+            k: result.get(k)
+            for k in ("platform", "value", "vs_baseline", "error")
+            if result.get(k) is not None
+        }
+    except json.JSONDecodeError:
+        summary = {"error": "unparseable result line"}
+    plog(
+        f"captured (rc={proc.returncode}, probed {healthy['platform']}): "
+        f"{json.dumps(summary)} -> {result_path}"
+    )
+    return 0 if proc.returncode == 0 and "error" not in summary else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
