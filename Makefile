@@ -5,7 +5,7 @@ NATIVE_LIB := native/build/libnemo_native.so
 REPORT_SRC := native/nemo_report.cpp
 REPORT_LIB := native/build/libnemo_report.so
 
-.PHONY: all native test bench bench-watch prewarm validate trace-smoke clean reset proto neo4j-up neo4j-validate neo4j-down
+.PHONY: all native test bench bench-watch bench-trend prewarm validate trace-smoke obs-smoke lint-print clean reset proto neo4j-up neo4j-validate neo4j-down
 
 all: native
 
@@ -21,10 +21,11 @@ $(REPORT_LIB): $(REPORT_SRC)
 test:
 	python -m pytest tests/ -x -q
 
-# Everything a reviewer needs in one command: the full suite, the driver's
-# multi-chip dry run (8 virtual CPU devices), and a CLI smoke whose jax
-# report is byte-compared against the Python oracle backend.
-validate: test
+# Everything a reviewer needs in one command: the print lint, the full
+# suite, the driver's multi-chip dry run (8 virtual CPU devices), and a CLI
+# smoke whose jax report is byte-compared against the Python oracle backend
+# (whose tail runs the trace + operational-observability smokes).
+validate: lint-print test
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 		python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 	python -m nemo_tpu.utils.validate_smoke
@@ -36,6 +37,26 @@ validate: test
 # one propagated trace id (nemo_tpu/obs).
 trace-smoke:
 	python -m nemo_tpu.utils.validate_smoke --trace-smoke
+
+# Operational-observability smoke (also the tail of `make validate`): boot
+# a sidecar with --metrics-port, drive a Kernel-RPC workload, scrape
+# /metrics (known series present, histogram buckets conformant) and
+# /healthz, and assert a structured sidecar log record carries the
+# propagated trace id (nemo_tpu/obs/promexp.py, obs/log.py).
+obs-smoke:
+	python -m nemo_tpu.utils.validate_smoke --obs-smoke
+
+# Structured-logging contract: no bare print() in nemo_tpu/ outside the
+# CLI/harness allowlist (tools/lint_no_print.py).
+lint-print:
+	python tools/lint_no_print.py
+
+# Regression sentinel (see bench-watch, which runs this automatically
+# after every capture): compares a BENCH json against the trailing
+# same-platform medians in bench_watch/history and exits nonzero past the
+# threshold.  Usage: make bench-trend BENCH=path/to/BENCH.json
+bench-trend:
+	python tools/bench_trend.py $(BENCH)
 
 bench:
 	python bench.py

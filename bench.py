@@ -211,6 +211,7 @@ def child_main() -> None:
     total_runs = 0
     t_gen = t_pack = t_linear_check = 0.0
     total_upload_mb = 0.0
+    total_upload_narrowed_mb = 0.0
     tmp = tempfile.mkdtemp(prefix="nemo_bench_")
     import atexit
 
@@ -225,6 +226,10 @@ def child_main() -> None:
     # (platform-gated; ADVICE r5 #2): the recorded upload volume must
     # describe the bytes the benched dispatches actually shipped.
     from nemo_tpu.backend.jax_backend import _narrow_xfer_default
+    from nemo_tpu.backend.jax_backend import kernel_cost_snapshot as _kernel_cost_snapshot
+    from nemo_tpu.backend.jax_backend import (
+        sample_memory_watermarks as _sample_memory_watermarks,
+    )
 
     narrow_active = bool(_narrow_xfer_default())
     for name in families:
@@ -260,33 +265,42 @@ def child_main() -> None:
         # Host->device upload volume for this family's fused inputs: on the
         # tunnel (~MB/s-class bandwidth) this is a candidate for the
         # unexplained e2e wall, so the bench records it (r5 task 5).
-        # Computed ARITHMETICALLY from shapes (no .astype, no device
-        # touch) with the narrowing THIS RUN actually applies (ADVICE r5
-        # #2: narrowing is platform-gated off on CPU, where the planes
-        # ship at their packed widths and the label plane ships in full
-        # instead of the [1,1] stub).  When active
-        # (backend/jax_backend.py:_narrow_fused_arrays): edge/table planes
-        # ship int8/int16 by bound, type int8, label a [1,1] stub
-        # (with_diff=0), masks 1-byte bool.
-        def _w(a, bound):
-            if not narrow_active:
-                return np.asarray(a).dtype.itemsize
-            return 1 if bound <= 127 else (2 if bound <= 32767 else 4)
-
+        # Two readings (ISSUE 4 satellite — BENCH_r05's 6.9 MB was the
+        # narrowed-width MODEL reported for a CPU run whose headline sweep
+        # shipped wide int32):
+        #   * fused_input_upload_mb: the EXACT bytes of the planes the
+        #     headline sweep below dispatches — analysis_step over the
+        #     packed batches as-is, which never narrows (.nbytes, no width
+        #     model, no device touch);
+        #   * fused_input_upload_mb_narrowed_est: the modeled bytes the
+        #     backend's _fused path would ship through
+        #     _narrow_fused_arrays (int8/int16 planes by bound, type int8,
+        #     [1,1] label stub under with_diff=0, 1-byte bool masks) —
+        #     reported ONLY when the resolved NEMO_NARROW_XFER gate is
+        #     active on this platform, None otherwise.
+        # The e2e tiers separately record upload_mb_measured from the
+        # executor's own kernel.upload_bytes counter — the dispatch-time
+        # ground truth for the pipeline path.
         upload_mb = sum(
-            ba.edge_src.size * _w(ba.edge_src, static["v"])
-            + ba.edge_dst.size * _w(ba.edge_dst, static["v"])
-            + ba.edge_mask.size  # bool
-            + ba.is_goal.size + ba.node_mask.size  # bool
-            + ba.table_id.size * _w(ba.table_id, static["num_tables"])
-            + ba.type_id.size * _w(ba.type_id, 8)
-            + (
-                1  # label [1,1] int8 stub (with_diff=0)
-                if narrow_active
-                else ba.label_id.size * np.asarray(ba.label_id).dtype.itemsize
-            )
+            np.asarray(getattr(ba, f)).nbytes
             for ba in (pre, post)
+            for f in BatchArrays.FIELDS
         ) / 1e6
+        if narrow_active:
+            def _w(bound):
+                return 1 if bound <= 127 else (2 if bound <= 32767 else 4)
+
+            narrowed_mb = sum(
+                ba.edge_src.size * _w(static["v"])
+                + ba.edge_dst.size * _w(static["v"])
+                + ba.edge_mask.size  # bool
+                + ba.is_goal.size + ba.node_mask.size  # bool
+                + ba.table_id.size * _w(static["num_tables"])
+                + ba.type_id.size * _w(8)
+                + 1  # label [1,1] int8 stub (with_diff=0)
+                for ba in (pre, post)
+            ) / 1e6
+            total_upload_narrowed_mb += narrowed_mb
         big_dirs.append((name, big_dir))
         log(
             f"  {name}: {b} distinct runs, bucket V={static['v']}, "
@@ -639,14 +653,24 @@ def child_main() -> None:
             wall = time.perf_counter() - t0
             # What THIS pass did, from the obs metrics registry (the
             # instrumented layers' own counters — not re-derived here):
-            # dispatch/compile split and measured upload volume.
-            mc = obs.Metrics.delta(obs.metrics.snapshot(), m_before)["counters"]
+            # dispatch/compile split, measured upload volume, and the
+            # kernel cost accounting's FLOPs / bytes / compile walls
+            # (ISSUE 4 — the numbers a roofline or capacity plan needs,
+            # per tier).
+            md = obs.Metrics.delta(obs.metrics.snapshot(), m_before)
+            mc = md["counters"]
             e2e[label] = {
                 "wall_s": round(wall, 2),
                 "phases_s": {k: round(v, 2) for k, v in phases.items()},
                 "kernel_compiles": int(mc.get("kernel.compiles", 0)),
                 "kernel_cache_hits": int(mc.get("kernel.cache_hits", 0)),
                 "upload_mb_measured": round(mc.get("kernel.upload_bytes", 0) / 1e6, 1),
+                "flops_est": mc.get("kernel.cost.flops"),
+                "bytes_accessed_est": mc.get("kernel.cost.bytes_accessed"),
+                "compile_s": round(
+                    md["histograms"].get("kernel.compile_s", {}).get("sum", 0.0), 2
+                ),
+                "slow_dispatches": int(mc.get("watchdog.slow_kernel", 0)),
                 # Chosen analysis routes this pass (ISSUE 3): per-verb
                 # sparse/dense dispatch counts from the backend's
                 # analysis.route metrics — the acceptance evidence that
@@ -967,6 +991,9 @@ def child_main() -> None:
         "distinct_runs": total_runs,
         "sweep_ms": round(t_step * 1e3, 1),
         "fused_input_upload_mb": round(total_upload_mb, 1),
+        "fused_input_upload_mb_narrowed_est": (
+            round(total_upload_narrowed_mb, 1) if narrow_active else None
+        ),
         "linear_check_ms": round(t_linear_check * 1e3, 1),
         "p50_diff_ms": None if np.isnan(p50_routed) else round(p50_routed, 4),
         "p50_diff_ms_device": None if np.isnan(p50_tpu) else round(p50_tpu, 3),
@@ -989,6 +1016,11 @@ def child_main() -> None:
         # counters (kernel dispatch/compile split, upload bytes, render
         # dedup/cache, RPC retries/latency) in one audited home.
         "metrics_snapshot": obs.metrics.snapshot(),
+        # Per-signature kernel cost table + memory watermarks (ISSUE 4):
+        # FLOPs / bytes-accessed estimates and compile walls per dispatch
+        # signature, device/host peaks — the roofline/capacity inputs.
+        "kernel_cost": _kernel_cost_snapshot(),
+        "memory_watermarks": _sample_memory_watermarks(),
         "e2e": {
             "runs": total_runs,
             "figures": "sample:8",

@@ -16,6 +16,8 @@ from dataclasses import dataclass
 
 from nemo_tpu import obs
 from nemo_tpu.backend.base import GraphBackend, NoSuccessfulRunError
+
+_log = obs.log.get_logger("nemo.pipeline")
 from nemo_tpu.ingest.molly import MollyOutput, load_molly_output
 from nemo_tpu.report.writer import Reporter
 from nemo_tpu.utils.timing import PhaseTimer
@@ -57,22 +59,36 @@ NONDETERMINISTIC_REPORT_FILES = frozenset({"telemetry.json"})
 def _write_telemetry(report_dir: str, timings: dict, figure_stats: dict | None) -> None:
     """Write the report's "Run telemetry" data (telemetry.json next to
     debugging.json): the phase walls, the figure pipeline's dedup/cache
-    stats, and the process metrics snapshot.  The frontend renders it when
-    present and hides the section otherwise, so pre-obs reports stay valid;
-    parity harnesses exclude this file (it is per-run wall-clock telemetry,
-    inherently nondeterministic across byte-identical reports).  Best
-    effort: telemetry must never fail a report."""
+    stats, the process metrics snapshot, and — when the jax backend ran in
+    this process — the per-signature kernel cost table (FLOPs / bytes /
+    compile walls) and the memory watermarks.  The frontend renders it
+    when present and hides the section otherwise, so pre-obs reports stay
+    valid; parity harnesses exclude this file (it is per-run wall-clock
+    telemetry, inherently nondeterministic across byte-identical reports).
+    Best effort: telemetry must never fail a report."""
     doc = {
         "timings": {k: round(v, 6) for k, v in timings.items()},
         "figure_stats": figure_stats,
         "metrics": obs.metrics.snapshot(),
         "trace_id": obs.trace_id(),
     }
+    # Kernel cost + memory sections ride along only when the jax backend is
+    # already loaded (sys.modules gate: an oracle-backend run must not drag
+    # jax in just to report that no kernels ran).
+    jb = sys.modules.get("nemo_tpu.backend.jax_backend")
+    if jb is not None:
+        try:
+            costs = jb.kernel_cost_snapshot()
+            if costs:
+                doc["kernel_cost"] = costs
+            doc["memory"] = jb.sample_memory_watermarks()
+        except Exception:
+            pass
     try:
         with open(os.path.join(report_dir, "telemetry.json"), "w", encoding="utf-8") as fh:
             json.dump(doc, fh, indent=1)
     except OSError as ex:
-        print(f"warning: telemetry.json not written: {ex}", file=sys.stderr)
+        _log.warning("telemetry.write_failed", report_dir=report_dir, error=str(ex))
 
 
 def _prov_json_str(prov) -> str:
@@ -433,11 +449,11 @@ def run_debug(
             good_iter = backend.good_run_iter()
         except NoSuccessfulRunError:
             if failed_iters:
-                print(
-                    "warning: no successful run in corpus; skipping "
-                    "differential provenance and correction synthesis "
-                    "(nothing to diff against)",
-                    file=sys.stderr,
+                _log.warning(
+                    "pipeline.no_successful_run",
+                    detail="skipping differential provenance and correction "
+                    "synthesis (nothing to diff against)",
+                    corpus=fault_inj_out,
                 )
         fig_iters = select_figure_iters(figures, iters, failed_iters, good_iter)
         fig_set = set(fig_iters)

@@ -15,6 +15,7 @@ oracle backend (analysis/queries.py).
 from __future__ import annotations
 
 import os
+import time
 import warnings
 from functools import partial
 
@@ -23,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from nemo_tpu import obs
+from nemo_tpu.obs import log as _obs_log
 from nemo_tpu.analysis.corrections import synthesize_corrections, synthesize_extensions
 from nemo_tpu.analysis.protos import intersect_proto, missing_from, union_proto, wrap_code
 from nemo_tpu.analysis.queries import (
@@ -56,6 +58,8 @@ from nemo_tpu.report.figures import create_diff_dot, create_dot
 
 from .base import GraphBackend
 from .python_ref import CLEAN_OFFSET, DIFF_OFFSET
+
+_log = _obs_log.get_logger("nemo.backend")
 
 
 @partial(jax.jit, static_argnames=("v", "cond_tid", "num_tables"))
@@ -172,6 +176,179 @@ def _device_annotation(name: str):
     return ann(name)
 
 
+#: Per-signature kernel cost table (ISSUE 4 tentpole): one record per
+#: (verb, input shapes/dtypes, statics) ever dispatched by this process,
+#: with the XLA cost model's FLOPs / bytes-accessed estimates captured at
+#: first sight and the first dispatch's wall (trace + compile + first
+#: execute — the cost a new signature makes a user pay).  Consumed by
+#: telemetry.json (kernel_cost_snapshot), the bench e2e rows, and the
+#: roofline-style gauges in the metrics registry.
+_KERNEL_COSTS: dict[tuple, dict] = {}
+#: AOT-jitted wrappers for the dict-returning verbs (whose dispatch `fn` is
+#: a plain function around an inner jit) so lower().cost_analysis() has a
+#: jittable callable; never executed, only lowered.
+_COST_JITS: dict[str, object] = {}
+
+
+def _cost_analysis_enabled() -> bool:
+    """NEMO_COST_ANALYSIS=0 disables the per-signature cost capture (it
+    costs one extra trace+lower per compiled signature — negligible next
+    to the compile it rides on, but an operator diagnosing trace-time
+    itself needs the off switch)."""
+    return os.environ.get("NEMO_COST_ANALYSIS", "").strip().lower() not in (
+        "0",
+        "false",
+        "off",
+        "no",
+    )
+
+
+def _kernel_cost_analysis(verb: str, fn, args, statics) -> dict:
+    """Best-effort XLA cost estimates for one dispatch signature:
+    {"flops": float|None, "bytes_accessed": float|None}.  Uses the AOT
+    ``lower(...).cost_analysis()`` path — an HLO-level analysis, no second
+    backend compile — wrapping the plain dict-returning verbs in a jit of
+    their own (never executed).  Any failure returns Nones: cost numbers
+    are observability, they must never fail a dispatch."""
+    out = {"flops": None, "bytes_accessed": None}
+    try:
+        target = fn
+        if verb in ("fused", "giant"):
+            target = _COST_JITS.get(verb)
+            if target is None:
+                n_arr = len(LocalExecutor.VERBS[verb][1])
+                n_stat = len(LocalExecutor.VERBS[verb][2])
+                target = _COST_JITS[verb] = jax.jit(
+                    fn, static_argnums=tuple(range(n_arr, n_arr + n_stat))
+                )
+        ca = target.lower(*args, *statics).cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if isinstance(ca, dict):
+            out["flops"] = float(ca.get("flops", 0.0)) or None
+            out["bytes_accessed"] = float(ca.get("bytes accessed", 0.0)) or None
+    except Exception:
+        pass
+    return out
+
+
+def _cost_signature(verb: str, arrays: dict, params: dict) -> tuple:
+    """The dispatch-signature key of the cost table: verb + per-input
+    (name, shape, dtype) + sorted statics — exactly what determines the
+    compiled program (modulo the traced-scalar table ids, deliberately)."""
+    shapes = tuple(
+        (n, tuple(np.shape(a)), str(getattr(a, "dtype", type(a).__name__)))
+        for n, a in sorted(arrays.items())
+        if a is not None
+    )
+    return (verb, shapes, tuple(sorted((k, int(v)) for k, v in params.items())))
+
+
+def _record_kernel_cost(
+    verb: str, sig: tuple, fn, args, statics, wall_s: float, compiled: bool
+) -> None:
+    """First sight of a signature: capture cost estimates + the dispatch
+    wall (the compile wall, when the jit cache says this dispatch
+    compiled); later sights: bump the dispatch count and flow the
+    signature's per-execution estimates into the cumulative counters."""
+    rec = _KERNEL_COSTS.get(sig)
+    if rec is None:
+        # Same bounded-growth contract as the metrics registry's series
+        # cap: a long-lived sidecar fed adversarial bucket shapes must not
+        # grow the cost table without bound.  512 signatures is ~50x any
+        # real corpus sweep; drops are counted where operators look.  Junk
+        # env warns-and-defaults like every other observability knob —
+        # cost numbers must never fail a dispatch.
+        try:
+            cap = int(os.environ.get("NEMO_COST_MAX_SIGNATURES", "512"))
+        except ValueError:
+            cap = 512
+        if len(_KERNEL_COSTS) >= cap:
+            # Counts DISPATCHES not represented in the cost table (every
+            # execution of an over-cap signature), so the cumulative
+            # flops/bytes counters' blind spot is quantified in the same
+            # unit they aggregate.
+            obs.metrics.inc("kernel.cost.uncosted_dispatches")
+            return
+        cost = (
+            _kernel_cost_analysis(verb, fn, args, statics)
+            if _cost_analysis_enabled()
+            else {"flops": None, "bytes_accessed": None}
+        )
+        rec = _KERNEL_COSTS[sig] = {
+            "verb": verb,
+            "shapes": " ".join(
+                f"{n}[{','.join(map(str, s))}]{d}" for n, s, d in sig[1]
+            ),
+            "statics": dict(sig[2]),
+            "flops": cost["flops"],
+            "bytes_accessed": cost["bytes_accessed"],
+            # Wall of the signature's first dispatch: trace + compile (or
+            # persistent-cache load) + first execute.  `compiled` False
+            # here means the in-memory jit cache already held the program
+            # (another signature maps to the same traced program).
+            "first_dispatch_s": wall_s,
+            "compiled": bool(compiled),
+            "dispatches": 0,
+        }
+        if compiled:
+            obs.metrics.observe("kernel.compile_s", wall_s)
+            obs.metrics.gauge(f"kernel.compile_s.{verb}", wall_s)
+        if rec["flops"] is not None:
+            obs.metrics.gauge(f"kernel.cost.flops.{verb}", rec["flops"])
+        if rec["bytes_accessed"] is not None:
+            obs.metrics.gauge(f"kernel.cost.bytes.{verb}", rec["bytes_accessed"])
+    rec["dispatches"] += 1
+    # Cumulative estimated work actually dispatched (per-execution cost x
+    # executions) — the numerator of any throughput/roofline readout.
+    if rec["flops"] is not None:
+        obs.metrics.inc("kernel.cost.flops", rec["flops"])
+    if rec["bytes_accessed"] is not None:
+        obs.metrics.inc("kernel.cost.bytes_accessed", rec["bytes_accessed"])
+
+
+def kernel_cost_snapshot() -> list[dict]:
+    """The per-signature cost table as JSON-able records, most-dispatched
+    first — telemetry.json's `kernel_cost` section and the bench's
+    `kernel_cost` row read this."""
+    return sorted(
+        (dict(rec) for rec in _KERNEL_COSTS.values()),
+        key=lambda r: (-r["dispatches"], r["verb"], r["shapes"]),
+    )
+
+
+def sample_memory_watermarks() -> dict:
+    """Device + host memory watermarks, sampled after dispatches and at
+    report time: per-device PJRT memory_stats peaks where the backend
+    exposes them (TPU), and the process peak RSS always (the CPU-fallback
+    watermark — on a CPU backend the device buffers ARE host memory).
+    Records the same numbers as gauges (mem.*) so they scrape."""
+    import resource
+
+    out: dict = {}
+    ru = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss  # KiB on Linux
+    out["host_peak_rss_bytes"] = int(ru) * 1024
+    obs.metrics.gauge("mem.host_peak_rss_bytes", out["host_peak_rss_bytes"])
+    try:
+        peak = in_use = 0
+        seen = False
+        for d in jax.devices():
+            stats = d.memory_stats()
+            if not stats:
+                continue
+            seen = True
+            peak += int(stats.get("peak_bytes_in_use", 0))
+            in_use += int(stats.get("bytes_in_use", 0))
+        if seen:
+            out["device_peak_bytes"] = peak
+            out["device_bytes_in_use"] = in_use
+            obs.metrics.gauge("mem.device_peak_bytes", peak)
+            obs.metrics.gauge("mem.device_bytes_in_use", in_use)
+    except Exception:
+        pass  # watermarks are observability; never fail the caller
+    return out
+
+
 def _jit_cache_size(verb: str, fn) -> int:
     """In-memory jit-cache entry count for a verb's underlying compiled
     function, or -1 when unknowable (the giant verb jits inside a closure).
@@ -187,6 +364,10 @@ def _jit_cache_size(verb: str, fn) -> int:
         return cs() if cs is not None else -1
     except Exception:
         return -1
+
+
+#: Dispatch counter driving the throttled memory-watermark sampling.
+_MEM_SAMPLE_TICK = [0]
 
 
 class LocalExecutor:
@@ -326,6 +507,8 @@ class LocalExecutor:
         # this dispatch paid trace/compile, an unchanged one was served
         # from the in-memory program cache.
         cs_before = _jit_cache_size(verb, fn)
+        compiled = False
+        t_disp = time.perf_counter()
         with obs.span(f"kernel:{verb}", **span_attrs) as sp:
             with _device_annotation(f"nemo:{verb}"):
                 out = fn(*args, *statics)
@@ -336,6 +519,41 @@ class LocalExecutor:
                 )
                 if sp is not None:
                     sp.set(compiled=compiled)
+        wall_s = time.perf_counter() - t_disp
+        # Cost accounting (ISSUE 4): per-signature FLOPs/bytes estimates +
+        # compile wall into the cost table and the metrics registry, device
+        # memory watermarks sampled while the dispatch's buffers are the
+        # high-water mark, and the slow-dispatch watchdog — a structured
+        # warning (route, bucket shape, upload bytes) for any dispatch past
+        # NEMO_SLOW_DISPATCH_MS, so a wedged tunnel or a pathological
+        # signature is a grep away instead of an unexplained wall.
+        _record_kernel_cost(
+            verb, _cost_signature(verb, arrays, params), fn, args, statics,
+            wall_s, compiled,
+        )
+        # Watermark sampling is throttled off the hot path: compiled
+        # dispatches (rare, and the likeliest new high-water mark) plus
+        # every 64th dispatch — peaks are monotone within a process, so a
+        # periodic sample loses nothing but sub-window timing, and the
+        # per-dispatch getrusage/memory_stats stack stays off the
+        # thousands-of-small-dispatches paths.  telemetry.json always
+        # samples once more at report time.
+        _MEM_SAMPLE_TICK[0] += 1
+        if compiled or _MEM_SAMPLE_TICK[0] % 64 == 0:
+            sample_memory_watermarks()
+        slow_ms = _obs_log.slow_dispatch_ms()
+        if slow_ms and wall_s * 1000.0 > slow_ms:
+            obs.metrics.inc("watchdog.slow_kernel")
+            _log.warning(
+                "kernel.slow_dispatch",
+                verb=verb,
+                wall_ms=round(wall_s * 1000.0, 1),
+                threshold_ms=slow_ms,
+                compiled=compiled,
+                rows=span_attrs.get("rows"),
+                v=int(params["v"]) if "v" in params else None,
+                upload_bytes=upload,
+            )
         if isinstance(out, dict):
             _prefetch_to_host(o for n, o in out.items() if n not in self.ON_DEVICE)
             res = {

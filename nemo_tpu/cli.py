@@ -117,6 +117,14 @@ def main(argv: list[str] | None = None) -> int:
         "Near-zero overhead when off",
     )
     parser.add_argument(
+        "--metrics-out",
+        metavar="FILE",
+        default=None,
+        help="after the run, dump the obs metrics registry one-shot in "
+        "Prometheus text format to FILE ('-' for stdout) — the same "
+        "rendering the sidecar serves on --metrics-port",
+    )
+    parser.add_argument(
         "--figures",
         default="all",
         metavar="POLICY",
@@ -277,6 +285,17 @@ def main(argv: list[str] | None = None) -> int:
     trace_path = obs_trace.finish()
     if trace_path:
         print(f"obs trace written to {trace_path} (open at ui.perfetto.dev)")
+
+    if args.metrics_out:
+        from nemo_tpu.obs import promexp
+
+        text = promexp.render_prometheus()
+        if args.metrics_out == "-":
+            sys.stdout.write(text)
+        else:
+            with open(args.metrics_out, "w", encoding="utf-8") as fh:
+                fh.write(text)
+            print(f"metrics written to {args.metrics_out} (Prometheus text format)")
 
     for res in results:
         print(f"All done! Find the debug report here: {os.path.join(res.report_dir, 'index.html')}")

@@ -25,8 +25,12 @@ import os
 from dataclasses import dataclass
 from typing import Any
 
+from nemo_tpu.obs import log as _obs_log
+
 from .ast import Program
 from .eval import Evaluator, FactInst, RunResult
+
+_log = _obs_log.get_logger("nemo.dedalus")
 
 
 @dataclass
@@ -145,21 +149,18 @@ def enumerate_runs(program: Program, spec: FaultSpec) -> list[FaultRun]:
                     if n1 != n2:
                         faults.append(({n1: t1, n2: t2}, set()))
         if spec.max_crashes > 2:
-            import sys
-
-            print(
-                f"dedalus: max_crashes={spec.max_crashes} > 2; only single "
-                "crashes and crash pairs are enumerated",
-                file=sys.stderr,
+            _log.warning(
+                "dedalus.max_crashes_capped",
+                max_crashes=spec.max_crashes,
+                detail="only single crashes and crash pairs are enumerated",
             )
 
     if len(faults) > spec.max_runs:
-        import sys
-
-        print(
-            f"dedalus: fault space truncated to max_runs={spec.max_runs} of "
-            f"{len(faults)} enumerated faults (raise -max-runs to cover all)",
-            file=sys.stderr,
+        _log.warning(
+            "dedalus.fault_space_truncated",
+            max_runs=spec.max_runs,
+            enumerated=len(faults),
+            detail="raise -max-runs to cover all",
         )
     for crashes, omissions in faults[: spec.max_runs]:
         result = Evaluator(program, spec.eot, crashes, omissions).run()

@@ -20,15 +20,23 @@ two primitives a sharded / two-process / worker-pool deployment needs:
   previously scattered and re-derived per layer (compile-cache hits, figure
   dedup, SVG-cache hits, upload bytes, batch sizes, RPC retries/latency).
   `bench.py` and the report's telemetry section consume the snapshot
-  instead of recomputing.
+  instead of recomputing; `obs.promexp` renders it in Prometheus text
+  format — pull-based on the sidecar's `--metrics-port`, one-shot via the
+  CLI's `--metrics-out`.
+
+* **Structured logging** (`obs.log`): leveled JSON-lines records carrying
+  the active tracer's trace id, so log lines from any process in a run —
+  render-pool workers, the sidecar — correlate with the Perfetto trace.
 
 Import cost is deliberately tiny (stdlib only, no jax/numpy) so every layer
-can depend on it unconditionally.
+can depend on it unconditionally.  `obs.promexp` is imported lazily by its
+consumers (it pulls in http.server).
 """
 
 from __future__ import annotations
 
-from .metrics import Metrics, metrics
+from . import log
+from .metrics import HIST_BUCKETS, Metrics, metrics
 from .trace import (
     Tracer,
     add_span,
@@ -43,6 +51,7 @@ from .trace import (
 )
 
 __all__ = [
+    "HIST_BUCKETS",
     "Metrics",
     "Tracer",
     "add_span",
@@ -50,6 +59,7 @@ __all__ = [
     "enabled",
     "export",
     "finish",
+    "log",
     "metrics",
     "span",
     "start_trace",

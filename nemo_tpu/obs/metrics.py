@@ -6,69 +6,138 @@ every consumer: compile-cache hits and upload bytes (backend/jax_backend.py),
 figure dedup and SVG-cache hits (report/render.py), RPC retries and latency
 (service/client.py, service/server.py), dispatch batch sizes.  `bench.py`
 reads `snapshot()` deltas instead of recomputing; the sidecar surfaces its
-snapshot through the Health RPC so operators see device-side state without
-SSH.
+snapshot through the Health RPC AND serves it pull-based in Prometheus text
+format on `--metrics-port` (obs/promexp.py), so operators scrape device-side
+state without SSH.
 
 Naming convention: dotted lowercase, layer-first — e.g.
 ``kernel.dispatches``, ``kernel.compiles``, ``render.figures``,
 ``rpc.retries``.  Breakdown by label rides the name
 (``kernel.dispatches.fused``) — a flat dict snapshot stays trivially
-JSON-able for the Health RPC and the report's telemetry section.
+JSON-able for the Health RPC and the report's telemetry section.  Because
+breakdown rides the name, adversarial inputs (bucket shapes, RPC method
+strings) could otherwise mint unbounded series on a long-lived sidecar, so
+the registry is CAPPED: past ``max_series`` distinct names
+(``NEMO_METRICS_MAX_SERIES``, default 4096) new series are dropped and
+counted in ``metrics.dropped_series`` — existing series keep updating.
 
-Histograms keep count/sum/min/max (mean derives) — enough for latency and
-batch-size distributions without a binning policy to version.
+Histograms keep count/sum/min/max (mean derives) plus cumulative bucket
+counts over a fixed 1-2.5-5 geometric ladder spanning 1e-4..5e9 — wide
+enough for seconds-scale latencies, batch-row counts, and byte volumes
+with one binning policy to version.  The buckets are what the Prometheus
+exposition renders as ``_bucket{le=...}`` series.
 """
 
 from __future__ import annotations
 
+import bisect
+import os
 import threading
 
-__all__ = ["Metrics", "metrics"]
+__all__ = ["HIST_BUCKETS", "Metrics", "metrics"]
+
+#: Histogram bucket upper bounds (cumulative, Prometheus ``le`` semantics):
+#: a 1-2.5-5 ladder per decade, 1e-4 .. 5e9.  One shared ladder for every
+#: histogram keeps the exposition conformant and the snapshot shape stable;
+#: observations above the top bound land only in the implicit +Inf bucket.
+HIST_BUCKETS: tuple[float, ...] = tuple(
+    m * 10.0**e for e in range(-4, 10) for m in (1.0, 2.5, 5.0)
+)
+
+
+def _max_series_default() -> int:
+    try:
+        return int(os.environ.get("NEMO_METRICS_MAX_SERIES", "4096"))
+    except ValueError:
+        return 4096
 
 
 class Metrics:
     """Thread-safe registry.  All mutators are cheap (one lock, dict ops);
     none allocate on the hot path beyond first sight of a name."""
 
-    def __init__(self) -> None:
+    def __init__(self, max_series: int | None = None) -> None:
         self._lock = threading.Lock()
+        self._max_series = _max_series_default() if max_series is None else int(max_series)
+        self._dropped = 0
         self._counters: dict[str, float] = {}
         self._gauges: dict[str, float] = {}
-        self._hists: dict[str, list[float]] = {}  # [count, sum, min, max]
+        # name -> [count, sum, min, max, per-bucket counts (len(HIST_BUCKETS))]
+        self._hists: dict[str, list] = {}
+
+    def _admit(self) -> bool:
+        """Bounded-registry gate, called under the lock for a name NOT yet
+        in its store: admit while the total series count is under the cap,
+        else count the drop.  Existing series always keep updating — the
+        cap bounds growth, it never loses established signals."""
+        if (
+            len(self._counters) + len(self._gauges) + len(self._hists)
+            < self._max_series
+        ):
+            return True
+        self._dropped += 1
+        return False
 
     # ------------------------------------------------------------- mutators
 
     def inc(self, name: str, value: float = 1) -> None:
         with self._lock:
-            self._counters[name] = self._counters.get(name, 0) + value
+            if name in self._counters:
+                self._counters[name] += value
+            elif self._admit():
+                self._counters[name] = value
 
     def gauge(self, name: str, value: float) -> None:
         with self._lock:
-            self._gauges[name] = value
+            if name in self._gauges or self._admit():
+                self._gauges[name] = value
 
     def observe(self, name: str, value: float) -> None:
         with self._lock:
             h = self._hists.get(name)
             if h is None:
-                self._hists[name] = [1, value, value, value]
-            else:
-                h[0] += 1
-                h[1] += value
-                if value < h[2]:
-                    h[2] = value
-                if value > h[3]:
-                    h[3] = value
+                if not self._admit():
+                    return
+                h = self._hists[name] = [0, 0.0, value, value, [0] * len(HIST_BUCKETS)]
+            h[0] += 1
+            h[1] += value
+            if value < h[2]:
+                h[2] = value
+            if value > h[3]:
+                h[3] = value
+            i = bisect.bisect_left(HIST_BUCKETS, value)
+            if i < len(HIST_BUCKETS):
+                h[4][i] += 1
 
     # ------------------------------------------------------------ snapshots
 
+    @staticmethod
+    def _cumulative(buckets: list[int], count: int) -> list:
+        """Per-bucket counts -> cumulative [le, count] pairs, trimmed after
+        the first bucket that already holds every observation (the tail
+        adds no information and would bloat telemetry.json ~40 pairs per
+        histogram); the exposition layer re-extends with +Inf."""
+        out = []
+        cum = 0
+        for le, c in zip(HIST_BUCKETS, buckets):
+            cum += c
+            out.append([le, cum])
+            if cum >= count:
+                break
+        return out
+
     def snapshot(self) -> dict:
         """Point-in-time copy: {"counters": {...}, "gauges": {...},
-        "histograms": {name: {count, sum, min, max, mean}}}.  Plain JSON-able
-        types only (the Health RPC and telemetry.json ship it verbatim)."""
+        "histograms": {name: {count, sum, min, max, mean, buckets}}} where
+        buckets is cumulative [le, count] pairs (Prometheus semantics).
+        Plain JSON-able types only (the Health RPC and telemetry.json ship
+        it verbatim)."""
         with self._lock:
             counters = dict(self._counters)
+            if self._dropped:
+                counters["metrics.dropped_series"] = self._dropped
             gauges = dict(self._gauges)
-            hists = {k: list(v) for k, v in self._hists.items()}
+            hists = {k: (v[0], v[1], v[2], v[3], list(v[4])) for k, v in self._hists.items()}
         return {
             "counters": counters,
             "gauges": gauges,
@@ -79,8 +148,9 @@ class Metrics:
                     "min": lo,
                     "max": hi,
                     "mean": s / c if c else 0.0,
+                    "buckets": self._cumulative(b, c),
                 }
-                for k, (c, s, lo, hi) in hists.items()
+                for k, (c, s, lo, hi, b) in hists.items()
             },
         }
 
@@ -118,6 +188,7 @@ class Metrics:
             self._counters.clear()
             self._gauges.clear()
             self._hists.clear()
+            self._dropped = 0
 
 
 #: The process-wide registry every layer records into.

@@ -212,6 +212,35 @@ async function telemetry() {
     );
   }
 
+  // Kernel cost accounting (backend/jax_backend.py:kernel_cost_snapshot):
+  // one row per dispatch signature — FLOPs / bytes-accessed estimates,
+  // the first-dispatch (compile) wall, and how often it dispatched.
+  const mega = (v) => (v == null ? "—" : `${(v / 1e6).toFixed(1)} M`);
+  const costs = data.kernel_cost || [];
+  if (costs.length) {
+    body.append(
+      telemetryTable(
+        "Kernel cost (per signature)",
+        costs.map((c) => [
+          `${c.verb} ×${c.dispatches}${c.compiled ? "" : " (cache)"}`,
+          `${mega(c.flops)}FLOP, ${mega(c.bytes_accessed)}B, ` +
+            `first ${(c.first_dispatch_s * 1e3).toFixed(0)} ms`,
+        ])
+      )
+    );
+  }
+
+  // Memory watermarks (device peaks where the backend exposes them, host
+  // peak RSS always).
+  const mem = data.memory || {};
+  const memRows = Object.entries(mem).map(([k, v]) => [
+    k.replace(/_/g, " "),
+    `${(v / 1e6).toFixed(1)} MB`,
+  ]);
+  if (memRows.length) {
+    body.append(telemetryTable("Memory watermarks", memRows));
+  }
+
   const counters = (data.metrics || {}).counters || {};
   const rows = Object.entries(counters)
     .sort()

@@ -180,7 +180,7 @@ class SvgCache:
             warnings.warn(f"SVG cache write failed ({ex}); continuing uncached", stacklevel=2)
 
 
-def _render_job(g: DotGraph, collect_spans: bool = False) -> tuple:
+def _render_job(g: DotGraph, collect_spans: bool = False, trace_id: str | None = None) -> tuple:
     """Pool worker body: render one DotGraph, returning (svg, render
     seconds, spans).  Lives at module top level for picklability; imports
     the engine lazily so spawned workers never touch jax (this module's
@@ -191,13 +191,25 @@ def _render_job(g: DotGraph, collect_spans: bool = False) -> tuple:
     obs.trace.Tracer.adopt) so the parent's Perfetto timeline shows the
     pool's overlap with analysis where it actually ran.  Worker and parent
     share CLOCK_MONOTONIC (same machine by construction — a spawned pool),
-    so no clock reconciliation is needed."""
+    so no clock reconciliation is needed.
+
+    `trace_id` is the submitting process's trace id: the worker has no
+    tracer of its own, so its structured log records (debug level — the
+    per-figure grain is noise at info) carry the id explicitly and a
+    render-worker log line greps up with the parent's trace and logs."""
+    from nemo_tpu.obs import log as obs_log
+
     from .native import render_svg_auto
 
     start_us = time.perf_counter_ns() // 1000
     t0 = time.perf_counter()
     svg = render_svg_auto(g)
     dt = time.perf_counter() - t0
+    if obs_log.level_enabled("debug"):
+        fields = dict(nodes=len(g.nodes), edges=len(g.edges), render_ms=round(dt * 1e3, 3))
+        if trace_id is not None:
+            fields["trace_id"] = trace_id  # else the emitter auto-attaches
+        obs_log.get_logger("nemo.render").debug("render.worker", **fields)
     spans = None
     if collect_spans:
         import threading
@@ -310,8 +322,11 @@ class RenderScheduler:
                 if pool is not None:
                     # A tracing parent asks workers to record their render
                     # spans; they come back through the future's result and
-                    # are adopted at drain.
-                    ent.future = pool.submit(_render_job, dot, obs.enabled())
+                    # are adopted at drain.  The trace id travels with the
+                    # job so worker log records correlate.
+                    ent.future = pool.submit(
+                        _render_job, dot, obs.enabled(), obs.trace_id()
+                    )
         ent.count += 1
         ent.pending_paths.append(svg_path)
 

@@ -16,9 +16,12 @@ import grpc
 import numpy as np
 
 from nemo_tpu import obs
+from nemo_tpu.obs import log as obs_log
 from nemo_tpu.service import codec
 from nemo_tpu.service.proto import nemo_service_pb2 as pb
 from nemo_tpu.service.server import SERVICE
+
+_log = obs_log.get_logger("nemo.client")
 
 
 class SidecarError(RuntimeError):
@@ -193,8 +196,25 @@ class RemoteAnalyzer:
                     resp, call = method.with_call(
                         request, timeout=timeout or self.timeout, metadata=md
                     )
+                dt = time.perf_counter() - t0
                 obs.metrics.inc(f"rpc.calls.{name}")
-                obs.metrics.observe(f"rpc.latency_s.{name}", time.perf_counter() - t0)
+                obs.metrics.observe(f"rpc.latency_s.{name}", dt)
+                # Client-side slow-RPC watchdog (the kernel-dispatch twin in
+                # backend/jax_backend.py): any RPC past NEMO_SLOW_DISPATCH_MS
+                # logs its route and payload size — the tunnel stall /
+                # pathological-signature tripwire for the two-process shape.
+                slow_ms = obs_log.slow_dispatch_ms()
+                if slow_ms and dt * 1000.0 > slow_ms:
+                    obs.metrics.inc("watchdog.slow_rpc")
+                    _log.warning(
+                        "rpc.slow",
+                        rpc=name,
+                        target=self.target,
+                        wall_ms=round(dt * 1000.0, 1),
+                        threshold_ms=slow_ms,
+                        request_bytes=request.ByteSize(),
+                        attempt=attempt,
+                    )
                 _adopt_remote(call)
                 return resp, call
             except grpc.RpcError as ex:

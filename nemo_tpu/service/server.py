@@ -11,14 +11,22 @@ grpcio is present in this environment but its codegen plugin is not, so the
 service is registered through grpc's generic-handler API with the
 protoc-generated message classes doing (de)serialization.
 
-Run:  python -m nemo_tpu.service.server --port 50051
+Operational surface (ISSUE 4): `--metrics-port` / `NEMO_METRICS_PORT`
+serves the obs metrics registry in Prometheus text format on a stdlib
+http.server thread (`/metrics`, plus `/healthz` mirroring the gRPC Health
+state) so a long-lived sidecar is scrapeable; every log line is a
+structured JSON record (obs/log.py) carrying the client's propagated trace
+id where one exists, and every RPC lands in a `serve.rpc_latency_s.<rpc>`
+histogram.
+
+Run:  python -m nemo_tpu.service.server --port 50051 --metrics-port 9464
 """
 
 from __future__ import annotations
 
 import argparse
 import json
-import logging
+import os
 import threading
 import time
 from concurrent import futures
@@ -26,6 +34,7 @@ from concurrent import futures
 import grpc
 
 from nemo_tpu import obs
+from nemo_tpu.obs import log as obs_log
 from nemo_tpu.obs import trace as obs_trace
 from nemo_tpu.service import codec
 from nemo_tpu.service.proto import nemo_service_pb2 as pb
@@ -33,7 +42,42 @@ from nemo_tpu.service.proto import nemo_service_pb2 as pb
 SERVICE = "nemo.NemoAnalysis"
 VERSION = "1"
 
-log = logging.getLogger("nemo.sidecar")
+log = obs_log.get_logger("nemo.sidecar")
+
+
+def _health_state() -> dict:
+    """The `/healthz` document: a JSON mirror of the gRPC Health response
+    (same fields a `health()` client sees), computed per request so an
+    operator's curl reflects live device state."""
+    import jax
+
+    devs = jax.devices()
+    return {
+        "status": "SERVING",
+        "platform": devs[0].platform,
+        "device_count": len(devs),
+        "version": VERSION,
+    }
+
+
+def _rpc_observed(name: str, t0: float, trace_id: str | None) -> None:
+    """Per-RPC server-side accounting shared by every handler: the latency
+    histogram the Prometheus endpoint exposes, plus a trace-correlated
+    debug record (the log line that joins a scrape, a trace file, and a
+    client's story under one id)."""
+    dt = time.perf_counter() - t0
+    obs.metrics.observe(f"serve.rpc_latency_s.{name}", dt)
+    log.debug(
+        "serve.rpc", rpc=name, seconds=round(dt, 6),
+        trace_id=trace_id,
+    )
+    slow_ms = obs_log.slow_dispatch_ms()
+    if slow_ms and dt * 1000.0 > slow_ms:
+        obs.metrics.inc("watchdog.slow_rpc")
+        log.warning(
+            "serve.slow_rpc", rpc=name, wall_ms=round(dt * 1000.0, 1),
+            threshold_ms=slow_ms, trace_id=trace_id,
+        )
 
 
 #: Traced requests sharing the lazily-created PATHLESS collector tracer.
@@ -127,6 +171,7 @@ class _Impl:
 
     def health(self, request: pb.HealthRequest, context) -> pb.HealthResponse:
         col = _SpanCollection(context)
+        t0 = time.perf_counter()
         try:
             with obs.span("serve:Health", trace_id=col.tid):
                 import jax
@@ -145,6 +190,7 @@ class _Impl:
             )
             return resp
         finally:
+            _rpc_observed("Health", t0, col.tid)
             col.release()
 
     def _analyze_one(
@@ -195,6 +241,7 @@ class _Impl:
 
     def analyze(self, request: pb.AnalyzeRequest, context) -> pb.AnalyzeResponse:
         col = _SpanCollection(context)
+        t0 = time.perf_counter()
         try:
             resp = self._analyze_one(request, trace_id=col.tid)
             md = col.trailing()
@@ -202,12 +249,14 @@ class _Impl:
                 context.set_trailing_metadata(md)
             return resp
         finally:
+            _rpc_observed("Analyze", t0, col.tid)
             col.release()
 
     def analyze_stream(self, request_iterator, context):
         # Sequential device dispatch preserves chunk arrival order; gRPC's
         # flow control provides the backpressure (SURVEY.md §7 hard part 6).
         col = _SpanCollection(context)
+        t0 = time.perf_counter()
         try:
             for request in request_iterator:
                 yield self._analyze_one(request, trace_id=col.tid)
@@ -215,6 +264,7 @@ class _Impl:
             if md:
                 context.set_trailing_metadata(md)
         finally:
+            _rpc_observed("AnalyzeStream", t0, col.tid)
             col.release()
 
     def kernel(self, request: pb.KernelRequest, context) -> pb.KernelResponse:
@@ -225,6 +275,7 @@ class _Impl:
         from nemo_tpu.backend.jax_backend import LocalExecutor
 
         col = _SpanCollection(context)
+        t_rpc = time.perf_counter()
         try:
             verb, arrays, params = codec.kernel_request_from_pb(request)
             if verb not in LocalExecutor.VERBS:
@@ -244,6 +295,7 @@ class _Impl:
                 context.set_trailing_metadata(md)
             return codec.kernel_response_to_pb(out, step_seconds=time.perf_counter() - t0)
         finally:
+            _rpc_observed("Kernel", t_rpc, col.tid)
             col.release()
 
 
@@ -306,8 +358,29 @@ def main(argv: list[str] | None = None) -> int:
         "tunnel outage), 'cpu', 'tpu', or a concrete platform name "
         "(default: $NEMO_PLATFORM or auto)",
     )
+    def _metrics_port_default() -> int:
+        # Junk env warns-and-defaults to off, like every observability
+        # knob: a typo here must not keep the gRPC service itself down.
+        try:
+            return int(os.environ.get("NEMO_METRICS_PORT", "0") or 0)
+        except ValueError:
+            log.warning(
+                "metrics.bad_port_env",
+                value=os.environ.get("NEMO_METRICS_PORT"),
+                detail="NEMO_METRICS_PORT is not an integer; metrics port off",
+            )
+            return 0
+
+    parser.add_argument(
+        "--metrics-port",
+        type=int,
+        default=_metrics_port_default(),
+        help="serve Prometheus text-format metrics on http://127.0.0.1:PORT"
+        "/metrics (plus /healthz mirroring the gRPC Health state) from a "
+        "stdlib http.server thread; 0 disables (default: "
+        "$NEMO_METRICS_PORT or off)",
+    )
     args = parser.parse_args(argv)
-    logging.basicConfig(level=logging.INFO)
     from nemo_tpu.utils.jax_config import (
         PlatformUnavailableError,
         enable_compilation_cache,
@@ -320,26 +393,38 @@ def main(argv: list[str] | None = None) -> int:
     # explicit --platform=tpu demand with no reachable device refuses to
     # start at all rather than serving CPU answers under a TPU flag.
     try:
-        platform = ensure_platform(args.platform, log=log.warning)
+        platform = ensure_platform(args.platform, log=lambda m: log.warning("platform", detail=m))
     except PlatformUnavailableError as e:
-        log.error("fatal: %s", e)
+        log.error("platform.unavailable", error=str(e))
         return 2
-    log.info("jax platform: %s", platform)
+    log.info("platform.resolved", platform=platform)
     enable_compilation_cache()
     # NEMO_TRACE=<file> makes the sidecar write its OWN Perfetto trace at
     # shutdown; traced clients additionally collect per-RPC spans in-band
     # either way (obs/trace.py).
     if obs_trace.configure_from_env() is not None:
-        log.info("obs tracing -> %s", obs.tracer().path)
+        log.info("trace.enabled", path=obs.tracer().path)
     if args.profiler_port:
         import jax
 
         jax.profiler.start_server(args.profiler_port)
-        log.info("jax profiler server on port %d", args.profiler_port)
+        log.info("profiler.listening", port=args.profiler_port)
+    metrics_httpd = None
+    if args.metrics_port:
+        from nemo_tpu.obs import promexp
+
+        metrics_httpd, mport = promexp.start_http_server(
+            args.metrics_port, health=_health_state
+        )
+        log.info("metrics.listening", port=mport, paths=["/metrics", "/healthz"])
     server, port = make_server(args.port, args.max_workers)
     server.start()
-    log.info("sidecar listening on 127.0.0.1:%d", port)
-    server.wait_for_termination()
+    log.info("sidecar.listening", port=port)
+    try:
+        server.wait_for_termination()
+    finally:
+        if metrics_httpd is not None:
+            metrics_httpd.shutdown()
     return 0
 
 

@@ -28,6 +28,8 @@ import os
 import subprocess
 import sys
 
+from nemo_tpu.obs import log as _obs_log
+
 #: Platform names that mean "use the environment's default selection".
 _DEFAULT_NAMES = ("", "auto", "tpu", "axon", "default")
 
@@ -63,7 +65,9 @@ def probe_default_platform(
     with it."""
     import time
 
-    log = log or (lambda msg: print(msg, file=sys.stderr, flush=True))
+    log = log or (lambda msg: _obs_log.get_logger("nemo.platform").warning(
+        "platform.probe", detail=msg
+    ))
     code = (
         "import jax, json;"
         "d = jax.devices();"
@@ -115,7 +119,9 @@ def ensure_platform(
     NEMO_PROBE_TIMEOUT / NEMO_PROBE_RETRIES (watchdog knobs).
     Returns the platform this process will use.
     """
-    log = log or (lambda msg: print(msg, file=sys.stderr, flush=True))
+    log = log or (lambda msg: _obs_log.get_logger("nemo.platform").warning(
+        "platform.probe", detail=msg
+    ))
     req = (requested or os.environ.get("NEMO_PLATFORM") or "auto").lower()
     if req not in _DEFAULT_NAMES and req != "cpu":
         # A concrete non-TPU platform (cuda, rocm, ...): trust the caller.

@@ -248,6 +248,183 @@ def _check_trace(out: str, tid: str, expect_rpc: bool) -> int:
     return 0
 
 
+def obs_smoke() -> int:
+    """Operational-observability smoke (`make obs-smoke`, also the tail of
+    `make validate`): boot a sidecar SUBPROCESS with `--metrics-port`,
+    drive a real RPC workload through it (a tiny traced pipeline on the
+    ServiceBackend, so Kernel RPCs dispatch server-side), then
+
+      * scrape `/metrics` and assert valid Prometheus text format with the
+        known series present — kernel dispatch/compile counters, the
+        FLOPs/bytes cost gauges, and a server-side RPC latency histogram
+        whose cumulative buckets are monotone with `+Inf` == `_count`;
+      * scrape `/healthz` and assert it mirrors the gRPC Health state;
+      * assert the sidecar's structured JSON log (NEMO_LOG_FILE) contains
+        a record carrying the client's propagated trace id.
+    """
+    import importlib.util
+    import socket
+    import subprocess
+    import sys as _sys
+    import time as _time
+    import urllib.request
+
+    from nemo_tpu.obs import trace as obs_trace
+    from nemo_tpu.utils.jax_config import pin_platform
+
+    if importlib.util.find_spec("grpc") is None:
+        print(
+            "obs-smoke: grpcio not installed; skipping (the smoke's whole "
+            "surface is the sidecar)",
+            file=sys.stderr,
+        )
+        return 0
+    pin_platform("cpu")
+    with tempfile.TemporaryDirectory(prefix="nemo_obs_smoke_") as tmp:
+        os.environ["NEMO_SVG_CACHE"] = os.path.join(tmp, "svg_cache")
+        log_path = os.path.join(tmp, "sidecar_log.jsonl")
+
+        def free_port() -> int:
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                return s.getsockname()[1]
+
+        port, mport = free_port(), free_port()
+        env = dict(os.environ, NEMO_LOG_FILE=log_path, NEMO_LOG_LEVEL="debug")
+        env.pop("NEMO_TRACE", None)
+        # The smoke's assertions need the Kernel-RPC route and the cost
+        # capture: an operator's own NEMO_ANALYSIS_IMPL=sparse (client-side
+        # routing, no Kernel RPCs) or NEMO_COST_ANALYSIS=0 (no FLOPs
+        # gauges) must not fail `make validate` on a healthy tree.  Pinned
+        # in the sidecar env AND (saved/restored) in this process, which
+        # hosts the ServiceBackend client.
+        for knob in ("NEMO_ANALYSIS_IMPL", "NEMO_COST_ANALYSIS"):
+            env.pop(knob, None)
+        prior_knobs = {
+            k: os.environ.pop(k, None)
+            for k in ("NEMO_ANALYSIS_IMPL", "NEMO_COST_ANALYSIS")
+        }
+        sidecar_log = os.path.join(tmp, "sidecar.stderr")
+        log_fh = open(sidecar_log, "w")
+        proc = subprocess.Popen(
+            [_sys.executable, "-m", "nemo_tpu.service.server",
+             "--port", str(port), "--platform", "cpu",
+             "--metrics-port", str(mport)],
+            stdout=log_fh,
+            stderr=subprocess.STDOUT,
+            env=env,
+        )
+        t = obs_trace.start_trace(os.path.join(tmp, "trace.json"))
+        tid = t.trace_id
+        problems: list[str] = []
+        try:
+            # Same listening-socket gate as trace_smoke: this environment's
+            # grpc wedges channels whose first connect raced the bind.
+            deadline = _time.monotonic() + 120.0
+            while True:
+                try:
+                    socket.create_connection(("127.0.0.1", port), 2.0).close()
+                    break
+                except OSError:
+                    if _time.monotonic() > deadline or proc.poll() is not None:
+                        raise RuntimeError(
+                            f"sidecar never listened on port {port} (rc={proc.poll()})"
+                        )
+                    _time.sleep(0.5)
+
+            from nemo_tpu.analysis.pipeline import run_debug
+            from nemo_tpu.backend.service_backend import ServiceBackend
+            from nemo_tpu.models.case_studies import write_case_study
+
+            corpus = write_case_study(
+                "pb_asynchronous", n_runs=4, seed=7, out_dir=os.path.join(tmp, "corp")
+            )
+            run_debug(
+                corpus, os.path.join(tmp, "results"), ServiceBackend(),
+                conn=f"127.0.0.1:{port}", figures="none",
+            )
+
+            from nemo_tpu.obs import promexp
+
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{mport}/metrics", timeout=15
+            ) as resp:
+                text = resp.read().decode("utf-8")
+            fams = promexp.parse_prometheus_text(text)  # raises on bad lines
+            for series in (
+                "nemo_serve_kernel_calls_total",
+                "nemo_kernel_dispatches_fused_total",
+                "nemo_kernel_compiles_total",
+            ):
+                if series not in fams:
+                    problems.append(f"/metrics missing series {series}")
+            if not any(f.startswith("nemo_kernel_cost_flops") for f in fams):
+                problems.append("/metrics has no kernel FLOPs cost gauge")
+            hist = fams.get("nemo_serve_rpc_latency_s_Kernel")
+            if hist is None:
+                problems.append("/metrics has no server-side Kernel RPC latency histogram")
+            else:
+                buckets = [v for n, _, v in hist["samples"] if n.endswith("_bucket")]
+                count = [v for n, _, v in hist["samples"] if n.endswith("_count")]
+                if buckets != sorted(buckets):
+                    problems.append("Kernel latency histogram buckets not monotone")
+                if not count or buckets[-1] != count[0]:
+                    problems.append("Kernel latency histogram +Inf bucket != count")
+
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{mport}/healthz", timeout=15
+            ) as resp:
+                health = json.loads(resp.read().decode("utf-8"))
+            if health.get("status") != "SERVING" or health.get("platform") != "cpu":
+                problems.append(f"/healthz does not mirror Health state: {health}")
+
+            correlated = []
+            if os.path.exists(log_path):
+                with open(log_path, "r", encoding="utf-8") as fh:
+                    for line in fh:
+                        try:
+                            rec = json.loads(line)
+                        except json.JSONDecodeError:
+                            problems.append(f"unparseable sidecar log line: {line!r}")
+                            break
+                        if rec.get("trace_id") == tid:
+                            correlated.append(rec)
+            if not correlated:
+                problems.append(
+                    "no sidecar structured log record carries the propagated trace id"
+                )
+        except Exception as ex:
+            if os.path.exists(sidecar_log):
+                with open(sidecar_log, "r", encoding="utf-8") as fh:
+                    print(
+                        "obs-smoke: sidecar log tail:\n" + fh.read()[-3000:],
+                        file=sys.stderr,
+                    )
+            print(f"obs-smoke: {type(ex).__name__}: {ex}", file=sys.stderr)
+            return 1
+        finally:
+            for k, v in prior_knobs.items():
+                if v is not None:
+                    os.environ[k] = v
+            obs_trace.finish()
+            proc.terminate()
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=15)
+            log_fh.close()
+        if problems:
+            print("obs-smoke: " + "; ".join(problems), file=sys.stderr)
+            return 1
+        print(
+            f"obs-smoke: ok — {len(fams)} metric families scraped, healthz "
+            f"SERVING, {len(correlated)} sidecar log record(s) correlated to "
+            f"trace id {tid}"
+        )
+        return 0
+
+
 def main() -> int:
     from nemo_tpu.analysis.pipeline import run_debug
     from nemo_tpu.backend.jax_backend import JaxBackend
@@ -387,13 +564,21 @@ def main() -> int:
             "sparse/dense analysis routes byte-identical with every verb's "
             "route recorded)"
         )
-    # The observability smoke rides the same validate path: a traced
+    # The observability smokes ride the same validate path: a traced
     # two-family run must produce a loadable Perfetto trace with the three
-    # promised span categories (also standalone: make trace-smoke).
-    return trace_smoke()
+    # promised span categories (also standalone: make trace-smoke), and
+    # the operational smoke must scrape a live sidecar's /metrics +
+    # /healthz and find a trace-correlated structured log record (also
+    # standalone: make obs-smoke).
+    rc = trace_smoke()
+    if rc:
+        return rc
+    return obs_smoke()
 
 
 if __name__ == "__main__":
     if "--trace-smoke" in sys.argv:
         sys.exit(trace_smoke())
+    if "--obs-smoke" in sys.argv:
+        sys.exit(obs_smoke())
     sys.exit(main())

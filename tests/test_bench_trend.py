@@ -1,0 +1,135 @@
+"""Regression sentinel (tools/bench_trend.py): metric extraction,
+direction-aware verdicts on synthetic histories, the BENCH_rNN wrapper
+shape, platform isolation, and the real repo capture as its own baseline."""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import bench_trend  # noqa: E402
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _bench_doc(value=10000.0, warm_wall=7.0, sparse=12, dense=0) -> dict:
+    return {
+        "metric": "graphs/s",
+        "value": value,
+        "platform": "cpu",
+        "peak_rss_mb": 1100.0,
+        "p50_diff_ms": 0.2,
+        "e2e": {
+            "fresh_cold": {"wall_s": 9.0},
+            "cached_cold": {"wall_s": 8.0},
+            "warm": {
+                "wall_s": warm_wall,
+                "phases_s": {"ingest": 0.5, "load_raw_provenance": 5.0},
+                "analysis_routes": {"fused.sparse": sparse, "fused.dense": dense},
+            },
+        },
+    }
+
+
+def _write(tmp_path, name: str, doc: dict) -> str:
+    p = str(tmp_path / name)
+    with open(p, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh)
+    return p
+
+
+def _run(tmp_path, candidate: dict, history: list[dict], extra=()) -> int:
+    hist_dir = tmp_path / "hist"
+    hist_dir.mkdir(exist_ok=True)
+    for i, doc in enumerate(history):
+        _write(hist_dir, f"{i:03d}_x.json", doc)
+    cand = _write(tmp_path, "candidate.json", candidate)
+    return bench_trend.main(
+        [cand, "--history-dir", str(hist_dir), "--no-append", *extra]
+    )
+
+
+def test_metric_extraction_directions():
+    m = bench_trend.extract_metrics(_bench_doc())
+    assert m["graphs_per_sec"] == (10000.0, "higher", "ratio")
+    assert m["e2e.warm.wall_s"] == (7.0, "lower", "s")
+    assert m["e2e.warm.phase.ingest_s"][1] == "lower"
+    assert m["route.fused.sparse_fraction"] == (1.0, "split", "ratio")
+
+
+def test_no_regression_on_equal_and_better(tmp_path):
+    base = _bench_doc()
+    assert _run(tmp_path, copy.deepcopy(base), [base] * 3) == 0
+    better = _bench_doc(value=15000.0, warm_wall=4.0)
+    assert _run(tmp_path, better, [base] * 3) == 0
+
+
+def test_throughput_regression_flags(tmp_path):
+    degraded = _bench_doc(value=5000.0)  # -50% graphs/s
+    assert _run(tmp_path, degraded, [_bench_doc()] * 3) == 1
+
+
+def test_wall_regression_flags_and_respects_abs_floor(tmp_path):
+    slow = _bench_doc(warm_wall=21.0)  # 3x the trailing median
+    assert _run(tmp_path, slow, [_bench_doc()] * 3) == 1
+    # A 3x blowup of a 100 ms phase is under the 0.5 s absolute floor —
+    # timer noise, not a verdict.
+    noisy = _bench_doc()
+    noisy["e2e"]["warm"]["phases_s"]["ingest"] = 0.3  # vs 0.5 median: under floor
+    base = _bench_doc()
+    base["e2e"]["warm"]["phases_s"]["ingest"] = 0.1
+    assert _run(tmp_path, noisy, [base] * 3) == 0
+
+
+def test_route_split_flip_flags_both_directions(tmp_path):
+    flipped = _bench_doc(sparse=0, dense=12)  # sparse fraction 1.0 -> 0.0
+    assert _run(tmp_path, flipped, [_bench_doc()] * 3) == 1
+
+
+def test_platform_mismatch_never_compares(tmp_path):
+    tpu = _bench_doc(value=300000.0)
+    tpu["platform"] = "tpu"
+    # The only history is another platform: no verdict, pass with a note.
+    assert _run(tmp_path, _bench_doc(value=100.0), [tpu] * 3) == 0
+
+
+def test_errored_history_skipped(tmp_path):
+    bad = {"platform": "cpu", "error": "child timed out", "value": None}
+    assert _run(tmp_path, _bench_doc(), [bad]) == 0
+
+
+def test_wrapper_shape_accepted(tmp_path):
+    wrapped = {"n": 5, "rc": 0, "parsed": _bench_doc()}
+    degraded = {"parsed": _bench_doc(value=4000.0)}
+    assert _run(tmp_path, degraded, [wrapped] * 2) == 1
+
+
+def test_append_records_candidate(tmp_path):
+    hist = tmp_path / "hist"
+    cand = _write(tmp_path, "candidate.json", _bench_doc())
+    assert bench_trend.main([cand, "--history-dir", str(hist)]) == 0
+    assert len(list(hist.glob("*.json"))) == 1
+    # Next run compares against the recorded entry.
+    degraded = _write(tmp_path, "degraded.json", _bench_doc(value=2000.0))
+    assert bench_trend.main([degraded, "--history-dir", str(hist)]) == 1
+
+
+@pytest.mark.skipif(
+    not os.path.exists(os.path.join(REPO_ROOT, "BENCH_r05.json")),
+    reason="repo capture not present",
+)
+def test_real_capture_is_its_own_baseline(tmp_path):
+    """The acceptance pair: the repo's real r05 capture judged against
+    itself must pass — the sentinel's floor must not page on noise-free
+    identity."""
+    r05 = os.path.join(REPO_ROOT, "BENCH_r05.json")
+    rc = bench_trend.main(
+        [r05, "--baseline", r05, "--history-dir", str(tmp_path / "h"), "--no-append"]
+    )
+    assert rc == 0

@@ -273,9 +273,41 @@ def test_metrics_counters_gauges_histograms():
     assert snap["counters"] == {"a": 3}
     assert snap["gauges"] == {"g": 7.5}
     h = snap["histograms"]["h"]
+    buckets = h.pop("buckets")
     assert h == {"count": 3, "sum": 6.0, "min": 1.0, "max": 3.0, "mean": 2.0}
+    # Cumulative bucket counts (Prometheus le semantics: inclusive upper
+    # bounds), monotone, trimmed once every observation is covered.
+    assert buckets == sorted(buckets)
+    counts = [c for _, c in buckets]
+    assert counts == sorted(counts)
+    assert counts[-1] == 3
+    by_le = dict((le, c) for le, c in buckets)
+    assert by_le[1.0] == 1  # le is inclusive
+    assert by_le[2.5] == 2
     # Snapshot is JSON-able as-is (the Health RPC ships it verbatim).
     json.dumps(snap)
+
+
+def test_metrics_cardinality_cap():
+    """A long-lived sidecar under adversarial series names stays bounded:
+    past max_series new names drop (counted), existing series keep
+    updating."""
+    m = obs.Metrics(max_series=3)
+    m.inc("keep.a")
+    m.gauge("keep.g", 1.0)
+    m.observe("keep.h", 2.0)
+    for i in range(50):
+        m.inc(f"adversarial.{i}")
+        m.gauge(f"adversarial.g{i}", i)
+        m.observe(f"adversarial.h{i}", i)
+    m.inc("keep.a", 9)  # established series still updates
+    m.observe("keep.h", 4.0)
+    snap = m.snapshot()
+    assert snap["counters"]["keep.a"] == 10
+    assert snap["counters"]["metrics.dropped_series"] == 150
+    assert set(snap["gauges"]) == {"keep.g"}
+    assert set(snap["histograms"]) == {"keep.h"}
+    assert snap["histograms"]["keep.h"]["count"] == 2
 
 
 def test_metrics_delta():
@@ -359,3 +391,140 @@ def test_rpc_retry_counted_in_metrics():
     assert d.get("rpc.retries") == 1  # retries - 1 sleeps before the final raise
     assert d.get("rpc.errors") == 1
     assert d.get("rpc.backoff_s") == pytest.approx(0.2)
+
+
+# ------------------------------------------------------------- structured log
+
+
+def _log_records(path: str) -> list[dict]:
+    with open(path, "r", encoding="utf-8") as fh:
+        return [json.loads(line) for line in fh if line.strip()]
+
+
+def test_structured_log_json_lines_and_levels(tmp_path, monkeypatch):
+    """obs.log emits one JSON record per line with the stable keys, filters
+    by NEMO_LOG_LEVEL, and appends to NEMO_LOG_FILE."""
+    from nemo_tpu.obs import log as obs_log
+
+    path = str(tmp_path / "log.jsonl")
+    monkeypatch.setenv("NEMO_LOG_FILE", path)
+    monkeypatch.setenv("NEMO_LOG_LEVEL", "info")
+    lg = obs_log.get_logger("nemo.test")
+    lg.debug("filtered.out", x=1)
+    lg.warning("kept.event", detail="hello", n=3)
+    recs = _log_records(path)
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["level"] == "warning"
+    assert rec["logger"] == "nemo.test"
+    assert rec["event"] == "kept.event"
+    assert rec["n"] == 3
+    assert rec["pid"] == os.getpid()
+    assert "trace_id" not in rec  # untraced process
+    monkeypatch.setenv("NEMO_LOG_LEVEL", "debug")
+    assert obs_log.level_enabled("debug")
+    lg.debug("now.kept")
+    assert [r["event"] for r in _log_records(path)] == ["kept.event", "now.kept"]
+
+
+def test_structured_log_carries_active_trace_id(tmp_path, monkeypatch, traced):
+    from nemo_tpu.obs import log as obs_log
+
+    tracer, _ = traced
+    path = str(tmp_path / "log.jsonl")
+    monkeypatch.setenv("NEMO_LOG_FILE", path)
+    obs_log.get_logger("nemo.test").warning("traced.event")
+    # An explicit trace_id field wins over the active tracer's (the sidecar
+    # logs the CLIENT's propagated id, not its own collector's).
+    obs_log.get_logger("nemo.test").warning("explicit.event", trace_id="deadbeef")
+    recs = _log_records(path)
+    assert recs[0]["trace_id"] == tracer.trace_id
+    assert recs[1]["trace_id"] == "deadbeef"
+
+
+def test_render_worker_log_record_correlates_to_trace(tmp_path, monkeypatch, traced):
+    """A spawn render-pool worker's structured debug record carries the
+    submitting process's trace id (ISSUE 4 satellite) — the worker has no
+    tracer, the id travels with the job."""
+    from nemo_tpu.report.dot import DotGraph
+    from nemo_tpu.report.render import RenderScheduler, SvgCache
+
+    tracer, _ = traced
+    path = str(tmp_path / "log.jsonl")
+    monkeypatch.setenv("NEMO_LOG_FILE", path)
+    monkeypatch.setenv("NEMO_LOG_LEVEL", "debug")
+
+    g = DotGraph(name="t")
+    g.add_node("a", {"label": "goal", "shape": "ellipse"})
+    g.add_node("b", {"label": "rule", "shape": "rect"})
+    g.add_edge("a", "b", {"color": "black"})
+    sched = RenderScheduler(workers=2, cache=SvgCache(root=""))
+    try:
+        sched.submit(g, str(tmp_path / "a.svg"))
+        sched.drain()
+    finally:
+        sched.close()
+    workers = [
+        r
+        for r in _log_records(path)
+        if r["event"] == "render.worker" and r["pid"] != os.getpid()
+    ]
+    assert workers, "no structured log record from a spawn render worker"
+    assert workers[0]["trace_id"] == tracer.trace_id
+    assert workers[0]["nodes"] == 2
+
+
+# ------------------------------------------- kernel cost accounting + watchdog
+
+
+def test_kernel_cost_accounting_and_slow_dispatch_watchdog(
+    tmp_path, monkeypatch, corpus_dir
+):
+    """One dense-routed pipeline run exercises the whole cost-accounting
+    path: per-signature FLOPs/bytes + compile walls in the cost table and
+    metrics, memory watermarks gauged, telemetry.json carrying the
+    kernel_cost and memory sections, and the slow-dispatch watchdog firing
+    (threshold pinned to 1 ms) with a structured record naming the verb,
+    bucket shape, and upload bytes."""
+    from nemo_tpu import backend as _  # noqa: F401 (package import order)
+    from nemo_tpu.analysis.pipeline import run_debug
+    from nemo_tpu.backend import jax_backend as jb
+
+    path = str(tmp_path / "log.jsonl")
+    monkeypatch.setenv("NEMO_LOG_FILE", path)
+    monkeypatch.setenv("NEMO_ANALYSIS_IMPL", "dense")  # force executor dispatches
+    monkeypatch.setenv("NEMO_SLOW_DISPATCH_MS", "1")
+    before = obs.metrics.snapshot()
+    res = run_debug(corpus_dir, str(tmp_path / "res"), jb.JaxBackend(), figures="none")
+    d = obs.Metrics.delta(obs.metrics.snapshot(), before)["counters"]
+
+    # Cost table: at least the fused signature, with estimates + a wall.
+    costs = jb.kernel_cost_snapshot()
+    fused = [r for r in costs if r["verb"] == "fused"]
+    assert fused, f"no fused signature in the cost table: {costs}"
+    assert fused[0]["dispatches"] >= 1
+    assert fused[0]["first_dispatch_s"] > 0
+    assert fused[0]["flops"] is None or fused[0]["flops"] > 0
+    if fused[0]["flops"] is not None:
+        assert d.get("kernel.cost.flops", 0) > 0
+
+    # Memory watermarks: host RSS always; gauged in the registry.
+    mem = jb.sample_memory_watermarks()
+    assert mem["host_peak_rss_bytes"] > 0
+    assert obs.metrics.snapshot()["gauges"]["mem.host_peak_rss_bytes"] > 0
+
+    # Watchdog: 1 ms threshold -> every dispatch is "slow"; the record
+    # carries verb + shape + upload bytes.
+    assert d.get("watchdog.slow_kernel", 0) >= 1
+    slow = [r for r in _log_records(path) if r["event"] == "kernel.slow_dispatch"]
+    assert slow, "watchdog fired per metrics but logged no record"
+    assert slow[0]["verb"] in jb.LocalExecutor.VERBS
+    assert slow[0]["upload_bytes"] > 0
+    assert slow[0]["wall_ms"] > 1
+
+    # telemetry.json gains the cost + memory sections (and stays excluded
+    # from byte parity via NONDETERMINISTIC_REPORT_FILES).
+    with open(os.path.join(res.report_dir, "telemetry.json"), encoding="utf-8") as fh:
+        doc = json.load(fh)
+    assert doc["memory"]["host_peak_rss_bytes"] > 0
+    assert any(r["verb"] == "fused" for r in doc["kernel_cost"])

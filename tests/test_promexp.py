@@ -1,0 +1,182 @@
+"""Prometheus exposition (nemo_tpu/obs/promexp.py): text-format
+conformance, histogram bucket semantics, the HTTP endpoint lifecycle, and
+the sidecar's --metrics-port + /healthz surface."""
+
+from __future__ import annotations
+
+import json
+import re
+import urllib.error
+import urllib.request
+
+import pytest
+
+from nemo_tpu import obs
+from nemo_tpu.obs import promexp
+
+# Exposition-format line grammar (format 0.0.4): comments, or
+# name[{labels}] value — the conformance floor every scraper assumes.
+_LINE = re.compile(
+    r"^(#.*"
+    r"|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z0-9_]+=\"[^\"]*\"(,[a-zA-Z0-9_]+=\"[^\"]*\")*\})?"
+    r" [0-9eE.+\-]+)$"
+)
+
+
+def _filled_registry() -> obs.Metrics:
+    m = obs.Metrics()
+    m.inc("kernel.dispatches.fused", 7)
+    m.inc("rpc.bytes_sent", 12345.0)
+    m.gauge("kernel.cost.flops.fused", 1.5e9)
+    for v in (0.002, 0.004, 0.05, 3.0, 3.0, 250.0):
+        m.observe("rpc.latency_s.Kernel", v)
+    return m
+
+
+def test_every_line_conforms_and_round_trips():
+    snap = _filled_registry().snapshot()
+    text = promexp.render_prometheus(snap)
+    for line in text.splitlines():
+        assert _LINE.match(line), f"nonconformant exposition line: {line!r}"
+    fams = promexp.parse_prometheus_text(text)
+    # Counters: _total suffix, exact values.
+    (name, labels, value), = fams["nemo_kernel_dispatches_fused_total"]["samples"]
+    assert (name, labels, value) == ("nemo_kernel_dispatches_fused_total", {}, 7.0)
+    assert fams["nemo_kernel_dispatches_fused_total"]["type"] == "counter"
+    # Gauges: bare name.
+    (_, _, gv), = fams["nemo_kernel_cost_flops_fused"]["samples"]
+    assert gv == 1.5e9
+    assert fams["nemo_kernel_cost_flops_fused"]["type"] == "gauge"
+
+
+def test_histogram_buckets_cumulative_monotone_and_complete():
+    snap = _filled_registry().snapshot()
+    fams = promexp.parse_prometheus_text(promexp.render_prometheus(snap))
+    hist = fams["nemo_rpc_latency_s_Kernel"]
+    assert hist["type"] == "histogram"
+    buckets = [(l["le"], v) for n, l, v in hist["samples"] if n.endswith("_bucket")]
+    counts = [v for _, v in buckets]
+    # Cumulative monotone nondecreasing, ending at +Inf == _count.
+    assert counts == sorted(counts)
+    assert buckets[-1][0] == "+Inf"
+    # The FULL fixed ladder is exposed every scrape (the snapshot's trimmed
+    # tail is re-extended): otherwise new _bucket series would be born
+    # mid-stream when a slower observation lands and Prometheus quantiles
+    # over the appearance window would mis-read the jump.
+    assert len(buckets) == len(obs.HIST_BUCKETS) + 1
+    (count,) = [v for n, _, v in hist["samples"] if n.endswith("_count")]
+    (total,) = [v for n, _, v in hist["samples"] if n.endswith("_sum")]
+    assert buckets[-1][1] == count == 6
+    assert total == pytest.approx(0.002 + 0.004 + 0.05 + 3.0 + 3.0 + 250.0)
+    # le bounds are inclusive: the two 3.0 observations land at le=5 but
+    # only one of the smaller ones at le=0.0025.
+    by_le = {le: v for le, v in buckets}
+    assert by_le["0.0025"] == 1
+    assert by_le["5"] == 5
+
+
+def test_name_sanitization_and_collision_safety():
+    assert promexp.sanitize_name("a.b-c d/e") == "nemo_a_b_c_d_e"
+    m = obs.Metrics()
+    m.inc("x.y")
+    m.inc("x-y")  # sanitizes identically: renderer must emit ONE family
+    text = promexp.render_prometheus(m.snapshot())
+    assert text.count("# TYPE nemo_x_y_total counter") == 1
+    promexp.parse_prometheus_text(text)  # still parses
+
+
+def test_http_server_lifecycle():
+    """/metrics + /healthz served from a daemon thread; unknown paths 404;
+    shutdown releases the port."""
+    httpd, port = promexp.start_http_server(0, health=lambda: {"status": "SERVING", "x": 1})
+    try:
+        obs.metrics.inc("promexp.test.counter")
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+            assert r.status == 200
+            assert "version=0.0.4" in r.headers["Content-Type"]
+            text = r.read().decode("utf-8")
+        fams = promexp.parse_prometheus_text(text)
+        assert "nemo_promexp_test_counter_total" in fams
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
+            doc = json.loads(r.read().decode("utf-8"))
+        assert doc == {"status": "SERVING", "x": 1}
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/nope", timeout=10)
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_healthz_degrades_to_503_not_serving_on_health_error():
+    """A dead health callable must fail the STATUS CODE too: k8s/LB probes
+    key on it, not on the body."""
+
+    def bad_health():
+        raise RuntimeError("device gone")
+
+    httpd, port = promexp.start_http_server(0, health=bad_health)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz", timeout=10)
+        assert exc.value.code == 503
+        doc = json.loads(exc.value.read().decode("utf-8"))
+        assert doc["status"] == "NOT_SERVING"
+        assert "device gone" in doc["error"]
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_sidecar_metrics_port_lifecycle(sidecar, corpus_dir):
+    """The sidecar's operational surface in-process: gRPC server + the
+    metrics HTTP thread wired to the same health state.  After a driven
+    RPC the scrape must show the serve-side series (the full subprocess
+    version of this lives in `make obs-smoke`)."""
+    pytest.importorskip("grpc")
+    from nemo_tpu.ingest.molly import load_molly_output
+    from nemo_tpu.models.pipeline_model import pack_molly_for_step
+    from nemo_tpu.service.client import RemoteAnalyzer
+    from nemo_tpu.service.server import _health_state
+
+    httpd, port = promexp.start_http_server(0, health=_health_state)
+    try:
+        pre, post, static = pack_molly_for_step(load_molly_output(corpus_dir))
+        with RemoteAnalyzer(target=sidecar) as client:
+            client.wait_ready()
+            client.analyze(pre, post, static)
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+            fams = promexp.parse_prometheus_text(r.read().decode("utf-8"))
+        # The in-process sidecar fixture shares this registry: the Analyze
+        # RPC's serve-side counters and latency histogram must scrape.
+        assert "nemo_serve_analyze_chunks_total" in fams
+        assert fams["nemo_serve_rpc_latency_s_Analyze"]["type"] == "histogram"
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
+            health = json.loads(r.read().decode("utf-8"))
+        assert health["status"] == "SERVING"
+        assert health["platform"] == "cpu"
+        assert health["device_count"] >= 1
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_cli_metrics_out_one_shot(tmp_path, corpus_dir):
+    """`--metrics-out FILE` dumps the registry in Prometheus text after a
+    run — the one-shot twin of the sidecar's /metrics."""
+    from nemo_tpu.cli import main
+
+    out = tmp_path / "metrics.prom"
+    rc = main(
+        [
+            "-faultInjOut", corpus_dir,
+            "--graph-backend", "jax",
+            "--results-dir", str(tmp_path / "res"),
+            "--figures", "none",
+            "--metrics-out", str(out),
+        ]
+    )
+    assert rc == 0
+    text = out.read_text(encoding="utf-8")
+    fams = promexp.parse_prometheus_text(text)  # conformant
+    # The jax-backend run records its routed dispatches; they must scrape.
+    assert any(f.startswith("nemo_analysis_route_fused") for f in fams)

@@ -1,0 +1,287 @@
+"""Bench regression sentinel: `python tools/bench_trend.py BENCH.json`.
+
+The standing capture loop (`make bench-watch`) records BENCH jsons but
+nothing READS them — a commit that regresses the hot path ships unnoticed
+until someone eyeballs two captures.  This tool closes the loop:
+
+  1. append the candidate BENCH json to a history directory
+     (``--history-dir``, default ``bench_watch/history`` in the repo);
+  2. compare it against the trailing **median** per metric over the most
+     recent ``--window`` same-platform history entries (medians because a
+     contended host makes single captures weather, and same-platform
+     because a CPU-fallback capture says nothing about a TPU trend);
+  3. exit nonzero past the regression threshold (default 25% relative,
+     with per-unit absolute floors so sub-noise walls can't flag).
+
+Metrics compared (direction-aware; anything missing on either side skips):
+
+  * ``value`` (graphs/s, higher is better), ``oracle`` ratio untouched;
+  * e2e tier walls (``fresh_cold``/``cached_cold``/``warm``) and the warm
+    tier's per-phase walls (lower is better);
+  * latency rows (``p50_diff_ms``), the giant warm wall, peak RSS;
+  * **analysis route splits**: the sparse fraction of each verb's routed
+    dispatches in the warm tier — a route FLIP on the same platform is
+    exactly the silent regression the crossover machinery can produce, so
+    any shift past the threshold (absolute) flags in either direction.
+
+Accepts both raw bench result lines and the repo's ``BENCH_rNN.json``
+wrapper shape (``{"parsed": {...}}``).  Entries whose result carries an
+``error`` field never enter a comparison.
+
+Exit codes: 0 ok (or insufficient history — says so), 1 regression
+detected, 2 usage/input error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: (relative threshold multiplier key, absolute floor) per metric family —
+#: a wall must move by both the relative threshold AND the floor to flag,
+#: so timer noise on sub-second phases can't page anyone.
+ABS_FLOORS = {
+    "s": 0.5,  # seconds-scale walls
+    "ms": 0.05,  # millisecond latencies
+    "mb": 64.0,  # RSS megabytes
+    "ratio": 0.0,  # unitless rates/ratios: relative threshold only
+}
+
+
+def load_bench(path: str) -> dict:
+    with open(path, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]  # the BENCH_rNN.json capture wrapper
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: not a bench result object")
+    return doc
+
+
+def extract_metrics(doc: dict) -> dict[str, tuple[float, str, str]]:
+    """Bench doc -> {metric name: (value, direction, unit)} where direction
+    is 'higher' / 'lower' / 'split' (absolute-shift comparison)."""
+    out: dict[str, tuple[float, str, str]] = {}
+
+    def put(name: str, value, direction: str, unit: str) -> None:
+        if isinstance(value, (int, float)) and not isinstance(value, bool):
+            out[name] = (float(value), direction, unit)
+
+    put("graphs_per_sec", doc.get("value"), "higher", "ratio")
+    put("p50_diff_ms", doc.get("p50_diff_ms"), "lower", "ms")
+    put("peak_rss_mb", doc.get("peak_rss_mb"), "lower", "mb")
+    giant = doc.get("giant") or {}
+    put("giant.warm_s", giant.get("warm_s"), "lower", "s")
+    tier = doc.get("analysis_tier") or {}
+    put("analysis_tier.sparse_sweep_s", tier.get("sparse_sweep_s"), "lower", "s")
+    figures = doc.get("figures") or {}
+    put(
+        "figures.e2e_warm_all_figures_s",
+        figures.get("e2e_warm_all_figures_s"),
+        "lower",
+        "s",
+    )
+    e2e = doc.get("e2e") or {}
+    for tier_name in ("fresh_cold", "cached_cold", "warm"):
+        t = e2e.get(tier_name) or {}
+        put(f"e2e.{tier_name}.wall_s", t.get("wall_s"), "lower", "s")
+    warm = e2e.get("warm") or {}
+    for phase, v in (warm.get("phases_s") or {}).items():
+        put(f"e2e.warm.phase.{phase}_s", v, "lower", "s")
+    # Route splits: sparse fraction per verb of the warm tier's dispatches.
+    routes = warm.get("analysis_routes") or {}
+    by_verb: dict[str, dict[str, float]] = {}
+    for key, n in routes.items():
+        verb, _, route = key.partition(".")
+        if route in ("sparse", "dense"):
+            by_verb.setdefault(verb, {})[route] = float(n)
+    for verb, counts in by_verb.items():
+        total = sum(counts.values())
+        if total:
+            put(
+                f"route.{verb}.sparse_fraction",
+                counts.get("sparse", 0.0) / total,
+                "split",
+                "ratio",
+            )
+    return out
+
+
+def _median(xs: list[float]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    return s[n // 2] if n % 2 else (s[n // 2 - 1] + s[n // 2]) / 2.0
+
+
+def compare(
+    candidate: dict, history: list[dict], threshold: float
+) -> tuple[list[dict], list[dict]]:
+    """Returns (regressions, verdicts) where verdicts covers every metric
+    compared (regression or not) for the report."""
+    cand = extract_metrics(candidate)
+    hists = [extract_metrics(h) for h in history]
+    regressions: list[dict] = []
+    verdicts: list[dict] = []
+    for name, (cv, direction, unit) in sorted(cand.items()):
+        past = [h[name][0] for h in hists if name in h]
+        if not past:
+            continue
+        med = _median(past)
+        floor = ABS_FLOORS.get(unit, 0.0)
+        if direction == "split":
+            # Route splits are fractions in [0,1]: compare the absolute
+            # shift against the threshold directly — a 25% default means a
+            # quarter of the dispatches changed route.
+            delta = abs(cv - med)
+            bad = delta > threshold
+            rel = delta
+        elif direction == "higher":
+            rel = (med - cv) / med if med else 0.0
+            bad = rel > threshold
+        else:  # lower is better
+            rel = (cv - med) / med if med else 0.0
+            bad = rel > threshold and (cv - med) > floor
+        verdict = {
+            "metric": name,
+            "candidate": round(cv, 4),
+            "trailing_median": round(med, 4),
+            "samples": len(past),
+            "direction": direction,
+            "rel_change": round(rel, 4),
+            "regressed": bool(bad),
+        }
+        verdicts.append(verdict)
+        if bad:
+            regressions.append(verdict)
+    return regressions, verdicts
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("candidate", help="BENCH json of the run under test")
+    ap.add_argument(
+        "--history-dir",
+        default=os.path.join(REPO_ROOT, "bench_watch", "history"),
+        help="directory of prior BENCH jsons (default bench_watch/history)",
+    )
+    ap.add_argument(
+        "--baseline",
+        action="append",
+        default=[],
+        metavar="FILE",
+        help="extra history file(s) compared alongside the history dir "
+        "(e.g. a pinned BENCH_rNN.json)",
+    )
+    ap.add_argument(
+        "--threshold", type=float, default=0.25,
+        help="relative regression threshold (default 0.25 = 25%%)",
+    )
+    ap.add_argument(
+        "--window", type=int, default=5,
+        help="trailing same-platform history entries per median (default 5)",
+    )
+    ap.add_argument(
+        "--min-history", type=int, default=1,
+        help="comparisons need at least this many history entries; fewer "
+        "is a pass with a note (default 1)",
+    )
+    ap.add_argument(
+        "--no-append", action="store_true",
+        help="compare only; do not record the candidate into the history dir",
+    )
+    args = ap.parse_args(argv)
+
+    try:
+        candidate = load_bench(args.candidate)
+    except (OSError, ValueError, json.JSONDecodeError) as ex:
+        _log(f"bench-trend: cannot load candidate: {ex}")
+        return 2
+    if candidate.get("error"):
+        _log(f"bench-trend: candidate carries an error field: {candidate['error']!r}; "
+             "nothing to compare")
+        return 2
+    platform = candidate.get("platform")
+
+    history: list[tuple[str, dict]] = []
+    if os.path.isdir(args.history_dir):
+        for f in sorted(os.listdir(args.history_dir)):
+            if not f.endswith(".json"):
+                continue
+            p = os.path.join(args.history_dir, f)
+            if os.path.abspath(p) == os.path.abspath(args.candidate):
+                continue  # re-judging a file already in history: skip self
+            try:
+                history.append((p, load_bench(p)))
+            except (OSError, ValueError, json.JSONDecodeError) as ex:
+                _log(f"bench-trend: skipping unreadable history {p}: {ex}")
+    for p in args.baseline:
+        try:
+            history.append((p, load_bench(p)))
+        except (OSError, ValueError, json.JSONDecodeError) as ex:
+            _log(f"bench-trend: skipping unreadable baseline {p}: {ex}")
+
+    usable = [
+        doc
+        for _, doc in history
+        if not doc.get("error") and doc.get("platform") == platform
+    ]
+    skipped = len(history) - len(usable)
+    if skipped:
+        _log(
+            f"bench-trend: {skipped} history entr{'y' if skipped == 1 else 'ies'} "
+            f"skipped (errored or platform != {platform!r})"
+        )
+    usable = usable[-args.window:]
+
+    rc = 0
+    if len(usable) < args.min_history:
+        _log(
+            f"bench-trend: only {len(usable)} usable same-platform history "
+            f"entr{'y' if len(usable) == 1 else 'ies'} (< {args.min_history}); "
+            "recording without a verdict"
+        )
+        verdict_doc = {"verdict": "no-history", "platform": platform}
+    else:
+        regressions, verdicts = compare(candidate, usable, args.threshold)
+        for v in verdicts:
+            arrow = "REGRESSED" if v["regressed"] else "ok"
+            _log(
+                f"bench-trend: {v['metric']}: {v['candidate']} vs trailing "
+                f"median {v['trailing_median']} over {v['samples']} "
+                f"({v['rel_change']:+.1%}) [{arrow}]"
+            )
+        verdict_doc = {
+            "verdict": "regression" if regressions else "ok",
+            "platform": platform,
+            "threshold": args.threshold,
+            "compared": len(verdicts),
+            "regressions": regressions,
+        }
+        rc = 1 if regressions else 0
+
+    if not args.no_append:
+        os.makedirs(args.history_dir, exist_ok=True)
+        stamp = time.strftime("%Y%m%d_%H%M%S", time.gmtime())
+        dest = os.path.join(
+            args.history_dir, f"{stamp}_{platform or 'unknown'}.json"
+        )
+        if os.path.abspath(args.candidate) != os.path.abspath(dest):
+            shutil.copyfile(args.candidate, dest)
+            verdict_doc["recorded"] = dest
+
+    print(json.dumps(verdict_doc))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,8 +17,13 @@ watcher turns that into a fire-and-forget job:
        <out-dir>/bench.stderr    the bench's full progress stream
        <out-dir>/BENCH.json      the single result line bench.py prints
 
-  3. exit 0 on a captured result, 3 if --max-wait expired with no healthy
-     window (the probe log records what the tunnel did the whole time).
+  3. run the regression sentinel (tools/bench_trend.py) over the capture:
+     the result is appended to <history-dir> (default bench_watch/history)
+     and compared against the trailing same-platform medians — the
+     standing loop now FLAGS regressions instead of just recording them;
+  4. exit 0 on a clean captured result, 2 if the sentinel flagged a
+     regression, 3 if --max-wait expired with no healthy window (the
+     probe log records what the tunnel did the whole time).
 
 Run it under nohup/tmux before walking away:
 
@@ -60,6 +65,11 @@ def main() -> int:
                     help="override NEMO_BENCH_RUNS for the capture")
     ap.add_argument("--once", action="store_true",
                     help="probe exactly once, then run or exit 3 (for tests/cron)")
+    ap.add_argument("--history-dir", default=None,
+                    help="bench_trend history directory (default "
+                    "bench_watch/history); 'off' skips the sentinel")
+    ap.add_argument("--trend-threshold", type=float, default=0.25,
+                    help="bench_trend relative regression threshold (default 0.25)")
     args = ap.parse_args()
 
     out_dir = args.out_dir or os.path.join(
@@ -133,7 +143,36 @@ def main() -> int:
         f"captured (rc={proc.returncode}, probed {healthy['platform']}): "
         f"{json.dumps(summary)} -> {result_path}"
     )
-    return 0 if proc.returncode == 0 and "error" not in summary else 1
+    if proc.returncode != 0 or "error" in summary:
+        return 1
+
+    # Regression sentinel: append this capture to the trailing history and
+    # compare against the per-metric medians; a flagged regression turns
+    # the watcher's exit code to 2 so the cron/tmux wrapper can page.
+    if args.history_dir == "off":
+        return 0
+    history_dir = args.history_dir or os.path.join(REPO_ROOT, "bench_watch", "history")
+    trend = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO_ROOT, "tools", "bench_trend.py"),
+            result_path,
+            "--history-dir", history_dir,
+            "--threshold", str(args.trend_threshold),
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        cwd=REPO_ROOT,
+    )
+    tail = (trend.stdout or "").strip().splitlines()
+    plog(f"bench-trend (rc={trend.returncode}): {tail[-1] if tail else '<no output>'}")
+    with open(os.path.join(out_dir, "trend.txt"), "w", encoding="utf-8") as fh:
+        fh.write(trend.stdout or "")
+    if trend.returncode == 1:
+        return 2  # regression flagged
+    # A sentinel usage/input error must not read as "no regression".
+    return 0 if trend.returncode == 0 else 1
 
 
 if __name__ == "__main__":

@@ -1,0 +1,92 @@
+"""Lint: no bare ``print(`` in ``nemo_tpu/`` outside the allowlist.
+
+The library's operational output contract is structured JSON-lines logging
+(nemo_tpu/obs/log.py) — leveled, machine-parseable, trace-correlated.  A
+bare ``print()`` in a library layer silently reverts that contract, so this
+lint (part of ``make validate``) fails the build on any real print CALL
+(ast-based: string literals and comments containing "print(" never flag)
+outside:
+
+  * the CLI entry points, whose human-facing stdout IS their interface;
+  * the validate/prewarm harnesses (operator-facing one-shot tools);
+  * lines carrying a ``# lint: allow-print`` pragma (e.g. the log sink's
+    own stderr write).
+
+Usage: python tools/lint_no_print.py [root]   (default: repo's nemo_tpu/)
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+#: Paths (relative to the package root) whose stdout/stderr prints are the
+#: interface: CLI entry points and operator-facing one-shot harnesses.
+ALLOWLIST = {
+    "cli.py",
+    "dedalus/__main__.py",
+    "utils/prewarm.py",
+    "utils/validate_smoke.py",
+}
+
+PRAGMA = "# lint: allow-print"
+
+
+def check_file(path: str, rel: str) -> list[str]:
+    with open(path, "r", encoding="utf-8") as fh:
+        source = fh.read()
+    try:
+        tree = ast.parse(source, filename=rel)
+    except SyntaxError as ex:
+        return [f"{rel}:{ex.lineno}: unparseable: {ex.msg}"]
+    lines = source.splitlines()
+    problems = []
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "print"
+        ):
+            line = lines[node.lineno - 1] if node.lineno - 1 < len(lines) else ""
+            if PRAGMA in line:
+                continue
+            problems.append(
+                f"{rel}:{node.lineno}: bare print() — use nemo_tpu.obs.log "
+                f"(or add '{PRAGMA}' if this file IS a CLI surface)"
+            )
+    return problems
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    root = args[0] if args else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "nemo_tpu"
+    )
+    problems: list[str] = []
+    n_checked = 0
+    for dirpath, dirnames, files in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for f in sorted(files):
+            if not f.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, f)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            if rel in ALLOWLIST:
+                continue
+            n_checked += 1
+            problems.extend(check_file(path, rel))
+    for p in problems:
+        print(p, file=sys.stderr)
+    if problems:
+        print(
+            f"lint-no-print: {len(problems)} bare print call(s) in "
+            f"{root}", file=sys.stderr,
+        )
+        return 1
+    print(f"lint-no-print: ok ({n_checked} files clean)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
