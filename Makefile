@@ -5,7 +5,7 @@ NATIVE_LIB := native/build/libnemo_native.so
 REPORT_SRC := native/nemo_report.cpp
 REPORT_LIB := native/build/libnemo_report.so
 
-.PHONY: all native test bench bench-watch bench-trend prewarm validate trace-smoke obs-smoke lint-print clean reset proto neo4j-up neo4j-validate neo4j-down
+.PHONY: all native test bench bench-watch bench-trend prewarm validate trace-smoke obs-smoke store-smoke lint-print clean reset proto neo4j-up neo4j-validate neo4j-down
 
 all: native
 
@@ -45,6 +45,14 @@ trace-smoke:
 # propagated trace id (nemo_tpu/obs/promexp.py, obs/log.py).
 obs-smoke:
 	python -m nemo_tpu.utils.validate_smoke --obs-smoke
+
+# Corpus-store smoke (also the tail of `make validate`): cold-populate the
+# persistent .npack store through a real pipeline run, warm-load it and
+# byte-compare the full report tree against a store-off run, then corrupt a
+# shard and assert the load rejects it loudly while the report stays
+# byte-identical (nemo_tpu/store).
+store-smoke:
+	python -m nemo_tpu.utils.validate_smoke --store-smoke
 
 # Structured-logging contract: no bare print() in nemo_tpu/ outside the
 # CLI/harness allowlist (tools/lint_no_print.py).

@@ -222,6 +222,12 @@ def child_main() -> None:
     # NEMO_SVG_CACHE still wins).  The all-figures section below swaps in
     # its own cold/warm cache dirs.
     os.environ.setdefault("NEMO_SVG_CACHE", os.path.join(tmp, "svg_cache_e2e"))
+    # Same hermeticity for the persistent corpus store (nemo_tpu/store): the
+    # bench must not warm-start from (or pollute) the user's ~/.cache corpus
+    # cache.  The e2e tiers run WITH this store — the production ingest path
+    # — so pass 1 parses + populates and later passes mmap-load, with the
+    # per-tier store counters recorded alongside the analysis routes.
+    os.environ.setdefault("NEMO_CORPUS_CACHE", os.path.join(tmp, "corpus_cache"))
     # Whether the fused dispatch narrows its upload dtypes ON THIS RUN
     # (platform-gated; ADVICE r5 #2): the recorded upload volume must
     # describe the bytes the benched dispatches actually shipped.
@@ -312,6 +318,57 @@ def child_main() -> None:
         f"stress corpus: {len(family_batches)} families, {total_runs} distinct runs, "
         f"{graphs} graphs (gen {t_gen:.1f}s, pack {t_pack:.1f}s, untimed)"
     )
+
+    # Ingest tier (ISSUE 5): cold JSON parse vs warm memory-mapped store
+    # load of the biggest family, plus the store's size on disk — the
+    # headline evidence for the .npack corpus store (nemo_tpu/store).  A
+    # DEDICATED store root keeps this tier from pre-warming the shared
+    # corpus cache the e2e tiers run against (their pass-1 populate must
+    # stay representative).
+    ingest_tier = None
+    try:
+        from nemo_tpu.ingest.molly import load_molly_output as _lmo
+        from nemo_tpu.ingest.native import (
+            load_molly_output_packed as _lmop,
+            native_available as _nat_avail,
+        )
+        from nemo_tpu.store import CorpusStore, store_size_bytes
+
+        tier_dir = big_dirs[0][1]
+        loader = "native" if _nat_avail() else "python"
+        t0 = time.perf_counter()
+        tier_molly = _lmop(tier_dir) if _nat_avail() else _lmo(tier_dir)
+        cold_parse_s = time.perf_counter() - t0
+        tier_store = CorpusStore(os.path.join(tmp, "ingest_tier_store"))
+        t0 = time.perf_counter()
+        if not tier_store.put(tier_dir, tier_molly):
+            raise RuntimeError("store populate failed")
+        populate_s = time.perf_counter() - t0
+        del tier_molly
+        warm_times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            warm = tier_store.load_packed(tier_dir)
+            warm_times.append(time.perf_counter() - t0)
+            if warm is None:
+                raise RuntimeError("warm store load missed")
+            del warm
+        warm_load_s = float(np.median(warm_times))
+        store_bytes = store_size_bytes(tier_store.store_dir(tier_dir))
+        ingest_tier = {
+            "family": big_dirs[0][0],
+            "runs": per_family,
+            "loader": loader,
+            "cold_parse_s": round(cold_parse_s, 3),
+            "store_populate_s": round(populate_s, 3),
+            "warm_load_s": round(warm_load_s, 4),
+            "warm_speedup": round(cold_parse_s / warm_load_s, 1),
+            "store_mb": round(store_bytes / 1e6, 1),
+            "runs_per_s_warm": round(per_family / warm_load_s, 1),
+        }
+        log(f"ingest tier (cold parse vs warm store load): {json.dumps(ingest_tier)}")
+    except Exception as ex:  # the ingest tier must never sink the bench
+        log(f"ingest tier skipped: {type(ex).__name__}: {ex}")
 
     # Warm up (one compile per family's shape signature), then time the full
     # sweep end to end.  Every timed dispatch gets DISTINCT input bytes (a
@@ -681,6 +738,15 @@ def child_main() -> None:
                     for k, v in sorted(mc.items())
                     if k.startswith("analysis.route.")
                 },
+                # Corpus-store traffic this pass (ISSUE 5): pass 1 should
+                # show misses + populates, later passes pure hits — a
+                # regression here means the store stopped serving the e2e
+                # ingest path.
+                "store": {
+                    k[len("store."):]: int(v)
+                    for k, v in sorted(mc.items())
+                    if k.startswith("store.")
+                },
             }
             if label == "fresh_cold":
                 e2e[label]["compiled_programs"] = len(os.listdir(fresh_cache))
@@ -973,6 +1039,11 @@ def child_main() -> None:
                         for k, v in sorted(mc10.items())
                         if k.startswith("analysis.route.")
                     },
+                    "store": {
+                        k[len("store."):]: int(v)
+                        for k, v in sorted(mc10.items())
+                        if k.startswith("store.")
+                    },
                 }
                 log(f"10x stress [{label}]: {json.dumps(stress_10x[label])}")
             shutil.rmtree(os.path.join(tmp, "big10x"), ignore_errors=True)
@@ -1011,6 +1082,7 @@ def child_main() -> None:
         "giant": giant,
         "figures": figures,
         "analysis_tier": analysis_tier,
+        "ingest_tier": ingest_tier,
         "stress_10x": stress_10x,
         # Whole-process obs registry at bench end: the scattered per-layer
         # counters (kernel dispatch/compile split, upload bytes, render

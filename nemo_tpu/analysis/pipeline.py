@@ -193,23 +193,31 @@ def select_figure_iters(
     return [i for i in iters if i in sel]
 
 
-def _choose_packed_ingest(backend: GraphBackend, save_corpus_path: str | None) -> bool:
+def _choose_packed_ingest(
+    backend: GraphBackend, save_corpus_path: str | None, store=None
+) -> bool:
     """Auto ingest policy: the packed-first loader (C++ ETL, RawProv
     placeholders) applies when the backend consumes packed arrays directly
     and nothing downstream needs the Python provenance object tree
-    (--save-corpus packs from ProvData, so it pins the object loader)."""
+    (--save-corpus packs from ProvData, so it pins the object loader).
+    An enabled corpus store also qualifies on lib-less hosts: a warm
+    ``.npack`` load is packed arrays with no C++ involvement, and a cold
+    one parses via the object loader and POPULATES, so the next run is
+    warm (nemo_tpu/store)."""
     if not getattr(backend, "supports_packed_ingest", False) or save_corpus_path:
         return False
     from nemo_tpu.ingest.native import native_available
 
-    return native_available()
+    return native_available() or store is not None
 
 
-def _resolve_ingest_mode(backend, ingest: str, save_corpus_path=None) -> bool:
+def _resolve_ingest_mode(
+    backend, ingest: str, save_corpus_path=None, store=None
+) -> bool:
     """ingest mode -> use_packed, with validation (single definition shared
     by run_debug and run_debug_dirs so the policy cannot drift)."""
     if ingest == "auto":
-        return _choose_packed_ingest(backend, save_corpus_path)
+        return _choose_packed_ingest(backend, save_corpus_path, store)
     if ingest == "native":
         if not getattr(backend, "supports_packed_ingest", False):
             raise ValueError(
@@ -221,18 +229,81 @@ def _resolve_ingest_mode(backend, ingest: str, save_corpus_path=None) -> bool:
                 "ingest='native' is incompatible with --save-corpus "
                 "(corpus bundling packs from the Python object tree)"
             )
+        from nemo_tpu.ingest.native import native_available, native_error
+
+        if not native_available():
+            # Fail fast HERE: _ingest's store-miss branch would otherwise
+            # silently serve the pure-Python loader, a different ETL than
+            # the one explicitly pinned.
+            raise RuntimeError(
+                f"ingest='native' requested but the native library is "
+                f"unavailable: {native_error()}"
+            )
         return True
     if ingest == "python":
         return False
     raise ValueError(f"unknown ingest mode {ingest!r} (expected auto, native, python)")
 
 
-def _ingest(fault_inj_out: str, use_packed: bool):
+def _ingest(fault_inj_out: str, use_packed: bool, store=None, consult_store=True):
+    """One corpus directory -> MollyOutput.  On the packed path the corpus
+    store is consulted FIRST: a warm hit mmaps the persisted arrays +
+    serialized strings in milliseconds (nemo_tpu/store — growing
+    directories are appended to incrementally); a miss/stale/corrupt store
+    falls back loudly to the parse path and repopulates, so the next
+    invocation hits.  The object path (oracle backends, --save-corpus)
+    never touches the store.  ``consult_store=False`` skips straight to
+    parse+populate — for callers that already took (and counted) the miss
+    themselves (the sidecar's AnalyzeDir after a load_corpus miss)."""
+    if use_packed and store is not None and consult_store:
+        molly = store.load_packed(fault_inj_out)
+        if molly is not None:
+            return molly
     if use_packed:
-        from nemo_tpu.ingest.native import load_molly_output_packed
+        from nemo_tpu.ingest.native import load_molly_output_packed, native_available
 
-        return load_molly_output_packed(fault_inj_out)
+        # Snapshot BEFORE parsing: a file mutated while the parse runs must
+        # mismatch the fingerprint the populate stores, so the NEXT load
+        # re-parses instead of serving a HIT over mixed content.
+        snap = store.snapshot(fault_inj_out) if store is not None else None
+        if native_available():
+            molly = load_molly_output_packed(fault_inj_out)
+        else:
+            # Lib-less host (or a corrupt store that just fell back): the
+            # object loader serves any backend, and the populate below
+            # makes the next run a warm mmap load.
+            molly = load_molly_output(fault_inj_out)
+        if store is not None:
+            store.put(fault_inj_out, molly, snapshot=snap)
+        return molly
     return load_molly_output(fault_inj_out)
+
+
+def _attach_ingest_dir(ex: BaseException, d: str) -> BaseException:
+    """Annotate an ingest exception with the corpus directory it came from
+    (in-place, preserving the exception type): the first string arg gets the
+    suffix, or — for arg shapes like OSError's (errno, strerror) — the first
+    string among the args; exceptions with no string arg gain one."""
+    note = f"(while ingesting {d})"
+    if isinstance(ex, OSError) and isinstance(getattr(ex, "strerror", None), str):
+        # OSError renders from .strerror (captured at construction), not
+        # from args — annotate the attribute str() actually shows.
+        if note not in ex.strerror:
+            ex.strerror = f"{ex.strerror} {note}"
+        return ex
+    args = list(ex.args)
+    for i, a in enumerate(args):
+        if isinstance(a, str):
+            if note not in a:
+                args[i] = f"{a} {note}"
+            break
+    else:
+        args.append(note)
+    try:
+        ex.args = tuple(args)
+    except Exception:
+        pass  # exotic exception types keep their args; attribution best-effort
+    return ex
 
 
 def run_debug_dirs(
@@ -281,6 +352,10 @@ def run_debug_dirs(
 
     prefetch = prefetch and effective_cpu_count() > 1
 
+    from nemo_tpu.store import resolve_store
+
+    store = resolve_store(kwargs.get("corpus_cache"))
+
     if kwargs.get("save_corpus_path"):
         raise ValueError(
             "save_corpus_path is not supported by run_debug_dirs: kwargs are "
@@ -305,7 +380,8 @@ def run_debug_dirs(
     # where the sequential loop is O(1)).  The probe instance only answers
     # the ingest-mode policy.
     use_packed = _resolve_ingest_mode(
-        make_backend(), kwargs.get("ingest", "auto"), kwargs.get("save_corpus_path")
+        make_backend(), kwargs.get("ingest", "auto"), kwargs.get("save_corpus_path"),
+        store,
     )
 
     results: list[DebugResult] = []
@@ -317,9 +393,13 @@ def run_debug_dirs(
             # the prefetch thread's track, riding under the previous
             # corpus's analysis phases on the main thread.
             with obs.span("ingest:prefetch", dir=os.path.basename(d)):
-                prefetched[0] = _ingest(d, use_packed)
+                prefetched[0] = _ingest(d, use_packed, store)
         except BaseException as ex:  # re-raised on the consuming thread
-            prefetched[1] = ex
+            # A bare re-raise on the consumer loses WHICH directory failed —
+            # with several corpora in flight that made multi-corpus failures
+            # unattributable; pin the dir into the message here, where it is
+            # known.
+            prefetched[1] = _attach_ingest_dir(ex, d)
 
     from nemo_tpu.report.render import RenderScheduler
 
@@ -389,6 +469,7 @@ def run_debug(
     ingest: str = "auto",
     molly=None,
     render_scheduler=None,
+    corpus_cache: str | None = None,
 ) -> DebugResult:
     """Full debug pipeline.  With profile_dir set, the analysis phases run
     under jax.profiler.trace — open the directory with TensorBoard or
@@ -404,8 +485,12 @@ def run_debug(
     drained — the caller overlaps rendering with its own later work and
     drains when ready (run_debug_dirs).  An explicitly passed `reporter`
     whose .scheduler is None keeps the sequential per-figure render loop —
-    the byte-parity oracle path."""
+    the byte-parity oracle path.  `corpus_cache` overrides the persistent
+    corpus store root (NEMO_CORPUS_CACHE; "off" disables) consulted by the
+    packed ingest path."""
     import contextlib
+
+    from nemo_tpu.store import resolve_store
 
     trace_ctx: contextlib.AbstractContextManager = contextlib.nullcontext()
     if profile_dir:
@@ -414,10 +499,11 @@ def run_debug(
         trace_ctx = jax.profiler.trace(profile_dir)
     timer = PhaseTimer()
 
+    store = resolve_store(corpus_cache)
     # Fail fast with the reason, not deep in the pipeline: RawProv
     # placeholders crash object backends/--save-corpus only after the
     # full native ingest already ran.
-    use_packed = _resolve_ingest_mode(backend, ingest, save_corpus_path)
+    use_packed = _resolve_ingest_mode(backend, ingest, save_corpus_path, store)
 
     with timer.phase("ingest"):
         # `molly` pre-supplied: the caller ingested out-of-band (the
@@ -425,7 +511,7 @@ def run_debug(
         # while corpus k analyzes) — the phase records ~0 and the ingest
         # wall lives on the prefetch thread instead of the critical path.
         if molly is None:
-            molly = _ingest(fault_inj_out, use_packed)
+            molly = _ingest(fault_inj_out, use_packed, store)
     if save_corpus_path:
         from nemo_tpu.graphs.corpus import pack_corpus, save_corpus
 

@@ -171,6 +171,17 @@ def main(argv: list[str] | None = None) -> int:
         "re-parsing the Molly output",
     )
     parser.add_argument(
+        "--corpus-cache",
+        default=None,
+        metavar="DIR|off",
+        help="persistent memory-mapped corpus store root (default "
+        "$NEMO_CORPUS_CACHE or ~/.cache/nemo_tpu/corpus; 'off' disables).  "
+        "The packed ingest path parses each Molly directory ONCE and then "
+        "mmap-loads the packed arrays in milliseconds; growing directories "
+        "are appended to incrementally, and any mismatch (fingerprint, "
+        "version, checksum) falls back loudly to the parse path",
+    )
+    parser.add_argument(
         "--ingest",
         default="auto",
         choices=("auto", "native", "python"),
@@ -229,6 +240,8 @@ def main(argv: list[str] | None = None) -> int:
         os.environ["NEMO_RENDER_WORKERS"] = str(args.render_workers)
     if args.svg_cache is not None:
         os.environ["NEMO_SVG_CACHE"] = args.svg_cache
+    if args.corpus_cache is not None:
+        os.environ["NEMO_CORPUS_CACHE"] = args.corpus_cache
     # The tracer is finished in the finally: a pipeline failure must still
     # write the partial trace (a trace of a failed run is exactly when you
     # want one) AND disable the global tracer — main() may run again in

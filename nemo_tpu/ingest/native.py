@@ -476,6 +476,33 @@ def load_molly_output_packed(output_dir: str):
     return out
 
 
+def corpus_step_static(c) -> dict:
+    """analysis_step statics for a whole packed corpus: the shared
+    `static_kwargs` plus the corpus-level comp_linear flag (AND over the
+    per-graph parse-time checks) — the ONE derivation used by
+    pack_molly_dir_host and the sidecar's AnalyzeDir handler."""
+    lin = bool(
+        np.asarray(c.pre.chain_linear).all() and np.asarray(c.post.chain_linear).all()
+    )
+    return dict(c.static_kwargs, comp_linear=lin)
+
+
+def packed_host_available(output_dir: str) -> bool:
+    """Can pack_molly_dir_host serve this directory?  Yes when the native
+    engine builds, or when the corpus store holds a warm hit for it — the
+    mmap load needs no C++ at all, so lib-less client paths
+    (analyze_dir/analyze_dir_pipelined) still get packed ingest whenever
+    the store can serve."""
+    if native_available():
+        return True
+    from nemo_tpu.store import resolve_store
+
+    store = resolve_store()
+    # "grown" qualifies too: load_corpus appends the new runs first (store
+    # maintenance) and then serves warm — the incremental-sweep scenario.
+    return store is not None and store.probe(output_dir) in ("hit", "grown")
+
+
 def pack_molly_dir_host(output_dir: str, timings: dict | None = None):
     """Directory -> (NativeCorpus, static kwargs): the native ETL's host-side
     product — numpy batch arrays plus the analysis_step statics (including
@@ -486,28 +513,58 @@ def pack_molly_dir_host(output_dir: str, timings: dict | None = None):
     cost of deriving the corpus flag — a trivial AND over the per-graph
     flags the C++ engine verified during parse (graph_chain_linear), so a
     near-zero reading means the check's real work rode the parse pass, not
-    that it disappeared.  Either way nothing touches the device."""
+    that it disappeared.  Either way nothing touches the device.
+
+    The persistent corpus store (nemo_tpu/store, NEMO_CORPUS_CACHE) is
+    consulted FIRST via its corpus-only load: a warm hit serves the same
+    corpus arrays by mmap with zero per-run Python work — the
+    analyze_dir/analyze_dir_pipelined client paths share the pipeline's
+    warm ingest.  This path never POPULATES a cold store (it drops the
+    per-run strings a full store needs; the report pipeline and the
+    sidecar's AnalyzeDir handler are the populating producers), though a
+    GROWN directory is appended to first — load-side store maintenance,
+    which takes that store's writer lock for the tail parse.  A miss
+    parses natively as before."""
     import time
 
+    from nemo_tpu.store import resolve_store
+
+    store = resolve_store()
+    if store is not None:
+        c = store.load_corpus(output_dir)
+        if c is not None:
+            if timings is not None:
+                timings["linear_check_s"] = 0.0
+            return c, corpus_step_static(c)
+
+    if not native_available():
+        # Reachable when a probed store hit went stale/corrupt between the
+        # packed_host_available() check and here: fail with the remedy
+        # instead of deep inside ingest_native.
+        raise RuntimeError(
+            f"native ingestion unavailable ({native_error()}) and no warm "
+            f"corpus store for {output_dir}; use the pure-Python loader "
+            "(pack_molly_for_step) or populate the store"
+        )
     c = ingest_native(output_dir, with_node_ids=False)
     t0 = time.perf_counter()
     # Per-graph linearity was verified by the C++ engine at parse time
     # (graph_chain_linear, mirroring ops/simplify.py:chains_linear_host);
     # the corpus-level flag is just the AND over both conditions.
-    lin = bool(c.pre.chain_linear.all() and c.post.chain_linear.all())
+    static = corpus_step_static(c)
     if timings is not None:
         timings["linear_check_s"] = time.perf_counter() - t0
-    static = dict(c.static_kwargs, comp_linear=lin)
     return c, static
 
 
 def pack_molly_dir(output_dir: str, timings: dict | None = None):
     """Directory -> (pre BatchArrays, post BatchArrays, static kwargs) for
-    models.pipeline_model.analysis_step, via the native engine when available
-    and the Python path otherwise.  `timings` passes through to
-    pack_molly_dir_host (no-op on the Python fallback, where the linearity
-    check runs inside pack_molly_for_step)."""
-    if native_available():
+    models.pipeline_model.analysis_step, via the host path when it can
+    serve (native engine OR a warm corpus-store hit — lib-less hosts
+    included) and the pure-Python path otherwise.  `timings` passes through
+    to pack_molly_dir_host (no-op on the Python fallback, where the
+    linearity check runs inside pack_molly_for_step)."""
+    if packed_host_available(output_dir):
         from nemo_tpu.models.pipeline_model import BatchArrays
 
         c, static = pack_molly_dir_host(output_dir, timings=timings)

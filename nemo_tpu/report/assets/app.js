@@ -212,6 +212,30 @@ async function telemetry() {
     );
   }
 
+  // Corpus store traffic (nemo_tpu/store): how this run's ingest was served
+  // — warm mmap hits vs parse-path misses/stale falls, appended segments,
+  // and the bytes mapped from .npack shards.
+  const allCounters = (data.metrics || {}).counters || {};
+  const storeRows = [];
+  for (const [key, label] of [
+    ["store.hit", "warm loads (hit)"],
+    ["store.miss", "parse-path misses"],
+    ["store.stale", "stale/corrupt falls"],
+    ["store.append", "segments appended"],
+    ["store.populate", "stores populated"],
+  ]) {
+    if (allCounters[key]) storeRows.push([label, allCounters[key]]);
+  }
+  if (allCounters["store.bytes_mapped"]) {
+    storeRows.push([
+      "bytes mapped",
+      `${(allCounters["store.bytes_mapped"] / 1e6).toFixed(1)} MB`,
+    ]);
+  }
+  if (storeRows.length) {
+    body.append(telemetryTable("Corpus store", storeRows));
+  }
+
   // Kernel cost accounting (backend/jax_backend.py:kernel_cost_snapshot):
   // one row per dispatch signature — FLOPs / bytes-accessed estimates,
   // the first-dispatch (compile) wall, and how often it dispatched.

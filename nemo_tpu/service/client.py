@@ -127,6 +127,13 @@ class RemoteAnalyzer:
             request_serializer=pb.KernelRequest.SerializeToString,
             response_deserializer=pb.KernelResponse.FromString,
         )
+        # JSON request (a directory path — see server.analyze_dir), standard
+        # AnalyzeResponse back; generic serializers need no protoc.
+        self._analyze_dir = self._channel.unary_unary(
+            f"/{SERVICE}/AnalyzeDir",
+            request_serializer=lambda d: _json.dumps(d).encode("utf-8"),
+            response_deserializer=pb.AnalyzeResponse.FromString,
+        )
 
     def close(self) -> None:
         self._channel.close()
@@ -212,7 +219,11 @@ class RemoteAnalyzer:
                         target=self.target,
                         wall_ms=round(dt * 1000.0, 1),
                         threshold_ms=slow_ms,
-                        request_bytes=request.ByteSize(),
+                        # AnalyzeDir requests are JSON dicts, not protobufs;
+                        # count wire bytes (utf-8), exactly like ByteSize.
+                        request_bytes=request.ByteSize()
+                        if hasattr(request, "ByteSize")
+                        else len(_json.dumps(request).encode("utf-8")),
                         attempt=attempt,
                     )
                 _adopt_remote(call)
@@ -248,6 +259,28 @@ class RemoteAnalyzer:
         req.static.CopyFrom(codec.static_to_pb(static))
         obs.metrics.inc("rpc.bytes_sent", req.ByteSize())
         resp, _ = self._call(self._analyze, req, name="Analyze")
+        obs.metrics.inc("rpc.bytes_received", resp.ByteSize())
+        return codec.outputs_from_pb(resp)
+
+    def analyze_dir_remote(
+        self, molly_dir: str, corpus_cache: str | None = None
+    ) -> dict[str, np.ndarray]:
+        """Server-side corpus analysis: ship only the DIRECTORY PATH; the
+        sidecar ingests (consulting its own persistent corpus store, so
+        repeated sessions over the same corpus mmap-load instead of
+        re-parsing) and runs the fused step.  Requires the path to be
+        readable on the sidecar host — the colocated/shared-volume
+        deployment the sidecar normally runs in.  ``corpus_cache`` can only
+        OPT OUT ("off") for this request; enabling or redirecting the
+        server-side store is the sidecar operator's knob, and any other
+        value is ignored server-side."""
+        import os
+
+        req: dict = {"dir": os.path.abspath(molly_dir)}
+        if corpus_cache is not None:
+            req["corpus_cache"] = corpus_cache
+        obs.metrics.inc("rpc.bytes_sent", len(_json.dumps(req).encode("utf-8")))
+        resp, _ = self._call(self._analyze_dir, req, name="AnalyzeDir")
         obs.metrics.inc("rpc.bytes_received", resp.ByteSize())
         return codec.outputs_from_pb(resp)
 
@@ -723,7 +756,6 @@ def analyze_dir_pipelined(
     from nemo_tpu.graphs.packed import CorpusVocab, pack_graph
     from nemo_tpu.ingest.datatypes import RunData
     from nemo_tpu.ingest.molly import load_run_prov
-    from nemo_tpu.ingest.native import native_available
     from nemo_tpu.models.pipeline_model import graphs_to_step
 
     from nemo_tpu.utils import effective_cpu_count
@@ -744,9 +776,12 @@ def analyze_dir_pipelined(
     chunk_runs = max(1, chunk_runs)
     spans, pad_to = _uniform_spans(n, chunk_runs)
 
-    if native_available():
+    from nemo_tpu.ingest.native import packed_host_available
+
+    if packed_host_available(molly_dir):
         # Packed-first producer: ONE C++ parse of the whole directory (~6x
-        # the Python per-chunk parser's throughput), then chunks are plain
+        # the Python per-chunk parser's throughput) — or, on any host, ONE
+        # mmap load from a warm corpus store — then chunks are plain
         # HOST row slices of the corpus arrays (_chunk_rows — never through
         # the device; the wire wants host bytes anyway).  All chunks share
         # the corpus-wide vocab and bucket AND a uniform batch size

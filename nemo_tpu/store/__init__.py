@@ -1,0 +1,586 @@
+"""Persistent memory-mapped corpus store (the ``.npack`` cache).
+
+Public surface:
+
+  * ``resolve_store(arg)`` — the pipeline/CLI entry: a ``CorpusStore`` over
+    the resolved cache root, or None when disabled (``off``/``NEMO_CORPUS_CACHE``).
+  * ``CorpusStore.load_packed(dir)`` — warm path: mmap the store into a
+    packed MollyOutput (appending new runs first when the directory grew);
+    None on miss/stale/corruption, always loudly.
+  * ``CorpusStore.put(dir, molly)`` — populate from either ingest producer
+    (native packed-first or pure-Python object loader).
+
+Format, fingerprinting, producers and shard IO live in ``npack.py``; the
+mmap reader in ``reader.py``.  See npack's module docstring for the
+on-disk layout and integrity/invalidation rules.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import time
+import uuid
+
+from nemo_tpu import obs
+from nemo_tpu.obs import log as obs_log
+from nemo_tpu.store.npack import (
+    GROWN,
+    HIT,
+    NPACK_ABI_VERSION,
+    NPACK_FORMAT_VERSION,
+    StoreCorrupt,
+    _runs_prefix_sha,
+    _verify_on_load,
+    classify_source,
+    corpus_cache_dir,
+    payload_from_molly,
+    payload_from_runs,
+    snapshot_source,
+    source_from_snapshot,
+    store_workers_default,
+    write_segment,
+    write_vocab,
+)
+
+__all__ = [
+    "CorpusStore",
+    "StoreCorrupt",
+    "NPACK_FORMAT_VERSION",
+    "NPACK_ABI_VERSION",
+    "corpus_cache_dir",
+    "resolve_store",
+    "store_size_bytes",
+]
+
+_log = obs_log.get_logger("nemo.store")
+
+
+def resolve_store(arg: str | None = None) -> "CorpusStore | None":
+    root = corpus_cache_dir(arg)
+    return CorpusStore(root) if root else None
+
+
+def store_size_bytes(store_dir: str) -> int:
+    """On-disk bytes of one .npack store (every file, stray tmp included) —
+    the single size measure shared by eviction and the bench's ingest tier."""
+    return sum(
+        os.path.getsize(os.path.join(dp, f))
+        for dp, _, fs in os.walk(store_dir)
+        for f in fs
+    )
+
+
+def _max_store_bytes() -> int:
+    """Cache-root size cap (bytes): ``NEMO_STORE_MAX_GB`` (default 16; 0 /
+    junk disables).  A corpus store mirrors whole corpora — arrays plus
+    every serialized string — so unlike the jit/SVG caches it needs
+    eviction: throwaway generated corpora would otherwise accumulate
+    orphaned stores forever under the default-on ~/.cache root."""
+    env = os.environ.get("NEMO_STORE_MAX_GB", "").strip()
+    try:
+        gb = float(env) if env else 16.0
+    except ValueError:
+        gb = 0.0
+    return int(gb * 1e9) if gb > 0 else 0
+
+
+class _Lock:
+    """fcntl advisory lock serializing writers of ONE store (the lock file
+    sits beside its .npack directory, so corpora never serialize each
+    other); no-op where fcntl is unavailable (non-POSIX)."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._fh = None
+
+    def __enter__(self):
+        try:
+            import fcntl
+
+            self._fh = open(self.path, "w")
+            fcntl.flock(self._fh, fcntl.LOCK_EX)
+        except (ImportError, OSError):
+            self._fh = None
+        return self
+
+    def __exit__(self, *exc):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+class CorpusStore:
+    """One cache root holding ``.npack`` stores keyed by source realpath."""
+
+    def __init__(self, root: str) -> None:
+        self.root = root
+
+    # ------------------------------------------------------------- plumbing
+
+    def store_dir(self, corpus_dir: str) -> str:
+        real = os.path.realpath(corpus_dir)
+        key = hashlib.sha256(real.encode()).hexdigest()[:12]
+        # Basename from the REALPATH, like the hash: a symlink alias must
+        # map to the same store, not a second full mirror of the corpus.
+        base = os.path.basename(real) or "corpus"
+        safe = "".join(c if c.isalnum() or c in "._-" else "_" for c in base)[:64]
+        return os.path.join(self.root, f"{safe}-{key}.npack")
+
+    def _lock(self, store_dir: str) -> _Lock:
+        os.makedirs(self.root, exist_ok=True)
+        return _Lock(f"{store_dir}.lock")
+
+    #: _read_header sentinel: a store EXISTS but cannot be trusted —
+    #: written by another format/ABI generation, or its header is
+    #: unreadable/corrupt.  Stale, not miss: a fleet-wide version bump (or
+    #: disk corruption) must be visible in the metrics as invalidation,
+    #: not cold caches.
+    _HEADER_UNTRUSTED = object()
+
+    def _read_header(self, store_dir: str):
+        """dict, None (no store at all), or _HEADER_UNTRUSTED."""
+        path = os.path.join(store_dir, "header.json")
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                header = json.load(fh)
+        except FileNotFoundError:
+            return None
+        except (OSError, ValueError) as ex:
+            _log.warning(
+                "store.header_unreadable",
+                store=store_dir,
+                error=f"{type(ex).__name__}: {ex}",
+                detail="treating the store as stale; the next populate "
+                "replaces it",
+            )
+            return self._HEADER_UNTRUSTED
+        if (
+            header.get("format") != NPACK_FORMAT_VERSION
+            or header.get("abi") != NPACK_ABI_VERSION
+        ):
+            _log.warning(
+                "store.version_mismatch",
+                store=store_dir,
+                format=header.get("format"),
+                abi=header.get("abi"),
+                expected_format=NPACK_FORMAT_VERSION,
+                expected_abi=NPACK_ABI_VERSION,
+            )
+            return self._HEADER_UNTRUSTED
+        return header
+
+    # ---------------------------------------------------------------- probe
+
+    def probe(self, corpus_dir: str) -> str:
+        """'hit' / 'grown' / 'stale' / 'miss' without mapping any shard —
+        the cheap check ingest-mode resolution uses on lib-less hosts."""
+        header = self._read_header(self.store_dir(corpus_dir))
+        if header is None:
+            return "miss"
+        if header is self._HEADER_UNTRUSTED:
+            return "stale"
+        return classify_source(header, corpus_dir)
+
+    # ----------------------------------------------------------------- load
+
+    def load_packed(self, corpus_dir: str):
+        """Warm load: a packed MollyOutput served from the store, or None
+        (miss / stale / corrupt — counted and logged, never raised: the
+        caller falls back to the parse path)."""
+        return self._load(corpus_dir, build_molly=True)
+
+    def load_corpus(self, corpus_dir: str):
+        """Warm load of JUST the packed corpus (a StoreCorpus / NativeCorpus
+        duck), skipping the per-run MollyOutput construction — for callers
+        that only dispatch arrays (pack_molly_dir_host, the analyze_dir
+        producers), so a 100k-run warm pack pays zero per-run Python work.
+        Same miss/stale semantics and metrics as load_packed."""
+        return self._load(corpus_dir, build_molly=False)
+
+    def _load(self, corpus_dir: str, build_molly: bool):
+        from nemo_tpu.store.reader import build_corpus, molly_from_corpus, open_segments
+
+        store_dir = self.store_dir(corpus_dir)
+        t0 = time.perf_counter()
+        with obs.span("ingest:store_load", dir=os.path.basename(corpus_dir)):
+            header = self._read_header(store_dir)
+            if header is None:
+                obs.metrics.inc("store.miss")
+                return None
+            if header is self._HEADER_UNTRUSTED:
+                obs.metrics.inc("store.stale")
+                return None
+            state = classify_source(header, corpus_dir)
+            if state == GROWN:
+                header = self._append(store_dir, header, corpus_dir)
+                if header is None:
+                    obs.metrics.inc("store.stale")
+                    return None
+                state = HIT
+            if state != HIT:
+                obs.metrics.inc("store.stale")
+                _log.warning(
+                    "store.stale",
+                    store=store_dir,
+                    corpus=corpus_dir,
+                    detail="source fingerprint changed; falling back to the parse path",
+                )
+                return None
+            try:
+                seg_readers, vocab_rd, mapped = open_segments(
+                    store_dir, header, verify=_verify_on_load()
+                )
+                corpus = build_corpus(store_dir, header, seg_readers, vocab_rd)
+                out = (
+                    molly_from_corpus(corpus, corpus_dir) if build_molly else corpus
+                )
+            except (StoreCorrupt, OSError, ValueError, KeyError) as ex:
+                obs.metrics.inc("store.stale")
+                _log.error(
+                    "store.corrupt",
+                    store=store_dir,
+                    corpus=corpus_dir,
+                    error=f"{type(ex).__name__}: {ex}",
+                    detail="falling back to the parse path; the next populate "
+                    "overwrites the bad store",
+                )
+                return None
+            obs.metrics.inc("store.hit")
+            obs.metrics.inc("store.bytes_mapped", mapped)
+            obs.metrics.observe("store.load_s", time.perf_counter() - t0)
+            try:
+                # Last-use stamp for the size-cap eviction: loads only READ,
+                # so without this a hot store looks as cold as an orphan.
+                os.utime(os.path.join(store_dir, "header.json"))
+            except OSError:
+                pass
+            _log.info(
+                "store.hit",
+                corpus=corpus_dir,
+                runs=corpus.n_runs,
+                segments=len(header["segments"]),
+                mapped_mb=round(mapped / 1e6, 1),
+                seconds=round(time.perf_counter() - t0, 3),
+            )
+            return out
+
+    # ------------------------------------------------------------- populate
+
+    def snapshot(self, corpus_dir: str) -> dict:
+        """Pre-parse source snapshot: callers that are about to PARSE the
+        directory take one first and hand it to :meth:`put`, so a file
+        mutated during the (minutes-long at scale) parse mismatches the
+        stored fingerprint on the next load instead of being served as a
+        HIT."""
+        return snapshot_source(corpus_dir)
+
+    def put(self, corpus_dir: str, molly, snapshot: dict | None = None) -> bool:
+        """Populate (or replace) the store for ``corpus_dir`` from a parsed
+        MollyOutput — packed-first (native) or object-loader (Python), both
+        producers yield bit-compatible stores.  ``snapshot`` is the
+        pre-parse :meth:`snapshot` (taken now when omitted — fine when the
+        directory cannot have changed since the parse).  Returns False
+        (logged) on any failure: populating is always best-effort."""
+        try:
+            return self._put(corpus_dir, molly, snapshot)
+        except Exception as ex:  # a cache write must never sink the pipeline
+            obs.metrics.inc("store.write_failed")
+            _log.warning(
+                "store.write_failed",
+                corpus=corpus_dir,
+                error=f"{type(ex).__name__}: {ex}",
+            )
+            return False
+
+    def _put(self, corpus_dir: str, molly, snapshot: dict | None = None) -> bool:
+        if not molly.runs:
+            return False
+        t0 = time.perf_counter()
+        workers = store_workers_default()
+        with obs.span("ingest:store_populate", dir=os.path.basename(corpus_dir)):
+            payload = payload_from_molly(molly)
+            source = source_from_snapshot(
+                snapshot or snapshot_source(corpus_dir), payload.n_runs
+            )
+            source["dir"] = os.path.realpath(corpus_dir)
+            final = self.store_dir(corpus_dir)
+            tmp = f"{final}.tmp-{os.getpid()}-{uuid.uuid4().hex[:8]}"
+            os.makedirs(tmp, exist_ok=True)
+            try:
+                seg_entry = write_segment(os.path.join(tmp, "seg-000"), payload, workers)
+                vshard = write_vocab(
+                    os.path.join(tmp, "vocab-0001.bin"), _VocabView(payload.vocab)
+                )
+                header = {
+                    "format": NPACK_FORMAT_VERSION,
+                    "abi": NPACK_ABI_VERSION,
+                    "source": source,
+                    "pre_tid": 0,
+                    "post_tid": 1,
+                    "vocab_shard": vshard,
+                    "segments": [seg_entry],
+                }
+                with open(os.path.join(tmp, "header.json"), "w", encoding="utf-8") as fh:
+                    json.dump(header, fh, indent=1)
+                with self._lock(final):
+                    doomed = None
+                    if os.path.isdir(final):
+                        doomed = f"{final}.doomed-{uuid.uuid4().hex[:8]}"
+                        os.rename(final, doomed)
+                    os.rename(tmp, final)
+                if doomed:
+                    shutil.rmtree(doomed, ignore_errors=True)
+            except BaseException:
+                shutil.rmtree(tmp, ignore_errors=True)
+                raise
+            self._evict_over_cap(keep=final)
+        obs.metrics.inc("store.populate")
+        _log.info(
+            "store.populated",
+            corpus=corpus_dir,
+            runs=payload.n_runs,
+            store=final,
+            seconds=round(time.perf_counter() - t0, 2),
+        )
+        return True
+
+    # ------------------------------------------------------------- eviction
+
+    #: Crash leftovers (`*.npack.tmp-*` populate dirs, `*.npack.doomed-*`
+    #: replace victims) older than this are swept at populate time; younger
+    #: ones may belong to a LIVE concurrent populate and are left alone.
+    _WRECKAGE_MAX_AGE_S = 3600.0
+
+    def _evict_over_cap(self, keep: str) -> None:
+        """Bound the cache root at NEMO_STORE_MAX_GB: when the .npack
+        directories exceed the cap, evict least-recently-USED stores
+        (header.json mtime — stamped on every hit) until under, never the
+        one just written.  Aged crash leftovers (interrupted populates /
+        replaces, which the '.npack' filter below would never see) are
+        swept first regardless of the cap.  Best effort; called at
+        populate time, the only moment the root grows.  Lock FILES are
+        never swept: deleting one a live writer holds open would hand the
+        next opener a fresh inode and break the mutual exclusion."""
+        try:
+            now = time.time()
+
+            def sweep(path: str) -> None:
+                try:
+                    if now - os.path.getmtime(path) < self._WRECKAGE_MAX_AGE_S:
+                        return
+                except OSError:
+                    return
+                try:
+                    (shutil.rmtree if os.path.isdir(path) else os.remove)(path)
+                except OSError:
+                    return
+                obs.metrics.inc("store.gc_wreckage")
+                _log.info("store.gc_wreckage", path=path)
+
+            for name in os.listdir(self.root):
+                path = os.path.join(self.root, name)
+                if ".npack.tmp-" in name or ".npack.doomed-" in name:
+                    sweep(path)
+                elif name.endswith(".npack"):
+                    # Interrupted APPENDS leave leftovers INSIDE a store:
+                    # seg-NNN.tmp-* segment dirs and header.json.tmp-*.
+                    try:
+                        inner = os.listdir(path)
+                    except OSError:
+                        continue
+                    for child in inner:
+                        if ".tmp-" in child:
+                            sweep(os.path.join(path, child))
+        except OSError:
+            pass
+        cap = _max_store_bytes()
+        if not cap:
+            return
+        try:
+            stores = []
+            for name in os.listdir(self.root):
+                if not name.endswith(".npack"):
+                    continue
+                path = os.path.join(self.root, name)
+                size = store_size_bytes(path)
+                try:
+                    used = os.path.getmtime(os.path.join(path, "header.json"))
+                except OSError:
+                    used = 0.0  # headerless wreckage evicts first
+                stores.append((used, size, path))
+            total = sum(s for _, s, _ in stores)
+            if total <= cap:
+                return
+            for used, size, path in sorted(stores):
+                if total <= cap:
+                    break
+                if os.path.abspath(path) == os.path.abspath(keep):
+                    continue
+                shutil.rmtree(path, ignore_errors=True)
+                total -= size
+                obs.metrics.inc("store.evicted")
+                _log.info(
+                    "store.evicted", store=path, freed_mb=round(size / 1e6, 1),
+                    cap_gb=round(cap / 1e9, 1),
+                )
+        except OSError as ex:
+            _log.warning("store.evict_failed", root=self.root, error=str(ex))
+
+    # --------------------------------------------------------------- append
+
+    def _append(self, store_dir: str, header: dict, corpus_dir: str) -> dict | None:
+        """The corpus directory GREW (incremental sweep): pack only the new
+        runs (pure-Python loader, positions >= n_stored) against the stored
+        vocabulary and publish them as a fresh segment.  Returns the new
+        header, or None when the old entries cannot be confirmed unchanged
+        (the caller then treats the store as stale)."""
+        try:
+            return self._append_locked(store_dir, header, corpus_dir)
+        except Exception as ex:
+            obs.metrics.inc("store.append_failed")
+            _log.warning(
+                "store.append_failed",
+                corpus=corpus_dir,
+                error=f"{type(ex).__name__}: {ex}",
+            )
+            return None
+
+    def _append_locked(self, store_dir: str, header, corpus_dir: str) -> dict | None:
+        from nemo_tpu.graphs.packed import CorpusVocab
+        from nemo_tpu.ingest.datatypes import RunData
+        from nemo_tpu.ingest.molly import load_run_prov
+        from nemo_tpu.store.reader import open_segments
+
+        with self._lock(store_dir), obs.span(
+            "ingest:store_append", dir=os.path.basename(corpus_dir)
+        ):
+            # Re-read under the lock: a concurrent appender may have won.
+            header = self._read_header(store_dir)
+            if not isinstance(header, dict):
+                return None
+            state = classify_source(header, corpus_dir)
+            if state == HIT:
+                return header
+            if state != GROWN:
+                return None
+            src = header["source"]
+            n_old = int(src["n_runs"])
+            # Snapshot BEFORE parsing anything: a file mutated while the
+            # tail parse below runs then mismatches the fingerprint this
+            # append publishes, so the NEXT load re-parses (fail-safe).
+            snap = snapshot_source(corpus_dir)
+            with open(os.path.join(corpus_dir, "runs.json"), "r", encoding="utf-8") as fh:
+                raw_runs = json.load(fh)
+            if len(raw_runs) <= n_old:
+                return None
+            # Old-entry confirmation: prefer the strong byte-prefix check (a
+            # stable serializer keeps the first n entries' bytes identical).
+            # Otherwise compare the baked-in iteration/status of EVERY old
+            # entry against the stored arrays, plus the full serialized head
+            # fragment (failureSpec/model/messages included) of a bounded
+            # <=64-entry spread — so a bulk rewrite of old entries cannot
+            # splice stale heads; a single mutated unsampled entry with
+            # stable iteration/status is outside the bounded budget, like
+            # the fingerprint sample (npack.py docstring).  The per-run
+            # provenance FILES are fingerprinted individually either way.
+            strong = src.get("runs_prefix_sha") and _runs_prefix_sha(
+                corpus_dir, (src.get("runs_json") or [0])[0]
+            ) == src.get("runs_prefix_sha")
+            seg_readers, vocab_rd, _ = open_segments(store_dir, header, verify=False)
+            if not strong:
+                from nemo_tpu.ingest.datatypes import RunData as _RunData
+                from nemo_tpu.store.npack import _head_bytes
+                from nemo_tpu.store.reader import build_corpus
+
+                old = build_corpus(store_dir, header, seg_readers, vocab_rd)
+
+                def refused(row: int, why: str) -> None:
+                    _log.warning(
+                        "store.append_refused", corpus=corpus_dir, row=row,
+                        detail=why,
+                    )
+
+                for i in range(n_old):
+                    r = raw_runs[i]
+                    if int(r.get("iteration", 0)) != int(old.iteration[i]) or (
+                        (r.get("status", "") == "success") != bool(old.success[i])
+                    ):
+                        refused(i, "old runs.json entries changed; store is stale")
+                        return None
+                stride = max(1, n_old // 64)
+                check = sorted(set(range(0, n_old, stride)) | {0, n_old - 1})
+                for i in check:
+                    if _head_bytes(_RunData.from_json(raw_runs[i])) != old.run_head_json(i):
+                        refused(i, "old run head fragment changed; store is stale")
+                        return None
+            # Stored vocabulary, extended in place by the new graphs ("pre"/
+            # "post" re-intern to their pinned 0/1).
+            from nemo_tpu.store.reader import _decode_vocab
+
+            vocab = CorpusVocab()
+            for part in ("tables", "labels", "times"):
+                v = getattr(vocab, part)
+                for s in _decode_vocab(vocab_rd, part):
+                    v.intern(s)
+            new_runs = []
+            for pos in range(n_old, len(raw_runs)):
+                run = RunData.from_json(raw_runs[pos])
+                load_run_prov(corpus_dir, pos, run)
+                new_runs.append(run)
+            payload = payload_from_runs(new_runs, vocab)
+            workers = store_workers_default()
+            seg_name = f"seg-{len(header['segments']):03d}"
+            tmp_seg = os.path.join(store_dir, f"{seg_name}.tmp-{uuid.uuid4().hex[:8]}")
+            try:
+                seg_entry = write_segment(tmp_seg, payload, workers)
+                seg_entry["name"] = seg_name
+                os.rename(tmp_seg, os.path.join(store_dir, seg_name))
+            except BaseException:
+                shutil.rmtree(tmp_seg, ignore_errors=True)
+                raise
+            # New vocab generation (old file kept: an in-flight reader of the
+            # old header still resolves), then the atomic commit point: the
+            # header swap.
+            gen = len(header["segments"]) + 1
+            vshard = write_vocab(
+                os.path.join(store_dir, f"vocab-{gen:04d}.bin"), _VocabView(vocab)
+            )
+            source = source_from_snapshot(snap, len(raw_runs))
+            source["dir"] = os.path.realpath(corpus_dir)
+            header = dict(
+                header,
+                source=source,
+                vocab_shard=vshard,
+                segments=header["segments"] + [seg_entry],
+            )
+            tmp_header = os.path.join(store_dir, f"header.json.tmp-{uuid.uuid4().hex[:8]}")
+            with open(tmp_header, "w", encoding="utf-8") as fh:
+                json.dump(header, fh, indent=1)
+            os.replace(tmp_header, os.path.join(store_dir, "header.json"))
+        obs.metrics.inc("store.append")
+        _log.info(
+            "store.appended",
+            corpus=corpus_dir,
+            new_runs=len(new_runs),
+            total_runs=len(raw_runs),
+            segment=seg_name,
+        )
+        return header
+
+
+class _VocabView:
+    """Adapter: write_vocab consumes either a CorpusVocab (``.strings``) or
+    a plain {part: list[str]} dict."""
+
+    def __init__(self, vocab) -> None:
+        if isinstance(vocab, dict):
+            self.tables = vocab["tables"]
+            self.labels = vocab["labels"]
+            self.times = vocab["times"]
+        else:
+            self.tables = vocab.tables
+            self.labels = vocab.labels
+            self.times = vocab.times

@@ -1,0 +1,795 @@
+"""The ``.npack`` persistent corpus store: parse once, mmap forever.
+
+PR 3 made analysis so fast that the 10x-scale wall is ingest-bound — the
+Molly JSON parse alone was ~78 s of a ~133 s run — yet every invocation
+re-parsed the same immutable fault-injection corpora.  This module is the
+training-stack-style data layer: a versioned, checksummed, memory-mapped
+binary corpus format that persists EXACTLY what the ETL produces —
+
+  * the packed ``[B,V]``/``[B,E]`` cond batch arrays (graphs/packed.py
+    layout, the native engine's padding values: -1 table/label/time ids,
+    0 type/edge ids, False masks),
+  * the corpus vocabularies (tables/labels/times, "pre"/"post" pinned 0/1),
+  * every per-run serialized string the report path splices verbatim:
+    namespaced provenance JSON, the canonical debugging.json head fragment,
+    the joined node-id list, plus status and holds-map keys —
+
+so a warm load is ``np.memmap`` of each shard plus a small JSON header, and
+the resulting MollyOutput is bit-interchangeable with the packed-first
+loader's (ingest/native.py:load_molly_output_packed): same RawProv splices,
+same LazyRunData head fragments, same arrays.  No C++ toolchain is needed to
+LOAD a store, so lib-less deployments get packed-path speed too.
+
+Layout (one directory per source corpus, keyed by realpath hash)::
+
+    <root>/<basename>-<hash12>.npack/
+      header.json            format/ABI versions, source fingerprint,
+                             segment + shard manifests (offsets, checksums)
+      vocab-<n>.bin          tables/labels/times blobs (rewritten-by-
+                             generation on append; old generations kept so
+                             in-flight readers of the old header survive)
+      seg-000/
+        arrays_pre.bin       the 12 packed arrays of the pre condition
+        arrays_post.bin      ... and of the post condition
+        runs.bin             iteration / success
+        meta.bin             status + holds-key + head-fragment blobs
+        strings_pre_000.bin  prov JSON + node-id blobs, chunked by row
+        strings_post_000.bin   range so ingest writes shards in parallel
+      seg-001/ ...           appended segments (incremental sweeps)
+
+Integrity & invalidation:
+
+  * every shard carries a CRC32 (verified on load unless
+    ``NEMO_STORE_VERIFY=off``) and a SHA-256 (audited by
+    tools/store_inspect.py);
+  * the header records a fingerprint over the Molly directory's file
+    names+sizes+mtimes, split into old-run / other / new-run classes so a
+    GROWN directory (an incremental sweep appended runs) is distinguished
+    from a STALE one (anything else changed);
+  * format/ABI mismatches, fingerprint mismatches, and checksum failures
+    all fall back LOUDLY to the parse path (``store.stale`` metric +
+    warning log).  Detection bounds: the default ``fast`` fingerprint
+    catches every entry add/remove/rename and any mutation touching
+    runs.json, the dir mtime, or the stat sample — an IN-PLACE rewrite of
+    a single unsampled provenance file in a huge corpus is outside its
+    budget (Molly corpora are write-once per run); set
+    ``NEMO_STORE_FINGERPRINT=full`` where that assumption does not hold.
+
+Appending packs only the NEW runs (pure-Python loader, positions >=
+n_stored) against the stored vocabulary, writes them as a fresh segment,
+and atomically swaps the header.  Append-then-load is decoded-equal to a
+repack-from-scratch (same vocabulary SET, same report bytes); raw integer
+ids may differ because interning order differs, which nothing downstream
+observes (everything resolves through the vocab).
+
+Concurrency: writers serialize on an ``fcntl`` lock file and publish via
+atomic rename, so concurrent populates of one corpus cannot tear a store;
+readers never lock (POSIX keeps their mmaps alive across a concurrent
+swap, and a reader that loses the race falls back to the parse path).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import zlib
+from dataclasses import dataclass
+
+import numpy as np
+
+#: On-disk layout version: bump when the shard/region/blob encoding changes.
+NPACK_FORMAT_VERSION = 1
+#: Content ABI: the contract for WHAT is persisted (array set, string blobs,
+#: padding values).  Mirrors the ingest engines' ABI — bump in lockstep with
+#: native nemo_abi_version when the packed layout changes.
+NPACK_ABI_VERSION = 5
+
+_ALIGN = 64
+
+#: Region set of one condition's shard, in NativeCondBatch field order.
+_COND_ARRAYS = (
+    ("table_id", "bv"),
+    ("label_id", "bv"),
+    ("time_id", "bv"),
+    ("type_id", "bv"),
+    ("is_goal", "bv"),
+    ("node_mask", "bv"),
+    ("edge_src", "be"),
+    ("edge_dst", "be"),
+    ("edge_mask", "be"),
+    ("n_nodes", "b"),
+    ("n_goals", "b"),
+    ("chain_linear", "b"),
+)
+
+
+# ---------------------------------------------------------------------------
+# env knobs
+# ---------------------------------------------------------------------------
+
+
+def corpus_cache_dir(arg: str | None = None) -> str | None:
+    """Resolve the corpus store root: an explicit argument wins (``off`` /
+    ``0`` / ``none`` / ``false`` disables -> None), else ``NEMO_CORPUS_CACHE``,
+    else ``~/.cache/nemo_tpu/corpus`` beside the SVG and jit-artifact caches
+    (report/render.py:svg_cache_dir — same default-on policy)."""
+    env = arg if arg is not None else os.environ.get("NEMO_CORPUS_CACHE")
+    if env is not None:
+        env = env.strip()
+        if env.lower() in ("", "0", "off", "none", "false"):
+            return None
+        # expanduser like the default below: NEMO_CORPUS_CACHE=~/x set in a
+        # non-shell context (systemd/.env/Docker ENV) must not create a
+        # literal './~' directory per cwd.
+        return os.path.expanduser(env)
+    return os.path.join(os.path.expanduser("~"), ".cache", "nemo_tpu", "corpus")
+
+
+def store_workers_default() -> int:
+    """Parallel shard-writer width: NEMO_STORE_WORKERS when set (>=1; junk
+    warns and falls through — the NEMO_RENDER_WORKERS policy), else
+    min(8, effective cores).  Threads, not processes: the shard payloads are
+    big shared numpy arrays, file writes and hashing release the GIL, and a
+    spawn pool would pickle every array across."""
+    import warnings
+
+    env = os.environ.get("NEMO_STORE_WORKERS", "").strip()
+    if env:
+        try:
+            n = int(env)
+        except ValueError:
+            n = 0
+        if n >= 1:
+            return n
+        warnings.warn(
+            f"NEMO_STORE_WORKERS={env!r} is not a positive integer; "
+            "using min(8, cpu count)",
+            stacklevel=2,
+        )
+    from nemo_tpu.utils import effective_cpu_count
+
+    return max(1, min(8, effective_cpu_count()))
+
+
+def _verify_on_load() -> bool:
+    return os.environ.get("NEMO_STORE_VERIFY", "").strip().lower() not in (
+        "0",
+        "off",
+        "none",
+        "false",
+    )
+
+
+# ---------------------------------------------------------------------------
+# shard files: aligned regions + checksums
+# ---------------------------------------------------------------------------
+
+
+def _blob_regions(name: str, rows: list[bytes]) -> list[tuple[str, np.ndarray]]:
+    """A variable-length string column as two fixed regions: int64 row
+    offsets [n+1] and the concatenated bytes."""
+    offs = np.zeros(len(rows) + 1, dtype=np.int64)
+    if rows:
+        np.cumsum([len(r) for r in rows], out=offs[1:])
+    data = np.frombuffer(b"".join(rows), dtype=np.uint8)
+    return [(f"{name}.offsets", offs), (f"{name}.bytes", data)]
+
+
+def write_shard(path: str, regions: list[tuple[str, np.ndarray]]) -> dict:
+    """Write one shard file (aligned raw regions) and return its manifest:
+    ``{file, nbytes, crc32, sha256, regions: [{name, dtype, shape, offset}]}``.
+    Checksums cover the whole file including alignment padding."""
+    crc = 0
+    sha = hashlib.sha256()
+    manifest: list[dict] = []
+    pos = 0
+    with open(path, "wb") as fh:
+
+        def emit(buf) -> None:
+            nonlocal crc, pos
+            fh.write(buf)
+            crc = zlib.crc32(buf, crc)
+            sha.update(buf)
+            pos += len(buf)
+
+        for name, arr in regions:
+            arr = np.ascontiguousarray(arr)
+            pad = -pos % _ALIGN
+            if pad:
+                emit(b"\0" * pad)
+            manifest.append(
+                {
+                    "name": name,
+                    "dtype": arr.dtype.name,
+                    "shape": list(arr.shape),
+                    "offset": pos,
+                }
+            )
+            emit(memoryview(arr).cast("B"))
+    return {
+        "file": os.path.basename(path),
+        "nbytes": pos,
+        "crc32": crc & 0xFFFFFFFF,
+        "sha256": sha.hexdigest(),
+        "regions": manifest,
+    }
+
+
+class ShardReader:
+    """One mmapped shard: zero-copy region views over the raw file."""
+
+    def __init__(self, path: str, manifest: dict) -> None:
+        self.path = path
+        self.manifest = manifest
+        self.nbytes = int(manifest["nbytes"])
+        if self.nbytes:
+            self._mm = np.memmap(path, dtype=np.uint8, mode="r")
+        else:  # np.memmap refuses zero-length files
+            self._mm = np.zeros(0, dtype=np.uint8)
+        if self._mm.size != self.nbytes:
+            raise StoreCorrupt(
+                f"{path}: size {self._mm.size} != manifest nbytes {self.nbytes}"
+            )
+        self._by_name = {r["name"]: r for r in manifest["regions"]}
+
+    def verify(self) -> None:
+        """CRC32 over the whole file (reads every page once — still orders
+        of magnitude cheaper than the JSON parse this store replaces)."""
+        crc = zlib.crc32(memoryview(self._mm)) & 0xFFFFFFFF
+        if crc != int(self.manifest["crc32"]):
+            raise StoreCorrupt(
+                f"{self.path}: crc32 {crc:#010x} != manifest "
+                f"{int(self.manifest['crc32']):#010x}"
+            )
+
+    def region(self, name: str) -> np.ndarray:
+        r = self._by_name[name]
+        dtype = np.dtype(r["dtype"])
+        shape = tuple(r["shape"])
+        n = int(np.prod(shape)) * dtype.itemsize if shape else dtype.itemsize
+        off = int(r["offset"])
+        if off + n > self.nbytes:
+            raise StoreCorrupt(f"{self.path}: region {name} overruns the file")
+        return self._mm[off : off + n].view(dtype).reshape(shape)
+
+    def blob(self, name: str) -> "BlobView":
+        return BlobView(self.region(f"{name}.offsets"), self.region(f"{name}.bytes"))
+
+
+class BlobView:
+    """Row accessor over an (offsets, bytes) blob pair."""
+
+    __slots__ = ("offsets", "data")
+
+    def __init__(self, offsets: np.ndarray, data: np.ndarray) -> None:
+        self.offsets = offsets
+        self.data = data
+
+    def __len__(self) -> int:
+        return len(self.offsets) - 1
+
+    def row(self, i: int) -> bytes:
+        o0, o1 = int(self.offsets[i]), int(self.offsets[i + 1])
+        return self.data[o0:o1].tobytes()
+
+    def rows(self) -> list[bytes]:
+        """Every row, decoded from ONE bulk read: per-row memmap indexing
+        costs ~9 µs in numpy dispatch alone, which dominates a 100k-row
+        eager column (statuses) — plain bytes slicing is ~1 µs."""
+        buf = self.data.tobytes()
+        offs = self.offsets.tolist()
+        return [buf[offs[i] : offs[i + 1]] for i in range(len(offs) - 1)]
+
+
+class StoreCorrupt(RuntimeError):
+    """A store exists but cannot be trusted (checksum/size/structure)."""
+
+
+# ---------------------------------------------------------------------------
+# source fingerprinting
+# ---------------------------------------------------------------------------
+
+
+#: Bounded per-file stat budget of the fast fingerprint check: enough to
+#: catch a bulk regeneration (every file's mtime moves) on the first load,
+#: cheap even on network filesystems where one stat costs ~100 µs.
+_SAMPLE_FILES = 64
+
+
+def fingerprint_mode() -> str:
+    """``fast`` (default): warm loads compare file NAMES (one scandir, no
+    per-file stat) plus runs.json's stat plus a stored <=64-file stat
+    sample — on the 9p/network filesystems this repo benches on, a full
+    per-file stat scan costs more than the entire mmap load (~136 µs/stat
+    observed; a 10x corpus has 300k+ files).  ``NEMO_STORE_FINGERPRINT=full``
+    restores the exhaustive per-file size+mtime comparison.  Write-time
+    fingerprints are always full — only the LOAD-side comparison is
+    sampled."""
+    env = os.environ.get("NEMO_STORE_FINGERPRINT", "").strip().lower()
+    return "full" if env == "full" else "fast"
+
+
+def _fp(lines: list[str]) -> str:
+    return hashlib.sha256("\n".join(sorted(lines)).encode()).hexdigest()
+
+
+def snapshot_source(corpus_dir: str, with_stats: bool = True) -> dict:
+    """Raw (name, size, mtime_ns) snapshot of the Molly directory, taken
+    BEFORE a writer parses it: a file mutated DURING the (minutes-long at
+    scale) parse then mismatches the stored pre-parse fingerprint on the
+    next load — the fail-safe direction.  ``runs_prefix_sha`` is captured
+    here too (the bytes could likewise change under the parse)."""
+    # Dir mtime BEFORE the enumeration: entry creates/deletes/renames bump
+    # it, so a load whose dir mtime still matches can skip the enumeration
+    # entirely (classify_source tier 0).  Files added between this stat and
+    # the scan below are included in the scan but leave the stored mtime
+    # older — the next load then re-scans, which is the safe direction.
+    dir_mtime_ns = os.stat(corpus_dir).st_mtime_ns
+    entries: list[tuple[str, int, int]] = []
+    runs_json: list[int] | None = None
+    with os.scandir(corpus_dir) as it:
+        for entry in it:
+            name = entry.name
+            if name == "runs.json":
+                st = entry.stat()
+                runs_json = [st.st_size, st.st_mtime_ns]
+                continue
+            if not entry.is_file(follow_symlinks=True):
+                continue
+            if with_stats:
+                st = entry.stat()
+                entries.append((name, st.st_size, st.st_mtime_ns))
+            else:
+                entries.append((name, 0, 0))
+    return {
+        "dir_mtime_ns": dir_mtime_ns,
+        "runs_json": runs_json,
+        "entries": entries,
+        "with_stats": with_stats,
+        "runs_prefix_sha": _runs_prefix_sha(
+            corpus_dir, (runs_json or [0])[0]
+        )
+        if with_stats
+        else None,
+    }
+
+
+def source_from_snapshot(snap: dict, n_old: int) -> dict:
+    """Snapshot -> fingerprint dict, classed so GROWN (runs appended by an
+    incremental sweep) is distinguishable from STALE (anything else
+    changed):
+
+      * ``old_*``   run_<i>_* files with i < n_old
+      * ``new_*``   run_<i>_* files with i >= n_old (normally none at
+                    write time)
+      * ``other_*`` every other regular file except runs.json
+      * ``runs_json`` (size, mtime_ns) of runs.json itself — it
+                    legitimately changes on append, so it is compared
+                    separately
+
+    Per class both a stat fingerprint (``*_fp``, names+sizes+mtimes; only
+    when the snapshot carried stats) and a names-only fingerprint
+    (``*_names_fp``) are produced; ``sample`` is a deterministic
+    <=:data:`_SAMPLE_FILES` spread of (name, size, mtime_ns) triples over
+    the old+other classes for the fast load check."""
+    classes: dict[str, list] = {"old": [], "new": [], "other": []}
+    old, new, other = classes["old"], classes["new"], classes["other"]
+    for rec in snap["entries"]:
+        name = rec[0]
+        # Hand-rolled ^run_(\d+)_ classification: the regex engine costs
+        # ~1 µs/name, and a 10x corpus directory holds 300k+ entries.
+        if name.startswith("run_"):
+            cut = name.find("_", 4)
+            idx = name[4:cut] if cut > 4 else ""
+            if idx.isdigit():
+                (old if int(idx) < n_old else new).append(rec)
+            else:
+                other.append(rec)
+        else:
+            other.append(rec)
+
+    with_stats = snap.get("with_stats", True)
+    out: dict = {
+        "runs_json": snap["runs_json"],
+        "n_new_files": len(new),
+        "dir_mtime_ns": snap["dir_mtime_ns"],
+        "n_runs": n_old,
+        "runs_prefix_sha": snap.get("runs_prefix_sha"),
+    }
+    for cls, recs in classes.items():
+        out[f"{cls}_names_fp"] = _fp([n for n, _, _ in recs])
+        if with_stats:
+            out[f"{cls}_fp"] = _fp([f"{n}\0{s}\0{t}" for n, s, t in recs])
+    if with_stats:
+        base = sorted(old + other)
+        stride = max(1, len(base) // _SAMPLE_FILES)
+        sample = base[::stride][:_SAMPLE_FILES]
+        if base and base[-1] not in sample:
+            sample.append(base[-1])
+        out["sample"] = [list(rec) for rec in sample]
+    return out
+
+
+def scan_source(corpus_dir: str, n_old: int, with_stats: bool = True) -> dict:
+    """One-shot snapshot + classification (the load-side compare path)."""
+    return source_from_snapshot(snapshot_source(corpus_dir, with_stats), n_old)
+
+
+def _runs_prefix_sha(corpus_dir: str, nbytes: int) -> str | None:
+    """SHA-256 of runs.json's first ``nbytes - 1`` bytes: an append that
+    re-serializes the same old entries plus new ones keeps this prefix when
+    the producer's serializer is stable — the strong old-entry check the
+    append path prefers over the cheap iteration/status comparison."""
+    try:
+        sha = hashlib.sha256()
+        remaining = max(0, nbytes - 1)
+        with open(os.path.join(corpus_dir, "runs.json"), "rb") as fh:
+            while remaining:
+                chunk = fh.read(min(1 << 20, remaining))
+                if not chunk:
+                    return None
+                sha.update(chunk)
+                remaining -= len(chunk)
+        return sha.hexdigest()
+    except OSError:
+        return None
+
+
+HIT, GROWN, STALE = "hit", "grown", "stale"
+
+
+def _sample_ok(corpus_dir: str, sample: list) -> bool:
+    for name, size, mtime_ns in sample or ():
+        try:
+            st = os.stat(os.path.join(corpus_dir, name))
+        except OSError:
+            return False
+        if st.st_size != size or st.st_mtime_ns != mtime_ns:
+            return False
+    return True
+
+
+def classify_source(header: dict, corpus_dir: str) -> str:
+    """HIT (byte-trustworthy), GROWN (append candidate), or STALE.
+
+    ``fast`` mode (default, :func:`fingerprint_mode`) compares names-only
+    fingerprints plus runs.json's stat plus the stored stat sample — one
+    scandir and <=~65 stats regardless of corpus size.  ``full`` mode
+    re-stats every file and compares the exhaustive fingerprints."""
+    src = header.get("source") or {}
+    full = fingerprint_mode() == "full"
+    if not full and src.get("dir_mtime_ns"):
+        # Tier 0, no directory enumeration at all: entry creates/deletes/
+        # renames bump the dir mtime, so an unchanged dir mtime + unchanged
+        # runs.json + intact stat sample is a HIT in ~66 stats regardless
+        # of corpus size (a 10x directory holds 300k+ entries; even
+        # enumerating names costs more than the whole mmap load).
+        try:
+            st = os.stat(corpus_dir)
+            rj = os.stat(os.path.join(corpus_dir, "runs.json"))
+        except OSError:
+            return STALE
+        if (
+            st.st_mtime_ns == src["dir_mtime_ns"]
+            and [rj.st_size, rj.st_mtime_ns] == src.get("runs_json")
+            and _sample_ok(corpus_dir, src.get("sample"))
+        ):
+            return HIT
+        # Something moved: fall through to the name-level scan to tell
+        # GROWN from STALE.
+    cur = scan_source(corpus_dir, int(src.get("n_runs", 0)), with_stats=full)
+    if full:
+        base_ok = cur["old_fp"] == src.get("old_fp") and cur["other_fp"] == src.get(
+            "other_fp"
+        )
+        hit_ok = base_ok and cur["new_fp"] == src.get("new_fp")
+    else:
+        base_ok = (
+            cur["old_names_fp"] == src.get("old_names_fp")
+            and cur["other_names_fp"] == src.get("other_names_fp")
+            and _sample_ok(corpus_dir, src.get("sample"))
+        )
+        hit_ok = base_ok and cur["new_names_fp"] == src.get("new_names_fp")
+    if not base_ok:
+        return STALE
+    if hit_ok and cur["runs_json"] == src.get("runs_json"):
+        return HIT
+    # Append candidate: every stored file untouched, runs.json changed, new
+    # run files exist, and the store was written with none pending (a store
+    # written over stray future-run files cannot tell them apart — rebuild).
+    if (
+        cur["n_new_files"] > 0
+        and int(src.get("n_new_files", 0)) == 0
+        and cur["runs_json"] != src.get("runs_json")
+    ):
+        return GROWN
+    return STALE
+
+
+# ---------------------------------------------------------------------------
+# segment payloads (what a writer persists)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SegmentPayload:
+    """One segment's full content, producer-agnostic.  ``prov`` /
+    ``node_ids`` / ``heads`` are callables so the native producer can fetch
+    C++-held strings lazily inside the parallel shard writers instead of
+    materializing the whole corpus's serialization up front."""
+
+    n_runs: int
+    v: int
+    e: int
+    max_depth: int
+    pre: object  # NativeCondBatch-shaped (12 arrays)
+    post: object
+    iteration: np.ndarray
+    success: np.ndarray
+    statuses: list[bytes]
+    holds_pre: list[bytes]  # per-run JSON array of holds-map keys
+    holds_post: list[bytes]
+    head: object  # row -> bytes
+    prov: object  # (cond_name, row) -> bytes
+    node_ids: object  # (cond_name, row) -> bytes ("\n"-joined)
+    #: the vocabulary these arrays were encoded against (CorpusVocab or
+    #: {part: list[str]} dict) — persisted alongside the segment
+    vocab: object = None
+
+
+def _int32_checked(values, what: str) -> np.ndarray:
+    arr = np.asarray(values, dtype=np.int64)
+    if arr.size and (arr.max(initial=0) > 2**31 - 1 or arr.min(initial=0) < -(2**31)):
+        raise ValueError(f"{what} out of int32 range")
+    return arr.astype(np.int32)
+
+
+def payload_from_packed_molly(molly) -> SegmentPayload:
+    """Native producer: a MollyOutput from load_molly_output_packed — the
+    arrays come straight from the C++ corpus, the strings from its live
+    handle (parse-time canonical serializations)."""
+    nc = molly.native_corpus
+    runs = molly.runs
+    return SegmentPayload(
+        n_runs=nc.n_runs,
+        v=nc.v,
+        e=nc.e,
+        max_depth=nc.max_depth,
+        pre=nc.pre,
+        post=nc.post,
+        iteration=np.asarray(nc.iteration, dtype=np.int32),
+        success=np.asarray(nc.success, dtype=bool),
+        statuses=[r.status.encode() for r in runs],
+        holds_pre=[json.dumps(list(r.time_pre_holds)).encode() for r in runs],
+        holds_post=[json.dumps(list(r.time_post_holds)).encode() for r in runs],
+        head=nc.run_head_json,
+        prov=nc.prov_json,
+        node_ids=lambda c, i: "\n".join(nc.lazy_node_ids(c, i)).encode(),
+        vocab={"tables": nc.tables, "labels": nc.labels, "times": nc.times},
+    )
+
+
+def _head_bytes(run) -> bytes:
+    """The canonical debugging.json head fragment — byte-identical to the
+    C++ engine's build_run_head and to analysis/pipeline._run_json_str's
+    object-path rendering (same pairs, same json.dumps defaults)."""
+    return (
+        f'"iteration": {json.dumps(run.iteration)}, '
+        f'"status": {json.dumps(run.status)}, '
+        f'"failureSpec": {json.dumps(run.failure_spec.to_json() if run.failure_spec else None)}, '
+        f'"model": {json.dumps(run.model.to_json() if run.model else None)}, '
+        f'"messages": {json.dumps([m.to_json() for m in run.messages])}'
+    ).encode()
+
+
+def _chain_linear_one(g) -> bool:
+    """Per-graph @next-chain linearity over one PackedGraph — the Python
+    mirror of the native parse-time graph_chain_linear, via the batched host
+    check restricted to a single row."""
+    from nemo_tpu.ops.simplify import chains_linear_host
+
+    n = g.n_nodes
+    is_goal = np.zeros((1, max(1, n)), dtype=bool)
+    is_goal[0, : g.n_goals] = True
+    node_mask = np.zeros((1, max(1, n)), dtype=bool)
+    node_mask[0, :n] = True
+    type_id = np.zeros((1, max(1, n)), dtype=np.int32)
+    type_id[0, :n] = g.type_id
+    ne = len(g.edges)
+    src = g.edges[:, 0].reshape(1, -1) if ne else np.zeros((1, 0), np.int32)
+    dst = g.edges[:, 1].reshape(1, -1) if ne else np.zeros((1, 0), np.int32)
+    em = np.ones((1, ne), dtype=bool)
+    return bool(chains_linear_host(is_goal, node_mask, type_id, src, dst, em))
+
+
+def payload_from_runs(runs: list, vocab) -> SegmentPayload:
+    """Pure-Python producer: pack RunData objects (object-loader provenance)
+    into a segment against ``vocab`` (a CorpusVocab — pass a fresh one for a
+    full store, the store's interned one for an append, which extends it
+    in place).  Interning order matches the native engine: all pre graphs
+    in run order, then all post."""
+    from nemo_tpu.graphs.packed import bucket_size, longest_path_len, pack_graph
+
+    pre_g = [pack_graph(r.pre_prov, vocab) for r in runs]
+    post_g = [pack_graph(r.post_prov, vocab) for r in runs]
+    all_g = pre_g + post_g
+    v = bucket_size(max((g.n_nodes for g in all_g), default=1))
+    e = bucket_size(max((len(g.edges) for g in all_g), default=1))
+    max_lp = max((longest_path_len(g.n_nodes, g.edges) for g in all_g), default=0)
+    b = len(runs)
+
+    def pack_cond(graphs):
+        """Mirror of the native pack_cond fills (table/label/time -1, type 0,
+        edges 0, masks False)."""
+        from nemo_tpu.ingest.native import NativeCondBatch
+
+        out = dict(
+            table_id=np.full((b, v), -1, np.int32),
+            label_id=np.full((b, v), -1, np.int32),
+            time_id=np.full((b, v), -1, np.int32),
+            type_id=np.zeros((b, v), np.int32),
+            is_goal=np.zeros((b, v), bool),
+            node_mask=np.zeros((b, v), bool),
+            edge_src=np.zeros((b, e), np.int32),
+            edge_dst=np.zeros((b, e), np.int32),
+            edge_mask=np.zeros((b, e), bool),
+            n_nodes=np.zeros(b, np.int32),
+            n_goals=np.zeros(b, np.int32),
+            chain_linear=np.zeros(b, bool),
+        )
+        for i, g in enumerate(graphs):
+            n = g.n_nodes
+            out["n_nodes"][i] = n
+            out["n_goals"][i] = g.n_goals
+            out["table_id"][i, :n] = g.table_id
+            out["label_id"][i, :n] = g.label_id
+            out["time_id"][i, :n] = g.time_id
+            out["type_id"][i, :n] = g.type_id
+            out["is_goal"][i, : g.n_goals] = True
+            out["node_mask"][i, :n] = True
+            ne = len(g.edges)
+            if ne:
+                out["edge_src"][i, :ne] = g.edges[:, 0]
+                out["edge_dst"][i, :ne] = g.edges[:, 1]
+                out["edge_mask"][i, :ne] = True
+            out["chain_linear"][i] = _chain_linear_one(g)
+        return NativeCondBatch(**out)
+
+    graphs_by_cond = {"pre": pre_g, "post": post_g}
+    # Holds-map keying matches ingest/molly.py:attach_run_metadata exactly
+    # ({row[-1]: True ...} — dedup keeps first-occurrence order).
+    def holds_keys(run, cond: str) -> bytes:
+        tables = run.model.tables if run.model else {}
+        return json.dumps(
+            list({row[-1]: True for row in tables.get(cond, []) if row})
+        ).encode()
+
+    return SegmentPayload(
+        n_runs=b,
+        v=v,
+        e=e,
+        max_depth=min(v, max(1, max_lp + 1)),
+        pre=pack_cond(pre_g),
+        post=pack_cond(post_g),
+        iteration=_int32_checked([r.iteration for r in runs], "run iteration"),
+        success=np.asarray([r.succeeded for r in runs], dtype=bool),
+        statuses=[r.status.encode() for r in runs],
+        holds_pre=[holds_keys(r, "pre") for r in runs],
+        holds_post=[holds_keys(r, "post") for r in runs],
+        head=lambda i: _head_bytes(runs[i]),
+        prov=lambda c, i: json.dumps(
+            (runs[i].pre_prov if c == "pre" else runs[i].post_prov).to_json()
+        ).encode(),
+        node_ids=lambda c, i: "\n".join(graphs_by_cond[c][i].node_ids).encode(),
+        vocab=vocab,
+    )
+
+
+def payload_from_molly(molly) -> SegmentPayload:
+    """Producer dispatch: packed-first MollyOutputs persist their native
+    corpus verbatim; object-loader MollyOutputs pack in Python.  Both yield
+    bit-compatible stores (the two ETLs are bit-identical by contract,
+    tests/test_native.py)."""
+    if getattr(molly, "native_corpus", None) is not None:
+        return payload_from_packed_molly(molly)
+    from nemo_tpu.graphs.packed import CorpusVocab
+
+    return payload_from_runs(list(molly.runs), CorpusVocab())
+
+
+# ---------------------------------------------------------------------------
+# segment writing (parallel shards)
+# ---------------------------------------------------------------------------
+
+
+def _string_chunk_rows(b: int, workers: int) -> int:
+    return max(256, -(-b // max(1, workers * 2)))
+
+
+def write_segment(seg_dir: str, payload: SegmentPayload, workers: int) -> dict:
+    """Write one segment directory; returns its header entry.  Shards are
+    written in parallel by a thread pool: one shard per array group plus
+    row-chunked string shards per condition, so a big corpus's serialization
+    and hashing spread across cores (writes + hashlib/zlib release the GIL,
+    and the array payloads are shared memory — no pickling)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    os.makedirs(seg_dir, exist_ok=True)
+    b = payload.n_runs
+    chunk = _string_chunk_rows(b, workers)
+    jobs: list[tuple[str, object]] = []
+
+    def cond_regions(cond):
+        return lambda: [(name, getattr(cond, name)) for name, _ in _COND_ARRAYS]
+
+    jobs.append(("arrays_pre.bin", cond_regions(payload.pre)))
+    jobs.append(("arrays_post.bin", cond_regions(payload.post)))
+    jobs.append(
+        (
+            "runs.bin",
+            lambda: [
+                ("iteration", payload.iteration),
+                ("success", np.asarray(payload.success, dtype=bool)),
+            ],
+        )
+    )
+    jobs.append(
+        (
+            "meta.bin",
+            lambda: (
+                _blob_regions("status", payload.statuses)
+                + _blob_regions("holds_pre", payload.holds_pre)
+                + _blob_regions("holds_post", payload.holds_post)
+                + _blob_regions("head", [payload.head(i) for i in range(b)])
+            ),
+        )
+    )
+
+    def string_shard(cond_name: str, start: int, end: int):
+        def build():
+            prov = [payload.prov(cond_name, i) for i in range(start, end)]
+            ids = [payload.node_ids(cond_name, i) for i in range(start, end)]
+            return _blob_regions("prov", prov) + _blob_regions("node_ids", ids)
+
+        return build
+
+    for cond_name in ("pre", "post"):
+        for k, start in enumerate(range(0, b, chunk)):
+            jobs.append(
+                (
+                    f"strings_{cond_name}_{k:03d}.bin",
+                    string_shard(cond_name, start, min(b, start + chunk)),
+                )
+            )
+
+    def run_job(job):
+        fname, regions = job
+        return write_shard(os.path.join(seg_dir, fname), regions())
+
+    if workers > 1:
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            manifests = list(pool.map(run_job, jobs))
+    else:
+        manifests = [run_job(j) for j in jobs]
+    return {
+        "name": os.path.basename(seg_dir),
+        "n_runs": b,
+        "v": payload.v,
+        "e": payload.e,
+        "max_depth": payload.max_depth,
+        "string_chunk_rows": chunk,
+        "shards": manifests,
+    }
+
+
+def write_vocab(path: str, vocab) -> dict:
+    """tables/labels/times blobs (CorpusVocab or plain string lists)."""
+    def strings(part):
+        v = getattr(vocab, part)
+        return getattr(v, "strings", v)
+
+    regions = []
+    for part in ("tables", "labels", "times"):
+        regions += _blob_regions(part, [s.encode() for s in strings(part)])
+    return write_shard(path, regions)

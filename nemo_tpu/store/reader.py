@@ -1,0 +1,367 @@
+"""Warm-load side of the ``.npack`` store: mmap shards -> packed MollyOutput.
+
+The loaded object is bit-interchangeable with the packed-first loader's
+(ingest/native.py:load_molly_output_packed): runs carry RawProv placeholders
+whose ``json_str()`` splices the stored parse-time serialization, LazyRunData
+head fragments come from the stored head blob, and ``.native_corpus`` exposes
+the packed arrays (memmapped, read-only) for the JaxBackend's zero-repack
+init path.  The run-metadata trio (failureSpec/model/messages) materializes
+from the ORIGINAL runs.json lazily — the standard pipeline never touches it,
+so a warm load never parses runs.json at all.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+
+import numpy as np
+
+from nemo_tpu.store.npack import ShardReader, StoreCorrupt
+
+#: Padding values per cond-array (the native pack_cond fills) — used when a
+#: multi-segment store consolidates differently-bucketed segments.
+_PAD = {
+    "table_id": -1,
+    "label_id": -1,
+    "time_id": -1,
+    "type_id": 0,
+    "is_goal": False,
+    "node_mask": False,
+    "edge_src": 0,
+    "edge_dst": 0,
+    "edge_mask": False,
+}
+
+
+class _SegmentStrings:
+    """String access for one segment: the meta shard's status/holds/head
+    blobs plus the row-chunked prov/node-id shards per condition."""
+
+    def __init__(self, entry: dict, readers: dict) -> None:
+        self.chunk = int(entry["string_chunk_rows"])
+        self.meta = readers["meta.bin"]
+        self.head = self.meta.blob("head")
+        self._chunks = {"pre": [], "post": []}
+        for cond in ("pre", "post"):
+            k = 0
+            while f"strings_{cond}_{k:03d}.bin" in readers:
+                self._chunks[cond].append(readers[f"strings_{cond}_{k:03d}.bin"])
+                k += 1
+
+    def _blob(self, cond: str, row: int, name: str) -> bytes:
+        rd = self._chunks[cond][row // self.chunk]
+        return rd.blob(name).row(row % self.chunk)
+
+    def prov(self, cond: str, row: int) -> bytes:
+        return self._blob(cond, row, "prov")
+
+    def node_ids(self, cond: str, row: int) -> bytes:
+        return self._blob(cond, row, "node_ids")
+
+
+class StoreStrings:
+    """Global-row string accessors over all segments."""
+
+    def __init__(self, segments: list[_SegmentStrings], seg_runs: list[int]) -> None:
+        self.segments = segments
+        self.starts = np.cumsum([0] + seg_runs)
+
+    def _locate(self, row: int) -> tuple[_SegmentStrings, int]:
+        s = int(np.searchsorted(self.starts, row, side="right")) - 1
+        return self.segments[s], row - int(self.starts[s])
+
+    def prov(self, cond: str, row: int) -> bytes:
+        seg, r = self._locate(row)
+        return seg.prov(cond, r)
+
+    def node_ids(self, cond: str, row: int) -> bytes:
+        seg, r = self._locate(row)
+        return seg.node_ids(cond, r)
+
+    def head(self, row: int) -> bytes:
+        seg, r = self._locate(row)
+        return seg.head.row(r)
+
+
+def _import_native():
+    # One import site: the reader builds the exact types the packed-first
+    # loader builds, so downstream (backend, report splicing) cannot drift.
+    from nemo_tpu.ingest.native import LazyRunData, NativeCondBatch, NativeCorpus, RawProv
+
+    return LazyRunData, NativeCondBatch, NativeCorpus, RawProv
+
+
+def _store_corpus_cls():
+    LazyRunData, NativeCondBatch, NativeCorpus, RawProv = _import_native()
+
+    @dataclass
+    class StoreCorpus(NativeCorpus):
+        """NativeCorpus whose per-run strings come from store blobs instead
+        of a live C++ handle.  The arrays are memmaps (single segment,
+        zero-copy) or consolidated numpy (multi-segment)."""
+
+        strings: StoreStrings | None = None
+
+        def prov_json(self, cond_name: str, row: int) -> bytes:
+            out = self.strings.prov(cond_name, row)
+            if not out:
+                raise StoreCorrupt(
+                    f"empty stored provenance for cond {cond_name} run row {row}"
+                )
+            return out
+
+        def run_head_json(self, row: int) -> bytes:
+            out = self.strings.head(row)
+            if not out:
+                raise StoreCorrupt(f"empty stored head fragment for run row {row}")
+            return out
+
+        def lazy_node_ids(self, cond_name: str, row: int) -> list[str]:
+            joined = self.strings.node_ids(cond_name, row).decode()
+            return joined.split("\n") if joined else []
+
+    return StoreCorpus
+
+
+class _RawRuns:
+    """Shared lazy runs.json parse: the metadata trio of a store-loaded run
+    is only reachable through here, and the file is parsed at most once per
+    load — and not at all on the standard pipeline path."""
+
+    def __init__(self, path: str, expected_n: int) -> None:
+        self.path = path
+        self.expected_n = expected_n
+        self._rows: list | None = None
+
+    def row(self, i: int) -> dict:
+        if self._rows is None:
+            with open(self.path, "r", encoding="utf-8") as fh:
+                self._rows = json.load(fh)
+            if len(self._rows) < self.expected_n:
+                raise StoreCorrupt(
+                    f"{self.path} has {len(self._rows)} runs but the store "
+                    f"holds {self.expected_n}"
+                )
+        return self._rows[i]
+
+
+class _RawProxy:
+    """dict-shaped view of one run's runs.json entry, parsed on demand."""
+
+    __slots__ = ("_runs", "_i")
+
+    def __init__(self, runs: _RawRuns, i: int) -> None:
+        self._runs = runs
+        self._i = i
+
+    def get(self, key, default=None):
+        return self._runs.row(self._i).get(key, default)
+
+    def __getitem__(self, key):
+        return self._runs.row(self._i)[key]
+
+
+def open_segments(store_dir: str, header: dict, verify: bool) -> tuple:
+    """mmap every shard of every segment (verifying checksums when asked);
+    returns (per-segment reader dicts, vocab reader, total mapped bytes)."""
+    seg_readers: list[dict[str, ShardReader]] = []
+    total = 0
+    for entry in header["segments"]:
+        readers: dict[str, ShardReader] = {}
+        for manifest in entry["shards"]:
+            path = os.path.join(store_dir, entry["name"], manifest["file"])
+            rd = ShardReader(path, manifest)
+            if verify:
+                rd.verify()
+            readers[manifest["file"]] = rd
+            total += rd.nbytes
+        seg_readers.append(readers)
+    vpath = os.path.join(store_dir, header["vocab_shard"]["file"])
+    vocab_rd = ShardReader(vpath, header["vocab_shard"])
+    if verify:
+        vocab_rd.verify()
+    total += vocab_rd.nbytes
+    return seg_readers, vocab_rd, total
+
+
+def _decode_vocab(vocab_rd: ShardReader, part: str) -> list[str]:
+    blob = vocab_rd.blob(part)
+    return [blob.row(i).decode() for i in range(len(blob))]
+
+
+def build_corpus(store_dir: str, header: dict, seg_readers: list[dict], vocab_rd):
+    """Assemble the StoreCorpus from mmapped shards.  Single segment: every
+    array is a zero-copy memmap view.  Multiple segments: consolidated into
+    the joint bucket (pad + concat — still array-speed, no JSON)."""
+    _, NativeCondBatch, _, _ = _import_native()
+    from nemo_tpu.store.npack import _COND_ARRAYS
+
+    segs = header["segments"]
+    seg_runs = [int(s["n_runs"]) for s in segs]
+
+    def cond_batch(cond: str):
+        if len(segs) == 1:
+            rd = seg_readers[0][f"arrays_{cond}.bin"]
+            return NativeCondBatch(**{n: rd.region(n) for n, _ in _COND_ARRAYS})
+        v = max(int(s["v"]) for s in segs)
+        e = max(int(s["e"]) for s in segs)
+        b = sum(seg_runs)
+        arrs = {}
+        for name, kind in _COND_ARRAYS:
+            parts = [sr[f"arrays_{cond}.bin"].region(name) for sr in seg_readers]
+            if kind == "b":
+                arrs[name] = np.concatenate(parts)
+                continue
+            width = v if kind == "bv" else e
+            out = np.full((b, width), _PAD[name], dtype=parts[0].dtype)
+            row = 0
+            for p in parts:
+                out[row : row + p.shape[0], : p.shape[1]] = p
+                row += p.shape[0]
+            arrs[name] = out
+        return NativeCondBatch(**arrs)
+
+    iteration = (
+        seg_readers[0]["runs.bin"].region("iteration")
+        if len(segs) == 1
+        else np.concatenate([sr["runs.bin"].region("iteration") for sr in seg_readers])
+    )
+    success = (
+        seg_readers[0]["runs.bin"].region("success")
+        if len(segs) == 1
+        else np.concatenate([sr["runs.bin"].region("success") for sr in seg_readers])
+    )
+    strings = StoreStrings(
+        [_SegmentStrings(s, rd) for s, rd in zip(segs, seg_readers)], seg_runs
+    )
+    StoreCorpus = _store_corpus_cls()
+    return StoreCorpus(
+        n_runs=sum(seg_runs),
+        v=max(int(s["v"]) for s in segs),
+        e=max(int(s["e"]) for s in segs),
+        tables=_decode_vocab(vocab_rd, "tables"),
+        labels=_decode_vocab(vocab_rd, "labels"),
+        times=_decode_vocab(vocab_rd, "times"),
+        pre_tid=int(header["pre_tid"]),
+        post_tid=int(header["post_tid"]),
+        max_depth=max(int(s["max_depth"]) for s in segs),
+        iteration=iteration,
+        success=success,
+        pre=cond_batch("pre"),
+        post=cond_batch("post"),
+        node_ids_pre=[],
+        node_ids_post=[],
+        handle=None,
+        strings=strings,
+    )
+
+
+_store_run_cls_cache: list = []
+
+
+def _store_run_cls():
+    if _store_run_cls_cache:
+        return _store_run_cls_cache[0]
+    LazyRunData, _, _, _ = _import_native()
+
+    class StoreRunData(LazyRunData):
+        """LazyRunData whose metadata trio parses the original runs.json
+        only on attribute access, whose head fragment comes from the store,
+        and whose holds maps decode from the store's blobs on first touch.
+
+        Instances are built by :func:`molly_from_corpus` via ``__new__`` +
+        a template ``__dict__`` (NOT the dataclass ``__init__`` chain): at
+        10x scale the per-run constructor overhead was the warm load's
+        dominant Python cost.  The template is produced by the real
+        ``RunData()`` constructor, so future dataclass fields keep their
+        defaults automatically."""
+
+        def _holds_get(self, cond: str) -> dict:
+            h = self._holds
+            got = h.get(cond)
+            if got is None:
+                pre_b, post_b, local = self._holds_blobs
+                raw = (pre_b if cond == "pre" else post_b).row(local)
+                # Same keying as ingest/molly.py:attach_run_metadata
+                # ({row[-1]: True ...}); the key list was deduped in order
+                # at store-write time.
+                got = h[cond] = dict.fromkeys(json.loads(raw), True)
+            return got
+
+        time_pre_holds = property(
+            lambda s: s._holds_get("pre"),
+            lambda s, v: s._holds.__setitem__("pre", v),
+        )
+        time_post_holds = property(
+            lambda s: s._holds_get("post"),
+            lambda s, v: s._holds.__setitem__("post", v),
+        )
+
+    _store_run_cls_cache.append(StoreRunData)
+    return StoreRunData
+
+
+def molly_from_corpus(corpus, corpus_dir: str):
+    """StoreCorpus -> MollyOutput, mirroring load_molly_output_packed's
+    product (RawProv placeholders, lazy head-carrying runs, iteration
+    bookkeeping) without touching any source JSON.  The per-run Python work
+    is kept near zero — template-dict construction, lazy holds/trio — so a
+    warm load stays mmap-bound even at 100k-run scale."""
+    LazyRunData, _, _, RawProv = _import_native()
+    from nemo_tpu.ingest.datatypes import RunData
+    from nemo_tpu.ingest.molly import MollyOutput
+
+    StoreRunData = _store_run_cls()
+    out = MollyOutput(
+        run_name=os.path.basename(os.path.normpath(corpus_dir)),
+        output_dir=corpus_dir,
+    )
+    raws = _RawRuns(os.path.join(corpus_dir, "runs.json"), corpus.n_runs)
+    strings = corpus.strings
+    # Every RunData default (future fields included), captured once from the
+    # real constructor; mutable containers are copied per run below.
+    tmpl = RunData().__dict__
+    plain = [(k, v) for k, v in tmpl.items() if not isinstance(v, (list, dict))]
+    mutable = [(k, v) for k, v in tmpl.items() if isinstance(v, (list, dict))]
+    sentinels = {
+        "failure_spec": LazyRunData._SENTINEL,
+        "model": LazyRunData._SENTINEL,
+        "messages": LazyRunData._SENTINEL,
+    }
+    iters = np.asarray(corpus.iteration)
+    iters_list = iters.tolist()  # plain ints: memmap indexing costs ~9 µs/row
+    runs = []
+    row = 0
+    for seg in strings.segments:
+        statuses = seg.meta.blob("status").rows()  # one bulk read
+        hpre_b = seg.meta.blob("holds_pre")
+        hpost_b = seg.meta.blob("holds_post")
+        for local in range(len(statuses)):
+            d = dict(plain)
+            for k, v in mutable:
+                d[k] = v.copy()
+            d["iteration"] = iters_list[row]
+            d["status"] = statuses[local].decode()
+            d["_raw"] = _RawProxy(raws, row)
+            d["_lazy"] = dict(sentinels)
+            d["_head_corpus"] = corpus
+            d["_head_row"] = row
+            d["_holds"] = {}
+            d["_holds_blobs"] = (hpre_b, hpost_b, local)
+            d["pre_prov"] = RawProv(corpus, "pre", row)
+            d["post_prov"] = RawProv(corpus, "post", row)
+            run = StoreRunData.__new__(StoreRunData)
+            run.__dict__ = d
+            runs.append(run)
+            row += 1
+    out.runs = runs
+    # Same bookkeeping attach_run_metadata does, vectorized; `success` is
+    # the stored exact-"success" classification (molly.go:53).
+    succ = np.asarray(corpus.success, dtype=bool)
+    out.runs_iters = iters.tolist()
+    out.success_runs_iters = iters[succ].tolist()
+    out.failed_runs_iters = iters[~succ].tolist()
+    out.native_corpus = corpus
+    return out

@@ -85,6 +85,7 @@ def trace_smoke() -> int:
     pin_platform("cpu")
     with tempfile.TemporaryDirectory(prefix="nemo_trace_smoke_") as tmp:
         os.environ["NEMO_SVG_CACHE"] = os.path.join(tmp, "svg_cache")
+        os.environ["NEMO_CORPUS_CACHE"] = os.path.join(tmp, "corpus_cache")
         os.environ["NEMO_RENDER_WORKERS"] = "2"
         trace_path = os.path.join(tmp, "trace.json")
         t = obs_trace.start_trace(trace_path)
@@ -282,6 +283,7 @@ def obs_smoke() -> int:
     pin_platform("cpu")
     with tempfile.TemporaryDirectory(prefix="nemo_obs_smoke_") as tmp:
         os.environ["NEMO_SVG_CACHE"] = os.path.join(tmp, "svg_cache")
+        os.environ["NEMO_CORPUS_CACHE"] = os.path.join(tmp, "corpus_cache")
         log_path = os.path.join(tmp, "sidecar_log.jsonl")
 
         def free_port() -> int:
@@ -425,6 +427,121 @@ def obs_smoke() -> int:
         return 0
 
 
+def store_smoke() -> int:
+    """Corpus-store smoke (`make store-smoke`, also the tail of `make
+    validate`): cold-populate the persistent .npack store through a real
+    pipeline run, then
+
+      * a warm run must serve ingest from the store (store.hit, no miss)
+        and produce a report tree BYTE-identical to a store-off run;
+      * a deliberately corrupted shard must be rejected (store.stale, loud
+        fallback to the parse path) while the report stays byte-identical,
+        and the fallback must repopulate the store so the next run hits.
+    """
+    from nemo_tpu.utils.jax_config import pin_platform
+
+    pin_platform("cpu")
+    # The corruption leg depends on the default verify/fingerprint policy;
+    # an operator's own NEMO_STORE_VERIFY=off (the documented escape hatch)
+    # must not turn a healthy tree into a red validate (the obs_smoke
+    # NEMO_ANALYSIS_IMPL precedent).  Saved and restored.
+    prior_knobs = {
+        k: os.environ.pop(k, None)
+        for k in ("NEMO_STORE_VERIFY", "NEMO_STORE_FINGERPRINT", "NEMO_STORE_WORKERS")
+    }
+    try:
+        return _store_smoke_inner()
+    finally:
+        for k, v in prior_knobs.items():
+            if v is not None:
+                os.environ[k] = v
+
+
+def _store_smoke_inner() -> int:
+    import glob
+
+    from nemo_tpu import obs
+    from nemo_tpu.analysis.pipeline import run_debug
+    from nemo_tpu.backend.jax_backend import JaxBackend
+    from nemo_tpu.models.synth import SynthSpec, write_corpus
+    from nemo_tpu.store import CorpusStore
+
+    with tempfile.TemporaryDirectory(prefix="nemo_store_smoke_") as tmp:
+        os.environ["NEMO_SVG_CACHE"] = os.path.join(tmp, "svg_cache")
+        cache = os.path.join(tmp, "corpus_cache")
+        corpus = write_corpus(SynthSpec(n_runs=6, seed=3), tmp)
+
+        def run(label: str, corpus_cache: str) -> tuple[dict[str, bytes], dict]:
+            m0 = obs.metrics.snapshot()
+            res = run_debug(
+                corpus,
+                os.path.join(tmp, label),
+                JaxBackend(),
+                figures="all",
+                corpus_cache=corpus_cache,
+            )
+            delta = obs.Metrics.delta(obs.metrics.snapshot(), m0)["counters"]
+            return _tree(res.report_dir), {
+                k: v for k, v in delta.items() if k.startswith("store.")
+            }
+
+        problems: list[str] = []
+        t_off, _ = run("off", "off")
+        t_cold, m_cold = run("cold", cache)
+        if not (m_cold.get("store.miss") and m_cold.get("store.populate")):
+            problems.append(f"cold run did not populate the store: {m_cold}")
+        t_warm, m_warm = run("warm", cache)
+        if not m_warm.get("store.hit") or m_warm.get("store.miss"):
+            problems.append(f"warm run was not served from the store: {m_warm}")
+
+        def diverges(label: str, tree: dict[str, bytes]) -> None:
+            if tree.keys() != t_off.keys():
+                problems.append(
+                    f"{label} file set diverges: {sorted(tree.keys() ^ t_off.keys())[:5]}"
+                )
+                return
+            bad = sorted(k for k in t_off if t_off[k] != tree[k])
+            if bad:
+                problems.append(
+                    f"{label} report DIVERGES from store-off in {len(bad)} "
+                    f"file(s), e.g. {bad[:5]}"
+                )
+
+        diverges("cold-populate", t_cold)
+        diverges("warm store load", t_warm)
+
+        # Deliberate corruption: flip one byte mid-shard; the load must
+        # reject it (stale), re-parse, repopulate, and the report must not
+        # change by a byte.
+        store_dir = CorpusStore(cache).store_dir(corpus)
+        shards = sorted(glob.glob(os.path.join(store_dir, "seg-*", "strings_*.bin")))
+        with open(shards[0], "r+b") as fh:
+            fh.seek(os.path.getsize(shards[0]) // 2)
+            byte = fh.read(1)
+            fh.seek(-1, os.SEEK_CUR)
+            fh.write(bytes([byte[0] ^ 0xFF]))
+        t_corrupt, m_corrupt = run("corrupt", cache)
+        if not m_corrupt.get("store.stale"):
+            problems.append(f"corrupted shard was not rejected: {m_corrupt}")
+        if not m_corrupt.get("store.populate"):
+            problems.append(f"corrupt fallback did not repopulate: {m_corrupt}")
+        diverges("corrupt-fallback", t_corrupt)
+        t_again, m_again = run("again", cache)
+        if not m_again.get("store.hit"):
+            problems.append(f"store not healthy after repopulate: {m_again}")
+        diverges("post-repopulate", t_again)
+
+        if problems:
+            print("store-smoke: " + "; ".join(problems), file=sys.stderr)
+            return 1
+        print(
+            "store-smoke: ok — cold populate, warm mmap load, corrupted-shard "
+            "rejection + repopulate all byte-identical to the store-off report "
+            f"({len(t_off)} files)"
+        )
+        return 0
+
+
 def main() -> int:
     from nemo_tpu.analysis.pipeline import run_debug
     from nemo_tpu.backend.jax_backend import JaxBackend
@@ -435,9 +552,13 @@ def main() -> int:
 
     pin_platform("cpu")  # never touch a (possibly tunneled) device here
     with tempfile.TemporaryDirectory(prefix="nemo_validate_") as tmp:
-        # Hermetic SVG cache: cold for the first pass, warm for the second,
-        # never the user's ~/.cache.
+        # Hermetic SVG + corpus caches: cold for the first pass, warm for
+        # the second, never the user's ~/.cache.  (The corpus store warms
+        # across the passes below, so the parity steps double as a
+        # store-on byte-parity check; the dedicated legs live in
+        # store_smoke.)
         os.environ["NEMO_SVG_CACHE"] = os.path.join(tmp, "svg_cache")
+        os.environ["NEMO_CORPUS_CACHE"] = os.path.join(tmp, "corpus_cache")
         os.environ.pop("NEMO_RENDER_WORKERS", None)
         corpus = write_corpus(SynthSpec(n_runs=6, seed=3), tmp)
 
@@ -573,7 +694,12 @@ def main() -> int:
     rc = trace_smoke()
     if rc:
         return rc
-    return obs_smoke()
+    rc = obs_smoke()
+    if rc:
+        return rc
+    # Corpus-store contract (also standalone: make store-smoke): cold
+    # populate, warm mmap load byte-parity, deliberate corruption rejected.
+    return store_smoke()
 
 
 if __name__ == "__main__":
@@ -581,4 +707,6 @@ if __name__ == "__main__":
         sys.exit(trace_smoke())
     if "--obs-smoke" in sys.argv:
         sys.exit(obs_smoke())
+    if "--store-smoke" in sys.argv:
+        sys.exit(store_smoke())
     sys.exit(main())

@@ -15,6 +15,9 @@ import os
 # the render-pipeline tests opt back in per-test via monkeypatch.
 os.environ.setdefault("NEMO_RENDER_WORKERS", "1")
 os.environ.setdefault("NEMO_SVG_CACHE", "off")
+# ... nor the persistent corpus store (nemo_tpu/store): the store tests opt
+# back in per-test with explicit cache roots under tmp_path.
+os.environ.setdefault("NEMO_CORPUS_CACHE", "off")
 
 _platform = os.environ.get("NEMO_TEST_PLATFORM", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")

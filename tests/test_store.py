@@ -1,0 +1,647 @@
+"""Persistent memory-mapped corpus store (nemo_tpu/store, ISSUE 5):
+round-trip bit-parity vs both ingest producers across all six case-study
+families, invalidation fallbacks (corrupted shard / stale fingerprint /
+old ABI), append-then-load vs repack-from-scratch, concurrent writer
+safety, the pipeline/service integration, and the prefetch-error
+dir-attribution fix."""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from nemo_tpu import obs
+from nemo_tpu.ingest import native
+from nemo_tpu.ingest.molly import load_molly_output
+from nemo_tpu.models.case_studies import CASE_STUDIES, write_case_study
+from nemo_tpu.models.synth import SynthSpec, write_corpus
+from nemo_tpu.store import CorpusStore, resolve_store
+
+_COND_FIELDS = (
+    "table_id",
+    "label_id",
+    "time_id",
+    "type_id",
+    "is_goal",
+    "node_mask",
+    "edge_src",
+    "edge_dst",
+    "edge_mask",
+    "n_nodes",
+    "n_goals",
+    "chain_linear",
+)
+
+needs_native = pytest.mark.skipif(
+    not native.native_available(),
+    reason=f"native lib unavailable: {native.native_error()}",
+)
+
+
+def _store_delta(fn):
+    m0 = obs.metrics.snapshot()
+    out = fn()
+    mc = obs.Metrics.delta(obs.metrics.snapshot(), m0)["counters"]
+    return out, {k: v for k, v in mc.items() if k.startswith("store.")}
+
+
+def _assert_corpus_bit_equal(a, b) -> None:
+    assert a.tables == b.tables and a.labels == b.labels and a.times == b.times
+    assert (a.v, a.e, a.max_depth, a.n_runs) == (b.v, b.e, b.max_depth, b.n_runs)
+    np.testing.assert_array_equal(np.asarray(a.iteration), np.asarray(b.iteration))
+    np.testing.assert_array_equal(np.asarray(a.success), np.asarray(b.success))
+    for cond in ("pre", "post"):
+        for f in _COND_FIELDS:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(a.cond(cond), f)),
+                np.asarray(getattr(b.cond(cond), f)),
+                err_msg=f"{cond}.{f}",
+            )
+    for i in range(a.n_runs):
+        assert a.run_head_json(i) == b.run_head_json(i), f"head row {i}"
+        for cond in ("pre", "post"):
+            assert a.prov_json(cond, i) == b.prov_json(cond, i), f"prov {cond} {i}"
+            assert a.lazy_node_ids(cond, i) == b.lazy_node_ids(cond, i)
+
+
+@needs_native
+@pytest.mark.parametrize("name", sorted(CASE_STUDIES))
+def test_round_trip_bit_parity_native(name, tmp_path):
+    """write-from-native + warm load == a fresh native ingest, bit for bit,
+    for every case-study family."""
+    corpus = write_case_study(name, n_runs=4, seed=9, out_dir=str(tmp_path / "m"))
+    molly = native.load_molly_output_packed(corpus)
+    store = CorpusStore(str(tmp_path / "cache"))
+    assert store.put(corpus, molly)
+    warm = store.load_packed(corpus)
+    assert warm is not None
+    _assert_corpus_bit_equal(molly.native_corpus, warm.native_corpus)
+    # Run-level surface: holds maps, iteration bookkeeping, lazy trio.
+    for rm, rw in zip(molly.runs, warm.runs):
+        assert (rm.iteration, rm.status) == (rw.iteration, rw.status)
+        assert rm.time_pre_holds == rw.time_pre_holds
+        assert rm.time_post_holds == rw.time_post_holds
+    assert molly.runs_iters == warm.runs_iters
+    assert molly.failed_runs_iters == warm.failed_runs_iters
+    assert molly.success_runs_iters == warm.success_runs_iters
+
+
+@needs_native
+def test_python_producer_bit_matches_native(tmp_path):
+    """A store populated by the pure-Python object loader is bit-identical
+    to one populated by the native packed-first loader."""
+    corpus = write_corpus(SynthSpec(n_runs=8, seed=2, eot=6), str(tmp_path))
+    s_py = CorpusStore(str(tmp_path / "cache_py"))
+    s_nat = CorpusStore(str(tmp_path / "cache_nat"))
+    assert s_py.put(corpus, load_molly_output(corpus))
+    assert s_nat.put(corpus, native.load_molly_output_packed(corpus))
+    _assert_corpus_bit_equal(
+        s_py.load_packed(corpus).native_corpus,
+        s_nat.load_packed(corpus).native_corpus,
+    )
+
+
+def test_probe_states(tmp_path):
+    corpus = write_corpus(SynthSpec(n_runs=4, seed=1), str(tmp_path))
+    store = CorpusStore(str(tmp_path / "cache"))
+    assert store.probe(corpus) == "miss"
+    assert store.put(corpus, load_molly_output(corpus))
+    assert store.probe(corpus) == "hit"
+    # Touch a provenance file -> stale (mtime is part of the fingerprint).
+    target = os.path.join(corpus, "run_0_pre_provenance.json")
+    st = os.stat(target)
+    os.utime(target, ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000))
+    assert store.probe(corpus) == "stale"
+
+
+def test_stale_fingerprint_falls_back(tmp_path):
+    corpus = write_corpus(SynthSpec(n_runs=4, seed=1), str(tmp_path))
+    store = CorpusStore(str(tmp_path / "cache"))
+    assert store.put(corpus, load_molly_output(corpus))
+    with open(os.path.join(corpus, "run_1_post_provenance.json"), "a") as fh:
+        fh.write(" ")
+    loaded, mc = _store_delta(lambda: store.load_packed(corpus))
+    assert loaded is None
+    assert mc.get("store.stale") == 1 and not mc.get("store.hit")
+
+
+def test_old_abi_rejected(tmp_path):
+    corpus = write_corpus(SynthSpec(n_runs=4, seed=1), str(tmp_path))
+    store = CorpusStore(str(tmp_path / "cache"))
+    assert store.put(corpus, load_molly_output(corpus))
+    header_path = os.path.join(store.store_dir(corpus), "header.json")
+    with open(header_path) as fh:
+        header = json.load(fh)
+    header["abi"] = header["abi"] - 1
+    with open(header_path, "w") as fh:
+        json.dump(header, fh)
+    loaded, mc = _store_delta(lambda: store.load_packed(corpus))
+    assert loaded is None
+    # An EXISTING store of another format generation is stale (a fleet-wide
+    # version bump must be visible as invalidation), not a cold miss.
+    assert mc.get("store.stale") == 1 and "store.miss" not in mc
+    assert store.probe(corpus) == "stale"
+
+
+def test_corrupt_header_is_stale_not_miss(tmp_path):
+    """A garbled header.json is an EXISTING untrustworthy store: stale (the
+    invalidation signal operators watch), never a silent cold miss."""
+    corpus = write_corpus(SynthSpec(n_runs=4, seed=1), str(tmp_path))
+    store = CorpusStore(str(tmp_path / "cache"))
+    assert store.put(corpus, load_molly_output(corpus))
+    with open(os.path.join(store.store_dir(corpus), "header.json"), "w") as fh:
+        fh.write("{ not json")
+    assert store.probe(corpus) == "stale"
+    loaded, mc = _store_delta(lambda: store.load_packed(corpus))
+    assert loaded is None
+    assert mc.get("store.stale") == 1 and "store.miss" not in mc
+
+
+@needs_native
+def test_pack_molly_dir_served_by_store_without_lib(tmp_path, monkeypatch):
+    """pack_molly_dir (the analyze_dir client producer) takes the host path
+    on a LIB-LESS host when the store holds a warm hit, and the arrays
+    match the native product bit for bit."""
+    corpus = write_corpus(SynthSpec(n_runs=6, seed=3), str(tmp_path))
+    ref = native.pack_molly_dir(corpus)
+    cache = str(tmp_path / "cache")
+    CorpusStore(cache).put(corpus, native.load_molly_output_packed(corpus))
+    monkeypatch.setenv("NEMO_CORPUS_CACHE", cache)
+    monkeypatch.setattr(native, "native_available", lambda: False)
+    assert native.packed_host_available(corpus) is True
+    (pre, post, static), mc = _store_delta(lambda: native.pack_molly_dir(corpus))
+    assert mc.get("store.hit") == 1
+    assert static == ref[2]
+    for a, b in ((pre, ref[0]), (post, ref[1])):
+        for f in a.FIELDS:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(a, f)), np.asarray(getattr(b, f)), err_msg=f
+            )
+    # Cold store + no lib: the host path is unavailable, loudly.
+    other = write_corpus(SynthSpec(n_runs=4, seed=9), str(tmp_path / "o"))
+    assert native.packed_host_available(other) is False
+    with pytest.raises(RuntimeError, match="native ingestion unavailable"):
+        native.pack_molly_dir_host(other)
+
+
+def test_explicit_native_ingest_fails_fast_without_lib(tmp_path, monkeypatch):
+    """--ingest native on a lib-less host must raise, not silently degrade
+    to the Python object loader (the pre-store fail-fast contract)."""
+    from nemo_tpu.analysis.pipeline import run_debug
+    from nemo_tpu.backend.jax_backend import JaxBackend
+
+    corpus = write_corpus(SynthSpec(n_runs=4, seed=1), str(tmp_path))
+    monkeypatch.setattr(native, "native_available", lambda: False)
+    with pytest.raises(RuntimeError, match="native library is unavailable"):
+        run_debug(
+            corpus, str(tmp_path / "r"), JaxBackend(), figures="none",
+            ingest="native", corpus_cache="off",
+        )
+
+
+def test_eviction_over_size_cap(tmp_path, monkeypatch):
+    """NEMO_STORE_MAX_GB bounds the cache root: populating past the cap
+    evicts the least-recently-used store, never the one just written."""
+    c1 = write_corpus(SynthSpec(n_runs=4, seed=1), str(tmp_path / "a"))
+    c2 = write_corpus(SynthSpec(n_runs=4, seed=2), str(tmp_path / "b"))
+    store = CorpusStore(str(tmp_path / "cache"))
+    monkeypatch.setenv("NEMO_STORE_MAX_GB", "1e-5")  # ~10 KB: one store max
+    assert store.put(c1, load_molly_output(c1))
+    _, mc = _store_delta(lambda: store.put(c2, load_molly_output(c2)))
+    assert mc.get("store.evicted", 0) >= 1
+    assert store.probe(c2) == "hit"  # the just-written store survives
+    assert store.probe(c1) == "miss"  # the older one was evicted
+    monkeypatch.setenv("NEMO_STORE_MAX_GB", "0")  # unlimited: no eviction
+    _, mc = _store_delta(lambda: store.put(c1, load_molly_output(c1)))
+    assert "store.evicted" not in mc
+    assert store.probe(c1) == "hit" and store.probe(c2) == "hit"
+
+
+def test_corrupted_shard_falls_back(tmp_path):
+    corpus = write_corpus(SynthSpec(n_runs=4, seed=1), str(tmp_path))
+    store = CorpusStore(str(tmp_path / "cache"))
+    assert store.put(corpus, load_molly_output(corpus))
+    shard = os.path.join(store.store_dir(corpus), "seg-000", "arrays_pre.bin")
+    with open(shard, "r+b") as fh:
+        fh.seek(100)
+        b = fh.read(1)
+        fh.seek(-1, os.SEEK_CUR)
+        fh.write(bytes([b[0] ^ 0x5A]))
+    loaded, mc = _store_delta(lambda: store.load_packed(corpus))
+    assert loaded is None
+    assert mc.get("store.stale") == 1
+    # NEMO_STORE_VERIFY=off skips the checksum pass (operator escape hatch).
+    os.environ["NEMO_STORE_VERIFY"] = "off"
+    try:
+        assert store.load_packed(corpus) is not None
+    finally:
+        del os.environ["NEMO_STORE_VERIFY"]
+
+
+def _grow_corpus(tmp_path, n_old: int, n_total: int):
+    """A corpus dir holding the first n_old runs of an n_total-run corpus,
+    plus the full source dir to grow it from."""
+    full = write_corpus(SynthSpec(n_runs=n_total, seed=2, eot=6), str(tmp_path / "full"))
+    grow = str(tmp_path / "grow" / os.path.basename(full))
+    os.makedirs(grow)
+    raw = json.load(open(os.path.join(full, "runs.json")))
+
+    def copy_runs(lo, hi):
+        for i in range(lo, hi):
+            for c in ("pre", "post"):
+                shutil.copy2(os.path.join(full, f"run_{i}_{c}_provenance.json"), grow)
+            st = os.path.join(full, f"run_{i}_spacetime.dot")
+            if os.path.exists(st):
+                shutil.copy2(st, grow)
+
+    copy_runs(0, n_old)
+    with open(os.path.join(grow, "runs.json"), "w") as fh:
+        json.dump(raw[:n_old], fh)
+
+    def grow_to_full():
+        copy_runs(n_old, n_total)
+        with open(os.path.join(grow, "runs.json"), "w") as fh:
+            json.dump(raw, fh)
+
+    return grow, raw, grow_to_full
+
+
+def test_append_then_load_equals_repack(tmp_path):
+    """Grow the directory after populating; the load must APPEND only the
+    new runs, and the result must be decoded-equal to a repack-from-scratch
+    (same vocab SET and per-slot strings; raw ids may differ because
+    interning order differs) with byte-identical serialized strings."""
+    grow, _, grow_to_full = _grow_corpus(tmp_path, n_old=5, n_total=8)
+    store = CorpusStore(str(tmp_path / "cache"))
+    assert store.put(grow, load_molly_output(grow))
+    grow_to_full()
+    assert store.probe(grow) == "grown"
+    warm, mc = _store_delta(lambda: store.load_packed(grow))
+    assert warm is not None and mc.get("store.append") == 1 and mc.get("store.hit") == 1
+    nw = warm.native_corpus
+    fresh_store = CorpusStore(str(tmp_path / "cache_fresh"))
+    assert fresh_store.put(grow, load_molly_output(grow))
+    nf = fresh_store.load_packed(grow).native_corpus
+    assert nf.n_runs == nw.n_runs == 8
+    assert sorted(nf.tables) == sorted(nw.tables)
+    assert sorted(nf.labels) == sorted(nw.labels)
+    assert sorted(nf.times) == sorted(nw.times)
+    assert (nf.v, nf.e, nf.max_depth) == (nw.v, nw.e, nw.max_depth)
+    for i in range(8):
+        assert nf.run_head_json(i) == nw.run_head_json(i)
+        for cond in ("pre", "post"):
+            assert nf.prov_json(cond, i) == nw.prov_json(cond, i)
+            assert nf.lazy_node_ids(cond, i) == nw.lazy_node_ids(cond, i)
+            cf, cw = nf.cond(cond), nw.cond(cond)
+            n = int(cf.n_nodes[i])
+            assert n == int(cw.n_nodes[i])
+            assert [nf.tables[t] for t in cf.table_id[i, :n]] == [
+                nw.tables[t] for t in cw.table_id[i, :n]
+            ]
+            assert [nf.labels[t] for t in cf.label_id[i, :n]] == [
+                nw.labels[t] for t in cw.label_id[i, :n]
+            ]
+    # A second load is a plain multi-segment hit, no further append.
+    again, mc2 = _store_delta(lambda: store.load_packed(grow))
+    assert again is not None and mc2.get("store.hit") == 1 and "store.append" not in mc2
+
+
+def test_append_report_byte_parity(tmp_path):
+    """End-to-end: a pipeline run over the grown directory served by the
+    appended store is byte-identical to a store-off run."""
+    from nemo_tpu.analysis.pipeline import NONDETERMINISTIC_REPORT_FILES, run_debug
+    from nemo_tpu.backend.jax_backend import JaxBackend
+
+    grow, _, grow_to_full = _grow_corpus(tmp_path, n_old=5, n_total=8)
+    cache = str(tmp_path / "cache")
+    store = CorpusStore(cache)
+    assert store.put(grow, load_molly_output(grow))
+    grow_to_full()
+
+    def tree(root):
+        out = {}
+        for dp, _, fs in os.walk(root):
+            for f in fs:
+                if f in NONDETERMINISTIC_REPORT_FILES:
+                    continue
+                p = os.path.join(dp, f)
+                with open(p, "rb") as fh:
+                    out[os.path.relpath(p, root)] = fh.read()
+        return out
+
+    on, mc = _store_delta(
+        lambda: run_debug(
+            grow, str(tmp_path / "on"), JaxBackend(), figures="all", corpus_cache=cache
+        )
+    )
+    assert mc.get("store.append") == 1 and mc.get("store.hit") == 1
+    off = run_debug(
+        grow, str(tmp_path / "off"), JaxBackend(), figures="all", corpus_cache="off"
+    )
+    t_on, t_off = tree(on.report_dir), tree(off.report_dir)
+    assert t_on.keys() == t_off.keys()
+    assert [k for k in t_off if t_off[k] != t_on[k]] == []
+
+
+def test_append_refused_when_old_entries_mutated(tmp_path):
+    """Growing the dir while ALSO rewriting an old runs.json entry must not
+    append stale heads — the store goes stale and re-parses."""
+    grow, raw, grow_to_full = _grow_corpus(tmp_path, n_old=5, n_total=8)
+    store = CorpusStore(str(tmp_path / "cache"))
+    assert store.put(grow, load_molly_output(grow))
+    grow_to_full()
+    mutated = json.loads(json.dumps(raw))
+    mutated[0]["status"] = "definitely-not-" + str(mutated[0].get("status", ""))
+    with open(os.path.join(grow, "runs.json"), "w") as fh:
+        json.dump(mutated, fh)
+    loaded, mc = _store_delta(lambda: store.load_packed(grow))
+    assert loaded is None
+    assert mc.get("store.stale") == 1 and not mc.get("store.append")
+
+
+def test_concurrent_writers_safe(tmp_path):
+    """Several threads populating the same corpus concurrently must leave
+    one valid store (atomic tmp-dir + rename under the root lock)."""
+    corpus = write_corpus(SynthSpec(n_runs=6, seed=3), str(tmp_path))
+    molly = load_molly_output(corpus)
+    store = CorpusStore(str(tmp_path / "cache"))
+    errors: list[BaseException] = []
+
+    def put():
+        try:
+            assert store.put(corpus, load_molly_output(corpus))
+        except BaseException as ex:  # surfaced below
+            errors.append(ex)
+
+    threads = [threading.Thread(target=put) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    leftovers = [
+        d
+        for d in os.listdir(str(tmp_path / "cache"))
+        if ".tmp-" in d or ".doomed-" in d
+    ]
+    assert leftovers == []
+    warm = store.load_packed(corpus)
+    assert warm is not None
+    if molly and getattr(molly, "native_corpus", None) is not None:
+        _assert_corpus_bit_equal(molly.native_corpus, warm.native_corpus)
+
+
+def test_symlink_alias_maps_to_same_store(tmp_path):
+    """A symlink alias of a corpus resolves to the SAME store (basename and
+    hash both derive from the realpath) — no second full mirror."""
+    corpus = write_corpus(SynthSpec(n_runs=4, seed=1), str(tmp_path))
+    alias = str(tmp_path / "latest")
+    os.symlink(corpus, alias)
+    store = CorpusStore(str(tmp_path / "cache"))
+    assert store.store_dir(alias) == store.store_dir(corpus)
+    assert store.put(alias, load_molly_output(alias))
+    assert store.probe(corpus) == "hit"
+
+
+def test_resolve_store_off_and_env(tmp_path, monkeypatch):
+    assert resolve_store("off") is None
+    monkeypatch.setenv("NEMO_CORPUS_CACHE", "off")
+    assert resolve_store() is None
+    monkeypatch.setenv("NEMO_CORPUS_CACHE", str(tmp_path / "c"))
+    assert resolve_store().root == str(tmp_path / "c")
+    # Explicit arg wins over env.
+    assert resolve_store("off") is None
+
+
+def test_store_serves_packed_ingest_without_native_lib(tmp_path, monkeypatch):
+    """A warm store hit upgrades auto ingest to the packed path even when
+    the C++ engine is unavailable — lib-less hosts load arrays by mmap."""
+    from nemo_tpu.analysis.pipeline import _choose_packed_ingest, run_debug
+    from nemo_tpu.backend.jax_backend import JaxBackend
+
+    corpus = write_corpus(SynthSpec(n_runs=4, seed=1), str(tmp_path))
+    cache = str(tmp_path / "cache")
+    store = CorpusStore(cache)
+    assert store.put(corpus, load_molly_output(corpus))
+    monkeypatch.setattr(native, "native_available", lambda: False)
+    backend = JaxBackend()
+    assert _choose_packed_ingest(backend, None, store) is True
+    assert _choose_packed_ingest(backend, None, None) is False  # store disabled
+    res, mc = _store_delta(
+        lambda: run_debug(
+            corpus, str(tmp_path / "r"), backend, figures="none", corpus_cache=cache
+        )
+    )
+    assert mc.get("store.hit") == 1
+    assert res.molly.native_corpus is not None
+
+
+def test_libless_cold_run_populates_store(tmp_path, monkeypatch):
+    """On a lib-less host with a COLD cache, the first run parses via the
+    object loader and POPULATES, so the second run is a warm mmap load."""
+    from nemo_tpu.analysis.pipeline import run_debug
+    from nemo_tpu.backend.jax_backend import JaxBackend
+
+    corpus = write_corpus(SynthSpec(n_runs=4, seed=1), str(tmp_path))
+    cache = str(tmp_path / "cache")
+    monkeypatch.setattr(native, "native_available", lambda: False)
+    _, mc1 = _store_delta(
+        lambda: run_debug(
+            corpus, str(tmp_path / "r1"), JaxBackend(), figures="none",
+            corpus_cache=cache,
+        )
+    )
+    assert mc1.get("store.miss") == 1 and mc1.get("store.populate") == 1, mc1
+    res2, mc2 = _store_delta(
+        lambda: run_debug(
+            corpus, str(tmp_path / "r2"), JaxBackend(), figures="none",
+            corpus_cache=cache,
+        )
+    )
+    assert mc2.get("store.hit") == 1 and "store.miss" not in mc2, mc2
+    assert res2.molly.native_corpus is not None
+
+
+def test_append_refused_when_old_heads_mutated(tmp_path):
+    """Old runs.json entries rewritten with STABLE iteration/status but
+    changed metadata (the head-fragment fields) must refuse the append —
+    stale heads would otherwise splice into debugging.json."""
+    grow, raw, grow_to_full = _grow_corpus(tmp_path, n_old=5, n_total=8)
+    store = CorpusStore(str(tmp_path / "cache"))
+    assert store.put(grow, load_molly_output(grow))
+    grow_to_full()
+    mutated = json.loads(json.dumps(raw))
+    mutated[2].setdefault("messages", []).append(
+        {"table": "ghost", "from": "a", "to": "b", "sendTime": 1, "receiveTime": 2}
+    )
+    with open(os.path.join(grow, "runs.json"), "w") as fh:
+        json.dump(mutated, fh)
+    loaded, mc = _store_delta(lambda: store.load_packed(grow))
+    assert loaded is None
+    assert mc.get("store.stale") == 1 and not mc.get("store.append")
+
+
+def test_prefetch_error_names_the_dir(tmp_path, monkeypatch):
+    """run_debug_dirs' prefetch thread must attribute ingest failures to the
+    originating corpus directory (ISSUE 5 satellite fix)."""
+    import nemo_tpu.utils as utils
+    from nemo_tpu.analysis.pipeline import run_debug_dirs
+    from nemo_tpu.backend.jax_backend import JaxBackend
+
+    # Force the prefetch thread even on a 1-core CI host.
+    monkeypatch.setattr(utils, "effective_cpu_count", lambda: 2)
+    good = write_corpus(SynthSpec(n_runs=4, seed=1), str(tmp_path))
+    bad = str(tmp_path / "bad_corpus")
+    os.makedirs(bad)
+    with open(os.path.join(bad, "runs.json"), "w") as fh:
+        fh.write("this is not json")
+    with pytest.raises(Exception) as exc_info:
+        run_debug_dirs(
+            [good, bad],
+            str(tmp_path / "results"),
+            JaxBackend,
+            figures="none",
+            corpus_cache="off",
+        )
+    assert "bad_corpus" in str(exc_info.value)
+
+
+def test_attach_ingest_dir_arg_shapes():
+    from nemo_tpu.analysis.pipeline import _attach_ingest_dir
+
+    ex = _attach_ingest_dir(ValueError("boom"), "/d")
+    assert "boom (while ingesting /d)" in str(ex)
+    # OSError keeps its (errno, strerror) shape; the strerror is annotated.
+    ex = _attach_ingest_dir(OSError(2, "No such file"), "/d")
+    assert isinstance(ex, OSError) and "/d" in str(ex)
+    # No string arg at all: the note is appended.
+    ex = _attach_ingest_dir(KeyError(42), "/d")
+    assert "/d" in str(ex.args)
+
+
+def test_store_inspect_tool(tmp_path):
+    sys.path.insert(
+        0,
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"),
+    )
+    import store_inspect
+
+    corpus = write_corpus(SynthSpec(n_runs=4, seed=1), str(tmp_path))
+    store = CorpusStore(str(tmp_path / "cache"))
+    assert store.put(corpus, load_molly_output(corpus))
+    sd = store.store_dir(corpus)
+    assert store_inspect.main([sd]) == 0
+    # Resolution through a corpus dir + --cache, and corruption detection.
+    assert store_inspect.main([corpus, "--cache", str(tmp_path / "cache")]) == 0
+    shard = os.path.join(sd, "seg-000", "runs.bin")
+    with open(shard, "r+b") as fh:
+        fh.seek(4)
+        fh.write(b"\xff")
+    assert store_inspect.main([sd]) == 1
+
+
+@needs_native
+def test_pack_molly_dir_host_served_by_store(tmp_path, monkeypatch):
+    """The client-side pack path (analyze_dir / analyze_dir_pipelined's
+    producer) consumes a warm store: identical arrays + statics, no parse."""
+    corpus = write_corpus(SynthSpec(n_runs=6, seed=3), str(tmp_path))
+    ref_c, ref_static = native.pack_molly_dir_host(corpus)
+    cache = str(tmp_path / "cache")
+    CorpusStore(cache).put(corpus, native.load_molly_output_packed(corpus))
+    monkeypatch.setenv("NEMO_CORPUS_CACHE", cache)
+    (warm_c, warm_static), mc = _store_delta(
+        lambda: native.pack_molly_dir_host(corpus)
+    )
+    assert mc.get("store.hit") == 1
+    assert warm_static == ref_static
+    for cond in ("pre", "post"):
+        for f in _COND_FIELDS:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(ref_c.cond(cond), f)),
+                np.asarray(getattr(warm_c.cond(cond), f)),
+                err_msg=f"{cond}.{f}",
+            )
+
+
+def test_service_analyze_dir_server_side(tmp_path, monkeypatch):
+    """The AnalyzeDir RPC: server-side ingest through the sidecar's own
+    store — first call populates, second hits (array-only load), outputs
+    equal the upload-path Analyze results.  Store authority is the
+    operator's: a client can opt OUT but never enable or redirect a
+    disabled server-side store."""
+    pytest.importorskip("grpc")
+    from nemo_tpu.service.client import RemoteAnalyzer, analyze_dir
+    from nemo_tpu.service.server import make_server
+
+    corpus = write_corpus(SynthSpec(n_runs=4, seed=1), str(tmp_path))
+    cache = str(tmp_path / "server_cache")
+    server, port = make_server(port=0)
+    server.start()
+    try:
+        ref = analyze_dir(f"127.0.0.1:{port}", corpus)  # upload path, store off
+        with RemoteAnalyzer(target=f"127.0.0.1:{port}") as client:
+            client.wait_ready()
+            monkeypatch.setenv("NEMO_CORPUS_CACHE", cache)
+            out1, mc1 = _store_delta(lambda: client.analyze_dir_remote(corpus))
+            out2, mc2 = _store_delta(lambda: client.analyze_dir_remote(corpus))
+            # Client opt-out is honored...
+            _, mc3 = _store_delta(
+                lambda: client.analyze_dir_remote(corpus, corpus_cache="off")
+            )
+            # ...but a client-chosen path cannot enable a disabled store.
+            monkeypatch.setenv("NEMO_CORPUS_CACHE", "off")
+            evil = str(tmp_path / "client_chosen_cache")
+            _, mc4 = _store_delta(
+                lambda: client.analyze_dir_remote(corpus, corpus_cache=evil)
+            )
+            # Valid JSON that is not an object fails with the clear status.
+            import grpc
+
+            with pytest.raises(grpc.RpcError) as rpc_err:
+                client._analyze_dir([1], timeout=10)
+            assert rpc_err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    finally:
+        server.stop(grace=None)
+    assert mc1.get("store.populate") == 1 and mc2.get("store.hit") == 1
+    assert not any(k.startswith("store.") for k in mc3), mc3
+    assert not any(k.startswith("store.") for k in mc4), mc4
+    assert not os.path.exists(evil)
+    assert set(ref) == set(out1) == set(out2)
+    for k in ref:
+        np.testing.assert_array_equal(ref[k], out1[k], err_msg=k)
+        np.testing.assert_array_equal(out1[k], out2[k], err_msg=k)
+
+
+def test_populate_sweeps_aged_wreckage(tmp_path):
+    """Crash leftovers (interrupted populate tmp dirs / replace victims)
+    older than the age guard are swept at populate time; fresh ones — a
+    possibly LIVE concurrent populate — are left alone."""
+    corpus = write_corpus(SynthSpec(n_runs=4, seed=1), str(tmp_path))
+    store = CorpusStore(str(tmp_path / "cache"))
+    os.makedirs(store.root)
+    old_tmp = os.path.join(store.root, "dead.npack.tmp-123-abc")
+    fresh_tmp = os.path.join(store.root, "live.npack.tmp-456-def")
+    for d in (old_tmp, fresh_tmp):
+        os.makedirs(d)
+        with open(os.path.join(d, "junk.bin"), "wb") as fh:
+            fh.write(b"x" * 128)
+    # Interrupted-APPEND leftovers live INSIDE a store directory.
+    inner_store = os.path.join(store.root, "other.npack")
+    inner_tmp = os.path.join(inner_store, "seg-001.tmp-9f")
+    os.makedirs(inner_tmp)
+    import time as _time
+
+    aged = CorpusStore._WRECKAGE_MAX_AGE_S + 60
+    for p in (old_tmp, inner_tmp):
+        os.utime(p, (os.path.getatime(p), _time.time() - aged))
+    _, mc = _store_delta(lambda: store.put(corpus, load_molly_output(corpus)))
+    assert mc.get("store.gc_wreckage") == 2
+    assert not os.path.exists(old_tmp)
+    assert not os.path.exists(inner_tmp)
+    assert os.path.exists(fresh_tmp)

@@ -80,6 +80,22 @@ def extract_metrics(doc: dict) -> dict[str, tuple[float, str, str]]:
     put("giant.warm_s", giant.get("warm_s"), "lower", "s")
     tier = doc.get("analysis_tier") or {}
     put("analysis_tier.sparse_sweep_s", tier.get("sparse_sweep_s"), "lower", "s")
+    # Corpus-store ingest tier (ISSUE 5): a warm mmap load regressing toward
+    # the cold parse wall, or the store bloating on disk, flags here.
+    ingest = doc.get("ingest_tier") or {}
+    put("ingest_tier.cold_parse_s", ingest.get("cold_parse_s"), "lower", "s")
+    put("ingest_tier.warm_load_s", ingest.get("warm_load_s"), "lower", "s")
+    put("ingest_tier.warm_speedup", ingest.get("warm_speedup"), "higher", "ratio")
+    put("ingest_tier.store_mb", ingest.get("store_mb"), "lower", "mb")
+    # Floorless companion (the 'mb' 64 MB floor was sized for RSS and would
+    # mask a 3x bloat of a tens-of-MB bench store): bytes per stored run.
+    if isinstance(ingest.get("store_mb"), (int, float)) and ingest.get("runs"):
+        put(
+            "ingest_tier.store_bytes_per_run",
+            ingest["store_mb"] * 1e6 / ingest["runs"],
+            "lower",
+            "ratio",
+        )
     figures = doc.get("figures") or {}
     put(
         "figures.e2e_warm_all_figures_s",

@@ -1,0 +1,175 @@
+#!/usr/bin/env python3
+"""Inspect and verify a ``.npack`` corpus store from the command line.
+
+Dumps the header (format/ABI versions, source fingerprint, vocabulary
+sizes), the segment manifest (runs / bucket dims / shard table with sizes
+and checksums), and — by default — verifies every shard's CRC32 AND SHA-256
+against the manifest, exiting nonzero on any mismatch (the integrity audit
+``nemo_tpu/store`` loads only CRC-check).
+
+Usage:
+    python tools/store_inspect.py PATH [--no-verify] [--json]
+
+PATH is either a ``.npack`` store directory (contains header.json) or a
+Molly corpus directory — the latter is resolved through the corpus cache
+root (``--cache`` or ``NEMO_CORPUS_CACHE``'s resolution, including its
+``~/.cache/nemo_tpu/corpus`` default).
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import zlib
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def _resolve(path: str, cache: str | None) -> str:
+    if os.path.isfile(os.path.join(path, "header.json")):
+        return path
+    from nemo_tpu.store import resolve_store
+
+    store = resolve_store(cache)
+    if store is None:
+        raise SystemExit(
+            f"{path} is not a .npack store and the corpus cache is disabled "
+            "(pass --cache or unset NEMO_CORPUS_CACHE=off)"
+        )
+    sd = store.store_dir(path)
+    if not os.path.isfile(os.path.join(sd, "header.json")):
+        raise SystemExit(f"no store for corpus {path} (looked at {sd})")
+    return sd
+
+
+def _verify_shard(path: str, manifest: dict) -> list[str]:
+    problems = []
+    try:
+        size = os.path.getsize(path)
+    except OSError as ex:
+        return [f"{manifest['file']}: unreadable ({ex})"]
+    if size != int(manifest["nbytes"]):
+        problems.append(
+            f"{manifest['file']}: size {size} != manifest {manifest['nbytes']}"
+        )
+        return problems
+    crc = 0
+    sha = hashlib.sha256()
+    with open(path, "rb") as fh:
+        while True:
+            chunk = fh.read(1 << 22)
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+            sha.update(chunk)
+    if (crc & 0xFFFFFFFF) != int(manifest["crc32"]):
+        problems.append(f"{manifest['file']}: crc32 mismatch")
+    if sha.hexdigest() != manifest["sha256"]:
+        problems.append(f"{manifest['file']}: sha256 mismatch")
+    return problems
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="store_inspect", description=__doc__.splitlines()[0]
+    )
+    ap.add_argument("path", help=".npack store directory OR Molly corpus directory")
+    ap.add_argument(
+        "--cache",
+        default=None,
+        metavar="DIR",
+        help="corpus cache root used to resolve a corpus-directory PATH "
+        "(default: NEMO_CORPUS_CACHE resolution)",
+    )
+    ap.add_argument(
+        "--no-verify",
+        action="store_true",
+        help="skip the checksum pass (header/manifest dump only)",
+    )
+    ap.add_argument("--json", action="store_true", help="machine-readable output")
+    args = ap.parse_args(argv)
+
+    store_dir = _resolve(args.path, args.cache)
+    with open(os.path.join(store_dir, "header.json"), "r", encoding="utf-8") as fh:
+        header = json.load(fh)
+
+    problems: list[str] = []
+    shard_rows = []
+    total_bytes = 0
+    all_shards = [(None, header["vocab_shard"])]
+    for seg in header["segments"]:
+        for m in seg["shards"]:
+            all_shards.append((seg["name"], m))
+    for seg_name, m in all_shards:
+        path = os.path.join(store_dir, *( [seg_name] if seg_name else [] ), m["file"])
+        total_bytes += int(m["nbytes"])
+        row = {
+            "segment": seg_name,
+            "file": m["file"],
+            "nbytes": int(m["nbytes"]),
+            "crc32": f"{int(m['crc32']):#010x}",
+            "sha256": m["sha256"][:16],
+            "regions": len(m["regions"]),
+        }
+        if not args.no_verify:
+            errs = _verify_shard(path, m)
+            row["ok"] = not errs
+            problems += errs
+        shard_rows.append(row)
+
+    src = header.get("source", {})
+    doc = {
+        "store": store_dir,
+        "format": header.get("format"),
+        "abi": header.get("abi"),
+        "source_dir": src.get("dir"),
+        "n_runs": src.get("n_runs"),
+        "segments": [
+            {
+                "name": s["name"],
+                "n_runs": s["n_runs"],
+                "v": s["v"],
+                "e": s["e"],
+                "max_depth": s["max_depth"],
+                "shards": len(s["shards"]),
+            }
+            for s in header["segments"]
+        ],
+        "total_mb": round(total_bytes / 1e6, 2),
+        "verified": not args.no_verify,
+        "problems": problems,
+    }
+    if args.json:
+        print(json.dumps(doc, indent=1))
+    else:
+        print(f"store:    {store_dir}")
+        print(f"format:   npack v{doc['format']} / abi {doc['abi']}")
+        print(f"source:   {doc['source_dir']}  ({doc['n_runs']} runs)")
+        for s in doc["segments"]:
+            print(
+                f"segment:  {s['name']}  runs={s['n_runs']}  V={s['v']} "
+                f"E={s['e']} depth={s['max_depth']}  shards={s['shards']}"
+            )
+        print(f"size:     {doc['total_mb']} MB across {len(shard_rows)} shards")
+        for r in shard_rows:
+            loc = f"{r['segment']}/{r['file']}" if r["segment"] else r["file"]
+            status = "" if args.no_verify else ("  OK" if r["ok"] else "  CORRUPT")
+            print(
+                f"  {loc:<28} {r['nbytes']:>12} B  crc {r['crc32']}  "
+                f"sha {r['sha256']}…{status}"
+            )
+        if problems:
+            print("PROBLEMS:")
+            for p in problems:
+                print(f"  {p}")
+        elif not args.no_verify:
+            print("integrity: all checksums verified")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
