@@ -5,7 +5,7 @@ NATIVE_LIB := native/build/libnemo_native.so
 REPORT_SRC := native/nemo_report.cpp
 REPORT_LIB := native/build/libnemo_report.so
 
-.PHONY: all native test bench bench-watch bench-trend prewarm validate trace-smoke obs-smoke store-smoke lint-print clean reset proto neo4j-up neo4j-validate neo4j-down
+.PHONY: all native test bench bench-watch bench-trend prewarm validate trace-smoke obs-smoke store-smoke delta-smoke lint-print clean reset proto neo4j-up neo4j-validate neo4j-down
 
 all: native
 
@@ -24,7 +24,8 @@ test:
 # Everything a reviewer needs in one command: the print lint, the full
 # suite, the driver's multi-chip dry run (8 virtual CPU devices), and a CLI
 # smoke whose jax report is byte-compared against the Python oracle backend
-# (whose tail runs the trace + operational-observability smokes).
+# (whose tail runs the trace, operational-observability, corpus-store and
+# result-cache/delta smokes).
 validate: lint-print test
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 		python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
@@ -53,6 +54,15 @@ obs-smoke:
 # byte-identical (nemo_tpu/store).
 store-smoke:
 	python -m nemo_tpu.utils.validate_smoke --store-smoke
+
+# Result-cache + incremental-delta smoke (also the tail of `make
+# validate`): populate the content-addressed analysis result cache through
+# a real pipeline run, re-run asserting a full-report cache hit with ZERO
+# kernel dispatches, then grow the corpus directory and assert only the
+# new runs were mapped and the merged report is byte-identical to a
+# from-scratch run (analysis/delta.py, nemo_tpu/store/rcache.py).
+delta-smoke:
+	python -m nemo_tpu.utils.validate_smoke --delta-smoke
 
 # Structured-logging contract: no bare print() in nemo_tpu/ outside the
 # CLI/harness allowlist (tools/lint_no_print.py).

@@ -1,0 +1,761 @@
+"""Per-segment map + associative reduce over the analysis pipeline.
+
+The corpus store (nemo_tpu/store) persists a Molly directory as append-only
+*segments*: an incremental sweep appends a new segment and never rewrites an
+old one.  This module decomposes ``run_debug``'s analysis along exactly that
+axis:
+
+  * **map** (:func:`map_runs`): the per-run verbs — condition marking,
+    simplification, hazard/provenance figures, per-run prototype rule
+    tables, good-anchored differential provenance — executed over one set
+    of runs (a segment, or the whole corpus when nothing is cached).  Every
+    per-run output is independent of which other runs share the batch
+    (the sparse/dense parity suites pin this), so mapping a subset produces
+    bit-identical per-run artifacts.
+  * **reduce** (:func:`reduce_partials`): the cross-run aggregation —
+    prototype intersection/union (analysis/protos.py set algebra over
+    per-run tables), per-failed-run missing lists, the achieved-antecedent
+    count, correction/extension tables, recommendation assembly inputs.
+    The reduce consumes per-run artifacts keyed by iteration and imposes
+    global run order itself, so merging partials is order-insensitive —
+    the property that lets a grown corpus merge cached per-segment
+    partials with freshly mapped ones (and, later, lets the run axis shard
+    across workers).
+
+A :class:`SegmentPartial` is the serializable intermediate between the two
+phases: everything the reduce needs from one segment's runs, as plain
+strings/ints (vocabulary-id free, so vocab growth across appends cannot
+invalidate it), plus the names of the segment's rendered figure files.
+Partials and whole report trees are cached content-addressed by
+``nemo_tpu/store/rcache.py``.
+
+Cache-key anchors: the differential verbs are computed *against* the
+corpus's good run, and extensions against its baseline run, so a partial is
+keyed on (its own segment fingerprint, the good/baseline identity and the
+fingerprints of the segments holding them, the analysis config, and the
+ABI/versions below).  :data:`ANALYSIS_ABI_VERSION` must be bumped whenever
+a kernel or reduce semantic changes output bytes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from nemo_tpu import obs
+from nemo_tpu.ingest.datatypes import MissingEvent
+
+#: Bump when any analysis kernel / verb / reduce semantic changes its
+#: OUTPUT (not its speed): every cached result is keyed on this, so a bump
+#: invalidates the whole result cache at once — the cheap, always-correct
+#: fleet-wide invalidation (the corpus store's NPACK_ABI_VERSION precedent).
+ANALYSIS_ABI_VERSION = 1
+
+_log = obs.log.get_logger("nemo.delta")
+
+#: Figure families rendered per selected run (writer.py naming:
+#: run_<iter>_<family>.{dot,svg}), in report generation order.
+FIG_FAMILIES = (
+    "spacetime",
+    "pre_prov",
+    "post_prov",
+    "pre_prov_clean",
+    "post_prov_clean",
+)
+#: Families rendered only for figure-selected FAILED runs when a good run
+#: exists to diff against.
+DIFF_FAMILIES = ("diff_post_prov-diff", "diff_post_prov-failed")
+
+
+# ---------------------------------------------------------------------------
+# good/baseline run selection (pure functions of the corpus)
+# ---------------------------------------------------------------------------
+
+
+def choose_good_run(molly) -> int | None:
+    """The baseline successful run used for differential provenance — the
+    first status-success run that ACHIEVED the consequent, else the first
+    status-success run, else None.  Single definition shared with
+    ``GraphBackend.good_run_iter`` (backend/base.py) so the pipeline-level
+    choice and every backend's internal choice can never drift."""
+    succ = molly.get_success_runs_iters()
+    if not succ:
+        return None
+    by_iter = {r.iteration: r for r in molly.runs}
+    for i in succ:
+        if by_iter[i].time_post_holds:
+            return i
+    return succ[0]
+
+
+def choose_baseline_run(molly, good_iter: int | None) -> int | None:
+    """The good run when one exists, else the first run (the run whose
+    antecedent provenance seeds extension candidates —
+    ``GraphBackend.baseline_run_iter``)."""
+    if good_iter is not None:
+        return good_iter
+    return molly.runs[0].iteration if molly.runs else None
+
+
+# ---------------------------------------------------------------------------
+# segments
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Segment:
+    """One store segment's slice of the corpus (or the whole corpus as a
+    single anonymous segment when no store served the ingest)."""
+
+    name: str
+    fingerprint: str | None  # None = anonymous (not cacheable)
+    start: int  # first global run POSITION
+    n_runs: int
+
+    @property
+    def stop(self) -> int:
+        return self.start + self.n_runs
+
+
+def corpus_segments(molly) -> list[Segment]:
+    """Segment spans of this corpus, from the store metadata the ingest
+    attached (``molly.store_segments``); a single anonymous segment
+    otherwise.  Positions index ``molly.runs`` — the store consolidates
+    segments in append order, so segment rows are contiguous."""
+    meta = getattr(molly, "store_segments", None)
+    if not meta or sum(int(m["n_runs"]) for m in meta) != len(molly.runs):
+        return [Segment("all", None, 0, len(molly.runs))]
+    out, start = [], 0
+    for m in meta:
+        n = int(m["n_runs"])
+        out.append(Segment(str(m["name"]), m.get("fingerprint"), start, n))
+        start += n
+    return out
+
+
+def config_blob(figures: str) -> dict:
+    """Everything besides the corpus content that determines report bytes:
+    the figure policy and every output-affecting version.  Part of every
+    cache key."""
+    from nemo_tpu.report.native import REPORT_ABI_VERSION
+    from nemo_tpu.report.svg import RENDER_FORMAT_VERSION
+    from nemo_tpu.store.npack import NPACK_ABI_VERSION
+
+    return {
+        "figures": figures or "all",
+        "analysis_abi": ANALYSIS_ABI_VERSION,
+        "report_abi": REPORT_ABI_VERSION,
+        "render_format": RENDER_FORMAT_VERSION,
+        "npack_abi": NPACK_ABI_VERSION,
+    }
+
+
+def _key(doc: dict) -> str:
+    return hashlib.sha256(
+        json.dumps(doc, sort_keys=True, separators=(",", ":")).encode()
+    ).hexdigest()
+
+
+def report_cache_key(molly, figures: str) -> str | None:
+    """Content address of the full report tree: every segment fingerprint +
+    the config/ABI blob.  None when any segment is anonymous (no store —
+    nothing fingerprints the content, so a hit is impossible)."""
+    segs = corpus_segments(molly)
+    if any(s.fingerprint is None for s in segs):
+        return None
+    return _key(
+        {"kind": "report", "config": config_blob(figures),
+         "segments": [s.fingerprint for s in segs]}
+    )
+
+
+def partial_cache_key(
+    seg: Segment,
+    segments: list[Segment],
+    good_iter: int | None,
+    baseline_iter: int | None,
+    figures: str,
+) -> str | None:
+    """Content address of one segment's partial.  Besides the segment's own
+    fingerprint and the config blob, the key pins the ANCHOR context: the
+    good/baseline run identities and the fingerprints of the segments
+    holding them — differential provenance, corrections and extensions are
+    functions of those runs' graphs, so a changed anchor (e.g. a grown
+    corpus whose first achieving success appears in a NEW segment) must
+    miss every old partial.  Delta caching is disabled (None) for the
+    ``sample:N`` figure policy: its selection depends on the whole corpus's
+    run list, so per-segment figure ownership does not decompose."""
+    if seg.fingerprint is None:
+        return None
+    if (figures or "all").startswith("sample:"):
+        return None
+
+    def anchor_fp(it: int | None) -> str | None:
+        if it is None:
+            return None
+        pos = _position_of(segments, it)
+        if pos is None:
+            return None
+        return next(
+            (s.fingerprint for s in segments if s.start <= pos < s.stop), None
+        )
+
+    # Anchor positions resolve through the segment table built from the
+    # SAME molly, so a lookup failure means an anonymous corpus — handled
+    # by the fingerprint None check above.
+    return _key(
+        {
+            "kind": "partial",
+            "config": config_blob(figures),
+            "segment": seg.fingerprint,
+            "good": [good_iter, anchor_fp(good_iter)],
+            "baseline": [baseline_iter, anchor_fp(baseline_iter)],
+        }
+    )
+
+
+#: iteration -> global position memo per segment-table identity (tiny).
+def _position_of(segments: list[Segment], iteration: int) -> int | None:
+    tbl = getattr(segments[0], "_pos_by_iter", None)
+    return None if tbl is None else tbl.get(iteration)
+
+
+def attach_positions(segments: list[Segment], molly) -> list[Segment]:
+    """Give the segment table an iteration->position index (duplicate
+    iterations keep the FIRST position, like every by-iter dict in the
+    pipeline)."""
+    tbl: dict[int, int] = {}
+    for pos, r in enumerate(molly.runs):
+        tbl.setdefault(r.iteration, pos)
+    for s in segments:
+        s._pos_by_iter = tbl  # type: ignore[attr-defined]
+    return segments
+
+
+# ---------------------------------------------------------------------------
+# sub-corpus views (the delta path maps only new rows)
+# ---------------------------------------------------------------------------
+
+_COND_FIELDS = (
+    "table_id",
+    "label_id",
+    "time_id",
+    "type_id",
+    "is_goal",
+    "node_mask",
+    "edge_src",
+    "edge_dst",
+    "edge_mask",
+    "n_nodes",
+    "n_goals",
+    "chain_linear",
+)
+
+
+class _CorpusRowView:
+    """Row-subset view of a NativeCorpus/StoreCorpus: batch arrays sliced to
+    the selected rows (fancy-index copies — delta maps are small by
+    construction), per-run string accessors delegated to the base corpus by
+    original row."""
+
+    def __init__(self, base, rows: list[int]) -> None:
+        from nemo_tpu.ingest.native import NativeCondBatch
+
+        idx = np.asarray(rows, dtype=np.int64)
+        self._base = base
+        self._rows = list(rows)
+        self.n_runs = len(rows)
+        self.v = base.v
+        self.e = base.e
+        self.max_depth = base.max_depth
+        self.tables = base.tables
+        self.labels = base.labels
+        self.times = base.times
+        self.pre_tid = base.pre_tid
+        self.post_tid = base.post_tid
+        self.iteration = np.asarray(base.iteration)[idx]
+        self.success = np.asarray(base.success)[idx]
+        self.pre = NativeCondBatch(
+            **{f: np.asarray(getattr(base.pre, f))[idx] for f in _COND_FIELDS}
+        )
+        self.post = NativeCondBatch(
+            **{f: np.asarray(getattr(base.post, f))[idx] for f in _COND_FIELDS}
+        )
+
+    def cond(self, name: str):
+        return self.pre if name == "pre" else self.post
+
+    def prov_json(self, cond_name: str, row: int) -> bytes:
+        return self._base.prov_json(cond_name, self._rows[row])
+
+    def run_head_json(self, row: int) -> bytes:
+        return self._base.run_head_json(self._rows[row])
+
+    def lazy_node_ids(self, cond_name: str, row: int) -> list[str]:
+        return self._base.lazy_node_ids(cond_name, self._rows[row])
+
+
+def subset_molly(molly, rows: list[int]):
+    """MollyOutput view over a row subset (global positions, ascending).
+    Run objects are SHARED with the full molly — the map phase never
+    mutates them; only the reduce-side recommendation assembly does, and it
+    operates on the full molly."""
+    from nemo_tpu.ingest.molly import MollyOutput
+
+    out = MollyOutput(run_name=molly.run_name, output_dir=molly.output_dir)
+    out.runs = [molly.runs[r] for r in rows]
+    for run in out.runs:
+        out.runs_iters.append(run.iteration)
+        if run.succeeded:
+            out.success_runs_iters.append(run.iteration)
+        else:
+            out.failed_runs_iters.append(run.iteration)
+    nc = getattr(molly, "native_corpus", None)
+    if nc is not None:
+        out.native_corpus = _CorpusRowView(nc, rows)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the serializable intermediate
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SegmentPartial:
+    """Everything the reduce needs from one segment's runs.  All content is
+    iteration-keyed plain data (strings/ints — no vocabulary ids), so it
+    serializes to JSON and survives vocab growth across appends.
+    ``fig_files`` names the segment-owned rendered figure files cached
+    alongside (restored into the report tree instead of re-rendering)."""
+
+    iters: list[int] = field(default_factory=list)
+    success_iters: list[int] = field(default_factory=list)
+    failed_iters: list[int] = field(default_factory=list)
+    #: per success run: ordered qualifying prototype rule tables ([] = run
+    #: did not achieve the antecedent)
+    proto_ordered: dict[int, list[str]] = field(default_factory=dict)
+    #: per failed run: sorted distinct rule tables of its simplified
+    #: consequent graph (prototype missing-list input)
+    present: dict[int, list[str]] = field(default_factory=dict)
+    #: per failed run: MissingEvent JSON objects (diff frontier)
+    missing: dict[int, list[dict]] = field(default_factory=dict)
+    #: per run: achieved-antecedent goal count (extensions gate input)
+    achieved: dict[int, int] = field(default_factory=dict)
+    #: anchor content (good/baseline-run derived): present on every partial
+    #: whose map had the anchors in view — in practice the segment that
+    #: owns the good (or baseline) run
+    corrections: list[str] | None = None
+    extensions: list[str] | None = None
+    #: figure files (basenames under figures/) owned by this segment's runs
+    fig_files: list[str] = field(default_factory=list)
+
+    def to_json(self) -> dict:
+        return {
+            "iters": self.iters,
+            "success_iters": self.success_iters,
+            "failed_iters": self.failed_iters,
+            "proto_ordered": {str(k): v for k, v in self.proto_ordered.items()},
+            "present": {str(k): v for k, v in self.present.items()},
+            "missing": {str(k): v for k, v in self.missing.items()},
+            "achieved": {str(k): v for k, v in self.achieved.items()},
+            "corrections": self.corrections,
+            "extensions": self.extensions,
+            "fig_files": self.fig_files,
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "SegmentPartial":
+        return cls(
+            iters=[int(i) for i in d["iters"]],
+            success_iters=[int(i) for i in d["success_iters"]],
+            failed_iters=[int(i) for i in d["failed_iters"]],
+            proto_ordered={int(k): list(v) for k, v in d["proto_ordered"].items()},
+            present={int(k): list(v) for k, v in d["present"].items()},
+            missing={int(k): list(v) for k, v in d["missing"].items()},
+            achieved={int(k): int(v) for k, v in d["achieved"].items()},
+            corrections=d.get("corrections"),
+            extensions=d.get("extensions"),
+            fig_files=list(d.get("fig_files") or []),
+        )
+
+
+@dataclass
+class MapOutput:
+    """Fresh map results: the per-run artifacts of the mapped (owned) runs
+    plus the in-memory DOT graphs for their figure-selected subset, keyed
+    by iteration."""
+
+    own_iters: list[int] = field(default_factory=list)
+    proto_ordered: dict[int, list[str]] = field(default_factory=dict)
+    present: dict[int, list[str]] = field(default_factory=dict)
+    missing: dict[int, list[MissingEvent]] = field(default_factory=dict)
+    achieved: dict[int, int] = field(default_factory=dict)
+    corrections: list[str] = field(default_factory=list)
+    extensions: list[str] = field(default_factory=list)
+    # figure dots per family, keyed by iteration (own figure-selected runs)
+    hazard: dict = field(default_factory=dict)
+    pre: dict = field(default_factory=dict)
+    post: dict = field(default_factory=dict)
+    pre_clean: dict = field(default_factory=dict)
+    post_clean: dict = field(default_factory=dict)
+    diff: dict = field(default_factory=dict)
+    diff_failed: dict = field(default_factory=dict)
+    #: filled instead of the per-run dicts when the backend has no per-run
+    #: decomposition (supports_delta False): the legacy global verb outputs
+    legacy: dict | None = None
+
+    def as_partial(self, seg: Segment, molly) -> SegmentPartial:
+        """Slice this map's artifacts down to one segment's runs."""
+        iters = [r.iteration for r in molly.runs[seg.start : seg.stop]]
+        own = set(iters)
+        succ = [i for i in iters if i in self.proto_ordered]
+        failed = [i for i in iters if i in self.present]
+        return SegmentPartial(
+            iters=iters,
+            success_iters=succ,
+            failed_iters=failed,
+            proto_ordered={i: self.proto_ordered[i] for i in succ},
+            present={i: self.present[i] for i in failed},
+            missing={
+                i: [m.to_json() for m in self.missing[i]]
+                for i in failed
+                if i in self.missing
+            },
+            achieved={i: self.achieved[i] for i in iters if i in self.achieved},
+            corrections=list(self.corrections),
+            extensions=list(self.extensions),
+            fig_files=[
+                f
+                for i in iters
+                for f in figure_files_for_run(
+                    i, failed=i in own and i in self.present,
+                    has_diff=i in self.diff,
+                    selected=i in self.hazard,
+                )
+            ],
+        )
+
+
+def figure_files_for_run(
+    iteration: int, failed: bool, has_diff: bool, selected: bool
+) -> list[str]:
+    """The figure file basenames one selected run owns (writer.py naming).
+    ``selected`` False -> none (the figure policy excluded the run)."""
+    if not selected:
+        return []
+    fams = list(FIG_FAMILIES) + (list(DIFF_FAMILIES) if failed and has_diff else [])
+    return [f"run_{iteration}_{fam}.{ext}" for fam in fams for ext in ("dot", "svg")]
+
+
+# ---------------------------------------------------------------------------
+# map
+# ---------------------------------------------------------------------------
+
+
+def map_runs(
+    backend,
+    molly_view,
+    fault_inj_out: str,
+    good_iter: int | None,
+    global_fig_set: set,
+    own_set: set,
+    timer,
+    publish: bool = True,
+) -> MapOutput:
+    """Run the per-run analysis verbs over ``molly_view`` (already
+    init_graph_db'd into ``backend``) and extract per-run artifacts for the
+    OWNED runs (``own_set``; anchor runs ride along as context only).
+    ``publish`` says whether this map's output will be cached as segment
+    partials — when False (cache off, anonymous corpus) the anchor verbs
+    keep the reference's gates instead of computing results the reduce
+    would discard.
+
+    The phase structure and verb call order mirror the original monolithic
+    run_debug exactly, so a map over the full corpus is byte- and
+    dispatch-identical to the pre-split pipeline."""
+    from nemo_tpu.backend.base import NoSuccessfulRunError
+
+    out = MapOutput()
+    view_iters = molly_view.get_runs_iters()
+    view_failed = molly_view.get_failed_runs_iters()
+    out.own_iters = [i for i in view_iters if i in own_set]
+
+    # The view's own good-run choice must equal the global one (the view
+    # always contains the global good run, and it precedes every other
+    # view success in run order) — guard the invariant rather than trust it.
+    try:
+        view_good = backend.good_run_iter()
+    except NoSuccessfulRunError:
+        view_good = None
+    if view_good != good_iter:
+        raise RuntimeError(
+            f"segment view chose good run {view_good!r} but the corpus good "
+            f"run is {good_iter!r}; the anchor run must be part of the view"
+        )
+
+    fig_iters = [i for i in view_iters if i in global_fig_set]
+    fig_set = set(fig_iters)
+    fig_failed = [f for f in view_failed if f in fig_set]
+    own_fig = [i for i in fig_iters if i in own_set]
+    own_fig_failed = [f for f in fig_failed if f in own_set]
+    own_failed = [f for f in view_failed if f in own_set]
+
+    with timer.phase("load_raw_provenance"):
+        backend.load_raw_provenance()
+    with timer.phase("simplify"):
+        backend.simplify_prov(view_iters)
+    with timer.phase("hazard"):
+        hazard_dots = backend.create_hazard_analysis(fault_inj_out, own_fig)
+    out.hazard = dict(zip(own_fig, hazard_dots))
+
+    view_succ = molly_view.get_success_runs_iters()
+    own_succ = [i for i in view_succ if i in own_set]
+    legacy = not getattr(backend, "supports_delta", False)
+    with timer.phase("prototypes"):
+        if legacy:
+            inter, inter_miss, union, union_miss = backend.create_prototypes(
+                view_succ, view_failed
+            )
+        else:
+            ordered, present = backend.proto_tables_by_run(own_succ, own_failed)
+            out.proto_ordered = {i: list(ordered.get(i, [])) for i in own_succ}
+            out.present = {f: sorted(present.get(f, ())) for f in own_failed}
+
+    # The good run's post dot is the diff overlay's backdrop; pull it even
+    # when the good run belongs to a cached segment (context, not output).
+    pull_iters = list(own_fig)
+    if good_iter is not None and good_iter in fig_set and good_iter not in own_set:
+        pull_iters = sorted(
+            set(pull_iters) | {good_iter}, key=view_iters.index
+        )
+    with timer.phase("pull_prov"):
+        pre_dots, post_dots, pre_clean_dots, post_clean_dots = (
+            backend.pull_pre_post_prov(pull_iters)
+        )
+    by = dict(zip(pull_iters, zip(pre_dots, post_dots, pre_clean_dots, post_clean_dots)))
+    for i in own_fig:
+        out.pre[i], out.post[i], out.pre_clean[i], out.post_clean[i] = by[i]
+
+    missing_events: list[list[MissingEvent]] = [[] for _ in own_failed]
+    diff_dots: list = []
+    failed_dots: list = []
+    if good_iter is not None and own_failed:
+        success_post_dot = (
+            by[good_iter][1] if good_iter in by else None
+        )
+        with timer.phase("diff_prov"):
+            diff_dots, failed_dots, missing_events = backend.create_naive_diff_prov(
+                False, own_failed, success_post_dot, dot_iters=own_fig_failed
+            )
+    out.missing = dict(zip(own_failed, missing_events))
+    out.diff = dict(zip(own_fig_failed, diff_dots))
+    out.diff_failed = dict(zip(own_fig_failed, failed_dots))
+
+    # Anchor content is computed UNGATED on the PUBLISHING delta path
+    # (cached partials must carry corrections even when the current corpus
+    # has no failures — a grown corpus may gain its first failure in a NEW
+    # segment and merge the old anchor partial); when nothing will be
+    # cached, and on the legacy monolithic path, the reference's failures
+    # gate applies (main.go:166-173) — the reduce discards the results in
+    # exactly those cases, so computing them would be pure waste.
+    if good_iter is not None and (view_failed or (publish and not legacy)):
+        with timer.phase("corrections"):
+            out.corrections = backend.generate_corrections()
+    with timer.phase("extensions"):
+        if legacy:
+            all_achieved, extensions = backend.generate_extensions()
+            out.legacy = {
+                "inter": inter,
+                "inter_miss": dict(zip(view_failed, inter_miss)),
+                "union": union,
+                "union_miss": dict(zip(view_failed, union_miss)),
+                "all_achieved": all_achieved,
+                "extensions": extensions,
+            }
+        else:
+            counts = backend.achieved_pre_goal_counts()
+            out.achieved = {i: int(counts.get(i, 0)) for i in out.own_iters}
+            # A non-publishing map is the WHOLE corpus (nothing was
+            # cached), so the local achieved sum decides the reduce's
+            # all-achieved gate — skip the suggestion synthesis it would
+            # discard.
+            if publish or sum(out.achieved.values()) < len(view_iters):
+                out.extensions = backend.extension_suggestions()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# reduce
+# ---------------------------------------------------------------------------
+
+
+class _JsonEvent:
+    """MissingEvent stand-in rehydrated from a cached partial: only its
+    ``to_json`` is ever consumed downstream (debugging.json splicing), so
+    the stored JSON is carried verbatim."""
+
+    __slots__ = ("_doc",)
+
+    def __init__(self, doc: dict) -> None:
+        self._doc = doc
+
+    def to_json(self) -> dict:
+        return self._doc
+
+
+@dataclass
+class Reduced:
+    """Global analysis results, ready for recommendation assembly."""
+
+    inter: list[str]  # <code>-wrapped
+    union: list[str]
+    inter_miss: dict[int, list[str]]  # per failed iteration
+    union_miss: dict[int, list[str]]
+    missing: dict[int, list]  # per failed iteration: MissingEvent-likes
+    corrections: list[str]
+    extensions: list[str]
+    all_achieved: bool
+
+
+def reduce_partials(
+    partials: list[SegmentPartial],
+    molly,
+    good_iter: int | None,
+    legacy: dict | None = None,
+) -> Reduced:
+    """Merge per-segment partials into the corpus-global analysis results.
+
+    Order-insensitive by construction: every per-run artifact is keyed by
+    iteration and the GLOBAL run order is imposed here from ``molly`` —
+    merging [seg0, seg1] equals merging [seg1, seg0], which is what lets a
+    grown corpus combine cached and fresh partials (and what a future
+    run-axis shard needs).  The set algebra is analysis/protos.py — the
+    same functions every backend's create_prototypes uses, so a one-segment
+    reduce is bit-identical to the monolithic pipeline."""
+    from nemo_tpu.analysis.protos import (
+        intersect_proto,
+        missing_from,
+        union_proto,
+        wrap_code,
+    )
+
+    failed_iters = molly.get_failed_runs_iters()
+    success_iters = molly.get_success_runs_iters()
+
+    if legacy is not None:
+        # Backend without per-run decomposition (supports_delta False): the
+        # map ran the global verbs over the whole corpus; pass their
+        # results through, with the per-failed-run missing events merged
+        # from the (single) partial like the mergeable path below.
+        missing = {f: [] for f in failed_iters}
+        corrections = []
+        for p in partials:
+            for f, docs in p.missing.items():
+                missing[f] = [
+                    d if isinstance(d, MissingEvent) else _JsonEvent(d)
+                    for d in docs
+                ]
+            if p.corrections is not None:
+                corrections = list(p.corrections)
+        return Reduced(
+            inter=legacy["inter"],
+            union=legacy["union"],
+            inter_miss=dict(legacy["inter_miss"]),
+            union_miss=dict(legacy["union_miss"]),
+            missing=missing,
+            corrections=corrections if (good_iter is not None and failed_iters) else [],
+            # generate_extensions already applied the all-achieved gate.
+            extensions=legacy["extensions"],
+            all_achieved=legacy["all_achieved"],
+        )
+
+    with obs.span("analysis:reduce", segments=len(partials)):
+        ordered: dict[int, list[str]] = {}
+        present: dict[int, list[str]] = {}
+        missing: dict[int, list] = {}
+        achieved_total = 0
+        corrections: list[str] = []
+        extensions: list[str] = []
+        anchor_seen = False
+        for p in partials:
+            ordered.update(p.proto_ordered)
+            present.update(p.present)
+            for f, docs in p.missing.items():
+                missing[f] = [
+                    d if isinstance(d, MissingEvent) else _JsonEvent(d)
+                    for d in docs
+                ]
+            achieved_total += sum(p.achieved.values())
+            if p.corrections is not None:
+                corrections = list(p.corrections)
+                extensions = list(p.extensions or [])
+                anchor_seen = True
+        if not anchor_seen and molly.runs:
+            raise RuntimeError(
+                "no partial carried the anchor (good/baseline) results; "
+                "the anchor segment must be mapped or served from cache"
+            )
+
+        per_run = [ordered.get(i, []) for i in success_iters]
+        inter_raw = intersect_proto(per_run, "post")
+        union_raw = union_proto(per_run, "post")
+        inter_miss: dict[int, list[str]] = {}
+        union_miss: dict[int, list[str]] = {}
+        for f in failed_iters:
+            ptab = set(present.get(f, ()))
+            inter_miss[f] = missing_from(inter_raw, ptab)
+            union_miss[f] = missing_from(union_raw, ptab)
+            missing.setdefault(f, [])
+
+        all_achieved = achieved_total >= len(molly.runs)
+        return Reduced(
+            inter=wrap_code(inter_raw),
+            union=wrap_code(union_raw),
+            inter_miss=inter_miss,
+            union_miss=union_miss,
+            missing=missing,
+            # The reference gates corrections on failures existing and a
+            # good run to diff against (main.go:166-173); extension
+            # suggestions only apply when some run missed the antecedent.
+            corrections=corrections if (good_iter is not None and failed_iters) else [],
+            extensions=[] if all_achieved else extensions,
+            all_achieved=all_achieved,
+        )
+
+
+def blob_cache_key(kind: str, segments_meta, extra: dict) -> str | None:
+    """Content address for an opaque result blob derived from a stored
+    corpus (e.g. the sidecar's AnalyzeDir response): every segment
+    fingerprint + a caller-supplied extra dict (statics, wire version) +
+    the analysis ABI.  None when the corpus carries no segment identities
+    (not store-served) — nothing content-addresses it."""
+    fps = [s.get("fingerprint") for s in segments_meta or ()]
+    if not fps or any(f is None for f in fps):
+        return None
+    return _key(
+        {
+            "kind": kind,
+            "segments": fps,
+            "extra": extra,
+            "analysis_abi": ANALYSIS_ABI_VERSION,
+        }
+    )
+
+
+# ---------------------------------------------------------------------------
+# metrics helpers
+# ---------------------------------------------------------------------------
+
+
+def kernel_dispatch_count(counters: dict) -> int:
+    """Total analysis kernel dispatches in a metrics counter dict — device
+    executor verbs AND the sparse host engine (kernel.dispatches.*).  The
+    zero-dispatch assertion of a warm cache hit sums exactly this."""
+    return int(
+        sum(v for k, v in counters.items() if k.startswith("kernel.dispatches."))
+    )

@@ -56,6 +56,22 @@ class DebugResult:
 NONDETERMINISTIC_REPORT_FILES = frozenset({"telemetry.json"})
 
 
+def report_tree_bytes(root: str) -> dict[str, bytes]:
+    """relpath -> content of every deterministic report file under ``root``
+    (``NONDETERMINISTIC_REPORT_FILES`` excluded).  THE byte-parity view of a
+    report tree — validate_smoke and the bench delta tier both compare
+    exactly this, so the exclusion set and the walk can never drift apart."""
+    out: dict[str, bytes] = {}
+    for dirpath, _, files in os.walk(root):
+        for f in files:
+            if f in NONDETERMINISTIC_REPORT_FILES:
+                continue
+            p = os.path.join(dirpath, f)
+            with open(p, "rb") as fh:
+                out[os.path.relpath(p, root)] = fh.read()
+    return out
+
+
 def _write_telemetry(report_dir: str, timings: dict, figure_stats: dict | None) -> None:
     """Write the report's "Run telemetry" data (telemetry.json next to
     debugging.json): the phase walls, the figure pipeline's dedup/cache
@@ -274,7 +290,19 @@ def _ingest(fault_inj_out: str, use_packed: bool, store=None, consult_store=True
             # makes the next run a warm mmap load.
             molly = load_molly_output(fault_inj_out)
         if store is not None:
-            store.put(fault_inj_out, molly, snapshot=snap)
+            header = store.put(fault_inj_out, molly, snapshot=snap)
+            if isinstance(header, dict):
+                # The populate's segment identities ride on the parsed
+                # object too, so the COLD run's analysis results are
+                # content-addressed (store/rcache.py) — the very next
+                # request can then be a full report-cache hit.
+                from nemo_tpu.store import attach_store_provenance
+
+                sd = store.store_dir(fault_inj_out)
+                attach_store_provenance(molly, sd, header)
+                nc = getattr(molly, "native_corpus", None)
+                if nc is not None:
+                    attach_store_provenance(nc, sd, header)
         return molly
     return load_molly_output(fault_inj_out)
 
@@ -306,6 +334,35 @@ def _attach_ingest_dir(ex: BaseException, d: str) -> BaseException:
     return ex
 
 
+def corpus_report_names(dirs: list[str]) -> list[str]:
+    """Collision-free report directory names for several corpora sharing
+    one results_root: the directory basename when unique across the batch,
+    else basename-<8-hex sha256 of the realpath> — stable across runs (the
+    same corpus path always maps to the same report dir), so bookmarks and
+    diff tooling keep working.  Raises when two entries resolve to the
+    SAME directory: both analyses would race one report tree, and no
+    naming scheme fixes that."""
+    import hashlib
+
+    basenames = [os.path.basename(os.path.normpath(d)) for d in dirs]
+    dupes = {b for b in basenames if basenames.count(b) > 1}
+    names = [
+        f"{b}-{hashlib.sha256(os.path.realpath(d).encode()).hexdigest()[:8]}"
+        if b in dupes
+        else b
+        for d, b in zip(dirs, basenames)
+    ]
+    clashes = {n for n in names if names.count(n) > 1}
+    if clashes:
+        raise ValueError(
+            f"corpus directories resolve to the same report name(s) "
+            f"{sorted(clashes)}: the same directory was listed more than "
+            "once (identical realpaths cannot be disambiguated); each "
+            "-faultInjOut must name a distinct corpus"
+        )
+    return names
+
+
 def run_debug_dirs(
     dirs: list[str],
     results_root: str,
@@ -332,14 +389,15 @@ def run_debug_dirs(
     per corpus, like the sequential loop it replaces).  kwargs flow to
     run_debug.  With prefetch=False this is exactly the sequential loop.
 
-    Corpus directories must have DISTINCT basenames (rejected loudly
-    otherwise): each report writes to results_root/<run_name> where
-    run_name is the directory basename, and a duplicate basename would
-    make the later report's prepare() silently delete the earlier report
-    (any of its figures still pending in the shared scheduler would then
-    land in the later report's directory).  save_corpus_path is rejected
-    for the same shared-kwargs reason: every corpus would overwrite the
-    same .npz bundle (ADVICE r5).
+    Reports write to results_root/<name> with collision-free names
+    (corpus_report_names): the directory basename when unique, and
+    basename-<8-hex realpath hash> when several corpora share one — a
+    duplicate basename used to be rejected outright because the later
+    report's prepare() would silently delete the earlier one.  The same
+    directory listed TWICE is still rejected (identical realpath hashes —
+    nothing can disambiguate two analyses racing one report directory).
+    save_corpus_path is rejected for the shared-kwargs reason: every
+    corpus would overwrite the same .npz bundle (ADVICE r5).
 
     On an effectively 1-core host the prefetch thread is skipped even with
     prefetch=True (utils.effective_cpu_count): a producer thread cannot
@@ -363,15 +421,7 @@ def run_debug_dirs(
             "same .npz bundle; call run_debug per directory with distinct "
             "paths instead"
         )
-    basenames = [os.path.basename(os.path.normpath(d)) for d in dirs]
-    dupes = {b for b in basenames if basenames.count(b) > 1}
-    if dupes:
-        raise ValueError(
-            f"corpus directories share basenames {sorted(dupes)}: each report "
-            "writes to results_root/<basename>, so the later corpus would "
-            "silently delete the earlier report; rename the directories or "
-            "use separate results roots"
-        )
+    report_names = corpus_report_names(dirs)
     if not dirs:
         return []
     # Backends are constructed lazily, one per iteration, and dropped after
@@ -427,6 +477,7 @@ def run_debug_dirs(
                     make_backend(),
                     molly=molly,
                     render_scheduler=scheduler,
+                    report_name=report_names[k],
                     **kwargs,
                 )
             )
@@ -450,6 +501,9 @@ def run_debug_dirs(
         scheduler.close()
     for r in results:
         r.figure_stats = stats
+        # Result-cache publication deferred from run_debug: its SVGs were
+        # pending in the shared scheduler until the drain above.
+        _flush_result_cache(r)
         # The telemetry written during each run_debug predates the shared
         # scheduler's drain (figure_stats was None then); refresh it with
         # the aggregate figure stats and the now-complete metrics.
@@ -470,6 +524,8 @@ def run_debug(
     molly=None,
     render_scheduler=None,
     corpus_cache: str | None = None,
+    result_cache: str | None = None,
+    report_name: str | None = None,
 ) -> DebugResult:
     """Full debug pipeline.  With profile_dir set, the analysis phases run
     under jax.profiler.trace — open the directory with TensorBoard or
@@ -487,10 +543,24 @@ def run_debug(
     whose .scheduler is None keeps the sequential per-figure render loop —
     the byte-parity oracle path.  `corpus_cache` overrides the persistent
     corpus store root (NEMO_CORPUS_CACHE; "off" disables) consulted by the
-    packed ingest path."""
+    packed ingest path.
+
+    The analysis itself runs as a per-store-segment MAP plus an associative
+    REDUCE (analysis/delta.py): when `result_cache` (NEMO_RESULT_CACHE;
+    "off" disables, default ~/.cache/nemo_tpu/results) resolves and the
+    corpus was served by the store, the full report tree and the
+    per-segment partials are cached content-addressed — a repeat request
+    restores the report with ZERO kernel dispatches, and a GROWN corpus
+    maps only its new segments and merges the cached partials.  A profiled
+    run (`profile_dir`) never consults the result cache: the point of a
+    profile is watching the kernels run.  `report_name` overrides the
+    report directory name under results_root (default: molly.run_name —
+    run_debug_dirs passes collision-free names)."""
     import contextlib
 
+    from nemo_tpu.analysis import delta
     from nemo_tpu.store import resolve_store
+    from nemo_tpu.store.rcache import resolve_result_cache
 
     trace_ctx: contextlib.AbstractContextManager = contextlib.nullcontext()
     if profile_dir:
@@ -520,67 +590,167 @@ def run_debug(
     iters = molly.get_runs_iters()
     failed_iters = molly.get_failed_runs_iters()
 
-    with timer.phase("init"):
-        backend.init_graph_db(conn, molly)
-    try:
-        # The baseline good run: the reference hard-codes run 0 and silently
-        # emits nonsense when run 0 failed (differential-provenance.go:22);
-        # here the backend's good-run policy (base.py:good_run_iter) decides,
-        # and on an all-failed corpus diff + corrections are skipped with a
-        # warning instead of raising.  Computed unconditionally (ADVICE r2):
-        # the restrictive figure policies include the good baseline run even
-        # on an all-success corpus.
-        good_iter: int | None = None
-        try:
-            good_iter = backend.good_run_iter()
-        except NoSuccessfulRunError:
-            if failed_iters:
-                _log.warning(
-                    "pipeline.no_successful_run",
-                    detail="skipping differential provenance and correction "
-                    "synthesis (nothing to diff against)",
-                    corpus=fault_inj_out,
-                )
-        fig_iters = select_figure_iters(figures, iters, failed_iters, good_iter)
-        fig_set = set(fig_iters)
-        fig_failed = [f for f in failed_iters if f in fig_set]
+    run_name = report_name or molly.run_name
+    this_results_dir = os.path.join(results_root, run_name)
+    # The result cache is bypassed for a profiled run (the point of a
+    # profile is watching the kernels run) and for an explicitly passed
+    # reporter (the sequential byte-parity ORACLE path — serving it from
+    # cache would make every oracle comparison vacuous).
+    rcache = (
+        None
+        if (profile_dir or reporter is not None)
+        else resolve_result_cache(result_cache)
+    )
 
-        with trace_ctx:
-            with timer.phase("load_raw_provenance"):
-                backend.load_raw_provenance()
-            with timer.phase("simplify"):
-                backend.simplify_prov(iters)
-            with timer.phase("hazard"):
-                hazard_dots = backend.create_hazard_analysis(fault_inj_out, fig_iters)
-            with timer.phase("prototypes"):
-                inter, inter_miss, union, union_miss = backend.create_prototypes(
-                    molly.get_success_runs_iters(), failed_iters
+    # Tier 1 — whole-report cache: every segment fingerprint + the
+    # config/ABI blob addresses the full report tree.  A verified hit
+    # restores it and returns without even initializing the backend:
+    # zero kernel dispatches, no figure rendering, no recommendation
+    # assembly (delta-smoke and the bench delta_tier assert exactly this).
+    report_key = (
+        delta.report_cache_key(molly, figures) if rcache is not None else None
+    )
+    if report_key is not None:
+        with timer.phase("report"):
+            hit = rcache.load_report(report_key, results_root, this_results_dir)
+        if hit:
+            timings = timer.as_dict()
+            _write_telemetry(this_results_dir, timings, None)
+            return DebugResult(
+                molly=molly,
+                report_dir=this_results_dir,
+                timings=timings,
+                figure_stats=None,
+            )
+
+    # The baseline good run, chosen at the PIPELINE level (the single
+    # definition backends delegate to — analysis/delta.py:choose_good_run):
+    # the reference hard-codes run 0 and silently emits nonsense when run 0
+    # failed (differential-provenance.go:22); on an all-failed corpus diff
+    # + corrections are skipped with a warning instead of raising.
+    # Computed unconditionally (ADVICE r2): the restrictive figure policies
+    # include the good baseline run even on an all-success corpus.
+    good_iter = delta.choose_good_run(molly)
+    if good_iter is None and failed_iters:
+        _log.warning(
+            "pipeline.no_successful_run",
+            detail="skipping differential provenance and correction "
+            "synthesis (nothing to diff against)",
+            corpus=fault_inj_out,
+        )
+    baseline_iter = delta.choose_baseline_run(molly, good_iter)
+    fig_iters = select_figure_iters(figures, iters, failed_iters, good_iter)
+    fig_set = set(fig_iters)
+
+    # Tier 2 — per-segment partials: consult the cache per store segment,
+    # map only the segments it cannot serve, reduce over cached + fresh.
+    legacy = not getattr(backend, "supports_delta", False)
+    segments = delta.attach_positions(delta.corpus_segments(molly), molly)
+    cached: list[tuple[object, object]] = []  # (Segment, SegmentPartial)
+    partial_keys: dict[str, str] = {}
+    if rcache is not None and not legacy:
+        for seg in segments:
+            k = delta.partial_cache_key(
+                seg, segments, good_iter, baseline_iter, figures
+            )
+            if k is None:
+                continue
+            partial_keys[seg.name] = k
+            p = rcache.load_partial(k)
+            if p is not None:
+                cached.append((seg, p))
+    cached_names = {seg.name for seg, _ in cached}
+    to_map = [s for s in segments if s.name not in cached_names]
+    n_cached_runs = sum(s.n_runs for s, _ in cached)
+    obs.metrics.inc("delta.segments_cached", len(cached))
+    obs.metrics.inc("delta.segments_mapped", len(to_map))
+    obs.metrics.inc("delta.runs_cached", n_cached_runs)
+    obs.metrics.inc("delta.runs_mapped", len(molly.runs) - n_cached_runs)
+    if cached:
+        _log.info(
+            "delta.plan",
+            corpus=fault_inj_out,
+            segments_cached=len(cached),
+            segments_mapped=len(to_map),
+            runs_cached=n_cached_runs,
+            runs_mapped=len(molly.runs) - n_cached_runs,
+        )
+
+    mo = delta.MapOutput()
+    if to_map:
+        pos_by_iter = {}
+        for pos, r in enumerate(molly.runs):
+            pos_by_iter.setdefault(r.iteration, pos)
+        own_rows = sorted(r for s in to_map for r in range(s.start, s.stop))
+        own_row_set = set(own_rows)
+        own_set = {molly.runs[r].iteration for r in own_rows}
+        # Anchor runs ride along as CONTEXT when they live in a cached
+        # segment: the differential verbs diff against the good run's
+        # graph and extensions read the baseline run's antecedent, so the
+        # map's view must contain them even though their per-run artifacts
+        # come from the cached partials.
+        anchor_rows = {
+            pos_by_iter[it]
+            for it in (good_iter, baseline_iter)
+            if it is not None and pos_by_iter[it] not in own_row_set
+        }
+        view_rows = sorted(own_row_set | anchor_rows)
+        molly_view = (
+            molly
+            if len(view_rows) == len(molly.runs)
+            else delta.subset_molly(molly, view_rows)
+        )
+        with timer.phase("init"):
+            backend.init_graph_db(conn, molly_view)
+        try:
+            with trace_ctx:
+                mo = delta.map_runs(
+                    backend,
+                    molly_view,
+                    fault_inj_out,
+                    good_iter,
+                    fig_set,
+                    own_set,
+                    timer,
+                    publish=bool(partial_keys),
                 )
-            with timer.phase("pull_prov"):
-                pre_dots, post_dots, pre_clean_dots, post_clean_dots = (
-                    backend.pull_pre_post_prov(fig_iters)
+        finally:
+            backend.close_db()
+
+    with timer.phase("reduce"):
+        if legacy:
+            # No per-run decomposition: the map ran the global verbs over
+            # the whole corpus; one pass-through partial carries the
+            # per-failed-run missing events and the anchor content.
+            partials = [
+                delta.SegmentPartial(
+                    iters=list(iters),
+                    missing=mo.missing,
+                    corrections=mo.corrections,
+                    extensions=mo.extensions,
                 )
-            diff_dots, failed_dots = [], []
-            missing_events: list[list] = [[] for _ in failed_iters]
-            corrections: list[str] = []
-            # Diff + corrections only when failures exist (reference:
-            # main.go:166-173 gates GenerateCorrections on failures).
-            if good_iter is not None and failed_iters:
-                success_post_dot = (
-                    post_dots[fig_iters.index(good_iter)]
-                    if good_iter in fig_set
-                    else None
+            ]
+            fresh: dict[str, object] = {}
+        elif not partial_keys and not cached:
+            # Nothing cacheable (anonymous corpus or cache off): skip the
+            # per-segment JSON slicing and feed the map output straight
+            # through as one in-memory partial.
+            fresh = {}
+            partials = [
+                delta.SegmentPartial(
+                    iters=list(iters),
+                    proto_ordered=mo.proto_ordered,
+                    present=mo.present,
+                    missing=mo.missing,
+                    achieved=mo.achieved,
+                    corrections=mo.corrections,
+                    extensions=mo.extensions,
                 )
-                with timer.phase("diff_prov"):
-                    diff_dots, failed_dots, missing_events = backend.create_naive_diff_prov(
-                        False, failed_iters, success_post_dot, dot_iters=fig_failed
-                    )
-                with timer.phase("corrections"):
-                    corrections = backend.generate_corrections()
-            with timer.phase("extensions"):
-                all_achieved_pre, extensions = backend.generate_extensions()
-    finally:
-        backend.close_db()
+            ]
+        else:
+            fresh = {s.name: mo.as_partial(s, molly) for s in to_map}
+            partials = [p for _, p in cached] + list(fresh.values())
+        red = delta.reduce_partials(partials, molly, good_iter, legacy=mo.legacy)
 
     # Recommendation assembly, 4-way priority (main.go:190-217).  The
     # reference indexes its positional runs slice with iteration numbers
@@ -590,27 +760,27 @@ def run_debug(
     by_iter = {r.iteration: r for r in runs}
     for i in iters:
         run = by_iter[i]
-        if corrections:
-            run.recommendation = [REC_FAULT, *corrections]
+        if red.corrections:
+            run.recommendation = [REC_FAULT, *red.corrections]
         elif failed_iters and good_iter is None:
             # Failures exist but there was no good run to synthesize
             # corrections from; "well done" / "no violation" would be a lie.
             run.recommendation = [REC_CANT_HELP]
-        elif extensions:
-            run.recommendation = [REC_EXTEND, *extensions]
-        elif not all_achieved_pre:
+        elif red.extensions:
+            run.recommendation = [REC_EXTEND, *red.extensions]
+        elif not red.all_achieved:
             run.recommendation = [REC_CANT_HELP]
         else:
             run.recommendation = [REC_WELL_DONE]
-        run.inter_proto = inter
-        run.union_proto = union
+        run.inter_proto = red.inter
+        run.union_proto = red.union
 
-    for j, f in enumerate(failed_iters):
+    for f in failed_iters:
         run = by_iter[f]
-        run.corrections = corrections
-        run.missing_events = missing_events[j]
-        run.inter_proto_missing = inter_miss[j]
-        run.union_proto_missing = union_miss[j]
+        run.corrections = red.corrections
+        run.missing_events = red.missing.get(f, [])
+        run.inter_proto_missing = red.inter_miss.get(f, [])
+        run.union_proto_missing = red.union_miss.get(f, [])
 
     # Reporting (main.go:239-292).
     fig_stats: dict | None = None
@@ -624,7 +794,6 @@ def run_debug(
             reporter = Reporter(scheduler=render_scheduler)
         elif render_scheduler is not None:
             reporter.scheduler = render_scheduler
-        this_results_dir = os.path.join(results_root, molly.run_name)
         reporter.prepare(results_root, this_results_dir)
 
         # Each run entry carries the backend's chosen good-run iteration so
@@ -648,14 +817,31 @@ def run_debug(
             fh.write("]")
 
         try:
-            reporter.generate_figures(fig_iters, "spacetime", hazard_dots)
-            reporter.generate_figures(fig_iters, "pre_prov", pre_dots)
-            reporter.generate_figures(fig_iters, "post_prov", post_dots)
-            reporter.generate_figures(fig_iters, "pre_prov_clean", pre_clean_dots)
-            reporter.generate_figures(fig_iters, "post_prov_clean", post_clean_dots)
-            diff_fig_iters = fig_failed if diff_dots else []
-            reporter.generate_figures(diff_fig_iters, "diff_post_prov-diff", diff_dots)
-            reporter.generate_figures(diff_fig_iters, "diff_post_prov-failed", failed_dots)
+            # Freshly mapped runs render through the scheduler; cached
+            # segments' figures restore from the partial entries (rendered
+            # by the run that populated them — same renderer version, part
+            # of the cache key, so byte-identical).
+            own_fig = [i for i in fig_iters if i in mo.hazard]
+
+            def dots(d: dict) -> list:
+                return [d[i] for i in own_fig]
+
+            reporter.generate_figures(own_fig, "spacetime", dots(mo.hazard))
+            reporter.generate_figures(own_fig, "pre_prov", dots(mo.pre))
+            reporter.generate_figures(own_fig, "post_prov", dots(mo.post))
+            reporter.generate_figures(own_fig, "pre_prov_clean", dots(mo.pre_clean))
+            reporter.generate_figures(own_fig, "post_prov_clean", dots(mo.post_clean))
+            diff_fig_iters = [f for f in fig_iters if f in mo.diff]
+            reporter.generate_figures(
+                diff_fig_iters, "diff_post_prov-diff", [mo.diff[f] for f in diff_fig_iters]
+            )
+            reporter.generate_figures(
+                diff_fig_iters,
+                "diff_post_prov-failed",
+                [mo.diff_failed[f] for f in diff_fig_iters],
+            )
+            for _seg, p in cached:
+                rcache.restore_figures(p, reporter.figures_dir)
 
             if own_scheduler is not None:
                 # Internally owned pipeline: settle it here so the report
@@ -668,9 +854,48 @@ def run_debug(
 
     timings = timer.as_dict()
     _write_telemetry(this_results_dir, timings, fig_stats)
-    return DebugResult(
+    result = DebugResult(
         molly=molly,
         report_dir=this_results_dir,
         timings=timings,
         figure_stats=fig_stats,
     )
+    # Cache publication needs the SVGs ON DISK.  When this call drained its
+    # own figure pipeline (or rendered inline through a sequential
+    # reporter), publish now; when an external scheduler still holds
+    # pending renders (run_debug_dirs), defer — the driver flushes after
+    # its shared drain.
+    if rcache is not None:
+        result._rcache_pending = (
+            rcache,
+            report_key,
+            [
+                (partial_keys[name], p)
+                for name, p in fresh.items()
+                if name in partial_keys
+            ],
+        )
+        drained = own_scheduler is not None or (
+            render_scheduler is None and getattr(reporter, "scheduler", None) is None
+        )
+        if drained:
+            _flush_result_cache(result)
+    return result
+
+
+def _flush_result_cache(result: DebugResult) -> None:
+    """Publish a completed run's result-cache entries (report tree +
+    fresh segment partials).  Requires every figure file to be on disk —
+    callers that deferred rendering to a shared scheduler call this after
+    the drain.  Best-effort like every cache write."""
+    pending = result.__dict__.pop("_rcache_pending", None)
+    if not pending:
+        return
+    rcache, report_key, partial_puts = pending
+    figures_dir = os.path.join(result.report_dir, "figures")
+    for key, partial in partial_puts:
+        rcache.put_partial(key, partial, figures_dir)
+    if report_key is not None:
+        rcache.put_report(
+            report_key, result.report_dir, NONDETERMINISTIC_REPORT_FILES
+        )

@@ -39,6 +39,14 @@ class NoSuccessfulRunError(RuntimeError):
 class GraphBackend(abc.ABC):
     """Interface over the graph analytics engine (reference: main.go:33-44)."""
 
+    #: True when the backend exposes the per-run decomposition hooks below
+    #: (proto_tables_by_run / achieved_pre_goal_counts /
+    #: extension_suggestions) that the segment-incremental map/reduce
+    #: pipeline (analysis/delta.py) merges across store segments.  Backends
+    #: without them still run through run_debug, but always as one
+    #: monolithic map with partial caching disabled.
+    supports_delta = False
+
     def good_run_iter(self) -> int:
         """Iteration of the baseline successful run used for differential
         provenance and the trigger queries.  The first successful run that
@@ -49,19 +57,45 @@ class GraphBackend(abc.ABC):
         (differential-provenance.go:22, corrections.go:210) in the normal
         Molly layout where run 0 is the failure-free execution.  Falls back
         to the first status-success run when no success achieved the
-        consequent; raises NoSuccessfulRunError when no run succeeded."""
+        consequent; raises NoSuccessfulRunError when no run succeeded.
+        (Selection logic lives in analysis/delta.py:choose_good_run — ONE
+        definition shared with the pipeline-level planner.)"""
         assert self.molly is not None
-        succ = self.molly.get_success_runs_iters()
-        if not succ:
+        from nemo_tpu.analysis.delta import choose_good_run
+
+        good = choose_good_run(self.molly)
+        if good is None:
             raise NoSuccessfulRunError(
                 "no successful run in this corpus: differential provenance "
                 "and correction synthesis need a good run to diff against"
             )
-        by_iter = {r.iteration: r for r in self.molly.runs}
-        for i in succ:
-            if by_iter[i].time_post_holds:
-                return i
-        return succ[0]
+        return good
+
+    # ---- per-run decomposition hooks (the map side of analysis/delta.py):
+    # implemented by backends that can slice their cross-run analyses per
+    # run, which is what makes segment partials mergeable.
+
+    def proto_tables_by_run(
+        self, success_iters: list[int], failed_iters: list[int]
+    ) -> tuple[dict[int, list[str]], dict[int, set[str]]]:
+        """(per success run: ordered qualifying prototype rule tables — []
+        when the run did not achieve the antecedent; per failed run: the
+        distinct rule tables of its simplified consequent graph).  The
+        prototype intersection/union and missing lists are pure set algebra
+        over these (analysis/protos.py), computed in the reduce."""
+        raise NotImplementedError
+
+    def achieved_pre_goal_counts(self) -> dict[int, int]:
+        """Per run: the count of antecedent goals with condition_holds
+        (extensions.go:25-50 counts goals, not runs) — summed across
+        segments in the reduce to decide all_runs_achieved_pre."""
+        raise NotImplementedError
+
+    def extension_suggestions(self) -> list[str]:
+        """The extension suggestion list from the baseline run's antecedent
+        provenance, UNgated (generate_extensions applies the all-achieved
+        gate, which is global — the reduce applies it instead)."""
+        raise NotImplementedError
 
     def baseline_run_iter(self) -> int:
         """The good run when one exists, else the first run.  Used where a

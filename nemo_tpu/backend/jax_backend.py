@@ -934,6 +934,10 @@ class JaxBackend(GraphBackend):
     #: packed corpus arrays directly, so the pipeline may skip building the
     #: per-goal Python object tree entirely (ingest/native.py:RawProv).
     supports_packed_ingest = True
+    #: Per-run decomposition hooks below are implemented, so the pipeline's
+    #: segment-incremental map/reduce (analysis/delta.py) can map a store
+    #: segment's runs in isolation and merge cached per-segment partials.
+    supports_delta = True
 
     def __init__(self, max_batch: int | None = None, executor=None) -> None:
         self.max_batch = max_batch
@@ -1356,6 +1360,12 @@ class JaxBackend(GraphBackend):
                 if route == "sparse":
                     from nemo_tpu.ops.sparse_host import sparse_analysis_step
 
+                    # Counted under the same kernel.dispatches.* prefix as
+                    # the device verbs: the result cache's zero-dispatch
+                    # assertion (analysis/delta.py:kernel_dispatch_count)
+                    # sums the prefix, so a sparse-routed recompute can
+                    # never masquerade as a cache hit.
+                    obs.metrics.inc("kernel.dispatches.sparse_fused")
                     with obs.span("analysis:route", **rec):
                         with obs.span("kernel:fused", impl="sparse_host", rows=n_rows):
                             res = sparse_analysis_step(
@@ -1434,6 +1444,7 @@ class JaxBackend(GraphBackend):
                     if self._giant_impl == "host":
                         from nemo_tpu.parallel.giant import giant_analysis_host
 
+                        obs.metrics.inc("kernel.dispatches.sparse_giant")
                         with obs.span("analysis:route", **rec):
                             res = giant_analysis_host(
                                 pre_b,
@@ -1552,6 +1563,17 @@ class JaxBackend(GraphBackend):
         union_miss = [missing_from(union, present.get(f, set())) for f in failed_iters]
         return wrap_code(inter), inter_miss, wrap_code(union), union_miss
 
+    def proto_tables_by_run(
+        self, success_iters: list[int], failed_iters: list[int]
+    ) -> tuple[dict[int, list[str]], dict[int, set[str]]]:
+        # The same fused-step slices create_prototypes consumes, exposed
+        # per run so the pipeline's reduce can merge across store segments.
+        ordered, present = self._proto_tables_by_run()
+        return (
+            {i: ordered.get(i, []) for i in success_iters},
+            {f: present.get(f, set()) for f in failed_iters},
+        )
+
     # ------------------------------------------------------------------- pull
 
     def pull_pre_post_prov(
@@ -1652,6 +1674,7 @@ class JaxBackend(GraphBackend):
             # Only the real failed-run rows: the padding rows exist for the
             # dense path's compile sharing, which the host path doesn't
             # have — an all-false row would cost a full-graph diff each.
+            obs.metrics.inc("kernel.dispatches.sparse_diff")
             with obs.span("analysis:route", **rec):
                 node_keep, edge_keep, frontier_rule, missing_goal = diff_masks_host(
                     good.edges, gb.v, padded_goal, padded_label, bits[: len(failed_iters)]
@@ -1783,13 +1806,13 @@ class JaxBackend(GraphBackend):
 
     # ------------------------------------------------------------- extensions
 
-    def generate_extensions(self) -> tuple[bool, list[str]]:
+    def achieved_pre_goal_counts(self) -> dict[int, int]:
         assert self.molly is not None
         pre_tid = self.vocab.tables.lookup("pre")
         # One vectorized reduction per fused bucket (equivalent to the
         # per-run holds[:n_goals] & table==pre sum: is_goal is exactly the
         # slots-below-n_goals mask, and padding rows are all-False).
-        achieved = 0
+        counts: dict[int, int] = {}
         for pre_b, _post_b, res in self._fused():
             holds = np.asarray(res["pre_holds"])
             k = len(pre_b.run_ids)
@@ -1798,10 +1821,20 @@ class JaxBackend(GraphBackend):
                 & np.asarray(pre_b.is_goal[:k])
                 & (np.asarray(pre_b.table_id[:k]) == pre_tid)
             )
-            achieved += int(sel.sum())
+            per_run = sel.sum(axis=1)
+            for row, rid in enumerate(pre_b.run_ids):
+                counts[rid] = counts.get(rid, 0) + int(per_run[row])
+        return counts
+
+    def extension_suggestions(self) -> list[str]:
+        return synthesize_extensions(
+            extension_candidates(self.raw[(self.baseline_run_iter(), "pre")])
+        )
+
+    def generate_extensions(self) -> tuple[bool, list[str]]:
+        assert self.molly is not None
+        achieved = sum(self.achieved_pre_goal_counts().values())
         all_achieved = achieved >= len(self.molly.runs)
         if all_achieved:
             return True, []
-        return False, synthesize_extensions(
-            extension_candidates(self.raw[(self.baseline_run_iter(), "pre")])
-        )
+        return False, self.extension_suggestions()

@@ -41,6 +41,13 @@ DIFF_OFFSET = 2000  # shadow run offset for diff graphs (differential-provenance
 
 
 class PythonBackend(GraphBackend):
+    #: Per-run decomposition hooks implemented (proto_tables_by_run /
+    #: achieved_pre_goal_counts / extension_suggestions), so the oracle
+    #: exercises the same map/reduce pipeline split as the array backends
+    #: (analysis/delta.py) — the reduce's set algebra is differential-tested
+    #: against create_prototypes through the byte-parity suites.
+    supports_delta = True
+
     def __init__(self) -> None:
         self.molly: MollyOutput | None = None
         # (run_id, condition) -> graph; shadow runs use offset run ids.
@@ -289,6 +296,14 @@ class PythonBackend(GraphBackend):
             union_miss.append(missing_from(union, present))
         return wrap_code(inter), inter_miss, wrap_code(union), union_miss
 
+    def proto_tables_by_run(
+        self, success_iters: list[int], failed_iters: list[int]
+    ) -> tuple[dict[int, list[str]], dict[int, set[str]]]:
+        return (
+            {i: self.proto_rule_tables(i, "post") for i in success_iters},
+            {f: self.clean_rule_tables(f, "post") for f in failed_iters},
+        )
+
     # ------------------------------------------------------------------- pull
 
     def pull_pre_post_prov(
@@ -445,18 +460,27 @@ class PythonBackend(GraphBackend):
 
     # ------------------------------------------------------------- extensions
 
+    def achieved_pre_goal_counts(self) -> dict[int, int]:
+        assert self.molly is not None
+        # Count goals with table == "pre" and condition_holds per raw
+        # antecedent graph (extensions.go:25-50 counts goals, not runs).
+        return {
+            run.iteration: sum(
+                1
+                for n in self.graphs[(run.iteration, "pre")].goals()
+                if n.table == "pre" and n.cond_holds
+            )
+            for run in self.molly.runs
+        }
+
+    def extension_suggestions(self) -> list[str]:
+        candidates = extension_candidates(self.graphs[(self.baseline_run_iter(), "pre")])
+        return synthesize_extensions(candidates)
+
     def generate_extensions(self) -> tuple[bool, list[str]]:
         assert self.molly is not None
-        # Count goals with table == "pre" and condition_holds across all raw
-        # antecedent graphs (extensions.go:25-50 counts goals, not runs).
-        achieved = sum(
-            1
-            for run in self.molly.runs
-            for n in self.graphs[(run.iteration, "pre")].goals()
-            if n.table == "pre" and n.cond_holds
-        )
+        achieved = sum(self.achieved_pre_goal_counts().values())
         all_achieved = achieved >= len(self.molly.runs)
         if all_achieved:
             return True, []
-        candidates = extension_candidates(self.graphs[(self.baseline_run_iter(), "pre")])
-        return False, synthesize_extensions(candidates)
+        return False, self.extension_suggestions()

@@ -182,6 +182,20 @@ def main(argv: list[str] | None = None) -> int:
         "version, checksum) falls back loudly to the parse path",
     )
     parser.add_argument(
+        "--result-cache",
+        default=None,
+        metavar="DIR|off",
+        help="content-addressed analysis result cache root (default "
+        "$NEMO_RESULT_CACHE or ~/.cache/nemo_tpu/results; 'off' disables).  "
+        "Keyed by (corpus store segment fingerprints, figure policy, "
+        "kernel/report ABI): a repeat request over an unchanged corpus "
+        "restores the full report with zero kernel dispatches, and a "
+        "grown corpus re-analyzes only its new runs, merging cached "
+        "per-segment partials (analysis/delta.py).  Requires the corpus "
+        "store (--corpus-cache) — without store fingerprints nothing "
+        "content-addresses the corpus, so every request recomputes",
+    )
+    parser.add_argument(
         "--ingest",
         default="auto",
         choices=("auto", "native", "python"),
@@ -242,6 +256,8 @@ def main(argv: list[str] | None = None) -> int:
         os.environ["NEMO_SVG_CACHE"] = args.svg_cache
     if args.corpus_cache is not None:
         os.environ["NEMO_CORPUS_CACHE"] = args.corpus_cache
+    if args.result_cache is not None:
+        os.environ["NEMO_RESULT_CACHE"] = args.result_cache
     # The tracer is finished in the finally: a pipeline failure must still
     # write the partial trace (a trace of a failed run is exactly when you
     # want one) AND disable the global tracer — main() may run again in

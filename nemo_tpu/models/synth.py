@@ -367,6 +367,35 @@ def write_corpus(spec: SynthSpec, out_dir: str) -> str:
     return corpus_dir
 
 
+def grow_corpus_dir(full_dir: str, dst_dir: str, n_runs: int) -> None:
+    """Materialize the first ``n_runs`` runs of an already-written corpus
+    (synth or case-study layout: run_<i>_{pre,post}_provenance.json,
+    run_<i>_spacetime.dot, runs.json) into ``dst_dir``.  Monotonic: call
+    again with a larger ``n_runs`` to grow the directory the way a
+    still-running Molly sweep appends runs — existing run files are left
+    untouched (their mtimes, and so the store's fingerprints, stay stable);
+    only runs.json is rewritten.  The incremental-sweep simulator shared by
+    the delta smoke and the bench delta tier."""
+    import shutil
+
+    os.makedirs(dst_dir, exist_ok=True)
+    with open(os.path.join(full_dir, "runs.json"), encoding="utf-8") as fh:
+        raw = json.load(fh)
+    for i in range(n_runs):
+        for c in ("pre", "post"):
+            name = f"run_{i}_{c}_provenance.json"
+            dst = os.path.join(dst_dir, name)
+            if not os.path.exists(dst):
+                shutil.copy2(os.path.join(full_dir, name), dst)
+        st = f"run_{i}_spacetime.dot"
+        src = os.path.join(full_dir, st)
+        dst = os.path.join(dst_dir, st)
+        if os.path.exists(src) and not os.path.exists(dst):
+            shutil.copy2(src, dst)
+    with open(os.path.join(dst_dir, "runs.json"), "w", encoding="utf-8") as fh:
+        json.dump(raw[:n_runs], fh, indent=1)
+
+
 # The shared 10k-node giant-path stress scenario (VERDICT r3 task 7): a
 # ~3000-step @next chain — the reference's collapseNextChains worst case
 # (preprocessing.go:253-353) at ~1000x its case-study depth.  One definition

@@ -236,6 +236,29 @@ async function telemetry() {
     body.append(telemetryTable("Corpus store", storeRows));
   }
 
+  // Result cache + delta analysis (nemo_tpu/store/rcache.py,
+  // analysis/delta.py): whether this report was served whole from cache,
+  // how many per-segment partials merged from cache vs mapped fresh, and
+  // the per-run split a grown corpus achieved.
+  const rcacheRows = [];
+  for (const [key, label] of [
+    ["rcache.report_hit", "full-report hits"],
+    ["rcache.report_miss", "full-report misses"],
+    ["rcache.report_stale", "report entries stale/corrupt"],
+    ["rcache.partial_hit", "segment partials from cache"],
+    ["rcache.partial_miss", "segment partials mapped fresh"],
+    ["rcache.partial_stale", "partials stale/corrupt"],
+    ["rcache.figures_restored", "figures restored from cache"],
+    ["delta.runs_mapped", "runs mapped (fresh)"],
+    ["delta.runs_cached", "runs served from cached partials"],
+    ["rcache.evicted", "entries LRU-evicted"],
+  ]) {
+    if (allCounters[key]) rcacheRows.push([label, allCounters[key]]);
+  }
+  if (rcacheRows.length) {
+    body.append(telemetryTable("Result cache / delta analysis", rcacheRows));
+  }
+
   // Kernel cost accounting (backend/jax_backend.py:kernel_cost_snapshot):
   // one row per dispatch signature — FLOPs / bytes-accessed estimates,
   // the first-dispatch (compile) wall, and how often it dispatched.

@@ -263,25 +263,42 @@ class RemoteAnalyzer:
         return codec.outputs_from_pb(resp)
 
     def analyze_dir_remote(
-        self, molly_dir: str, corpus_cache: str | None = None
+        self,
+        molly_dir: str,
+        corpus_cache: str | None = None,
+        result_cache: str | None = None,
     ) -> dict[str, np.ndarray]:
         """Server-side corpus analysis: ship only the DIRECTORY PATH; the
         sidecar ingests (consulting its own persistent corpus store, so
         repeated sessions over the same corpus mmap-load instead of
-        re-parsing) and runs the fused step.  Requires the path to be
-        readable on the sidecar host — the colocated/shared-volume
-        deployment the sidecar normally runs in.  ``corpus_cache`` can only
-        OPT OUT ("off") for this request; enabling or redirecting the
-        server-side store is the sidecar operator's knob, and any other
+        re-parsing) and runs the fused step — or serves the whole response
+        from its result cache when the stored corpus + statics are
+        unchanged (zero device dispatches; the trailing-metadata
+        ``nemo-rcache`` status lands in the ``rpc.analyze_dir_rcache.*``
+        counters and a log record).  ``corpus_cache``/``result_cache`` can
+        only OPT OUT ("off") for this request; enabling or redirecting the
+        server-side caches is the sidecar operator's knob, and any other
         value is ignored server-side."""
         import os
 
         req: dict = {"dir": os.path.abspath(molly_dir)}
         if corpus_cache is not None:
             req["corpus_cache"] = corpus_cache
+        if result_cache is not None:
+            req["result_cache"] = result_cache
         obs.metrics.inc("rpc.bytes_sent", len(_json.dumps(req).encode("utf-8")))
-        resp, _ = self._call(self._analyze_dir, req, name="AnalyzeDir")
+        resp, call = self._call(self._analyze_dir, req, name="AnalyzeDir")
         obs.metrics.inc("rpc.bytes_received", resp.ByteSize())
+        try:
+            status = dict(call.trailing_metadata() or ()).get("nemo-rcache")
+        except Exception:
+            status = None
+        if status:
+            obs.metrics.inc(f"rpc.analyze_dir_rcache.{status}")
+            if status == "hit":
+                _log.info(
+                    "rpc.analyze_dir_cached", dir=molly_dir, target=self.target
+                )
         return codec.outputs_from_pb(resp)
 
     def analyze_chunks(

@@ -283,9 +283,20 @@ class _Impl:
         mmap-loads on every session after the first.
 
         Wire shape: the request is a JSON object (``{"dir": ..., optional
-        "corpus_cache": ...}``) carried through a generic-handler JSON
-        deserializer — no protoc regeneration needed — and the response is
-        the standard AnalyzeResponse the Analyze RPC returns."""
+        "corpus_cache": ..., optional "result_cache": ...}``) carried
+        through a generic-handler JSON deserializer — no protoc
+        regeneration needed — and the response is the standard
+        AnalyzeResponse the Analyze RPC returns.
+
+        Response caching: when the sidecar's result cache resolves
+        (``--result-cache``/``NEMO_RESULT_CACHE``) and the corpus was
+        served by the store, the serialized response is cached
+        content-addressed on (segment fingerprints, statics, wire
+        version, analysis ABI) — a repeat session gets the stored bytes
+        with ZERO device dispatches, flagged ``nemo-rcache: hit`` in the
+        trailing metadata (hit/miss/off streams back on every call).
+        ``result_cache`` in the request can only opt OUT ("off"), like
+        ``corpus_cache``."""
         col = _SpanCollection(context)
         t0 = time.perf_counter()
         try:
@@ -338,15 +349,56 @@ class _Impl:
                     pre = BatchArrays.from_packed(nc.pre)
                     post = BatchArrays.from_packed(nc.post)
                     static = corpus_step_static(nc)
+                    seg_meta = getattr(nc, "store_segments", None)
                 else:  # object-loader fallback (no native lib, cold store)
                     from nemo_tpu.models.pipeline_model import pack_molly_for_step
 
                     pre, post, static = pack_molly_for_step(molly)
+                    seg_meta = getattr(molly, "store_segments", None)
                 obs.metrics.inc("serve.analyze_dir")
-                resp = self._run_step(pre, post, static, chunk=0, trace_id=col.tid)
-            md = col.trailing()
-            if md:
-                context.set_trailing_metadata(md)
+
+                # Response cache: operator authority like the store —
+                # resolved from the sidecar's own env, request can only
+                # opt out.  Keyed on segment fingerprints + statics + wire
+                # version, so a stale store or a kernel ABI bump can never
+                # serve old bytes.
+                from nemo_tpu.analysis.delta import blob_cache_key
+                from nemo_tpu.store.rcache import (
+                    resolve_result_cache,
+                    result_cache_dir,
+                )
+
+                req_rc = request.get("result_cache")
+                rc_opt_out = req_rc is not None and result_cache_dir(req_rc) is None
+                rc = None if rc_opt_out else resolve_result_cache()
+                blob_key = (
+                    blob_cache_key(
+                        "analyze_dir",
+                        seg_meta,
+                        {"static": {k: int(v) for k, v in static.items()}, "wire": VERSION},
+                    )
+                    if rc is not None
+                    else None
+                )
+                rc_status = "off"
+                resp = None
+                if blob_key is not None:
+                    payload = rc.load_blob("analyze_dir", blob_key)
+                    if payload is not None:
+                        resp = pb.AnalyzeResponse.FromString(payload)
+                        # The stored wall is the POPULATING run's; a served
+                        # hit dispatched nothing.
+                        resp.step_seconds = 0.0
+                        rc_status = "hit"
+                        obs.metrics.inc("serve.analyze_dir_cached")
+                    else:
+                        rc_status = "miss"
+                if resp is None:
+                    resp = self._run_step(pre, post, static, chunk=0, trace_id=col.tid)
+                    if blob_key is not None:
+                        rc.put_blob("analyze_dir", blob_key, resp.SerializeToString())
+            md = col.trailing() + (("nemo-rcache", rc_status),)
+            context.set_trailing_metadata(md)
             return resp
         finally:
             _rpc_observed("AnalyzeDir", t0, col.tid)
@@ -473,6 +525,16 @@ def main(argv: list[str] | None = None) -> int:
         "sessions over the same corpus directory skip upload AND parse",
     )
     parser.add_argument(
+        "--result-cache",
+        default=None,
+        metavar="DIR|off",
+        help="server-side analysis result cache consulted by the AnalyzeDir "
+        "RPC (default $NEMO_RESULT_CACHE or ~/.cache/nemo_tpu/results; "
+        "'off' disables): a repeat session over an unchanged stored corpus "
+        "gets the cached response bytes with zero device dispatches "
+        "(trailing metadata nemo-rcache: hit)",
+    )
+    parser.add_argument(
         "--metrics-port",
         type=int,
         default=_metrics_port_default(),
@@ -486,6 +548,8 @@ def main(argv: list[str] | None = None) -> int:
         # Env-carried like the CLI's knob, so the AnalyzeDir handler and the
         # store module resolve identically in every process shape.
         os.environ["NEMO_CORPUS_CACHE"] = args.corpus_cache
+    if args.result_cache is not None:
+        os.environ["NEMO_RESULT_CACHE"] = args.result_cache
     from nemo_tpu.utils.jax_config import (
         PlatformUnavailableError,
         enable_compilation_cache,

@@ -38,7 +38,11 @@ from nemo_tpu.store.npack import (
     corpus_cache_dir,
     payload_from_molly,
     payload_from_runs,
+    fingerprint_mode,
+    segment_fingerprint,
+    segment_source_fp,
     snapshot_source,
+    snapshot_source_appended,
     source_from_snapshot,
     store_workers_default,
     write_segment,
@@ -53,7 +57,26 @@ __all__ = [
     "corpus_cache_dir",
     "resolve_store",
     "store_size_bytes",
+    "segment_fingerprint",
+    "attach_store_provenance",
 ]
+
+
+def attach_store_provenance(obj, store_dir: str, header: dict) -> None:
+    """Stamp a loaded corpus/MollyOutput with the store identity the
+    analysis result cache keys on: one ``{name, n_runs, fingerprint}``
+    record per segment (append order == global run order).  Set on both
+    the MollyOutput and the array-only corpus objects so every consumer
+    of a warm load can content-address its downstream results."""
+    obj.store_dir = store_dir
+    obj.store_segments = [
+        {
+            "name": e["name"],
+            "n_runs": int(e["n_runs"]),
+            "fingerprint": segment_fingerprint(e),
+        }
+        for e in header["segments"]
+    ]
 
 _log = obs_log.get_logger("nemo.store")
 
@@ -237,6 +260,11 @@ class CorpusStore:
                 out = (
                     molly_from_corpus(corpus, corpus_dir) if build_molly else corpus
                 )
+                # Segment identities ride on the loaded object: the result
+                # cache (store/rcache.py) keys analysis outputs on them.
+                attach_store_provenance(corpus, store_dir, header)
+                if out is not corpus:
+                    attach_store_provenance(out, store_dir, header)
             except (StoreCorrupt, OSError, ValueError, KeyError) as ex:
                 obs.metrics.inc("store.stale")
                 _log.error(
@@ -277,13 +305,16 @@ class CorpusStore:
         HIT."""
         return snapshot_source(corpus_dir)
 
-    def put(self, corpus_dir: str, molly, snapshot: dict | None = None) -> bool:
+    def put(self, corpus_dir: str, molly, snapshot: dict | None = None):
         """Populate (or replace) the store for ``corpus_dir`` from a parsed
         MollyOutput — packed-first (native) or object-loader (Python), both
         producers yield bit-compatible stores.  ``snapshot`` is the
         pre-parse :meth:`snapshot` (taken now when omitted — fine when the
-        directory cannot have changed since the parse).  Returns False
-        (logged) on any failure: populating is always best-effort."""
+        directory cannot have changed since the parse).  Returns the
+        written header (truthy) on success — callers that populate on the
+        parse path use it to attach the segment identities the result
+        cache keys on — or False (logged) on any failure: populating is
+        always best-effort."""
         try:
             return self._put(corpus_dir, molly, snapshot)
         except Exception as ex:  # a cache write must never sink the pipeline
@@ -295,22 +326,26 @@ class CorpusStore:
             )
             return False
 
-    def _put(self, corpus_dir: str, molly, snapshot: dict | None = None) -> bool:
+    def _put(self, corpus_dir: str, molly, snapshot: dict | None = None):
         if not molly.runs:
             return False
         t0 = time.perf_counter()
         workers = store_workers_default()
         with obs.span("ingest:store_populate", dir=os.path.basename(corpus_dir)):
             payload = payload_from_molly(molly)
-            source = source_from_snapshot(
-                snapshot or snapshot_source(corpus_dir), payload.n_runs
-            )
+            snap = snapshot or snapshot_source(corpus_dir)
+            source = source_from_snapshot(snap, payload.n_runs)
             source["dir"] = os.path.realpath(corpus_dir)
             final = self.store_dir(corpus_dir)
             tmp = f"{final}.tmp-{os.getpid()}-{uuid.uuid4().hex[:8]}"
             os.makedirs(tmp, exist_ok=True)
             try:
                 seg_entry = write_segment(os.path.join(tmp, "seg-000"), payload, workers)
+                # Per-segment SOURCE fingerprint: the run files these rows
+                # came from (spacetime DOTs included — content the packed
+                # arrays don't mirror); part of the segment's identity for
+                # the result cache.
+                seg_entry["source_fp"] = segment_source_fp(snap, 0, payload.n_runs)
                 vshard = write_vocab(
                     os.path.join(tmp, "vocab-0001.bin"), _VocabView(payload.vocab)
                 )
@@ -345,7 +380,7 @@ class CorpusStore:
             store=final,
             seconds=round(time.perf_counter() - t0, 2),
         )
-        return True
+        return header
 
     # ------------------------------------------------------------- eviction
 
@@ -471,7 +506,16 @@ class CorpusStore:
             # Snapshot BEFORE parsing anything: a file mutated while the
             # tail parse below runs then mismatches the fingerprint this
             # append publishes, so the NEXT load re-parses (fail-safe).
-            snap = snapshot_source(corpus_dir)
+            # In fast fingerprint mode the snapshot is PARTIAL — names
+            # enumeration + stats for only runs.json, the new run files,
+            # and the load-check sample — so the append wall scales with
+            # the growth, not the corpus (a full per-file stat pass is
+            # ~40 s on a 9p-mounted 10x corpus).
+            snap = (
+                snapshot_source(corpus_dir)
+                if fingerprint_mode() == "full"
+                else snapshot_source_appended(corpus_dir, n_old)
+            )
             with open(os.path.join(corpus_dir, "runs.json"), "r", encoding="utf-8") as fh:
                 raw_runs = json.load(fh)
             if len(raw_runs) <= n_old:
@@ -537,6 +581,9 @@ class CorpusStore:
             try:
                 seg_entry = write_segment(tmp_seg, payload, workers)
                 seg_entry["name"] = seg_name
+                seg_entry["source_fp"] = segment_source_fp(
+                    snap, n_old, len(raw_runs)
+                )
                 os.rename(tmp_seg, os.path.join(store_dir, seg_name))
             except BaseException:
                 shutil.rmtree(tmp_seg, ignore_errors=True)

@@ -297,15 +297,32 @@ class StoreCorrupt(RuntimeError):
 _SAMPLE_FILES = 64
 
 
+def _select_sample(entries: list) -> list:
+    """The deterministic <=:data:`_SAMPLE_FILES` spread used by every
+    fingerprint sample: an even stride over ``sorted(entries)`` plus the
+    last element.  Membership is a pure function of the sorted entry list
+    — single definition so the populate, append, and load-side selections
+    can never drift."""
+    base = sorted(entries)
+    stride = max(1, len(base) // _SAMPLE_FILES)
+    sample = base[::stride][:_SAMPLE_FILES]
+    if base and base[-1] not in sample:
+        sample.append(base[-1])
+    return sample
+
+
 def fingerprint_mode() -> str:
     """``fast`` (default): warm loads compare file NAMES (one scandir, no
     per-file stat) plus runs.json's stat plus a stored <=64-file stat
     sample — on the 9p/network filesystems this repo benches on, a full
     per-file stat scan costs more than the entire mmap load (~136 µs/stat
     observed; a 10x corpus has 300k+ files).  ``NEMO_STORE_FINGERPRINT=full``
-    restores the exhaustive per-file size+mtime comparison.  Write-time
-    fingerprints are always full — only the LOAD-side comparison is
-    sampled."""
+    restores the exhaustive per-file size+mtime comparison.  POPULATE-time
+    fingerprints are always full (the stat pass amortizes into the
+    minutes-long parse); APPEND-time snapshots follow this mode
+    (:func:`snapshot_source_appended` — stats proportional to the growth,
+    not the corpus, so fast-mode appends publish no ``old_fp``/``other_fp``
+    and a later ``full``-mode load repopulates)."""
     env = os.environ.get("NEMO_STORE_FINGERPRINT", "").strip().lower()
     return "full" if env == "full" else "fast"
 
@@ -352,6 +369,73 @@ def snapshot_source(corpus_dir: str, with_stats: bool = True) -> dict:
         )
         if with_stats
         else None,
+    }
+
+
+def snapshot_source_appended(corpus_dir: str, n_old: int) -> dict:
+    """Partial pre-parse snapshot for the APPEND path in ``fast``
+    fingerprint mode: one names-only enumeration plus stats for exactly
+    the files the published fingerprint will read — runs.json, the NEW
+    run files (positions >= ``n_old``; their stats become the appended
+    segment's ``source_fp``), and the deterministic <=
+    :data:`_SAMPLE_FILES` spread the fast load check verifies.  A full
+    :func:`snapshot_source` stats EVERY file, which is O(corpus) syscalls
+    per append (~136 µs each on the 9p/network filesystems this repo
+    benches on: a 10x corpus holds 300k+ files = ~40 s of stats to append
+    a 5% sweep increment); this keeps the append wall proportional to the
+    GROWTH, which is the whole point of the append path.
+
+    The exhaustive per-class stat fingerprints (``old_fp``/``other_fp``)
+    are consequently absent from the published source: a later
+    ``NEMO_STORE_FINGERPRINT=full`` load finds no stored ``old_fp`` to
+    compare against and classifies STALE — a loud repopulate, the
+    conservative direction (switching to the stricter mode re-verifies
+    from scratch; it can never serve stale bytes).  Old-file stats are
+    untouched here by design: the append separately confirms old content
+    via the runs.json byte-prefix sha / head-fragment checks, and every
+    sampled stat is captured BEFORE the tail parse (same fail-safe
+    direction as the full snapshot)."""
+    dir_mtime_ns = os.stat(corpus_dir).st_mtime_ns
+    entries: list[tuple] = []
+    runs_json: list[int] | None = None
+    with os.scandir(corpus_dir) as it:
+        for entry in it:
+            name = entry.name
+            if name == "runs.json":
+                st = entry.stat()
+                runs_json = [st.st_size, st.st_mtime_ns]
+                continue
+            if not entry.is_file(follow_symlinks=True):
+                continue
+            idx = ""
+            if name.startswith("run_"):
+                cut = name.find("_", 4)
+                idx = name[4:cut] if cut > 4 else ""
+            if idx.isdigit() and int(idx) >= n_old:
+                st = entry.stat()
+                entries.append((name, st.st_size, st.st_mtime_ns))
+            else:
+                entries.append((name, None, None))
+    # Same selection RULE as the full snapshot (_select_sample), applied to
+    # this directory's whole entry list — which includes the new-run files
+    # the full path's old+other base excludes, so membership can differ
+    # from a from-scratch snapshot's.  Benign: the stored sample is
+    # self-contained (name, size, mtime triples), the load-side check
+    # compares exactly the stored members.  Stat them now, pre-parse.
+    sample = _select_sample(entries)
+    sampled: list[list] = []
+    for name, size, mtime_ns in sample:
+        if size is None:
+            st = os.stat(os.path.join(corpus_dir, name))
+            size, mtime_ns = st.st_size, st.st_mtime_ns
+        sampled.append([name, size, mtime_ns])
+    return {
+        "dir_mtime_ns": dir_mtime_ns,
+        "runs_json": runs_json,
+        "entries": entries,
+        "with_stats": False,
+        "sample": sampled,
+        "runs_prefix_sha": _runs_prefix_sha(corpus_dir, (runs_json or [0])[0]),
     }
 
 
@@ -402,12 +486,11 @@ def source_from_snapshot(snap: dict, n_old: int) -> dict:
         if with_stats:
             out[f"{cls}_fp"] = _fp([f"{n}\0{s}\0{t}" for n, s, t in recs])
     if with_stats:
-        base = sorted(old + other)
-        stride = max(1, len(base) // _SAMPLE_FILES)
-        sample = base[::stride][:_SAMPLE_FILES]
-        if base and base[-1] not in sample:
-            sample.append(base[-1])
-        out["sample"] = [list(rec) for rec in sample]
+        out["sample"] = [list(rec) for rec in _select_sample(old + other)]
+    elif snap.get("sample") is not None:
+        # Partial append snapshot (snapshot_source_appended): the sample
+        # was selected and statted at snapshot time, pre-parse.
+        out["sample"] = [list(rec) for rec in snap["sample"]]
     return out
 
 
@@ -437,6 +520,43 @@ def _runs_prefix_sha(corpus_dir: str, nbytes: int) -> str | None:
 
 
 HIT, GROWN, STALE = "hit", "grown", "stale"
+
+
+def segment_source_fp(snapshot: dict, lo: int, hi: int) -> str:
+    """Fingerprint of the SOURCE files belonging to run positions
+    [lo, hi) — the ``run_<pos>_*`` files (provenance JSON, spacetime DOTs,
+    anything else per-run), names + stats when the snapshot carried them.
+    Stored per segment so the analysis result cache (store/rcache.py) can
+    key per-segment partials on content the packed arrays do NOT mirror
+    (the hazard figures read run_<pos>_spacetime.dot directly)."""
+    lines = []
+    for rec in snapshot["entries"]:
+        name = rec[0]
+        if not name.startswith("run_"):
+            continue
+        cut = name.find("_", 4)
+        idx = name[4:cut] if cut > 4 else ""
+        if idx.isdigit() and lo <= int(idx) < hi:
+            lines.append(f"{rec[0]}\0{rec[1]}\0{rec[2]}")
+    return _fp(lines)
+
+
+def segment_fingerprint(entry: dict) -> str:
+    """Content address of one store segment: its packed-shard checksums,
+    its shape row, and its source-file fingerprint.  The analysis result
+    cache keys every per-segment partial (and, joined over all segments,
+    every full report) on exactly this."""
+    doc = [
+        int(entry["n_runs"]),
+        int(entry["v"]),
+        int(entry["e"]),
+        int(entry["max_depth"]),
+        entry.get("source_fp") or "",
+        sorted((m["file"], m["sha256"]) for m in entry["shards"]),
+    ]
+    return hashlib.sha256(
+        json.dumps(doc, sort_keys=True, separators=(",", ":")).encode()
+    ).hexdigest()
 
 
 def _sample_ok(corpus_dir: str, sample: list) -> bool:

@@ -1,0 +1,422 @@
+"""Content-addressed analysis result cache (sibling of the corpus store).
+
+Three entry kinds under one size-capped root (default
+``~/.cache/nemo_tpu/results``; ``NEMO_RESULT_CACHE`` / ``--result-cache``
+override, ``off`` disables):
+
+  * ``report/<key>/``  — a full report tree (minus the nondeterministic
+    telemetry files): a warm repeat request restores it with ZERO kernel
+    dispatches and no backend at all;
+  * ``partial/<key>/`` — one store segment's :class:`SegmentPartial` JSON
+    plus its rendered figure files: a GROWN corpus maps only its new
+    segments and merges these (analysis/delta.py);
+  * ``blob/<ns>/<key>`` — small opaque payloads (the sidecar's AnalyzeDir
+    response cache).
+
+Keys are produced by analysis/delta.py from (store segment fingerprints,
+analysis config, kernel/report ABI versions) — pure content addressing, so
+the cache needs no invalidation protocol: any input change produces a new
+key and the stale entry ages out via the same LRU size-cap machinery the
+corpus store uses (``NEMO_RESULT_CACHE_MAX_GB``, last-use stamped on every
+hit).  Every entry carries a sha256 manifest; a corrupted entry fails the
+verify pass (``NEMO_STORE_VERIFY=off`` skips it, like the store) and is
+treated as a loud, counted miss — never served.
+
+Files are hardlinked between the cache and report trees where the
+filesystem allows (the report is regenerated output, and a mutated
+hardlinked report file is exactly what the manifest verify catches), with
+a copy fallback across devices.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import time
+import uuid
+
+from nemo_tpu import obs
+from nemo_tpu.obs import log as obs_log
+from nemo_tpu.store.npack import _verify_on_load
+
+_log = obs_log.get_logger("nemo.rcache")
+
+
+def result_cache_dir(arg: str | None = None) -> str | None:
+    """Resolve the result-cache root: explicit argument wins (``off`` etc.
+    disables), else ``NEMO_RESULT_CACHE``, else
+    ``~/.cache/nemo_tpu/results`` beside the corpus/SVG/jit caches."""
+    env = arg if arg is not None else os.environ.get("NEMO_RESULT_CACHE")
+    if env is not None:
+        env = env.strip()
+        if env.lower() in ("", "0", "off", "none", "false"):
+            return None
+        return os.path.expanduser(env)
+    return os.path.join(os.path.expanduser("~"), ".cache", "nemo_tpu", "results")
+
+
+def resolve_result_cache(arg: str | None = None) -> "ResultCache | None":
+    root = result_cache_dir(arg)
+    return ResultCache(root) if root else None
+
+
+def _max_cache_bytes() -> int:
+    """Size cap (bytes): ``NEMO_RESULT_CACHE_MAX_GB`` (default 8; 0/junk
+    disables).  Report trees mirror whole debugging.json documents, so the
+    cap matters for the same reason the corpus store's does."""
+    env = os.environ.get("NEMO_RESULT_CACHE_MAX_GB", "").strip()
+    try:
+        gb = float(env) if env else 8.0
+    except ValueError:
+        gb = 0.0
+    return int(gb * 1e9) if gb > 0 else 0
+
+
+def _sha256_file(path: str) -> str:
+    sha = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            sha.update(chunk)
+    return sha.hexdigest()
+
+
+def _link_or_copy(src: str, dst: str) -> None:
+    os.makedirs(os.path.dirname(dst), exist_ok=True)
+    try:
+        os.link(src, dst)
+    except OSError:
+        shutil.copy2(src, dst)
+
+
+class ResultCache:
+    """One result-cache root.  All writes are atomic (tmp dir + rename)
+    and best-effort: a cache failure must never sink the pipeline."""
+
+    def __init__(self, root: str) -> None:
+        self.root = root
+
+    # ------------------------------------------------------------ plumbing
+
+    def _entry_dir(self, kind: str, key: str) -> str:
+        return os.path.join(self.root, kind, key)
+
+    def _load_entry(self, kind: str, key: str):
+        """(entry dict, entry dir) on a verified read, else None — misses
+        and stale entries counted and logged per kind.  The HIT counter is
+        the caller's to record (:meth:`_hit`) once the payload actually
+        decodes — a manifest-valid entry whose payload is undecodable must
+        count as stale only, never as both a hit and a stale."""
+        d = self._entry_dir(kind, key)
+        path = os.path.join(d, "entry.json")
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                entry = json.load(fh)
+        except FileNotFoundError:
+            obs.metrics.inc(f"rcache.{kind}_miss")
+            return None
+        except (OSError, ValueError) as ex:
+            obs.metrics.inc(f"rcache.{kind}_stale")
+            _log.warning(
+                "rcache.entry_unreadable", kind=kind, key=key,
+                error=f"{type(ex).__name__}: {ex}",
+            )
+            return None
+        if _verify_on_load():
+            for rec in entry.get("manifest", ()):
+                p = os.path.join(d, rec["path"])
+                try:
+                    ok = (
+                        os.path.getsize(p) == int(rec["size"])
+                        and _sha256_file(p) == rec["sha256"]
+                    )
+                except OSError:
+                    ok = False
+                if not ok:
+                    obs.metrics.inc(f"rcache.{kind}_stale")
+                    _log.error(
+                        "rcache.entry_corrupt", kind=kind, key=key,
+                        file=rec["path"],
+                        detail="failing the verify pass; recomputing instead "
+                        "of serving stale bytes",
+                    )
+                    return None
+        return entry, d
+
+    def _hit(self, kind: str, entry_dir: str) -> None:
+        """Record a served hit: counter + LRU last-use stamp."""
+        obs.metrics.inc(f"rcache.{kind}_hit")
+        try:
+            os.utime(os.path.join(entry_dir, "entry.json"))
+        except OSError:
+            pass
+
+    def _put_entry(self, kind: str, key: str, build) -> bool:
+        """Atomically publish one entry: ``build(tmp_dir) -> entry dict``
+        populates the payload and returns the entry body (the manifest is
+        appended here).  Returns False (logged) on any failure."""
+        try:
+            os.makedirs(os.path.join(self.root, kind), exist_ok=True)
+            final = self._entry_dir(kind, key)
+            tmp = f"{final}.tmp-{os.getpid()}-{uuid.uuid4().hex[:8]}"
+            os.makedirs(tmp, exist_ok=True)
+            try:
+                entry = build(tmp)
+                manifest = []
+                for dirpath, _, files in os.walk(tmp):
+                    for f in sorted(files):
+                        p = os.path.join(dirpath, f)
+                        rel = os.path.relpath(p, tmp)
+                        manifest.append(
+                            {
+                                "path": rel,
+                                "size": os.path.getsize(p),
+                                "sha256": _sha256_file(p),
+                            }
+                        )
+                entry["manifest"] = manifest
+                entry["created"] = time.time()
+                with open(os.path.join(tmp, "entry.json"), "w", encoding="utf-8") as fh:
+                    json.dump(entry, fh, indent=1)
+                if os.path.isdir(final):
+                    # Same key == same content: keep the existing entry (its
+                    # LRU stamp included) rather than replace-racing it.
+                    shutil.rmtree(tmp, ignore_errors=True)
+                else:
+                    try:
+                        os.rename(tmp, final)
+                    except OSError:
+                        shutil.rmtree(tmp, ignore_errors=True)
+            except BaseException:
+                shutil.rmtree(tmp, ignore_errors=True)
+                raise
+            obs.metrics.inc(f"rcache.{kind}_put")
+            self._evict_over_cap(keep=final)
+            return True
+        except Exception as ex:
+            obs.metrics.inc("rcache.write_failed")
+            _log.warning(
+                "rcache.write_failed", kind=kind, key=key,
+                error=f"{type(ex).__name__}: {ex}",
+            )
+            return False
+
+    # ------------------------------------------------------------- reports
+
+    def load_report(self, key: str, results_root: str, report_dir: str) -> bool:
+        """Restore a cached full report tree into ``report_dir`` (replacing
+        any existing report, like Reporter.prepare).  True on a verified
+        hit — the caller then writes fresh telemetry and is DONE: no
+        backend, no kernel dispatches."""
+        got = self._load_entry("report", key)
+        if got is None:
+            return False
+        entry, d = got
+        t0 = time.perf_counter()
+        with obs.span("report:cache_restore", key=key[:12]):
+            os.makedirs(results_root, exist_ok=True)
+            tmp = f"{report_dir}.tmp-{uuid.uuid4().hex[:8]}"
+            try:
+                tree = os.path.join(d, "tree")
+                for dirpath, _, files in os.walk(tree):
+                    for f in files:
+                        src = os.path.join(dirpath, f)
+                        rel = os.path.relpath(src, tree)
+                        _link_or_copy(src, os.path.join(tmp, rel))
+                if os.path.isdir(report_dir):
+                    shutil.rmtree(report_dir)
+                os.rename(tmp, report_dir)
+            except OSError as ex:
+                shutil.rmtree(tmp, ignore_errors=True)
+                obs.metrics.inc("rcache.restore_failed")
+                _log.warning(
+                    "rcache.restore_failed", key=key, error=str(ex),
+                )
+                return False
+        self._hit("report", d)
+        obs.metrics.observe("rcache.restore_s", time.perf_counter() - t0)
+        _log.info(
+            "rcache.report_hit", key=key[:12], report_dir=report_dir,
+            files=len(entry.get("manifest", ())),
+            seconds=round(time.perf_counter() - t0, 3),
+        )
+        return True
+
+    def put_report(self, key: str, report_dir: str, exclude: frozenset) -> bool:
+        """Cache a freshly written report tree (minus ``exclude`` basenames
+        — the nondeterministic telemetry set)."""
+
+        def build(tmp: str) -> dict:
+            tree = os.path.join(tmp, "tree")
+            for dirpath, _, files in os.walk(report_dir):
+                for f in files:
+                    if f in exclude:
+                        continue
+                    src = os.path.join(dirpath, f)
+                    rel = os.path.relpath(src, report_dir)
+                    _link_or_copy(src, os.path.join(tree, rel))
+            return {"kind": "report", "key": key}
+
+        return self._put_entry("report", key, build)
+
+    # ------------------------------------------------------------ partials
+
+    def load_partial(self, key: str):
+        """A verified cached SegmentPartial (figure files NOT yet restored
+        — restore_figures does that into the report tree), or None."""
+        from nemo_tpu.analysis.delta import SegmentPartial
+
+        got = self._load_entry("partial", key)
+        if got is None:
+            return None
+        entry, d = got
+        try:
+            p = SegmentPartial.from_json(entry["partial"])
+        except (KeyError, TypeError, ValueError) as ex:
+            obs.metrics.inc("rcache.partial_stale")
+            _log.warning(
+                "rcache.partial_undecodable", key=key,
+                error=f"{type(ex).__name__}: {ex}",
+            )
+            return None
+        p.cache_dir = d  # type: ignore[attr-defined]
+        self._hit("partial", d)
+        return p
+
+    def put_partial(self, key: str, partial, figures_dir: str) -> bool:
+        """Cache one segment's partial + its figure files (hardlinked from
+        the just-written report's figures/)."""
+
+        def build(tmp: str) -> dict:
+            fdir = os.path.join(tmp, "figures")
+            for name in partial.fig_files:
+                src = os.path.join(figures_dir, name)
+                _link_or_copy(src, os.path.join(fdir, name))
+            return {"kind": "partial", "key": key, "partial": partial.to_json()}
+
+        return self._put_entry("partial", key, build)
+
+    def restore_figures(self, partial, figures_dir: str) -> int:
+        """Place a cached partial's figure files into the report's
+        figures/ directory; returns the file count.  Best-effort like
+        every cache read: the entry's manifest was verified at load time,
+        but a concurrent evictor can rmtree it between load and restore
+        (or NEMO_STORE_VERIFY=off skipped the check) — a vanished file is
+        counted and logged as an ERROR (the report tree is missing that
+        figure), never raised: a cache failure must not sink an analysis
+        whose kernel work is already done."""
+        d = getattr(partial, "cache_dir", None)
+        if d is None:
+            return 0
+        os.makedirs(figures_dir, exist_ok=True)
+        n = 0
+        for name in partial.fig_files:
+            src = os.path.join(d, "figures", name)
+            dst = os.path.join(figures_dir, name)
+            try:
+                if os.path.exists(dst):
+                    os.remove(dst)
+                _link_or_copy(src, dst)
+            except OSError as ex:
+                obs.metrics.inc("rcache.figures_missing")
+                _log.error(
+                    "rcache.figure_restore_failed", entry=d, file=name,
+                    error=f"{type(ex).__name__}: {ex}",
+                    detail="cached figure vanished (concurrent eviction or "
+                    "unverified entry); the report is missing this figure",
+                )
+                continue
+            n += 1
+        obs.metrics.inc("rcache.figures_restored", n)
+        return n
+
+    # --------------------------------------------------------------- blobs
+
+    def load_blob(self, namespace: str, key: str) -> bytes | None:
+        got = self._load_entry(f"blob_{namespace}", key)
+        if got is None:
+            return None
+        _, d = got
+        try:
+            with open(os.path.join(d, "payload.bin"), "rb") as fh:
+                payload = fh.read()
+        except OSError:
+            obs.metrics.inc(f"rcache.blob_{namespace}_stale")
+            return None
+        self._hit(f"blob_{namespace}", d)
+        return payload
+
+    def put_blob(self, namespace: str, key: str, payload: bytes) -> bool:
+        def build(tmp: str) -> dict:
+            with open(os.path.join(tmp, "payload.bin"), "wb") as fh:
+                fh.write(payload)
+            return {"kind": f"blob_{namespace}", "key": key}
+
+        return self._put_entry(f"blob_{namespace}", key, build)
+
+    # ------------------------------------------------------------ eviction
+
+    _WRECKAGE_MAX_AGE_S = 3600.0
+
+    def _evict_over_cap(self, keep: str) -> None:
+        """LRU size-cap eviction mirroring the corpus store's: sweep aged
+        crash leftovers, then evict least-recently-used entries
+        (entry.json mtime, stamped on every hit) until under
+        NEMO_RESULT_CACHE_MAX_GB — never the entry just written."""
+        from nemo_tpu.store import store_size_bytes
+
+        now = time.time()
+        try:
+            for kind in os.listdir(self.root):
+                kdir = os.path.join(self.root, kind)
+                if not os.path.isdir(kdir):
+                    continue
+                for name in os.listdir(kdir):
+                    if ".tmp-" not in name:
+                        continue
+                    path = os.path.join(kdir, name)
+                    try:
+                        if now - os.path.getmtime(path) < self._WRECKAGE_MAX_AGE_S:
+                            continue
+                        shutil.rmtree(path, ignore_errors=True)
+                        obs.metrics.inc("rcache.gc_wreckage")
+                    except OSError:
+                        continue
+        except OSError:
+            pass
+        cap = _max_cache_bytes()
+        if not cap:
+            return
+        try:
+            entries = []
+            for kind in os.listdir(self.root):
+                kdir = os.path.join(self.root, kind)
+                if not os.path.isdir(kdir):
+                    continue
+                for name in os.listdir(kdir):
+                    if ".tmp-" in name:
+                        continue
+                    path = os.path.join(kdir, name)
+                    size = store_size_bytes(path)
+                    try:
+                        used = os.path.getmtime(os.path.join(path, "entry.json"))
+                    except OSError:
+                        used = 0.0
+                    entries.append((used, size, path))
+            total = sum(s for _, s, _ in entries)
+            if total <= cap:
+                return
+            for used, size, path in sorted(entries):
+                if total <= cap:
+                    break
+                if os.path.abspath(path) == os.path.abspath(keep):
+                    continue
+                shutil.rmtree(path, ignore_errors=True)
+                total -= size
+                obs.metrics.inc("rcache.evicted")
+                _log.info(
+                    "rcache.evicted", entry=path, freed_mb=round(size / 1e6, 1),
+                )
+        except OSError as ex:
+            _log.warning("rcache.evict_failed", root=self.root, error=str(ex))

@@ -33,17 +33,9 @@ import tempfile
 
 
 def _tree(root: str) -> dict[str, bytes]:
-    from nemo_tpu.analysis.pipeline import NONDETERMINISTIC_REPORT_FILES
+    from nemo_tpu.analysis.pipeline import report_tree_bytes
 
-    out: dict[str, bytes] = {}
-    for dirpath, _, files in os.walk(root):
-        for f in files:
-            if f in NONDETERMINISTIC_REPORT_FILES:
-                continue  # wall-clock telemetry: never byte-comparable
-            p = os.path.join(dirpath, f)
-            with open(p, "rb") as fh:
-                out[os.path.relpath(p, root)] = fh.read()
-    return out
+    return report_tree_bytes(root)
 
 
 def _validate_trace_events(doc: dict) -> list[dict]:
@@ -86,6 +78,9 @@ def trace_smoke() -> int:
     with tempfile.TemporaryDirectory(prefix="nemo_trace_smoke_") as tmp:
         os.environ["NEMO_SVG_CACHE"] = os.path.join(tmp, "svg_cache")
         os.environ["NEMO_CORPUS_CACHE"] = os.path.join(tmp, "corpus_cache")
+        # The span assertions need the pipeline to actually run its phases
+        # (and the smoke must not write into the user's results cache).
+        os.environ["NEMO_RESULT_CACHE"] = "off"
         os.environ["NEMO_RENDER_WORKERS"] = "2"
         trace_path = os.path.join(tmp, "trace.json")
         t = obs_trace.start_trace(trace_path)
@@ -284,6 +279,9 @@ def obs_smoke() -> int:
     with tempfile.TemporaryDirectory(prefix="nemo_obs_smoke_") as tmp:
         os.environ["NEMO_SVG_CACHE"] = os.path.join(tmp, "svg_cache")
         os.environ["NEMO_CORPUS_CACHE"] = os.path.join(tmp, "corpus_cache")
+        # The Kernel-RPC assertions need dispatches to actually happen (and
+        # the smoke must not write into the user's results cache).
+        os.environ["NEMO_RESULT_CACHE"] = "off"
         log_path = os.path.join(tmp, "sidecar_log.jsonl")
 
         def free_port() -> int:
@@ -468,6 +466,9 @@ def _store_smoke_inner() -> int:
 
     with tempfile.TemporaryDirectory(prefix="nemo_store_smoke_") as tmp:
         os.environ["NEMO_SVG_CACHE"] = os.path.join(tmp, "svg_cache")
+        # The warm-load parity legs exist to exercise the STORE's decode; a
+        # report-cache hit would restore the tree without touching it.
+        os.environ["NEMO_RESULT_CACHE"] = "off"
         cache = os.path.join(tmp, "corpus_cache")
         corpus = write_corpus(SynthSpec(n_runs=6, seed=3), tmp)
 
@@ -542,6 +543,126 @@ def _store_smoke_inner() -> int:
         return 0
 
 
+def delta_smoke() -> int:
+    """Result-cache + incremental-delta smoke (`make delta-smoke`, also the
+    tail of `make validate`): through real pipeline runs,
+
+      * a warm repeat request (same store fingerprints + figure policy +
+        ABI) must serve the FULL report from the result cache with ZERO
+        kernel dispatches (kernel.dispatches.* metrics delta) and a report
+        tree byte-identical to the cold run's;
+      * after growing the corpus directory, only the new runs may map
+        (delta.runs_mapped), the old segment's partial must merge from
+        cache (rcache.partial_hit), and the merged report must be
+        byte-identical to a from-scratch run of the grown corpus.
+    """
+    from nemo_tpu.utils.jax_config import pin_platform
+
+    pin_platform("cpu")
+    # Same escape-hatch policy as store_smoke: operator NEMO_STORE_* /
+    # NEMO_RESULT_CACHE* knobs must not red a healthy validate.
+    prior_knobs = {
+        k: os.environ.pop(k, None)
+        for k in (
+            "NEMO_STORE_VERIFY",
+            "NEMO_STORE_FINGERPRINT",
+            "NEMO_STORE_WORKERS",
+            "NEMO_RESULT_CACHE",
+            "NEMO_RESULT_CACHE_MAX_GB",
+        )
+    }
+    try:
+        return _delta_smoke_inner()
+    finally:
+        for k, v in prior_knobs.items():
+            if v is not None:
+                os.environ[k] = v
+
+
+def _delta_smoke_inner() -> int:
+    from nemo_tpu import obs
+    from nemo_tpu.analysis.delta import kernel_dispatch_count
+    from nemo_tpu.analysis.pipeline import run_debug
+    from nemo_tpu.backend.jax_backend import JaxBackend
+    from nemo_tpu.models.synth import SynthSpec, grow_corpus_dir, write_corpus
+
+    with tempfile.TemporaryDirectory(prefix="nemo_delta_smoke_") as tmp:
+        os.environ["NEMO_SVG_CACHE"] = os.path.join(tmp, "svg_cache")
+        cc = os.path.join(tmp, "corpus_cache")
+        rc = os.path.join(tmp, "result_cache")
+        # 8 runs cover all four run kinds; the corpus dir starts at 6 and
+        # GROWS to 8 (the incremental-sweep scenario).
+        full = write_corpus(SynthSpec(n_runs=8, seed=2, eot=6), os.path.join(tmp, "full"))
+        corpus = os.path.join(tmp, "grow", os.path.basename(full))
+        grow_corpus_dir(full, corpus, 6)
+
+        def run(label: str, corpus_cache: str = None, result_cache: str = None):
+            m0 = obs.metrics.snapshot()
+            res = run_debug(
+                corpus,
+                os.path.join(tmp, label),
+                JaxBackend(),
+                figures="all",
+                corpus_cache=corpus_cache or cc,
+                result_cache=result_cache or rc,
+            )
+            md = obs.Metrics.delta(obs.metrics.snapshot(), m0)["counters"]
+            return _tree(res.report_dir), md
+
+        problems: list[str] = []
+        t_cold, m_cold = run("cold")
+        if not m_cold.get("rcache.report_put"):
+            problems.append(f"cold run did not populate the report cache: {m_cold}")
+        t_warm, m_warm = run("warm")
+        disp = kernel_dispatch_count(m_warm)
+        if disp:
+            problems.append(f"warm repeat dispatched {disp} kernels (want 0)")
+        if not m_warm.get("rcache.report_hit"):
+            problems.append(f"warm repeat was not a report-cache hit: {m_warm}")
+        if t_warm != t_cold:
+            bad = sorted(k for k in t_cold if t_cold.get(k) != t_warm.get(k))
+            problems.append(
+                f"warm-hit report diverges from cold in {len(bad)} file(s): {bad[:5]}"
+            )
+
+        # Grow the directory by 2 runs (the incremental sweep) and re-run:
+        # only the new runs may map; the merged report must equal a
+        # from-scratch analysis of the grown corpus, byte for byte.
+        grow_corpus_dir(full, corpus, 8)
+        t_grown, m_grown = run("grown")
+        if m_grown.get("delta.runs_mapped") != 2 or m_grown.get("delta.runs_cached") != 6:
+            problems.append(
+                "grown run mapped "
+                f"{m_grown.get('delta.runs_mapped')} runs / served "
+                f"{m_grown.get('delta.runs_cached')} from cache (want 2/6)"
+            )
+        if not m_grown.get("rcache.partial_hit"):
+            problems.append(f"grown run did not merge a cached partial: {m_grown}")
+        t_scratch, _ = run("scratch", corpus_cache="off", result_cache="off")
+        if t_grown.keys() != t_scratch.keys():
+            problems.append(
+                "grown-delta file set diverges from from-scratch: "
+                f"{sorted(t_grown.keys() ^ t_scratch.keys())[:5]}"
+            )
+        else:
+            bad = sorted(k for k in t_scratch if t_scratch[k] != t_grown[k])
+            if bad:
+                problems.append(
+                    f"grown-delta report DIVERGES from from-scratch in "
+                    f"{len(bad)} file(s), e.g. {bad[:5]}"
+                )
+
+        if problems:
+            print("delta-smoke: " + "; ".join(problems), file=sys.stderr)
+            return 1
+        print(
+            "delta-smoke: ok — warm repeat served the full report from cache "
+            "with 0 kernel dispatches; the grown corpus mapped only its 2 new "
+            f"runs and merged byte-identical to from-scratch ({len(t_scratch)} files)"
+        )
+        return 0
+
+
 def main() -> int:
     from nemo_tpu.analysis.pipeline import run_debug
     from nemo_tpu.backend.jax_backend import JaxBackend
@@ -559,6 +680,11 @@ def main() -> int:
         # store_smoke.)
         os.environ["NEMO_SVG_CACHE"] = os.path.join(tmp, "svg_cache")
         os.environ["NEMO_CORPUS_CACHE"] = os.path.join(tmp, "corpus_cache")
+        # The result cache is OFF here: these steps assert that renders and
+        # kernel dispatches actually happen (SVG-warm stats, forced-route
+        # counters) — a report-cache hit would short-circuit them all.  The
+        # dedicated delta smoke covers the result cache.
+        os.environ["NEMO_RESULT_CACHE"] = "off"
         os.environ.pop("NEMO_RENDER_WORKERS", None)
         corpus = write_corpus(SynthSpec(n_runs=6, seed=3), tmp)
 
@@ -699,7 +825,13 @@ def main() -> int:
         return rc
     # Corpus-store contract (also standalone: make store-smoke): cold
     # populate, warm mmap load byte-parity, deliberate corruption rejected.
-    return store_smoke()
+    rc = store_smoke()
+    if rc:
+        return rc
+    # Result-cache + incremental-delta contract (also standalone: make
+    # delta-smoke): warm repeat = full-report hit with zero kernel
+    # dispatches; grown corpus maps only the new runs, byte-identical.
+    return delta_smoke()
 
 
 if __name__ == "__main__":
@@ -709,4 +841,6 @@ if __name__ == "__main__":
         sys.exit(obs_smoke())
     if "--store-smoke" in sys.argv:
         sys.exit(store_smoke())
+    if "--delta-smoke" in sys.argv:
+        sys.exit(delta_smoke())
     sys.exit(main())

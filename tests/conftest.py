@@ -18,6 +18,9 @@ os.environ.setdefault("NEMO_SVG_CACHE", "off")
 # ... nor the persistent corpus store (nemo_tpu/store): the store tests opt
 # back in per-test with explicit cache roots under tmp_path.
 os.environ.setdefault("NEMO_CORPUS_CACHE", "off")
+# ... nor the analysis result cache (nemo_tpu/store/rcache.py): the delta
+# tests opt back in per-test with explicit roots under tmp_path.
+os.environ.setdefault("NEMO_RESULT_CACHE", "off")
 
 _platform = os.environ.get("NEMO_TEST_PLATFORM", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")

@@ -1,0 +1,333 @@
+"""Result cache + segment-incremental delta analysis (ISSUE 6).
+
+Covers the two cache tiers (whole-report restore, per-segment partial
+merge), the zero-kernel-dispatch contract of a warm hit, byte parity of
+every served/merged report against a from-scratch run, the invalidation
+matrix (config change, ABI bump, fingerprint mismatch, corrupted entry —
+each falls back loudly to recompute, counted, never serving stale bytes),
+the reduce's order-insensitivity, and the sidecar's AnalyzeDir response
+cache.
+"""
+
+import json
+import os
+import shutil
+
+import pytest
+
+from nemo_tpu import obs
+from nemo_tpu.analysis import delta
+from nemo_tpu.analysis.delta import kernel_dispatch_count
+from nemo_tpu.analysis.pipeline import report_tree_bytes as _tree
+from nemo_tpu.analysis.pipeline import run_debug
+from nemo_tpu.backend.jax_backend import JaxBackend
+from nemo_tpu.models.synth import SynthSpec, grow_corpus_dir, write_corpus
+
+
+def _counters_delta(fn):
+    m0 = obs.metrics.snapshot()
+    out = fn()
+    return out, obs.Metrics.delta(obs.metrics.snapshot(), m0)["counters"]
+
+
+class _Caches:
+    """Per-test cache roots + a run_debug wrapper pinned to them."""
+
+    def __init__(self, tmp_path):
+        self.cc = str(tmp_path / "corpus_cache")
+        self.rc = str(tmp_path / "result_cache")
+        self.tmp = tmp_path
+
+    def run(self, corpus: str, label: str, **kw):
+        kw.setdefault("corpus_cache", self.cc)
+        kw.setdefault("result_cache", self.rc)
+        kw.setdefault("figures", "all")
+        return _counters_delta(
+            lambda: run_debug(
+                corpus, str(self.tmp / "results" / label), JaxBackend(), **kw
+            )
+        )
+
+
+@pytest.fixture()
+def caches(tmp_path):
+    return _Caches(tmp_path)
+
+
+def _growable_corpus(tmp_path, n_old: int, n_total: int):
+    """A corpus dir holding the first n_old runs, plus a grow() closure
+    (the shared incremental-sweep simulator, models/synth.grow_corpus_dir)."""
+    full = write_corpus(SynthSpec(n_runs=n_total, seed=2, eot=6), str(tmp_path / "full"))
+    corpus = str(tmp_path / "grow" / os.path.basename(full))
+    grow_corpus_dir(full, corpus, n_old)
+    return corpus, lambda: grow_corpus_dir(full, corpus, n_total)
+
+
+# ---------------------------------------------------------------- warm hit
+
+
+def test_warm_repeat_serves_report_with_zero_dispatches(corpus_dir, caches):
+    r1, m1 = caches.run(corpus_dir, "cold")
+    assert m1.get("rcache.report_put") == 1
+    assert m1.get("rcache.partial_put") == 1
+    assert kernel_dispatch_count(m1) > 0
+
+    r2, m2 = caches.run(corpus_dir, "warm")
+    assert m2.get("rcache.report_hit") == 1
+    assert kernel_dispatch_count(m2) == 0, m2
+    # No backend phases ran at all — only ingest + the cache restore.
+    assert set(r2.timings) == {"ingest", "report"}
+    assert _tree(r1.report_dir) == _tree(r2.report_dir)
+
+
+def test_reduce_only_path_when_report_evicted(corpus_dir, caches):
+    """All partials cached but the report entry gone (evicted): the run
+    reduces from cached partials WITHOUT initializing a backend — still
+    zero kernel dispatches — and reproduces the report byte-identically."""
+    r1, _ = caches.run(corpus_dir, "cold")
+    shutil.rmtree(os.path.join(caches.rc, "report"))
+    r2, m2 = caches.run(corpus_dir, "reduce_only")
+    assert m2.get("rcache.partial_hit") == 1
+    assert m2.get("delta.runs_mapped", 0) == 0
+    assert kernel_dispatch_count(m2) == 0, m2
+    assert "init" not in r2.timings
+    assert _tree(r1.report_dir) == _tree(r2.report_dir)
+    # ... and the report entry was re-published for the next request.
+    assert m2.get("rcache.report_put") == 1
+
+
+# ------------------------------------------------------------- grown delta
+
+
+def test_grown_corpus_maps_only_new_runs(tmp_path, caches):
+    corpus, grow = _growable_corpus(tmp_path, n_old=6, n_total=8)
+    caches.run(corpus, "cold")
+    grow()
+    r2, m2 = caches.run(corpus, "grown")
+    assert m2.get("store.append") == 1
+    assert m2.get("rcache.partial_hit") == 1
+    assert m2.get("delta.runs_mapped") == 2
+    assert m2.get("delta.runs_cached") == 6
+    assert m2.get("delta.segments_mapped") == 1
+    # From-scratch oracle over the grown dir, all caches off.
+    r3, _ = caches.run(corpus, "scratch", corpus_cache="off", result_cache="off")
+    assert _tree(r2.report_dir) == _tree(r3.report_dir)
+
+
+def test_grown_then_warm_is_again_a_full_hit(tmp_path, caches):
+    corpus, grow = _growable_corpus(tmp_path, n_old=6, n_total=8)
+    caches.run(corpus, "cold")
+    grow()
+    caches.run(corpus, "grown")
+    _, m3 = caches.run(corpus, "warm")
+    assert m3.get("rcache.report_hit") == 1
+    assert kernel_dispatch_count(m3) == 0
+
+
+# ------------------------------------------------------ invalidation matrix
+
+
+def test_config_change_misses_and_recomputes(corpus_dir, caches):
+    caches.run(corpus_dir, "cold", figures="all")
+    _, m2 = caches.run(corpus_dir, "failed_policy", figures="failed")
+    # Different figure policy -> different content address: a loud,
+    # counted miss and a real recompute, never the cached "all" bytes.
+    assert m2.get("rcache.report_hit") is None
+    assert m2.get("rcache.report_miss") == 1
+    assert kernel_dispatch_count(m2) > 0
+    # The original config still hits.
+    _, m3 = caches.run(corpus_dir, "all_again", figures="all")
+    assert m3.get("rcache.report_hit") == 1
+
+
+def test_abi_bump_invalidates(corpus_dir, caches, monkeypatch):
+    caches.run(corpus_dir, "cold")
+    monkeypatch.setattr(delta, "ANALYSIS_ABI_VERSION", delta.ANALYSIS_ABI_VERSION + 1)
+    r2, m2 = caches.run(corpus_dir, "bumped")
+    assert m2.get("rcache.report_hit") is None
+    assert m2.get("rcache.report_miss") == 1
+    assert m2.get("rcache.partial_hit") is None
+    assert kernel_dispatch_count(m2) > 0
+
+
+def test_segment_fingerprint_mismatch_invalidates(corpus_dir, caches, tmp_path):
+    """An in-place mutation of a run's provenance file makes the store
+    stale (re-parse + repopulate with a NEW segment fingerprint), so the
+    old result-cache entries can never serve — counted as misses."""
+    corpus = str(tmp_path / "mut" / os.path.basename(corpus_dir))
+    shutil.copytree(corpus_dir, corpus)
+    caches.run(corpus, "cold")
+    target = os.path.join(corpus, "run_1_post_provenance.json")
+    doc = json.load(open(target))
+    with open(target, "w") as fh:
+        json.dump(doc, fh, indent=2)  # same content, different bytes/size
+    _, m2 = caches.run(corpus, "mutated")
+    assert m2.get("store.stale") == 1  # store fell back loudly...
+    assert m2.get("store.populate") == 1  # ...and repopulated
+    assert m2.get("rcache.report_hit") is None  # old entry never served
+    assert m2.get("rcache.report_miss") == 1
+    assert kernel_dispatch_count(m2) > 0
+
+
+def test_corrupted_cache_entry_recomputes(corpus_dir, caches):
+    caches.run(corpus_dir, "cold")
+
+    # Flip a byte inside every cached payload (report tree AND partial
+    # figures): the sha256 manifest verify must fail each entry (counted
+    # stale), and the run must fall back to a REAL recompute — kernels
+    # dispatched, bytes still correct.
+    def corrupt(kind: str, rel: str) -> None:
+        root = os.path.join(caches.rc, kind)
+        victim = os.path.join(root, os.listdir(root)[0], rel)
+        with open(victim, "r+b") as fh:
+            fh.seek(10)
+            b = fh.read(1)
+            fh.seek(-1, os.SEEK_CUR)
+            fh.write(bytes([b[0] ^ 0xFF]))
+
+    corrupt("report", os.path.join("tree", "debugging.json"))
+    part = os.path.join(caches.rc, "partial")
+    figs = os.path.join(part, os.listdir(part)[0], "figures")
+    corrupt("partial", os.path.join("figures", sorted(os.listdir(figs))[0]))
+
+    r2, m2 = caches.run(corpus_dir, "after_corrupt")
+    assert m2.get("rcache.report_stale") == 1
+    assert m2.get("rcache.partial_stale") == 1
+    assert m2.get("rcache.report_hit") is None
+    assert m2.get("rcache.partial_hit") is None
+    assert kernel_dispatch_count(m2) > 0
+    # Byte-correct against a from-scratch oracle (NOT the cold run's tree:
+    # cache entries HARDLINK report files, so the corruption above also
+    # mutated the cold report's copy — exactly the mutation the manifest
+    # verify exists to catch).
+    r3, _ = caches.run(corpus_dir, "oracle", corpus_cache="off", result_cache="off")
+    assert _tree(r3.report_dir) == _tree(r2.report_dir)
+
+
+def test_sample_policy_disables_partial_caching(corpus_dir, caches):
+    """sample:N selection depends on the whole corpus's run list, so
+    per-segment partials don't decompose — only the report tier caches."""
+    _, m1 = caches.run(corpus_dir, "cold", figures="sample:2")
+    assert m1.get("rcache.partial_put") is None
+    assert m1.get("rcache.report_put") == 1
+    _, m2 = caches.run(corpus_dir, "warm", figures="sample:2")
+    assert m2.get("rcache.report_hit") == 1
+    assert kernel_dispatch_count(m2) == 0
+
+
+def test_no_store_segments_means_no_cache(corpus_dir, caches):
+    """Without the corpus store nothing fingerprints the content: a hit is
+    impossible, and the pipeline must not publish unkeyed entries."""
+    _, m1 = caches.run(corpus_dir, "cold", corpus_cache="off")
+    assert m1.get("rcache.report_put") is None
+    assert m1.get("rcache.partial_put") is None
+    assert kernel_dispatch_count(m1) > 0
+    _, m2 = caches.run(corpus_dir, "again", corpus_cache="off")
+    assert kernel_dispatch_count(m2) > 0
+
+
+# ------------------------------------------------------------------ reduce
+
+
+def test_reduce_is_order_insensitive():
+    from nemo_tpu.ingest.molly import MollyOutput
+    from nemo_tpu.ingest.datatypes import RunData
+
+    molly = MollyOutput(run_name="m", output_dir="")
+    for i, ok in enumerate([True, False, True, False]):
+        r = RunData(iteration=i, status="success" if ok else "fail")
+        molly.runs.append(r)
+        molly.runs_iters.append(i)
+        (molly.success_runs_iters if ok else molly.failed_runs_iters).append(i)
+
+    p0 = delta.SegmentPartial(
+        iters=[0, 1],
+        success_iters=[0],
+        failed_iters=[1],
+        proto_ordered={0: ["a", "b", "c"]},
+        present={1: ["a"]},
+        missing={1: [{"rule": {"id": "r"}, "goals": []}]},
+        achieved={0: 1, 1: 1},
+        corrections=["fix-x"],
+        extensions=["ext-y"],
+    )
+    p1 = delta.SegmentPartial(
+        iters=[2, 3],
+        success_iters=[2],
+        failed_iters=[3],
+        proto_ordered={2: ["b", "a"]},
+        present={3: ["b"]},
+        missing={3: []},
+        achieved={2: 1, 3: 0},
+        corrections=["fix-x"],
+        extensions=["ext-y"],
+    )
+
+    def norm(red):
+        return (
+            red.inter,
+            red.union,
+            red.inter_miss,
+            red.union_miss,
+            {k: [m.to_json() for m in v] for k, v in red.missing.items()},
+            red.corrections,
+            red.extensions,
+            red.all_achieved,
+        )
+
+    fwd = norm(delta.reduce_partials([p0, p1], molly, good_iter=0))
+    rev = norm(delta.reduce_partials([p1, p0], molly, good_iter=0))
+    assert fwd == rev
+    inter, union = fwd[0], fwd[1]
+    # {a,b,c} ∩ {b,a} in the FIRST achieving run's order — the global run
+    # order imposed by the reduce, not the partial arrival order.
+    assert inter == ["<code>a</code>", "<code>b</code>"]
+    assert set(union) == {"<code>a</code>", "<code>b</code>", "<code>c</code>"}
+    # Round-trip through JSON (the cached-partial path) changes nothing.
+    r0 = delta.SegmentPartial.from_json(p0.to_json())
+    r1 = delta.SegmentPartial.from_json(p1.to_json())
+    assert norm(delta.reduce_partials([r0, r1], molly, good_iter=0)) == fwd
+
+
+def test_kernel_dispatch_count_sums_prefix():
+    counters = {
+        "kernel.dispatches.fused": 2,
+        "kernel.dispatches.sparse_fused": 3,
+        "kernel.dispatches.diff": 1,
+        "kernel.dispatches.sparse_diff": 1,
+        "kernel.upload_bytes": 999,
+        "rcache.report_hit": 1,
+    }
+    assert kernel_dispatch_count(counters) == 7
+
+
+# ----------------------------------------------------------------- service
+
+
+def test_analyze_dir_response_cache(sidecar, tmp_path, monkeypatch):
+    np = pytest.importorskip("numpy")
+    from nemo_tpu.service.client import RemoteAnalyzer
+
+    monkeypatch.setenv("NEMO_CORPUS_CACHE", str(tmp_path / "cc"))
+    monkeypatch.setenv("NEMO_RESULT_CACHE", str(tmp_path / "rc"))
+    d = write_corpus(SynthSpec(n_runs=6, seed=3), str(tmp_path))
+    with RemoteAnalyzer(target=sidecar) as cl:
+        cl.wait_ready()
+        (out1, m1) = _counters_delta(lambda: cl.analyze_dir_remote(d))
+        (out2, m2) = _counters_delta(lambda: cl.analyze_dir_remote(d))
+        (out3, m3) = _counters_delta(
+            lambda: cl.analyze_dir_remote(d, result_cache="off")
+        )
+    assert m1.get("rcache.blob_analyze_dir_put") == 1
+    assert m1.get("rpc.analyze_dir_rcache.miss") == 1
+    # Warm repeat: the stored response bytes, zero device dispatches,
+    # flagged hit all the way to the client counters.
+    assert m2.get("serve.analyze_dir_cached") == 1
+    assert m2.get("rpc.analyze_dir_rcache.hit") == 1
+    assert not m2.get("serve.analyze_chunks")
+    # Client opt-out is honored (and only opts OUT).
+    assert m3.get("rpc.analyze_dir_rcache.off") == 1
+    assert not m3.get("serve.analyze_dir_cached")
+    for k in out1:
+        assert np.array_equal(np.asarray(out1[k]), np.asarray(out2[k])), k
+        assert np.array_equal(np.asarray(out1[k]), np.asarray(out3[k])), k

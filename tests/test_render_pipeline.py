@@ -206,12 +206,50 @@ def test_run_debug_dirs_rejects_save_corpus_path(tmp_path):
         )
 
 
-def test_run_debug_dirs_rejects_duplicate_basenames(tmp_path):
-    """Two corpus dirs with one basename would silently overwrite one
-    report (and cross-wire pending figures in the shared scheduler)."""
-    a = tmp_path / "x" / "corpus"
-    b = tmp_path / "y" / "corpus"
-    a.mkdir(parents=True)
-    b.mkdir(parents=True)
-    with pytest.raises(ValueError, match="basename"):
-        run_debug_dirs([str(a), str(b)], str(tmp_path / "r"), JaxBackend)
+def test_run_debug_dirs_disambiguates_duplicate_basenames(tmp_path):
+    """Two corpus dirs sharing a basename get collision-free per-corpus
+    report subdirs (basename-<realpath hash>) instead of the later run
+    silently deleting the earlier report; both reports materialize, and
+    the names are stable across invocations."""
+    import shutil
+
+    from nemo_tpu.analysis.pipeline import corpus_report_names
+    from nemo_tpu.models.synth import SynthSpec, write_corpus
+
+    # write_corpus names the corpus dir after the spec, so the same spec
+    # name under two parents IS the duplicate-basename scenario.
+    a = write_corpus(SynthSpec(n_runs=3, seed=2, eot=5), str(tmp_path / "x"))
+    b = write_corpus(SynthSpec(n_runs=3, seed=3, eot=5), str(tmp_path / "y"))
+    base = os.path.basename(a)
+    assert os.path.basename(b) == base
+
+    names = corpus_report_names([str(a), str(b)])
+    assert len(set(names)) == 2
+    assert all(n.startswith(f"{base}-") for n in names)
+    assert names == corpus_report_names([str(a), str(b)])  # stable
+
+    results = run_debug_dirs(
+        [str(a), str(b)], str(tmp_path / "r"), JaxBackend, figures="none"
+    )
+    assert [os.path.basename(r.report_dir) for r in results] == names
+    for r in results:
+        assert os.path.exists(os.path.join(r.report_dir, "debugging.json"))
+    # Distinct corpora produced distinct reports (seed 2 vs 3).
+    with open(os.path.join(results[0].report_dir, "debugging.json")) as fh:
+        ja = fh.read()
+    with open(os.path.join(results[1].report_dir, "debugging.json")) as fh:
+        jb = fh.read()
+    assert ja != jb
+
+    # The SAME directory twice is still rejected: identical realpaths
+    # hash identically, so nothing can disambiguate the two analyses
+    # racing one report tree.  A symlink alias hits the same guard.
+    with pytest.raises(ValueError, match="same"):
+        corpus_report_names([str(a), str(a)])
+    link = tmp_path / "y" / "corpus2"
+    os.symlink(b, link)
+    link2 = tmp_path / "x" / "corpus2"
+    os.symlink(b, link2)
+    with pytest.raises(ValueError, match="same"):
+        corpus_report_names([str(link), str(link2)])
+    shutil.rmtree(str(tmp_path / "r"))

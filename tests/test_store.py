@@ -312,6 +312,57 @@ def test_append_then_load_equals_repack(tmp_path):
     assert again is not None and mc2.get("store.hit") == 1 and "store.append" not in mc2
 
 
+def test_fast_append_partial_fingerprint_semantics(tmp_path, monkeypatch):
+    """Fast-mode appends snapshot only names + new-run/sample stats
+    (npack.snapshot_source_appended — O(growth) stats, not O(corpus)): the
+    published source still classifies HIT in fast mode, still fingerprints
+    the new segment's run files, and still catches a sampled-file
+    mutation; the exhaustive old_fp/other_fp are absent, so switching to
+    NEMO_STORE_FINGERPRINT=full afterwards classifies STALE (loud
+    repopulate — the conservative direction, never stale bytes)."""
+    import json as _json
+
+    grow, _, grow_to_full = _grow_corpus(tmp_path, n_old=5, n_total=8)
+    store = CorpusStore(str(tmp_path / "cache"))
+    assert store.put(grow, load_molly_output(grow))
+    grow_to_full()
+    warm, mc = _store_delta(lambda: store.load_packed(grow))
+    assert warm is not None and mc.get("store.append") == 1
+    header = store._read_header(store.store_dir(grow))
+    src = header["source"]
+    assert "old_fp" not in src and "other_fp" not in src
+    assert src["old_names_fp"] and src["sample"]
+    # The appended segment's source files are fingerprinted (the result
+    # cache keys per-segment partials on this).
+    assert header["segments"][-1]["source_fp"]
+    assert store.probe(grow) == "hit"
+    # Stricter mode finds no exhaustive fingerprint to trust -> stale.
+    monkeypatch.setenv("NEMO_STORE_FINGERPRINT", "full")
+    assert store.probe(grow) == "stale"
+    monkeypatch.delenv("NEMO_STORE_FINGERPRINT")
+    assert store.probe(grow) == "hit"
+    # A mutated SAMPLED file still flags: every sample entry carries real
+    # (size, mtime) captured pre-parse.
+    name, _size, _mtime = src["sample"][0]
+    with open(os.path.join(grow, name), "ab") as fh:
+        fh.write(b" ")
+    assert store.probe(grow) == "stale"
+    # A full-mode append (populate in full mode, grow, append) keeps the
+    # exhaustive fingerprints, so full-mode loads keep working.
+    monkeypatch.setenv("NEMO_STORE_FINGERPRINT", "full")
+    grow2, _, grow_to_full2 = _grow_corpus(
+        tmp_path / "full_mode", n_old=5, n_total=8
+    )
+    store2 = CorpusStore(str(tmp_path / "cache2"))
+    assert store2.put(grow2, load_molly_output(grow2))
+    grow_to_full2()
+    warm2, mc2 = _store_delta(lambda: store2.load_packed(grow2))
+    assert warm2 is not None and mc2.get("store.append") == 1
+    src2 = store2._read_header(store2.store_dir(grow2))["source"]
+    assert src2.get("old_fp") and src2.get("other_fp")
+    assert store2.probe(grow2) == "hit"
+
+
 def test_append_report_byte_parity(tmp_path):
     """End-to-end: a pipeline run over the grown directory served by the
     appended store is byte-identical to a store-off run."""

@@ -48,8 +48,10 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 #: so timer noise on sub-second phases can't page anyone.
 ABS_FLOORS = {
     "s": 0.5,  # seconds-scale walls
+    "s_fast": 0.1,  # sub-second hot-path walls (warm cache hits)
     "ms": 0.05,  # millisecond latencies
     "mb": 64.0,  # RSS megabytes
+    "mb_cache": 8.0,  # cache-entry sizes (a bench result cache is small)
     "ratio": 0.0,  # unitless rates/ratios: relative threshold only
 }
 
@@ -96,6 +98,19 @@ def extract_metrics(doc: dict) -> dict[str, tuple[float, str, str]]:
             "lower",
             "ratio",
         )
+    # Result-cache delta tier (ISSUE 6): the warm-hit wall creeping up, the
+    # grown-delta wall approaching the from-scratch wall, the cold/warm
+    # speedup collapsing, or the cache entries bloating on disk all flag.
+    # Sub-second-scale walls get the "s_fast" floor (0.1 s): the whole
+    # POINT of the warm hit is being far under the "s" 0.5 s noise floor,
+    # so the seconds-scale floor would mask a 10x regression of it.
+    dtier = doc.get("delta_tier") or {}
+    put("delta_tier.cold_s", dtier.get("cold_s"), "lower", "s")
+    put("delta_tier.warm_hit_s", dtier.get("warm_hit_s"), "lower", "s_fast")
+    put("delta_tier.grown_s", dtier.get("grown_s"), "lower", "s_fast")
+    put("delta_tier.delta_speedup", dtier.get("delta_speedup"), "higher", "ratio")
+    put("delta_tier.grown_fraction", dtier.get("grown_fraction"), "lower", "ratio")
+    put("delta_tier.cache_mb", dtier.get("cache_mb"), "lower", "mb_cache")
     figures = doc.get("figures") or {}
     put(
         "figures.e2e_warm_all_figures_s",
