@@ -5,7 +5,7 @@ NATIVE_LIB := native/build/libnemo_native.so
 REPORT_SRC := native/nemo_report.cpp
 REPORT_LIB := native/build/libnemo_report.so
 
-.PHONY: all native test bench bench-watch bench-trend prewarm validate trace-smoke obs-smoke store-smoke delta-smoke lint-print clean reset proto neo4j-up neo4j-validate neo4j-down
+.PHONY: all native test bench bench-watch bench-trend prewarm validate trace-smoke obs-smoke store-smoke delta-smoke shard-smoke lint-print clean reset proto neo4j-up neo4j-validate neo4j-down
 
 all: native
 
@@ -29,7 +29,16 @@ test:
 validate: lint-print test
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 		python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+	$(MAKE) shard-smoke
 	python -m nemo_tpu.utils.validate_smoke
+
+# Mesh-sharding + scheduler smoke (also a `make validate` step; ISSUE 7):
+# on an 8-virtual-CPU-device mesh the sharded + scheduler-drained fused
+# path must report byte-identical to the single-device oracle, with
+# dispatches landing on >1 device and analysis.sched.* series recorded.
+shard-smoke:
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+		python -m nemo_tpu.utils.validate_smoke --shard-smoke
 
 # Observability smoke (also the tail of `make validate`): a traced
 # two-family pipeline run + one sidecar RPC, whose emitted Chrome-trace

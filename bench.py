@@ -458,6 +458,40 @@ def child_main() -> None:
     except Exception as ex:  # the delta tier must never sink the bench
         log(f"delta tier skipped: {type(ex).__name__}: {ex}")
 
+    # Shard tier (ISSUE 7): the mesh-sharded fused analysis at 1/2/4/8
+    # virtual CPU devices over the same big corpus (NEMO_SHARD_DEVICES caps
+    # one 8-virtual-device process — mesh width is the only variable), plus
+    # one heterogeneous-scheduler pass (dispatch/steal counts).  Runs in a
+    # SUBPROCESS because the virtual device count is fixed at interpreter
+    # start; this child's own platform (possibly a TPU tunnel) is useless
+    # for it.  bench_watch runs the same child on the real device mesh for
+    # the MULTICHIP capture.
+    shard_tier = None
+    try:
+        env = dict(os.environ)
+        xf = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in xf:
+            env["XLA_FLAGS"] = (
+                xf + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        env["NEMO_BENCH_SHARD_PLATFORM"] = "cpu"
+        env["NEMO_BENCH_SHARD_DIRS"] = os.pathsep.join(d for _, d in big_dirs)
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--shard-child"],
+            stdout=subprocess.PIPE,
+            text=True,
+            timeout=float(os.environ.get("NEMO_BENCH_SHARD_TIMEOUT", "1800")),
+            env=env,
+        )
+        lines = (proc.stdout or "").strip().splitlines()
+        if proc.returncode == 0 and lines:
+            shard_tier = json.loads(lines[-1])
+            log(f"shard tier (mesh scaling + scheduler): {json.dumps(shard_tier)}")
+        else:
+            log(f"shard tier child failed (rc={proc.returncode})")
+    except Exception as ex:  # the shard tier must never sink the bench
+        log(f"shard tier skipped: {type(ex).__name__}: {ex}")
+
     # Warm up (one compile per family's shape signature), then time the full
     # sweep end to end.  Every timed dispatch gets DISTINCT input bytes (a
     # poke in a masked padding slot — results unchanged): the device tunnel
@@ -1172,6 +1206,7 @@ def child_main() -> None:
         "analysis_tier": analysis_tier,
         "ingest_tier": ingest_tier,
         "delta_tier": delta_tier,
+        "shard_tier": shard_tier,
         "stress_10x": stress_10x,
         # Whole-process obs registry at bench end: the scattered per-layer
         # counters (kernel dispatch/compile split, upload bytes, render
@@ -1199,6 +1234,161 @@ def child_main() -> None:
     if note:
         result["note"] = note
     print(json.dumps(result))
+
+
+def shard_child_main() -> None:
+    """The shard tier's measurement process (`bench.py --shard-child`).
+
+    Measures the ANALYSIS phase (the _fused drain — pack + routed
+    dispatches) of the production JaxBackend over the corpus dirs in
+    NEMO_BENCH_SHARD_DIRS (pathsep-joined; synthesizes its own 6-family
+    corpus when unset, for standalone / bench_watch use) at each mesh width
+    in NEMO_BENCH_SHARD_DEVICES (default 1,2,4,8, clipped to the visible
+    device count), dense route pinned so the device lane executes and the
+    mesh width is the ONLY variable.  Per width: one cold pass (compiles)
+    then one timed warm pass.  A final pass at the widest mesh turns the
+    heterogeneous scheduler on (auto route) and records its dispatch/steal
+    counters.  Prints one JSON line on stdout."""
+    platform = os.environ.get("NEMO_BENCH_SHARD_PLATFORM", "cpu")
+    if platform not in ("tpu", "axon", "auto", "device", ""):
+        from nemo_tpu.utils.jax_config import pin_platform
+
+        pin_platform(platform)
+    import shutil
+
+    import jax
+
+    from nemo_tpu import obs
+    from nemo_tpu.backend.jax_backend import JaxBackend
+    from nemo_tpu.ingest.molly import load_molly_output as _lmo
+    from nemo_tpu.ingest.native import (
+        load_molly_output_packed as _lmop,
+        native_available as _nat_avail,
+    )
+
+    n_avail = len(jax.devices())
+    want = [
+        int(x)
+        for x in os.environ.get("NEMO_BENCH_SHARD_DEVICES", "1,2,4,8").split(",")
+    ]
+    tiers = sorted({n for n in want if 1 <= n <= n_avail})
+    if not tiers or tiers == [1]:
+        print(json.dumps({"error": f"only {n_avail} device(s) visible"}))
+        return
+    log(f"shard child: {jax.devices()[0].platform} x{n_avail}, widths {tiers}")
+
+    dirs = [
+        d for d in os.environ.get("NEMO_BENCH_SHARD_DIRS", "").split(os.pathsep) if d
+    ]
+    tmp = None
+    if not dirs:
+        from nemo_tpu.models.case_studies import CASE_STUDIES, write_case_study
+
+        n_total = int(os.environ.get("NEMO_BENCH_SHARD_RUNS", "10200"))
+        families = sorted(CASE_STUDIES)
+        per_family = (n_total + len(families) - 1) // len(families)
+        tmp = tempfile.mkdtemp(prefix="nemo_shard_bench_")
+        import atexit
+
+        atexit.register(shutil.rmtree, tmp, ignore_errors=True)
+        dirs = [
+            write_case_study(fam, per_family, seed=1, out_dir=os.path.join(tmp, fam))
+            for fam in families
+        ]
+    mollys = [(_lmop(d) if _nat_avail() else _lmo(d)) for d in dirs]
+    total_runs = sum(len(m.runs) for m in mollys)
+
+    def analysis_pass() -> float:
+        t0 = time.perf_counter()
+        for molly in mollys:
+            be = JaxBackend()
+            be.init_graph_db("", molly)
+            be.load_raw_provenance()
+            be.close_db()
+        return time.perf_counter() - t0
+
+    def hist_sum(snap: dict, name: str) -> float:
+        return float((snap["histograms"].get(name) or {}).get("sum", 0.0))
+
+    os.environ["NEMO_ANALYSIS_IMPL"] = "dense"
+    os.environ["NEMO_SCHED"] = "off"
+    os.environ["NEMO_SHARD"] = "auto"
+    out = {
+        "platform": jax.devices()[0].platform,
+        "devices_visible": n_avail,
+        "runs": total_runs,
+        "widths": {},
+    }
+    for n in tiers:
+        os.environ["NEMO_SHARD_DEVICES"] = str(n)
+        cold_s = analysis_pass()
+        m0 = obs.metrics.snapshot()
+        warm_s = analysis_pass()
+        m1 = obs.metrics.snapshot()
+        mc = obs.Metrics.delta(m1, m0)["counters"]
+        out["widths"][str(n)] = {
+            "analysis_s": round(warm_s, 3),
+            "cold_s": round(cold_s, 3),
+            "sharded_dispatches": int(mc.get("kernel.sharded_dispatches", 0)),
+            "gather_s": round(
+                hist_sum(m1, "analysis.shard.gather_s")
+                - hist_sum(m0, "analysis.shard.gather_s"),
+                3,
+            ),
+        }
+        log(f"shard width {n}: {json.dumps(out['widths'][str(n)])}")
+    w1 = out["widths"][str(tiers[0])]["analysis_s"]
+    for n in tiers:
+        row = out["widths"][str(n)]
+        row["speedup"] = round(w1 / row["analysis_s"], 2) if row["analysis_s"] else None
+        row["scaling_efficiency"] = (
+            round(row["speedup"] / n, 3) if row["speedup"] else None
+        )
+    widest = tiers[-1]
+    out["speedup_widest"] = out["widths"][str(widest)]["speedup"]
+    out["scaling_efficiency_widest"] = out["widths"][str(widest)]["scaling_efficiency"]
+
+    # Heterogeneous scheduler passes at the widest mesh, dispatch/steal
+    # counts recorded for the trend sentinel.  TWO rows because plain auto
+    # on a CPU child resolves to the platform pin (every job pinned host,
+    # inline-serial — the PRODUCTION routing, and the headline number),
+    # while "crossover" drops the pin so the cost model plans per bucket
+    # and BOTH lanes + work stealing actually execute — the row whose
+    # steal fraction the sentinel can watch.
+    os.environ["NEMO_ANALYSIS_IMPL"] = "auto"
+    os.environ["NEMO_SCHED"] = "on"
+    os.environ["NEMO_SHARD_DEVICES"] = str(widest)
+    m0 = obs.metrics.snapshot()
+    sched_s = analysis_pass()
+    mc = obs.Metrics.delta(obs.metrics.snapshot(), m0)["counters"]
+    os.environ["NEMO_ANALYSIS_IMPL"] = "crossover"
+    m0x = obs.metrics.snapshot()
+    sched_x_s = analysis_pass()
+    mcx = obs.Metrics.delta(obs.metrics.snapshot(), m0x)["counters"]
+    out["sched_crossover"] = {
+        "analysis_s": round(sched_x_s, 3),
+        "jobs": int(mcx.get("analysis.sched.jobs", 0)),
+        "dispatch_device": int(mcx.get("analysis.sched.dispatch.device", 0)),
+        "dispatch_host": int(mcx.get("analysis.sched.dispatch.host", 0)),
+        "steal_device": int(mcx.get("analysis.sched.steal.device", 0)),
+        "steal_host": int(mcx.get("analysis.sched.steal.host", 0)),
+    }
+    log(f"shard sched crossover pass: {json.dumps(out['sched_crossover'])}")
+    out["sched"] = {
+        "analysis_s": round(sched_s, 3),
+        "jobs": int(mc.get("analysis.sched.jobs", 0)),
+        "dispatch_device": int(mc.get("analysis.sched.dispatch.device", 0)),
+        "dispatch_host": int(mc.get("analysis.sched.dispatch.host", 0)),
+        "steal_device": int(mc.get("analysis.sched.steal.device", 0)),
+        "steal_host": int(mc.get("analysis.sched.steal.host", 0)),
+        "routes": {
+            k[len("analysis.route."):]: int(v)
+            for k, v in sorted(mc.items())
+            if k.startswith("analysis.route.")
+        },
+    }
+    log(f"shard sched pass: {json.dumps(out['sched'])}")
+    print(json.dumps(out))
 
 
 def closure_microbench(family_batch) -> dict:
@@ -1271,7 +1461,9 @@ def closure_microbench(family_batch) -> dict:
 
 
 if __name__ == "__main__":
-    if "--child" in sys.argv:
+    if "--shard-child" in sys.argv:
+        shard_child_main()
+    elif "--child" in sys.argv:
         child_main()
     else:
         try:

@@ -100,6 +100,17 @@ def _write_telemetry(report_dir: str, timings: dict, figure_stats: dict | None) 
             doc["memory"] = jb.sample_memory_watermarks()
         except Exception:
             pass
+    # Scheduler decision table (ISSUE 7): one record per scheduled bucket —
+    # lane, reason, stolen, predicted-vs-measured walls — same sys.modules
+    # gate as the cost table (an oracle run must not drag the scheduler in).
+    sch = sys.modules.get("nemo_tpu.parallel.sched")
+    if sch is not None:
+        try:
+            table = sch.sched_snapshot()
+            if table:
+                doc["sched"] = table
+        except Exception:
+            pass
     try:
         with open(os.path.join(report_dir, "telemetry.json"), "w", encoding="utf-8") as fh:
             json.dump(doc, fh, indent=1)

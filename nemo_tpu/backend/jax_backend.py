@@ -245,12 +245,28 @@ def _cost_signature(verb: str, arrays: dict, params: dict) -> tuple:
 
 
 def _record_kernel_cost(
-    verb: str, sig: tuple, fn, args, statics, wall_s: float, compiled: bool
+    verb: str,
+    sig: tuple,
+    fn,
+    args,
+    statics,
+    wall_s: float,
+    compiled: bool,
+    rows_frac: float = 1.0,
+    pad_rows: int = 0,
 ) -> None:
     """First sight of a signature: capture cost estimates + the dispatch
     wall (the compile wall, when the jit cache says this dispatch
     compiled); later sights: bump the dispatch count and flow the
-    signature's per-execution estimates into the cumulative counters."""
+    signature's per-execution estimates into the cumulative counters.
+
+    ``rows_frac`` is real rows / dispatched rows for the run-axis-batched
+    verbs: the XLA estimates price the PADDED program (padding is what the
+    compiler sees), but the cumulative flops/bytes counters — and the cost
+    model the scheduler routes by — must count only real work, or the
+    shard-multiple padding would inflate the very estimates that decide
+    routing (ISSUE 7 satellite).  ``pad_rows`` is recorded on the signature
+    so telemetry shows how much of each program is padding."""
     rec = _KERNEL_COSTS.get(sig)
     if rec is None:
         # Same bounded-growth contract as the metrics registry's series
@@ -290,6 +306,7 @@ def _record_kernel_cost(
             "first_dispatch_s": wall_s,
             "compiled": bool(compiled),
             "dispatches": 0,
+            "pad_rows": int(pad_rows),
         }
         if compiled:
             obs.metrics.observe("kernel.compile_s", wall_s)
@@ -299,12 +316,55 @@ def _record_kernel_cost(
         if rec["bytes_accessed"] is not None:
             obs.metrics.gauge(f"kernel.cost.bytes.{verb}", rec["bytes_accessed"])
     rec["dispatches"] += 1
+    rec["pad_rows"] = int(pad_rows)
     # Cumulative estimated work actually dispatched (per-execution cost x
-    # executions) — the numerator of any throughput/roofline readout.
+    # executions), padding rows excluded via rows_frac — the numerator of
+    # any throughput/roofline readout must count real work only.
     if rec["flops"] is not None:
-        obs.metrics.inc("kernel.cost.flops", rec["flops"])
+        obs.metrics.inc("kernel.cost.flops", rec["flops"] * rows_frac)
     if rec["bytes_accessed"] is not None:
-        obs.metrics.inc("kernel.cost.bytes_accessed", rec["bytes_accessed"])
+        obs.metrics.inc("kernel.cost.bytes_accessed", rec["bytes_accessed"] * rows_frac)
+
+
+#: Outputs reduced over the run axis (no rows to un-pad after a sharded
+#: dispatch) — mirror of parallel/mesh.py:run_step_sharded's corpus_level.
+_CORPUS_LEVEL_OUTPUTS = frozenset({"proto_inter", "proto_union"})
+
+#: (verb, v, e) -> latest cost-table record of that shape class: the
+#: scheduler's device-lane hint reads this to price a bucket the session
+#: has costed (FLOPs from the XLA estimate) but not yet measured.
+_COST_BY_CLASS: dict[tuple[str, int, int], dict] = {}
+
+
+def _index_cost_class(verb: str, arrays: dict, params: dict) -> None:
+    """File the signature's cost record under its (verb, V, E) shape class
+    so the scheduler can look a bucket's cost up without reconstructing
+    dispatch signatures.  Best effort, like all cost accounting."""
+    try:
+        sig = _cost_signature(verb, arrays, params)
+        rec = _KERNEL_COSTS.get(sig)
+        if rec is None or "v" not in params:
+            return
+        e = int(np.shape(arrays["pre_edge_src"])[1]) if verb in ("fused", "giant") else 0
+        _COST_BY_CLASS[(verb, int(params["v"]), e)] = rec
+    except Exception:
+        pass
+
+
+def sched_device_hint(job) -> float | None:
+    """Device-lane cost hint for the heterogeneous scheduler
+    (parallel/sched.py): the PR-4 cost table's FLOPs estimate for the job's
+    shape class, priced at NEMO_SCHED_FLOPS_PER_S (default 5e9 — a host-CPU
+    XLA ballpark; on a real accelerator the measured-wall EWMA takes over
+    after one bucket anyway).  None when the class was never costed."""
+    rec = _COST_BY_CLASS.get((job.verb, job.v, job.e))
+    if rec is None or rec.get("flops") is None:
+        return None
+    try:
+        rate = float(os.environ.get("NEMO_SCHED_FLOPS_PER_S", "5e9"))
+    except ValueError:
+        rate = 5e9
+    return float(rec["flops"]) / max(rate, 1.0)
 
 
 def kernel_cost_snapshot() -> list[dict]:
@@ -459,10 +519,18 @@ class LocalExecutor:
     #: host labels runs the exact — if expensive — closure labeling).
     OPTIONAL_ARRAYS = frozenset({"pre_comp_labels", "post_comp_labels"})
 
-    def run(self, verb: str, arrays: dict, params: dict) -> dict[str, np.ndarray]:
+    def run(
+        self, verb: str, arrays: dict, params: dict, rows: int | None = None
+    ) -> dict[str, np.ndarray]:
         """Returns a dict of array-likes: numpy for summary outputs, jax
         device arrays for the ON_DEVICE bulk outputs (consumers slice rows
-        and np.asarray what they touch)."""
+        and np.asarray what they touch).
+
+        ``rows`` is the caller's real-run count for the batched verbs (the
+        batch arrays carry power-of-two padding rows); when given, the
+        batch-width metrics and the cost accounting count only real rows
+        (ISSUE 7 satellite) — absent (older remote clients), the dispatched
+        width stands in, exactly the pre-sharding behavior."""
         if verb not in self.VERBS:
             raise ValueError(f"unknown kernel verb {verb!r}")
         fn, array_names, param_names, out_names = self.VERBS[verb]
@@ -470,9 +538,10 @@ class LocalExecutor:
             params = dict(params, pack_out=_pack_out_default())
         # Host->device transfer volume of this dispatch, as the bytes the
         # inputs occupy on entry (post-narrowing: _narrow_fused_arrays has
-        # already run by here) — the single home for the "upload bytes"
-        # number bench.py used to re-derive arithmetically.  .nbytes via
-        # getattr, NEVER np.asarray: an input that is already a device
+        # already run by here; pre-shard-padding — padding rows are not
+        # upload the caller asked for) — the single home for the "upload
+        # bytes" number bench.py used to re-derive arithmetically.  .nbytes
+        # via getattr, NEVER np.asarray: an input that is already a device
         # array must not be pulled host-side just to be counted.
         upload = 0
         for a in arrays.values():
@@ -483,12 +552,44 @@ class LocalExecutor:
         # is_goal is a [V] node vector, whose length is a node count, not
         # a batch size — observing it would corrupt the histogram.
         span_attrs = {"upload_bytes": upload}
+        b_in = rows_real = None
         if verb in ("fused", "giant") and arrays.get("pre_is_goal") is not None:
-            rows = int(np.shape(arrays["pre_is_goal"])[0])
-            obs.metrics.observe("kernel.batch_rows", rows)
-            span_attrs["rows"] = rows
+            b_in = int(np.shape(arrays["pre_is_goal"])[0])
+            rows_real = min(int(rows), b_in) if rows is not None else b_in
+            obs.metrics.observe("kernel.batch_rows", rows_real)
+            span_attrs["rows"] = rows_real
         obs.metrics.inc(f"kernel.dispatches.{verb}")
         obs.metrics.inc("kernel.upload_bytes", upload)
+        # Run-axis mesh sharding (ISSUE 7 tentpole): under NEMO_SHARD the
+        # fused verb's batch arrays pad to the mesh multiple and place with
+        # NamedSharding(mesh, P(run)) so the SAME jitted program runs SPMD
+        # across the device mesh — per-run verbs and reductions stay
+        # shard-local (GSPMD inserts only the row-0 broadcast and the
+        # prototype all-reduces), and the host pays ONE gather per bucket
+        # when the outputs materialize below.
+        b_pad = b_in
+        shard_n = 0
+        if verb == "fused" and b_in is not None:
+            from nemo_tpu.parallel.mesh import pad_place_named_arrays, shard_plan
+
+            place, n_dev = shard_plan()
+            if place:
+                from nemo_tpu.ops.adjacency import resolve_closure_impl
+
+                if resolve_closure_impl() == "pallas":
+                    # GSPMD cannot partition through a Mosaic pallas_call;
+                    # honor the operator's closure pin over the mesh.
+                    warnings.warn(
+                        "NEMO_SHARD requested but NEMO_CLOSURE_IMPL=pallas "
+                        "cannot shard; dispatching single-device",
+                        stacklevel=2,
+                    )
+                else:
+                    arrays, b_pad = pad_place_named_arrays(arrays, b_in, n_dev)
+                    shard_n = n_dev
+                    span_attrs["shard_devices"] = n_dev
+                    obs.metrics.inc("kernel.sharded_dispatches")
+                    obs.metrics.gauge("analysis.shard.devices", n_dev)
         args = [
             (jnp.asarray(arrays[n]) if arrays.get(n) is not None else None)
             if n in self.OPTIONAL_ARRAYS
@@ -520,6 +621,12 @@ class LocalExecutor:
                 if sp is not None:
                     sp.set(compiled=compiled)
         wall_s = time.perf_counter() - t_disp
+        # Whether THIS dispatch paid a trace+compile, exposed for the
+        # scheduler's feedback loop: a compile wall folded into the warm
+        # cost EWMA would misroute every later same-class bucket.  Safe as
+        # an instance attribute — the scheduler's device lane is one
+        # thread, and it reads the flag before its next dispatch.
+        self.last_dispatch_compiled = compiled
         # Cost accounting (ISSUE 4): per-signature FLOPs/bytes estimates +
         # compile wall into the cost table and the metrics registry, device
         # memory watermarks sampled while the dispatch's buffers are the
@@ -530,7 +637,10 @@ class LocalExecutor:
         _record_kernel_cost(
             verb, _cost_signature(verb, arrays, params), fn, args, statics,
             wall_s, compiled,
+            rows_frac=(rows_real / b_pad) if (rows_real is not None and b_pad) else 1.0,
+            pad_rows=(b_pad - rows_real) if (rows_real is not None and b_pad) else 0,
         )
+        _index_cost_class(verb, arrays, params)
         # Watermark sampling is throttled off the hot path: compiled
         # dispatches (rare, and the likeliest new high-water mark) plus
         # every 64th dispatch — peaks are monotone within a process, so a
@@ -555,6 +665,12 @@ class LocalExecutor:
                 upload_bytes=upload,
             )
         if isinstance(out, dict):
+            # The one-gather rule: all device->host traffic for this bucket
+            # happens here, once, async-overlapped — under sharding this is
+            # the single cross-shard gather the mesh layout allows per
+            # bucket, and its wall is the scheduler's visibility into
+            # shard-collection cost.
+            t_gather = time.perf_counter()
             _prefetch_to_host(o for n, o in out.items() if n not in self.ON_DEVICE)
             res = {
                 n: (o if n in self.ON_DEVICE else np.asarray(o)) for n, o in out.items()
@@ -570,6 +686,18 @@ class LocalExecutor:
                         giant=verb == "giant",
                     )
                 )
+            if shard_n:
+                obs.metrics.observe(
+                    "analysis.shard.gather_s", time.perf_counter() - t_gather
+                )
+                if b_pad != b_in:
+                    # Shed the shard-multiple padding rows so callers see
+                    # exactly the batch width they dispatched; corpus-level
+                    # reductions have no run axis to shed.
+                    res = {
+                        k: v if k in _CORPUS_LEVEL_OUTPUTS else v[:b_in]
+                        for k, v in res.items()
+                    }
             return res
         # Tuple-returning verbs always materialize: none of their outputs
         # are in ON_DEVICE, and the diff verb's consumers specifically rely
@@ -683,8 +811,11 @@ def _giant_impl_default() -> str:
     impl = _giant_impl_env()
     if impl == "auto":
         umbrella = _analysis_impl_env()
-        if umbrella != "auto":
+        if umbrella in ("sparse", "dense"):
             return "host" if umbrella == "sparse" else "device"
+        # auto AND crossover both land here: a giant's own crossover is the
+        # platform inversion (dense giant on CPU loses to the oracle), so
+        # the per-bucket budget knob must not drag giants onto the device.
         return "host" if jax.default_backend() == "cpu" else "device"
     return impl
 
@@ -756,11 +887,18 @@ def _analysis_impl_env() -> str:
     device; see _resolve_analysis_impl / the ServiceBackend override).
     Loud on junk for the same reason NEMO_GIANT_IMPL is: a typo silently
     falling back to auto would change which algorithm analyzes the corpus
-    in exactly the dimension the operator was trying to pin."""
+    in exactly the dimension the operator was trying to pin.
+
+    "crossover" (ISSUE 7) is auto WITHOUT the CPU-platform pin: per-bucket
+    work-budget / scheduler-cost-model routing even on a host backend —
+    the knob that lets the heterogeneous scheduler's both-lanes path (and
+    work stealing) be exercised and benched on a CPU-only box, where plain
+    auto resolves every bucket to the sparse tier."""
     impl = os.environ.get("NEMO_ANALYSIS_IMPL", "auto").strip().lower()
-    if impl not in ("auto", "dense", "sparse"):
+    if impl not in ("auto", "dense", "sparse", "crossover"):
         raise ValueError(
-            f"NEMO_ANALYSIS_IMPL={impl!r} (expected auto, dense, or sparse)"
+            f"NEMO_ANALYSIS_IMPL={impl!r} (expected auto, dense, sparse, "
+            "or crossover)"
         )
     return impl
 
@@ -1015,6 +1153,8 @@ class JaxBackend(GraphBackend):
         impl = _analysis_impl_env()
         if impl == "auto" and jax.default_backend() == "cpu":
             return "sparse"
+        # "crossover" passes through: _analysis_route's per-bucket budget
+        # branch handles any impl that is neither sparse nor dense.
         return impl
 
     def _analysis_route(self, rows: int, v: int, e: int) -> tuple[str, str, int]:
@@ -1329,8 +1469,104 @@ class JaxBackend(GraphBackend):
                         run_ids, pre, post, self._max_batch, min_v=min_v, min_e=min_e
                     )
             from nemo_tpu.ops.simplify import pair_chains_linear
+            from nemo_tpu.parallel import sched as sched_mod
 
-            out = []
+            # Heterogeneous schedule (ISSUE 7 tentpole): every joint bucket
+            # becomes a two-lane Job — the (mesh-sharded) fused device
+            # dispatch or the sparse CSR host engine compute IDENTICAL
+            # results (the parity suites pin that), so the scheduler is
+            # free to run both tiers concurrently and steal across them.
+            # PR 3's crossover survives two ways: forced/platform routes
+            # PIN their lane (an operator decision, not a preference), and
+            # the unmeasured cost model is seeded to cross at the same
+            # work budget — feedback from measured walls takes over within
+            # a session (parallel/sched.py).
+            jobs: list = []
+            serial_plan: list[tuple[str, str]] = []  # (lane, reason) sans scheduler
+
+            def _add_fused_job(pre_b, post_b, linear):
+                n_rows = len(pre_b.run_ids)
+                route, reason, work = self._analysis_route(n_rows, pre_b.v, pre_b.e)
+                lane = "host" if route == "sparse" else "device"
+                pinned = lane if reason in ("forced", "platform") else None
+                job = sched_mod.Job(
+                    index=len(jobs),
+                    verb="fused",
+                    rows=n_rows,
+                    v=pre_b.v,
+                    e=pre_b.e,
+                    work=work,
+                    execute=None,  # assigned below (the closure marks `job`)
+                    pinned=pinned,
+                    reason=reason,
+                )
+
+                def execute(run_lane, rec_reason, stolen):
+                    rec = self._record_route(
+                        "fused",
+                        sched_mod.ROUTE_OF_LANE[run_lane],
+                        n_rows,
+                        pre_b.v,
+                        pre_b.e,
+                        work,
+                        rec_reason,
+                    )
+                    if run_lane == "host":
+                        from nemo_tpu.ops.sparse_host import sparse_analysis_step
+
+                        # Counted under the same kernel.dispatches.* prefix
+                        # as the device verbs: the result cache's
+                        # zero-dispatch assertion (analysis/delta.py:
+                        # kernel_dispatch_count) sums the prefix, so a
+                        # sparse-routed recompute can never masquerade as a
+                        # cache hit.
+                        obs.metrics.inc("kernel.dispatches.sparse_fused")
+                        with obs.span("analysis:route", **rec):
+                            with obs.span(
+                                "kernel:fused", impl="sparse_host", rows=n_rows
+                            ):
+                                res = sparse_analysis_step(
+                                    pre_b,
+                                    post_b,
+                                    v=pre_b.v,
+                                    pre_tid=params_common["pre_tid"],
+                                    post_tid=params_common["post_tid"],
+                                    num_tables=params_common["num_tables"],
+                                    comp_linear=linear,
+                                )
+                        return (pre_b, post_b, res)
+                    with obs.span("analysis:route", **rec):
+                        res = self.executor.run(
+                            "fused",
+                            _narrow_fused_arrays(
+                                _verb_arrays(pre_b, post_b),
+                                v=pre_b.v,
+                                num_tables=params_common["num_tables"],
+                                with_diff=False,
+                                narrow=self._narrow_xfer,
+                            ),
+                            dict(
+                                v=pre_b.v,
+                                max_depth=bucket_size(
+                                    max(pre_b.max_depth, post_b.max_depth), min_d
+                                ),
+                                comp_linear=int(linear),
+                                **params_common,
+                            ),
+                            rows=n_rows,
+                        )
+                    # Compile walls must not feed the scheduler's warm-cost
+                    # EWMA (they are one-off; a RemoteExecutor has no flag
+                    # and its server-side compiles stay unmarked — the EWMA
+                    # absorbs those over a session).
+                    if getattr(self.executor, "last_dispatch_compiled", False):
+                        job.wall_tainted = True
+                    return (pre_b, post_b, res)
+
+                job.execute = execute
+                jobs.append(job)
+                serial_plan.append((lane, reason))
+
             for pre_b, post_b in batches:
                 # Linear-chain fast path: when every run's @next member
                 # subgraph is a verified linear chain, the device step
@@ -1343,60 +1579,7 @@ class JaxBackend(GraphBackend):
                     linear = all(self._lin_by_iter[i] for i in pre_b.run_ids)
                 else:
                     linear = pair_chains_linear(pre_b, post_b)
-                # Batched-analysis crossover (ISSUE 3 tentpole): per joint
-                # bucket, the SAME analyses run either as the fused dense
-                # device dispatch or as O(B*(V+E)) CSR scatters on the host
-                # (ops/sparse_host.py) — the giant/diff crossover pattern
-                # generalized to every dense bucket.  Decided per bucket,
-                # recorded as analysis.route metrics + a span wrapping the
-                # routed execution (the bench JSON surfaces both).
-                n_rows = len(pre_b.run_ids)
-                route, reason, work = self._analysis_route(
-                    n_rows, pre_b.v, pre_b.e
-                )
-                rec = self._record_route(
-                    "fused", route, n_rows, pre_b.v, pre_b.e, work, reason
-                )
-                if route == "sparse":
-                    from nemo_tpu.ops.sparse_host import sparse_analysis_step
-
-                    # Counted under the same kernel.dispatches.* prefix as
-                    # the device verbs: the result cache's zero-dispatch
-                    # assertion (analysis/delta.py:kernel_dispatch_count)
-                    # sums the prefix, so a sparse-routed recompute can
-                    # never masquerade as a cache hit.
-                    obs.metrics.inc("kernel.dispatches.sparse_fused")
-                    with obs.span("analysis:route", **rec):
-                        with obs.span("kernel:fused", impl="sparse_host", rows=n_rows):
-                            res = sparse_analysis_step(
-                                pre_b,
-                                post_b,
-                                v=pre_b.v,
-                                pre_tid=params_common["pre_tid"],
-                                post_tid=params_common["post_tid"],
-                                num_tables=params_common["num_tables"],
-                                comp_linear=linear,
-                            )
-                    out.append((pre_b, post_b, res))
-                    continue
-                with obs.span("analysis:route", **rec):
-                    res = self.executor.run(
-                        "fused",
-                        _narrow_fused_arrays(
-                            _verb_arrays(pre_b, post_b),
-                            v=pre_b.v,
-                            num_tables=params_common["num_tables"],
-                            with_diff=False,
-                            narrow=self._narrow_xfer,
-                        ),
-                        dict(
-                            v=pre_b.v,
-                            max_depth=bucket_size(max(pre_b.max_depth, post_b.max_depth), min_d),
-                            comp_linear=int(linear),
-                            **params_common,
-                        ),
-                    )
-                out.append((pre_b, post_b, res))
+                _add_fused_job(pre_b, post_b, linear)
             if giant_ids:
                 from nemo_tpu.parallel.giant import giant_plan, pad_comp_labels
 
@@ -1422,61 +1605,107 @@ class JaxBackend(GraphBackend):
                 # diff crossover fixed one verb over.  Resolved per corpus
                 # in init_graph_db (_giant_impl_default).
                 self.giant_impl_used = self._giant_impl
+                giant_lane = "host" if self._giant_impl == "host" else "device"
                 for rid, (gpre, gpost) in zip(giant_ids, g_graphs):
-                    pre_b = pack_batch([rid], [gpre], v_g, e_g)
-                    post_b = pack_batch([rid], [gpost], v_g, e_g)
-                    lin_pre, depth_pre, lab_pre = giant_plan(gpre)
-                    lin_post, depth_post, lab_post = giant_plan(gpost)
-                    pre_labels = pad_comp_labels(lab_pre, gpre.n_nodes, v_g)
-                    post_labels = pad_comp_labels(lab_post, gpost.n_nodes, v_g)
-                    # Route record for the giant verb: "host" is the sparse
-                    # side of this crossover, "device" the dense one — one
-                    # uniform sparse/dense vocabulary across all verbs.
-                    rec = self._record_route(
-                        "giant",
-                        "sparse" if self._giant_impl == "host" else "dense",
-                        1,
-                        v_g,
-                        e_g,
-                        v_g + e_g,
-                        "giant_impl",
+                    g_job = sched_mod.Job(
+                        index=len(jobs),
+                        verb="giant",
+                        rows=1,
+                        v=v_g,
+                        e=e_g,
+                        work=v_g + e_g,
+                        execute=None,  # assigned below (the closure marks it)
+                        pinned=giant_lane,
+                        reason="giant_impl",
                     )
-                    if self._giant_impl == "host":
-                        from nemo_tpu.parallel.giant import giant_analysis_host
 
-                        obs.metrics.inc("kernel.dispatches.sparse_giant")
-                        with obs.span("analysis:route", **rec):
-                            res = giant_analysis_host(
-                                pre_b,
-                                post_b,
-                                pre_tid=params_common["pre_tid"],
-                                post_tid=params_common["post_tid"],
-                                num_tables=params_common["num_tables"],
-                                pre_labels=pre_labels,
-                                post_labels=post_labels,
-                            )
-                        out.append((pre_b, post_b, res))
-                        continue
-                    arrays = _verb_arrays(pre_b, post_b)
-                    arrays["pre_comp_labels"] = pre_labels
-                    arrays["post_comp_labels"] = post_labels
-                    with obs.span("analysis:route", **rec):
-                        res = self.executor.run(
+                    def g_execute(run_lane, rec_reason, stolen, gpre=gpre, gpost=gpost, rid=rid, job=g_job):
+                        pre_b = pack_batch([rid], [gpre], v_g, e_g)
+                        post_b = pack_batch([rid], [gpost], v_g, e_g)
+                        lin_pre, depth_pre, lab_pre = giant_plan(gpre)
+                        lin_post, depth_post, lab_post = giant_plan(gpost)
+                        pre_labels = pad_comp_labels(lab_pre, gpre.n_nodes, v_g)
+                        post_labels = pad_comp_labels(lab_post, gpost.n_nodes, v_g)
+                        # Route record for the giant verb: "host" is the
+                        # sparse side of this crossover, "device" the dense
+                        # one — one uniform sparse/dense vocabulary across
+                        # all verbs.
+                        rec = self._record_route(
                             "giant",
-                            arrays,
-                            dict(
-                                v=v_g,
-                                pre_tid=params_common["pre_tid"],
-                                post_tid=params_common["post_tid"],
-                                num_tables=params_common["num_tables"],
-                                max_depth=bucket_size(
-                                    max(pre_b.max_depth, post_b.max_depth), 4
-                                ),
-                                comp_linear=int(lin_pre and lin_post),
-                                proto_depth=bucket_size(max(depth_pre, depth_post), 8),
-                            ),
+                            sched_mod.ROUTE_OF_LANE[run_lane],
+                            1,
+                            v_g,
+                            e_g,
+                            v_g + e_g,
+                            rec_reason,
                         )
-                    out.append((pre_b, post_b, res))
+                        if run_lane == "host":
+                            from nemo_tpu.parallel.giant import giant_analysis_host
+
+                            obs.metrics.inc("kernel.dispatches.sparse_giant")
+                            with obs.span("analysis:route", **rec):
+                                res = giant_analysis_host(
+                                    pre_b,
+                                    post_b,
+                                    pre_tid=params_common["pre_tid"],
+                                    post_tid=params_common["post_tid"],
+                                    num_tables=params_common["num_tables"],
+                                    pre_labels=pre_labels,
+                                    post_labels=post_labels,
+                                )
+                            return (pre_b, post_b, res)
+                        arrays = _verb_arrays(pre_b, post_b)
+                        arrays["pre_comp_labels"] = pre_labels
+                        arrays["post_comp_labels"] = post_labels
+                        with obs.span("analysis:route", **rec):
+                            res = self.executor.run(
+                                "giant",
+                                arrays,
+                                dict(
+                                    v=v_g,
+                                    pre_tid=params_common["pre_tid"],
+                                    post_tid=params_common["post_tid"],
+                                    num_tables=params_common["num_tables"],
+                                    max_depth=bucket_size(
+                                        max(pre_b.max_depth, post_b.max_depth), 4
+                                    ),
+                                    comp_linear=int(lin_pre and lin_post),
+                                    proto_depth=bucket_size(
+                                        max(depth_pre, depth_post), 8
+                                    ),
+                                ),
+                                rows=1,
+                            )
+                        if getattr(self.executor, "last_dispatch_compiled", False):
+                            job.wall_tainted = True
+                        return (pre_b, post_b, res)
+
+                    # Giant jobs PIN their per-corpus resolved lane: the
+                    # crossover there is a platform inversion (dense giant
+                    # on a CPU fallback is 5-6x slower than the oracle),
+                    # not a preference the cost model may override.
+                    g_job.execute = g_execute
+                    jobs.append(g_job)
+                    serial_plan.append((giant_lane, "giant_impl"))
+            # Drain: the two-lane work-stealing scheduler overlaps the
+            # device and host tiers (NEMO_SCHED auto/on); off — or a
+            # single-job corpus, where concurrency has nothing to overlap —
+            # keeps the exact serial pre-scheduler loop.  Results land in
+            # job order either way, so bucket order (and with it every
+            # downstream row index) is schedule-independent.
+            mode = sched_mod.sched_env()
+            if mode != "off" and (mode == "on" or len(jobs) > 1):
+                scheduler = sched_mod.HeterogeneousScheduler(
+                    sched_mod.session_models(
+                        self._analysis_host_work, sched_device_hint
+                    )
+                )
+                out = scheduler.run(jobs)
+            else:
+                out = [
+                    job.execute(lane, reason, False)
+                    for job, (lane, reason) in zip(jobs, serial_plan)
+                ]
             self._fused_out = out
         return self._fused_out
 
@@ -1643,7 +1872,7 @@ class JaxBackend(GraphBackend):
         umbrella = _analysis_impl_env()
         if good.n_nodes > self._giant_v:
             use_host, route_reason = True, "giant"
-        elif umbrella != "auto":
+        elif umbrella in ("sparse", "dense"):
             use_host, route_reason = umbrella == "sparse", "forced"
         elif self._analysis_impl == "sparse":
             use_host, route_reason = True, "platform"

@@ -111,6 +111,6 @@ class ServiceBackend(JaxBackend):
 class _Unconnected:
     """Placeholder executor before init_graph_db / after close_db."""
 
-    def run(self, verb, arrays, params):
+    def run(self, verb, arrays, params, rows=None):
         raise RuntimeError("ServiceBackend is not connected; call init_graph_db first")
 

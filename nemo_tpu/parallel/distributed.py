@@ -20,15 +20,17 @@ from __future__ import annotations
 import os
 
 import jax
-import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from nemo_tpu.models.pipeline_model import BatchArrays
-from nemo_tpu.parallel.mesh import run_step_sharded
+from nemo_tpu.parallel.mesh import (  # noqa: F401  (make_hybrid_mesh re-export)
+    DCN_AXIS,
+    ICI_AXIS,
+    Mesh,
+    make_hybrid_mesh,
+    run_step_sharded,
+)
 from nemo_tpu.utils.jax_config import distributed_is_initialized
-
-DCN_AXIS = "dcn"
-ICI_AXIS = "ici"
 
 
 def init_distributed(
@@ -75,56 +77,6 @@ def init_distributed(
         process_id=process_id,
     )
     return jax.process_count() > 1
-
-
-def make_hybrid_mesh(
-    dcn_size: int | None = None, ici_size: int | None = None
-) -> Mesh:
-    """A 2-D (dcn, ici) mesh: outer axis across hosts, inner across each
-    host's chips.  In a single process the axes are a reshape of the local
-    devices (dcn_size defaults to 1); in a multi-process runtime the outer
-    axis defaults to the process count so each host owns one DCN row.
-    """
-    devices = jax.devices()
-    n_proc = jax.process_count()
-    if dcn_size is None:
-        dcn_size = n_proc if n_proc > 1 else 1
-    if ici_size is None:
-        if len(devices) % dcn_size:
-            raise ValueError(
-                f"{len(devices)} devices not divisible by dcn axis {dcn_size}"
-            )
-        ici_size = len(devices) // dcn_size
-    if dcn_size * ici_size > len(devices):
-        raise ValueError(
-            f"mesh {dcn_size}x{ici_size} needs {dcn_size * ici_size} devices, "
-            f"have {len(devices)}"
-        )
-    if n_proc > 1:
-        # Group devices so each DCN row is one process's chips: collectives
-        # inside an ici row then ride ICI only.  The requested factorization
-        # must match the process layout exactly — a silently truncated or
-        # ragged grid would drop devices.
-        by_proc: dict[int, list] = {}
-        for d in devices:
-            by_proc.setdefault(d.process_index, []).append(d)
-        if len(by_proc) != dcn_size:
-            raise ValueError(
-                f"dcn axis {dcn_size} != process count {len(by_proc)}; one DCN "
-                "row per process is required in multi-process mode"
-            )
-        rows = []
-        for pid, ds in sorted(by_proc.items()):
-            if len(ds) != ici_size:
-                raise ValueError(
-                    f"process {pid} has {len(ds)} devices, ici axis needs {ici_size}"
-                )
-            rows.append(sorted(ds, key=lambda d: d.id))
-        grid = np.asarray(rows)
-    else:
-        grid = np.asarray(devices[: dcn_size * ici_size]).reshape(dcn_size, ici_size)
-    assert grid.shape == (dcn_size, ici_size)
-    return Mesh(grid, (DCN_AXIS, ICI_AXIS))
 
 
 def analysis_step_hybrid(

@@ -1,4 +1,4 @@
-"""Device-mesh scaling of the analysis pipeline.
+"""Device topology + run-axis sharding: THE mesh module (ISSUE 7).
 
 The run axis is the framework's data-parallel axis (SURVEY.md §2.3): the
 reference analyzes runs in a sequential host loop; here the packed run batch
@@ -7,9 +7,30 @@ runs SPMD, with the cross-run prototype reductions (jnp.all/any over the run
 axis) lowered by XLA to all-reduces over ICI.  Multi-host scale-out uses the
 same code path — jax.distributed + a larger mesh — with DCN only between
 hosts, never inside the per-run kernels.
+
+This module is the single source of truth for device topology: every mesh
+the repo builds — the production run mesh (`make_run_mesh`, consumed by the
+sharded fused dispatch in backend/jax_backend.py:LocalExecutor), the
+node-sharded giant/ring mesh (`make_node_mesh`, re-exported by
+parallel/ring.py), and the multi-host hybrid DCN x ICI grid
+(`make_hybrid_mesh`, re-exported by parallel/distributed.py) — derives its
+device list from one `device_grid` helper, so a future multi-host layout
+changes exactly one place.
+
+Production knobs (the NEMO_SHARD_* family, see also parallel/sched.py):
+
+  NEMO_SHARD=auto|1|0    run-axis sharding of the fused dispatch: auto
+                         (default) shards whenever >1 device is visible;
+                         0 pins the single-device dispatch; 1 forces the
+                         mesh path even on one device (a no-op placement,
+                         kept dispatchable for parity tests).
+  NEMO_SHARD_DEVICES=N   cap the run mesh at the first N devices
+                         (default: all visible devices).
 """
 
 from __future__ import annotations
+
+import os
 
 import jax
 import numpy as np
@@ -19,12 +40,162 @@ from nemo_tpu.models.pipeline_model import BatchArrays, analysis_step
 
 RUN_AXIS = "run"
 NODE_AXIS = "node"
+DCN_AXIS = "dcn"
+ICI_AXIS = "ici"
+
+
+def device_grid(n_devices: int | None = None, shape: tuple | None = None) -> np.ndarray:
+    """The validated device array every mesh constructor builds on: the
+    first `n_devices` visible devices (default all), reshaped to `shape`
+    (default 1-D).  Raises — rather than silently truncating — when the
+    request exceeds the visible device count or the grid would drop
+    devices from the requested slice."""
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    if n > len(devices):
+        raise ValueError(f"need {n} devices, have {len(devices)}")
+    grid = np.asarray(devices[:n])
+    if shape is not None:
+        if int(np.prod(shape)) != n:
+            raise ValueError(f"grid shape {shape} does not cover {n} devices")
+        grid = grid.reshape(shape)
+    return grid
 
 
 def make_run_mesh(n_devices: int | None = None) -> Mesh:
+    """The production 1-D run-axis mesh (SNIPPETS [2]'s "batch" mesh, with
+    this repo's axis name)."""
+    grid = device_grid(n_devices)
+    return Mesh(grid, (RUN_AXIS,))
+
+
+def make_node_mesh(n_devices: int | None = None) -> Mesh:
+    """The 1-D node-axis mesh of the giant/ring paths (parallel/ring.py,
+    parallel/giant.py)."""
+    grid = device_grid(n_devices)
+    return Mesh(grid, (NODE_AXIS,))
+
+
+def make_hybrid_mesh(
+    dcn_size: int | None = None, ici_size: int | None = None
+) -> Mesh:
+    """A 2-D (dcn, ici) mesh: outer axis across hosts, inner across each
+    host's chips.  In a single process the axes are a reshape of the local
+    devices (dcn_size defaults to 1); in a multi-process runtime the outer
+    axis defaults to the process count so each host owns one DCN row.
+    """
     devices = jax.devices()
-    n = n_devices or len(devices)
-    return Mesh(np.asarray(devices[:n]).reshape(n), (RUN_AXIS,))
+    n_proc = jax.process_count()
+    if dcn_size is None:
+        dcn_size = n_proc if n_proc > 1 else 1
+    if ici_size is None:
+        if len(devices) % dcn_size:
+            raise ValueError(
+                f"{len(devices)} devices not divisible by dcn axis {dcn_size}"
+            )
+        ici_size = len(devices) // dcn_size
+    if n_proc > 1:
+        # Group devices so each DCN row is one process's chips: collectives
+        # inside an ici row then ride ICI only.  The requested factorization
+        # must match the process layout exactly — a silently truncated or
+        # ragged grid would drop devices.
+        by_proc: dict[int, list] = {}
+        for d in devices:
+            by_proc.setdefault(d.process_index, []).append(d)
+        if len(by_proc) != dcn_size:
+            raise ValueError(
+                f"dcn axis {dcn_size} != process count {len(by_proc)}; one DCN "
+                "row per process is required in multi-process mode"
+            )
+        rows = []
+        for pid, ds in sorted(by_proc.items()):
+            if len(ds) != ici_size:
+                raise ValueError(
+                    f"process {pid} has {len(ds)} devices, ici axis needs {ici_size}"
+                )
+            rows.append(sorted(ds, key=lambda d: d.id))
+        grid = np.asarray(rows)
+    else:
+        grid = device_grid(dcn_size * ici_size, (dcn_size, ici_size))
+    assert grid.shape == (dcn_size, ici_size)
+    return Mesh(grid, (DCN_AXIS, ICI_AXIS))
+
+
+# ---------------------------------------------------------------------------
+# production sharding policy (NEMO_SHARD_* knobs)
+# ---------------------------------------------------------------------------
+
+
+def _shard_env() -> str:
+    """Parse + validate NEMO_SHARD.  Loud on junk, like NEMO_ANALYSIS_IMPL:
+    a typo silently resolving to auto would change how many devices execute
+    the corpus in exactly the dimension the operator was pinning."""
+    v = os.environ.get("NEMO_SHARD", "auto").strip().lower()
+    if v in ("auto",):
+        return "auto"
+    if v in ("1", "true", "yes", "on"):
+        return "on"
+    if v in ("0", "false", "no", "off"):
+        return "off"
+    raise ValueError(f"NEMO_SHARD={v!r} (expected auto, 1, or 0)")
+
+
+def _shard_devices_cap() -> int | None:
+    """Parse + validate NEMO_SHARD_DEVICES (None = no cap).  Loud on junk:
+    a typo silently lifting the cap would change the mesh width in exactly
+    the dimension the operator was pinning (the NEMO_MAX_BATCH policy)."""
+    cap = os.environ.get("NEMO_SHARD_DEVICES", "").strip()
+    if not cap:
+        return None
+    try:
+        c = int(cap)
+    except ValueError:
+        raise ValueError(
+            f"NEMO_SHARD_DEVICES={cap!r} is not an integer"
+        ) from None
+    if c < 1:
+        raise ValueError(f"NEMO_SHARD_DEVICES={c} must be >= 1")
+    return c
+
+
+def shard_plan() -> tuple[bool, int]:
+    """The production sharding decision: (place_on_mesh, n_devices).
+
+    ``place_on_mesh`` False means the single-device dispatch — no mesh, no
+    padding, the exact pre-sharding path.  NEMO_SHARD=1 returns True even
+    on one device (a no-op placement kept dispatchable so parity suites can
+    drive the mesh path without multiple devices); auto places only when
+    >1 device is actually visible under the NEMO_SHARD_DEVICES cap."""
+    mode = _shard_env()
+    if mode == "off":
+        return False, 1
+    n = len(jax.devices())
+    cap = _shard_devices_cap()
+    if cap is not None:
+        n = min(n, cap)
+    if mode == "auto":
+        return n > 1, n
+    return True, max(1, n)
+
+
+def shard_device_count() -> int:
+    """Number of devices the production run mesh spans under the current
+    NEMO_SHARD / NEMO_SHARD_DEVICES settings: 1 means the single-device
+    dispatch (no mesh placement at all)."""
+    place, n = shard_plan()
+    return n if place else 1
+
+
+#: Process-cached production run mesh, keyed by device count (the visible
+#: device set is fixed per process; only the NEMO_SHARD_DEVICES cap varies).
+_RUN_MESH_CACHE: dict[int, Mesh] = {}
+
+
+def run_mesh(n_devices: int) -> Mesh:
+    mesh = _RUN_MESH_CACHE.get(n_devices)
+    if mesh is None:
+        mesh = _RUN_MESH_CACHE[n_devices] = make_run_mesh(n_devices)
+    return mesh
 
 
 def pad_batch_rows(arrays: BatchArrays, multiple: int) -> tuple[BatchArrays, int]:
@@ -58,6 +229,40 @@ def shard_arrays(mesh: Mesh, arrays: BatchArrays, spec: P | None = None) -> Batc
     (per `spec`; default: the 1-D run axis)."""
     sharding = NamedSharding(mesh, spec if spec is not None else P(RUN_AXIS))
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), arrays)
+
+
+def pad_place_named_arrays(
+    arrays: dict, b: int, n_devices: int
+) -> tuple[dict, int]:
+    """The executor-boundary form of pad_batch_rows + shard_arrays: pad the
+    run axis of every [b, ...] array in the fused verb's named-array dict to
+    a multiple of the mesh size (padding rows are empty graphs — all masks
+    False, indices 0 — exactly pack_batch's own padding rows) and place it
+    with ``NamedSharding(run_mesh, P(RUN_AXIS))``; arrays whose leading dim
+    is not the run axis (the [1,1] label stubs the narrowing pass leaves
+    when the diff tail is off) replicate.  Returns (placed, b_padded).
+
+    One host->device placement per array here, ONE gather per bucket on the
+    way back (backend/jax_backend.py materializes outputs post-dispatch) —
+    the one-gather rule that keeps shard traffic off the per-verb paths."""
+    mesh = run_mesh(n_devices)
+    row_sharded = NamedSharding(mesh, P(RUN_AXIS))
+    replicated = NamedSharding(mesh, P())
+    b_pad = ((b + n_devices - 1) // n_devices) * n_devices
+    out: dict = {}
+    for name, a in arrays.items():
+        if a is None:
+            out[name] = None
+            continue
+        a = np.asarray(a)
+        if a.ndim and a.shape[0] == b:
+            if b_pad != b:
+                widths = [(0, b_pad - b)] + [(0, 0)] * (a.ndim - 1)
+                a = np.pad(a, widths)
+            out[name] = jax.device_put(a, row_sharded)
+        else:
+            out[name] = jax.device_put(a, replicated)
+    return out, b_pad
 
 
 def run_step_sharded(

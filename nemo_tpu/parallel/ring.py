@@ -23,15 +23,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from nemo_tpu.utils.jax_config import axis_size, pcast_varying, shard_map
 
-from .mesh import NODE_AXIS
-
-
-def make_node_mesh(n_devices: int | None = None) -> Mesh:
-    import numpy as np
-
-    devices = jax.devices()
-    n = n_devices or len(devices)
-    return Mesh(np.asarray(devices[:n]).reshape(n), (NODE_AXIS,))
+# Device topology comes from THE mesh module (parallel/mesh.py): the ring
+# path shares one device-grid source with the production run mesh and the
+# multi-host hybrid mesh, so a topology change lands in one place.
+from .mesh import NODE_AXIS, make_node_mesh  # noqa: F401  (re-export)
 
 
 def _ring_step_body(frontier_chunk, adj_shard, axis_name):

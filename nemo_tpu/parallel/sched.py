@@ -1,0 +1,364 @@
+"""Cost-model-driven heterogeneous work-stealing scheduler (ISSUE 7).
+
+PR 3 gave every joint bucket a per-bucket ROUTE (dense device dispatch vs
+the sparse CSR host engine, backend/jax_backend.py:_analysis_route) but
+executed the routed buckets one at a time: while a device dispatch runs,
+the host cores idle, and vice versa.  This module turns the route decision
+into a two-lane schedule:
+
+  * **device lane**: one worker thread draining buckets into the (now
+    mesh-sharded) fused executor dispatch — serialized per device, which is
+    exactly what the accelerator wants;
+  * **host lane**: one worker thread draining buckets into the sparse-CSR
+    host engine (ops/sparse_host.py).
+
+Buckets are assigned a PREFERRED lane by a cost model — wall ≈ fixed +
+unit x work per lane, seeded from the PR-3/PR-4 measured constants (the
+sparse engine's ~1 us/work-unit and the dispatch-crossover budget
+NEMO_ANALYSIS_HOST_WORK) and refined per (verb, V, E) shape class by an
+EWMA over the walls this process actually measured, so a mispredicted
+bucket corrects the predictions for the rest of the session.  The device
+lane additionally consults the PR-4 per-signature cost table through an
+optional ``hint`` callable (FLOPs-derived wall for a signature costed in a
+previous corpus but not yet measured by this scheduler).
+
+An idle lane STEALS the next queued unpinned bucket from the other lane's
+tail rather than waiting — so a corpus whose cost model mispredicts still
+finishes at the speed of both tiers combined.  Jobs pinned by an explicit
+NEMO_ANALYSIS_IMPL (or the platform resolution) never migrate: a forced
+route is an operator decision, not a preference.
+
+Determinism: results land by job index, so callers see bucket order
+independent of completion order, and each bucket's result is bit-identical
+on either lane (the sparse/dense parity suites pin that) — scheduling
+changes WHEN work runs, never what it produces.
+
+Every decision is recorded: ``analysis.sched.*`` metrics (dispatch/steal
+counters per lane, per-lane wall histograms), one record per job in a
+process-global table exported to telemetry.json, and the
+``analysis:sched`` span wrapping each drain.
+
+Knobs: NEMO_SCHED=auto|on|off (auto = schedule when >1 job; off = the
+serial pre-PR loop, kept as the debugging fallback), NEMO_SCHED_HOST_UNIT /
+NEMO_SCHED_DEVICE_UNIT (seconds per work unit), NEMO_SCHED_DEVICE_FIXED
+(seconds per dispatch; default derives from the crossover budget so an
+unmeasured scheduler reproduces PR 3's routing exactly).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable
+
+from nemo_tpu import obs
+
+_log = obs.log.get_logger("nemo.sched")
+
+LANES = ("device", "host")
+
+#: route vocabulary of the analysis.route records, per lane (the scheduler
+#: speaks "lane", the route records speak the PR-3 sparse/dense vocabulary).
+ROUTE_OF_LANE = {"device": "dense", "host": "sparse"}
+
+
+def sched_env() -> str:
+    """Parse + validate NEMO_SCHED.  Loud on junk (the NEMO_ANALYSIS_IMPL
+    policy): a typo silently resolving to auto would change execution
+    concurrency in exactly the dimension the operator was pinning."""
+    v = os.environ.get("NEMO_SCHED", "auto").strip().lower()
+    if v == "auto":
+        return "auto"
+    if v in ("1", "true", "yes", "on"):
+        return "on"
+    if v in ("0", "false", "no", "off"):
+        return "off"
+    raise ValueError(f"NEMO_SCHED={v!r} (expected auto, on, or off)")
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        val = float(raw)
+    except ValueError:
+        raise ValueError(f"{name}={raw!r} is not a number") from None
+    if val <= 0:
+        raise ValueError(f"{name}={val} must be > 0")
+    return val
+
+
+@dataclass
+class Job:
+    """One schedulable bucket: identity for the cost model (verb, rows, V,
+    E, work = rows x (V+E) — the same work unit as the PR-3 crossover) plus
+    the execution callable.  ``execute(lane, reason, stolen)`` runs the
+    bucket on the named lane and returns its result dict; the callable owns
+    route recording and spans so records look identical to the serial path.
+    ``pinned`` names the only lane allowed to run this job (a forced or
+    platform route); ``reason`` is the route reason recorded when the job
+    runs on its planned lane ("sched" for cost-model preferences)."""
+
+    index: int
+    verb: str
+    rows: int
+    v: int
+    e: int
+    work: int
+    execute: Callable[[str, str, bool], dict]
+    pinned: str | None = None
+    reason: str = "sched"
+    #: Set True BY the execute callable when the measured wall includes a
+    #: one-off cost that must not feed the cost model — a jit compile
+    #: (seconds) folded into a warm-execution EWMA (tens of ms) would price
+    #: every later same-class bucket off the device lane for the whole
+    #: session.  The scheduler still records the wall; it skips observe().
+    wall_tainted: bool = False
+
+
+class LaneModel:
+    """Per-lane wall-clock predictor: wall ≈ fixed + unit x work, with a
+    per-(verb, V, E) shape-class EWMA of measured per-row walls taking over
+    once the lane has actually executed that class — measured walls beat
+    any static model, and the shape class is what the jit cache keys on, so
+    walls within a class are comparable.  ``hint(job)`` (optional) supplies
+    a prediction between those two: consulted when the class is unmeasured,
+    e.g. the PR-4 cost table's FLOPs estimate for a signature compiled in
+    an earlier corpus."""
+
+    def __init__(
+        self,
+        fixed_s: float,
+        unit_s: float,
+        alpha: float = 0.5,
+        hint: Callable[[Job], float | None] | None = None,
+    ) -> None:
+        self.fixed_s = float(fixed_s)
+        self.unit_s = float(unit_s)
+        self.alpha = float(alpha)
+        self.hint = hint
+        #: (verb, v, e) -> EWMA seconds per row, measured by this process.
+        self.per_row: dict[tuple[str, int, int], float] = {}
+
+    def predict(self, job: Job) -> float:
+        per_row = self.per_row.get((job.verb, job.v, job.e))
+        if per_row is not None:
+            return self.fixed_s + per_row * job.rows
+        if self.hint is not None:
+            h = self.hint(job)
+            if h is not None:
+                return self.fixed_s + float(h)
+        return self.fixed_s + self.unit_s * job.work
+
+    def observe(self, job: Job, wall_s: float) -> None:
+        """Feed one measured execution back into the model (the feedback
+        loop that corrects a mispredicted bucket for the whole session)."""
+        variable = max(wall_s - self.fixed_s, 1e-9)
+        per_row = variable / max(job.rows, 1)
+        key = (job.verb, job.v, job.e)
+        old = self.per_row.get(key)
+        self.per_row[key] = (
+            per_row if old is None else (1 - self.alpha) * old + self.alpha * per_row
+        )
+        unit = variable / max(job.work, 1)
+        self.unit_s = (1 - self.alpha) * self.unit_s + self.alpha * unit
+
+
+def default_models(
+    host_work_budget: int | None = None,
+    device_hint: Callable[[Job], float | None] | None = None,
+) -> dict[str, LaneModel]:
+    """Lane models seeded so an UNMEASURED scheduler reproduces the PR-3
+    crossover: the host lane costs the sparse engine's measured ~1 us per
+    work unit (BENCH sparse tier), and the device lane pays a fixed
+    dispatch cost equal to the crossover budget's worth of host work —
+    predictions then cross at exactly work ≈ NEMO_ANALYSIS_HOST_WORK, the
+    measured break-even PR 3 shipped.  Feedback refines both from there."""
+    host_unit = _env_float("NEMO_SCHED_HOST_UNIT", 1e-6)
+    device_unit = _env_float("NEMO_SCHED_DEVICE_UNIT", 5e-8)
+    budget = host_work_budget
+    if budget is None:
+        budget = int(os.environ.get("NEMO_ANALYSIS_HOST_WORK", "100000"))
+    # fixed + unit_d*budget == unit_h*budget: the two lines intersect at
+    # exactly the budget (a fixed of budget*unit_h alone would put the
+    # break-even ~unit_d/unit_h above it).
+    device_fixed = _env_float(
+        "NEMO_SCHED_DEVICE_FIXED", budget * max(host_unit - device_unit, 1e-12)
+    )
+    return {
+        "device": LaneModel(device_fixed, device_unit, hint=device_hint),
+        "host": LaneModel(0.0, host_unit),
+    }
+
+
+#: Process-global lane models: measured walls persist across corpora in one
+#: session (a long-lived sidecar keeps learning), like the jit cache.
+_SESSION_MODELS: dict[str, LaneModel] | None = None
+#: Process-global decision table exported to telemetry.json (bounded like
+#: the metrics registry's series cap; drops are impossible — deque evicts).
+_RECORDS: deque = deque(maxlen=512)
+_RECORDS_LOCK = threading.Lock()
+
+
+def session_models(
+    host_work_budget: int | None = None,
+    device_hint: Callable[[Job], float | None] | None = None,
+) -> dict[str, LaneModel]:
+    global _SESSION_MODELS
+    if _SESSION_MODELS is None:
+        _SESSION_MODELS = default_models(host_work_budget, device_hint)
+    elif device_hint is not None and _SESSION_MODELS["device"].hint is None:
+        _SESSION_MODELS["device"].hint = device_hint
+    return _SESSION_MODELS
+
+
+def reset_session_models() -> None:
+    """Forget learned walls (tests, and operators bouncing a bad model)."""
+    global _SESSION_MODELS
+    _SESSION_MODELS = None
+    with _RECORDS_LOCK:
+        _RECORDS.clear()
+
+
+def sched_snapshot() -> list[dict]:
+    """The decision table as JSON-able records (newest last) — the
+    telemetry.json `sched` section reads this."""
+    with _RECORDS_LOCK:
+        return [dict(r) for r in _RECORDS]
+
+
+class HeterogeneousScheduler:
+    """Two-lane work-stealing executor over a job list.
+
+    ``run(jobs)`` drains the jobs on one worker thread per lane and returns
+    results in job-index order.  Planned lanes come from the cost model
+    (or the job's pin); an idle lane steals the next UNPINNED job from the
+    other lane's tail (the far end — the victim lane keeps its head-of-line
+    locality).  The first worker exception aborts both lanes and re-raises
+    in the caller."""
+
+    def __init__(self, models: dict[str, LaneModel] | None = None) -> None:
+        self.models = models or session_models()
+        self.steals = {lane: 0 for lane in LANES}
+        self.dispatched = {lane: 0 for lane in LANES}
+
+    def plan(self, job: Job) -> tuple[str, str, dict]:
+        """(lane, reason, predictions) for one job."""
+        preds = {lane: self.models[lane].predict(job) for lane in LANES}
+        if job.pinned:
+            return job.pinned, job.reason, preds
+        lane = "device" if preds["device"] <= preds["host"] else "host"
+        return lane, "sched", preds
+
+    def run(self, jobs: list[Job], serial: bool = False) -> list[dict]:
+        results: list[dict | None] = [None] * len(jobs)
+        queues: dict[str, deque[Job]] = {lane: deque() for lane in LANES}
+        plans: dict[int, tuple[str, str, dict]] = {}
+        for job in jobs:
+            lane, reason, preds = self.plan(job)
+            plans[job.index] = (lane, reason, preds)
+            queues[lane].append(job)
+        obs.metrics.inc("analysis.sched.jobs", len(jobs))
+
+        lock = threading.Lock()
+        errors: list[BaseException] = []
+
+        def run_one(job: Job, lane: str, stolen: bool) -> None:
+            planned_lane, reason, preds = plans[job.index]
+            if stolen:
+                reason = "steal"
+            t0 = time.perf_counter()
+            res = job.execute(lane, reason, stolen)
+            wall = time.perf_counter() - t0
+            with lock:
+                if not job.wall_tainted:
+                    self.models[lane].observe(job, wall)
+                self.dispatched[lane] += 1
+                if stolen:
+                    self.steals[lane] += 1
+                results[job.index] = res
+            obs.metrics.inc(f"analysis.sched.dispatch.{lane}")
+            if stolen:
+                obs.metrics.inc(f"analysis.sched.steal.{lane}")
+            obs.metrics.observe(f"analysis.sched.wall_s.{lane}", wall)
+            rec = {
+                "index": job.index,
+                "verb": job.verb,
+                "rows": job.rows,
+                "v": job.v,
+                "e": job.e,
+                "work": job.work,
+                "lane": lane,
+                "planned": planned_lane,
+                "reason": reason,
+                "stolen": stolen,
+                "pinned": job.pinned is not None,
+                "tainted": job.wall_tainted,
+                "predicted_s": {k: round(v, 6) for k, v in preds.items()},
+                "wall_s": round(wall, 6),
+            }
+            with _RECORDS_LOCK:
+                _RECORDS.append(rec)
+
+        def take(lane: str) -> tuple[Job, bool] | None:
+            """Pop the next job for `lane`: its own queue's head, else steal
+            an unpinned job from the other lane's tail."""
+            other = "host" if lane == "device" else "device"
+            with lock:
+                if queues[lane]:
+                    return queues[lane].popleft(), False
+                for i in range(len(queues[other]) - 1, -1, -1):
+                    job = queues[other][i]
+                    if job.pinned is None:
+                        del queues[other][i]
+                        return job, True
+            return None
+
+        # A job list pinned entirely to ONE lane has no concurrency to win
+        # (stealing is forbidden, the other lane would idle-exit), so drain
+        # it inline on the caller's thread — keeping kernel spans nested
+        # under the caller's phase spans in the Perfetto view, exactly like
+        # the serial loop.  The platform-routed CPU path (everything pinned
+        # host) takes this branch.
+        pinned_lanes = {job.pinned for job in jobs}
+        if None not in pinned_lanes and len(pinned_lanes) == 1:
+            serial = True
+        if serial:
+            # The NEMO_SCHED=off fallback (and the single-lane case): same
+            # plans, same records, no threads — index order, planned lane.
+            for job in jobs:
+                run_one(job, plans[job.index][0], False)
+            return results  # type: ignore[return-value]
+
+        def worker(lane: str) -> None:
+            while not errors:
+                nxt = take(lane)
+                if nxt is None:
+                    return
+                job, stolen = nxt
+                try:
+                    run_one(job, lane, stolen)
+                except BaseException as ex:  # propagate to the caller
+                    with lock:
+                        errors.append(ex)
+                    return
+
+        with obs.span("analysis:sched", jobs=len(jobs)):
+            threads = [
+                threading.Thread(target=worker, args=(lane,), name=f"nemo-sched-{lane}")
+                for lane in LANES
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        if errors:
+            raise errors[0]
+        missing = [j.index for j in jobs if results[j.index] is None]
+        if missing:  # a lane died mid-drain without recording an exception
+            raise RuntimeError(f"scheduler dropped jobs {missing}")
+        return results  # type: ignore[return-value]

@@ -348,7 +348,13 @@ class RemoteExecutor:
     #: both configure grpc.max_*_message_length = 1 GiB, service/server.py).
     MAX_MESSAGE_BYTES = 1 << 30
 
-    def run(self, verb: str, arrays: dict, params: dict) -> dict[str, np.ndarray]:
+    def run(
+        self, verb: str, arrays: dict, params: dict, rows: int | None = None
+    ) -> dict[str, np.ndarray]:
+        # `rows` (the caller's real-run count, see LocalExecutor.run) is a
+        # metrics/cost hint the wire protocol does not carry; the sidecar's
+        # LocalExecutor falls back to the dispatched width, the documented
+        # older-client behavior.
         # A single Kernel RPC ships the whole batch in one message each way;
         # bool planes bit-pack 8x on the wire (service/codec.py).  Fail
         # BEFORE serialization with the remedy, not deep inside grpc with

@@ -663,6 +663,101 @@ def _delta_smoke_inner() -> int:
         return 0
 
 
+def shard_smoke() -> int:
+    """Mesh-sharding + scheduler smoke (`make shard-smoke`, also a `make
+    validate` step; ISSUE 7): on the 8-virtual-CPU-device platform, the
+    mesh-sharded + scheduler-drained fused path must produce a report tree
+    byte-identical to the single-device serial oracle (figures included),
+    with kernel dispatches actually landing on >1 device and the
+    analysis.sched.* decision series present.
+
+    Runs under XLA_FLAGS=--xla_force_host_platform_device_count=8 (the
+    Makefile target sets it); anything under 2 visible devices means the
+    flag did not take and the smoke fails loudly rather than vacuously
+    passing on one device."""
+    import jax
+
+    from nemo_tpu import obs
+    from nemo_tpu.analysis.pipeline import run_debug
+    from nemo_tpu.backend.jax_backend import JaxBackend
+    from nemo_tpu.models.synth import SynthSpec, write_corpus
+    from nemo_tpu.utils.jax_config import pin_platform
+
+    pin_platform("cpu")
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        print(
+            f"shard-smoke: only {n_dev} device(s) visible — "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8 did not take",
+            file=sys.stderr,
+        )
+        return 1
+
+    problems: list[str] = []
+    with tempfile.TemporaryDirectory(prefix="nemo_shard_smoke_") as tmp:
+        os.environ["NEMO_SVG_CACHE"] = os.path.join(tmp, "svg_cache")
+        os.environ["NEMO_CORPUS_CACHE"] = "off"
+        os.environ["NEMO_RESULT_CACHE"] = "off"
+        # The dense route forced: the smoke is about the DEVICE lane (the
+        # CPU platform's auto route would send every bucket to the sparse
+        # host engine and the mesh would never engage); NEMO_MAX_BATCH=3
+        # forces a bucket width that does not divide the mesh, so the
+        # shard-multiple padding path is exercised too.
+        os.environ["NEMO_ANALYSIS_IMPL"] = "dense"
+        os.environ["NEMO_MAX_BATCH"] = "3"
+        os.environ["NEMO_SCHED"] = "on"
+        corpus = write_corpus(SynthSpec(n_runs=6, seed=3), tmp)
+
+        os.environ["NEMO_SHARD"] = "0"
+        oracle = run_debug(
+            corpus, os.path.join(tmp, "oracle"), JaxBackend(), figures="all"
+        )
+        want = _tree(oracle.report_dir)
+
+        os.environ["NEMO_SHARD"] = "1"
+        m0 = obs.metrics.snapshot()
+        sharded = run_debug(
+            corpus, os.path.join(tmp, "sharded"), JaxBackend(), figures="all"
+        )
+        snap = obs.metrics.snapshot()
+        mc = obs.Metrics.delta(snap, m0)["counters"]
+        got = _tree(sharded.report_dir)
+
+        if want.keys() != got.keys():
+            problems.append(
+                f"report file sets diverge: {sorted(want.keys() ^ got.keys())[:10]}"
+            )
+        else:
+            bad = sorted(k for k in want if want[k] != got[k])
+            if bad:
+                problems.append(
+                    f"sharded report diverges in {len(bad)} file(s), e.g. {bad[:5]}"
+                )
+        if not mc.get("kernel.sharded_dispatches"):
+            problems.append("no dispatch took the mesh-sharded path")
+        devices_used = snap["gauges"].get("analysis.shard.devices", 0)
+        if devices_used < 2:
+            problems.append(
+                f"mesh spanned {devices_used} device(s); need >1 to call it sharded"
+            )
+        sched_series = [k for k in mc if k.startswith("analysis.sched.")]
+        if not any(k.startswith("analysis.sched.dispatch.") for k in sched_series):
+            problems.append(
+                f"no analysis.sched.* dispatch series recorded: {sched_series}"
+            )
+
+    if problems:
+        print("shard-smoke: " + "; ".join(problems), file=sys.stderr)
+        return 1
+    print(
+        f"shard-smoke: ok — {int(devices_used)}-device mesh report "
+        f"byte-identical to the single-device oracle ({len(want)} files), "
+        f"{int(mc.get('kernel.sharded_dispatches', 0))} sharded dispatch(es), "
+        f"scheduler series {sorted(sched_series)}"
+    )
+    return 0
+
+
 def main() -> int:
     from nemo_tpu.analysis.pipeline import run_debug
     from nemo_tpu.backend.jax_backend import JaxBackend
@@ -843,4 +938,6 @@ if __name__ == "__main__":
         sys.exit(store_smoke())
     if "--delta-smoke" in sys.argv:
         sys.exit(delta_smoke())
+    if "--shard-smoke" in sys.argv:
+        sys.exit(shard_smoke())
     sys.exit(main())

@@ -26,7 +26,7 @@ class SpyExecutor:
     def __init__(self):
         self.sigs = []
 
-    def run(self, verb, arrays, params):
+    def run(self, verb, arrays, params, rows=None):
         shapes = tuple(sorted((k, tuple(np.asarray(v).shape)) for k, v in arrays.items()))
         self.sigs.append((verb, tuple(sorted(params.items())), shapes))
         b, v = np.asarray(arrays["pre_is_goal"]).shape

@@ -61,13 +61,13 @@ def test_small_job_routes_to_host(corpus, monkeypatch):
             self.inner = None
             self.verbs = []
 
-        def run(self, verb, arrays, params):
+        def run(self, verb, arrays, params, rows=None):
             self.verbs.append(verb)
             from nemo_tpu.backend.jax_backend import LocalExecutor
 
             if self.inner is None:
                 self.inner = LocalExecutor()
-            return self.inner.run(verb, arrays, params)
+            return self.inner.run(verb, arrays, params, rows=rows)
 
     ex = NoDiffExecutor()
     b = JaxBackend(executor=ex)

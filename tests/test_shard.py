@@ -1,0 +1,329 @@
+"""Run-axis mesh sharding + heterogeneous scheduler (ISSUE 7).
+
+Parity: the mesh-sharded fused dispatch must be byte-identical to the
+single-device path — at the executor boundary (per-output array equality,
+including the pack_out folding and a batch that does NOT divide by the mesh
+so the shard-multiple padding engages) and at the report-tree level
+(run_debug output trees compared file by file across 1/2/8-device meshes).
+
+Scheduling: parallel/sched.py unit-tested without jax — forced lanes stay
+pinned, cost-model preferences follow the seeded crossover, a mispredicted
+bucket corrects the model (feedback), and an idle lane steals only unpinned
+work.  The suite runs on the 8-virtual-CPU-device platform conftest pins.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from nemo_tpu import obs
+from nemo_tpu.parallel import sched as sched_mod
+from nemo_tpu.parallel.mesh import shard_plan
+
+# ---------------------------------------------------------------------------
+# executor-level parity
+# ---------------------------------------------------------------------------
+
+
+def _fused_call(n_runs: int, pack_out: int):
+    from nemo_tpu.backend.jax_backend import _BA_FIELDS
+    from nemo_tpu.models.pipeline_model import synth_batch_arrays
+
+    pre, post, static = synth_batch_arrays(n_runs=n_runs, seed=2)
+    arrays = {
+        f"{prefix}_{f}": np.asarray(getattr(b, f))
+        for prefix, b in (("pre", pre), ("post", post))
+        for f in _BA_FIELDS
+    }
+    params = dict(static, with_diff=1, comp_linear=0, pack_out=pack_out)
+    return arrays, params
+
+
+@pytest.mark.parametrize("pack_out", [0, 1])
+def test_sharded_executor_parity_nondivisible(pack_out, monkeypatch):
+    """A 6-row batch on a 4-device mesh (pads to 8) returns arrays equal to
+    the single-device dispatch, at the dispatched width (padding shed)."""
+    from nemo_tpu.backend.jax_backend import LocalExecutor
+
+    arrays, params = _fused_call(6, pack_out)
+    ex = LocalExecutor()
+
+    monkeypatch.setenv("NEMO_SHARD", "0")
+    base = ex.run("fused", dict(arrays), dict(params))
+
+    monkeypatch.setenv("NEMO_SHARD", "1")
+    monkeypatch.setenv("NEMO_SHARD_DEVICES", "4")
+    before = obs.metrics.snapshot()["counters"].get("kernel.sharded_dispatches", 0)
+    sharded = ex.run("fused", dict(arrays), dict(params))
+    after = obs.metrics.snapshot()["counters"].get("kernel.sharded_dispatches", 0)
+    assert after == before + 1, "the mesh placement path did not engage"
+
+    assert sorted(sharded) == sorted(base)
+    b = arrays["pre_is_goal"].shape[0]
+    for name, want in base.items():
+        got = sharded[name]
+        if name not in ("proto_inter", "proto_union"):
+            assert np.shape(got)[0] == b, f"{name}: padding rows not shed"
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(want), err_msg=f"shard parity: {name}"
+        )
+
+
+def test_shard_plan_knobs(monkeypatch):
+    monkeypatch.setenv("NEMO_SHARD", "0")
+    assert shard_plan() == (False, 1)
+    monkeypatch.setenv("NEMO_SHARD", "auto")
+    monkeypatch.setenv("NEMO_SHARD_DEVICES", "1")
+    assert shard_plan() == (False, 1)  # capped to one device: nothing to shard
+    monkeypatch.setenv("NEMO_SHARD_DEVICES", "4")
+    assert shard_plan() == (True, 4)
+    monkeypatch.setenv("NEMO_SHARD", "1")
+    monkeypatch.setenv("NEMO_SHARD_DEVICES", "1")
+    assert shard_plan() == (True, 1)  # forced: mesh path stays dispatchable
+    monkeypatch.setenv("NEMO_SHARD", "junk")
+    with pytest.raises(ValueError):
+        shard_plan()
+    monkeypatch.setenv("NEMO_SHARD", "auto")
+    monkeypatch.setenv("NEMO_SHARD_DEVICES", "zero")
+    with pytest.raises(ValueError):
+        shard_plan()
+
+
+def test_padding_rows_excluded_from_cost_accounting(monkeypatch):
+    """The rows hint keeps shard/bucket padding out of kernel.batch_rows
+    and scales the cumulative flops/bytes counters (ISSUE 7 satellite)."""
+    from nemo_tpu.backend import jax_backend as jb
+
+    arrays, params = _fused_call(6, 0)
+    ex = jb.LocalExecutor()
+    monkeypatch.setenv("NEMO_SHARD", "1")
+    monkeypatch.setenv("NEMO_SHARD_DEVICES", "4")
+    ex.run("fused", dict(arrays), dict(params), rows=5)
+    recs = [
+        r
+        for r in jb.kernel_cost_snapshot()
+        if r["verb"] == "fused" and r.get("pad_rows", 0) > 0
+    ]
+    assert recs, "no fused cost record carries pad_rows"
+    # 6 real-row batch, 5-row hint, padded to the 4-device multiple of 8:
+    # the record of THIS dispatch carries 3 padding rows.  (The cost table
+    # is process-global and signatures are shared across tests, so assert
+    # membership, not position.)
+    assert 8 - 5 in {r["pad_rows"] for r in recs}
+
+
+# ---------------------------------------------------------------------------
+# report-tree parity across mesh widths
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n_dev", [1, 2, 8])
+def test_report_tree_parity_across_mesh_widths(n_dev, corpus_dir, tmp_path, monkeypatch):
+    """run_debug's report tree on an n-device mesh is byte-identical to the
+    single-device oracle — the dense route forced so the device lane (and
+    with it the mesh) actually executes, and NEMO_MAX_BATCH pinned to a
+    bucket width that does NOT divide the mesh, forcing the shard pad."""
+    from nemo_tpu.analysis.pipeline import report_tree_bytes, run_debug
+    from nemo_tpu.backend.jax_backend import JaxBackend
+
+    monkeypatch.setenv("NEMO_ANALYSIS_IMPL", "dense")
+    monkeypatch.setenv("NEMO_MAX_BATCH", "3")
+
+    monkeypatch.setenv("NEMO_SHARD", "0")
+    oracle = run_debug(corpus_dir, str(tmp_path / "oracle"), JaxBackend(), figures="all")
+    want = report_tree_bytes(oracle.report_dir)
+
+    monkeypatch.setenv("NEMO_SHARD", "1")
+    monkeypatch.setenv("NEMO_SHARD_DEVICES", str(n_dev))
+    got_res = run_debug(
+        corpus_dir, str(tmp_path / f"mesh{n_dev}"), JaxBackend(), figures="all"
+    )
+    got = report_tree_bytes(got_res.report_dir)
+    assert sorted(got) == sorted(want)
+    diff = [k for k in want if got[k] != want[k]]
+    assert not diff, f"sharded report tree diverges at {diff[:5]}"
+
+
+def test_crossover_impl_unpins_platform(corpus_dir, tmp_path, monkeypatch):
+    """NEMO_ANALYSIS_IMPL=crossover drops the CPU platform pin: routing is
+    per-bucket (budget / scheduler cost model — both lanes reachable on a
+    host-only box), and the report stays byte-identical to plain auto."""
+    from nemo_tpu.analysis.pipeline import report_tree_bytes, run_debug
+    from nemo_tpu.backend.jax_backend import JaxBackend
+
+    monkeypatch.setenv("NEMO_ANALYSIS_IMPL", "auto")
+    auto = run_debug(corpus_dir, str(tmp_path / "auto"), JaxBackend(), figures="none")
+    monkeypatch.setenv("NEMO_ANALYSIS_IMPL", "crossover")
+    be = JaxBackend()
+    x = run_debug(corpus_dir, str(tmp_path / "crossover"), be, figures="none")
+    assert report_tree_bytes(x.report_dir) == report_tree_bytes(auto.report_dir)
+    fused = [r for r in be.analysis_routes if r["verb"] == "fused"]
+    assert fused and all(
+        r["reason"] in ("crossover", "sched", "steal") for r in fused
+    ), be.analysis_routes
+
+
+# ---------------------------------------------------------------------------
+# scheduler units (no jax)
+# ---------------------------------------------------------------------------
+
+
+def _job(index, rows=4, v=16, e=16, pinned=None, reason="sched", body=None, log=None):
+    def execute(lane, rec_reason, stolen):
+        if log is not None:
+            log.append((index, lane, rec_reason, stolen))
+        if body is not None:
+            body(lane)
+        return {"index": index, "lane": lane}
+
+    return sched_mod.Job(
+        index=index,
+        verb="fused",
+        rows=rows,
+        v=v,
+        e=e,
+        work=rows * (v + e),
+        execute=execute,
+        pinned=pinned,
+        reason=reason,
+    )
+
+
+def _models(host_unit=1e-6, device_fixed=0.1, device_unit=5e-8):
+    return {
+        "device": sched_mod.LaneModel(device_fixed, device_unit),
+        "host": sched_mod.LaneModel(0.0, host_unit),
+    }
+
+
+def test_plan_reproduces_crossover_when_unmeasured():
+    s = sched_mod.HeterogeneousScheduler(_models())
+    small = _job(0, rows=10, v=50, e=50)  # work 1000 << 100k budget
+    big = _job(1, rows=4000, v=64, e=256)  # work 1.28M >> budget
+    assert s.plan(small)[0] == "host"
+    assert s.plan(big)[0] == "device"
+
+
+def test_forced_lane_stays_pinned():
+    s = sched_mod.HeterogeneousScheduler(_models())
+    j = _job(0, rows=10, pinned="device", reason="forced")
+    lane, reason, _ = s.plan(j)
+    assert (lane, reason) == ("device", "forced")
+    log = []
+    jobs = [
+        _job(0, pinned="device", reason="forced", log=log),
+        _job(1, pinned="host", reason="platform", log=log),
+    ]
+    res = sched_mod.HeterogeneousScheduler(_models()).run(jobs)
+    assert [r["index"] for r in res] == [0, 1]
+    lanes = {i: lane for i, lane, _, _ in log}
+    assert lanes == {0: "device", 1: "host"}
+    assert all(not stolen for _, _, _, stolen in log)
+
+
+def test_feedback_corrects_misprediction():
+    """A bucket the model sent to the device lane measures slow; the next
+    identical bucket routes to the host — the session-feedback loop."""
+    models = _models(device_fixed=0.0, device_unit=1e-9)  # device looks free
+    s = sched_mod.HeterogeneousScheduler(models)
+    j = _job(0, rows=100, v=64, e=64)
+    assert s.plan(j)[0] == "device"
+    models["device"].observe(j, wall_s=5.0)  # measured: catastrophically slow
+    assert s.plan(_job(1, rows=100, v=64, e=64))[0] == "host"
+    # ... and a lane model never goes below its fixed cost.
+    assert models["device"].predict(j) >= 0.0
+
+
+def test_idle_lane_steals_unpinned_work():
+    log = []
+    slow = lambda lane: time.sleep(0.2)
+    jobs = [
+        _job(0, rows=10, body=slow, log=log),  # host-planned (small work)
+        _job(1, rows=10, body=slow, log=log),
+        _job(2, rows=10, body=slow, log=log),
+    ]
+    s = sched_mod.HeterogeneousScheduler(_models())
+    res = s.run(jobs)
+    assert [r["index"] for r in res] == [0, 1, 2]
+    assert s.steals["device"] >= 1, f"idle device lane never stole: {log}"
+    stolen = [rec for rec in log if rec[3]]
+    assert all(rec[2] == "steal" for rec in stolen)
+
+
+def test_pinned_jobs_never_stolen():
+    log = []
+    slow = lambda lane: time.sleep(0.05)
+    jobs = [
+        _job(i, rows=10, pinned="host", reason="platform", body=slow, log=log)
+        for i in range(3)
+    ]
+    s = sched_mod.HeterogeneousScheduler(_models())
+    s.run(jobs)
+    assert s.steals == {"device": 0, "host": 0}
+    assert all(lane == "host" for _, lane, _, _ in log)
+
+
+def test_serial_mode_matches_plans():
+    log = []
+    jobs = [_job(0, rows=10, log=log), _job(1, rows=5000, v=64, e=256, log=log)]
+    s = sched_mod.HeterogeneousScheduler(_models())
+    res = s.run(jobs, serial=True)
+    assert [r["index"] for r in res] == [0, 1]
+    assert log == [(0, "host", "sched", False), (1, "device", "sched", False)]
+
+
+def test_worker_exception_propagates():
+    def boom(lane):
+        raise RuntimeError("lane exploded")
+
+    jobs = [_job(0, body=boom)]
+    with pytest.raises(RuntimeError, match="lane exploded"):
+        sched_mod.HeterogeneousScheduler(_models()).run(jobs)
+
+
+def test_sched_env_parse(monkeypatch):
+    monkeypatch.setenv("NEMO_SCHED", "auto")
+    assert sched_mod.sched_env() == "auto"
+    monkeypatch.setenv("NEMO_SCHED", "0")
+    assert sched_mod.sched_env() == "off"
+    monkeypatch.setenv("NEMO_SCHED", "on")
+    assert sched_mod.sched_env() == "on"
+    monkeypatch.setenv("NEMO_SCHED", "bogus")
+    with pytest.raises(ValueError):
+        sched_mod.sched_env()
+
+
+def test_records_and_snapshot():
+    sched_mod.reset_session_models()
+    s = sched_mod.HeterogeneousScheduler(_models())
+    s.run([_job(0), _job(1, pinned="host", reason="platform")])
+    snap = sched_mod.sched_snapshot()
+    assert len(snap) >= 2
+    for rec in snap[-2:]:
+        assert {"lane", "reason", "stolen", "predicted_s", "wall_s"} <= set(rec)
+
+
+# ---------------------------------------------------------------------------
+# scheduler x backend integration: forced routes survive the drain
+# ---------------------------------------------------------------------------
+
+
+def test_scheduler_preserves_forced_route_records(corpus_dir, tmp_path, monkeypatch):
+    """NEMO_SCHED=on (threads even for one job) + a forced route: every
+    fused route record keeps route=forced exactly as the serial loop."""
+    from nemo_tpu.analysis.pipeline import run_debug
+    from nemo_tpu.backend.jax_backend import JaxBackend
+
+    monkeypatch.setenv("NEMO_ANALYSIS_IMPL", "sparse")
+    monkeypatch.setenv("NEMO_SCHED", "on")
+    be = JaxBackend()
+    run_debug(corpus_dir, str(tmp_path / "sched_on"), be, figures="none")
+    fused = [r for r in be.analysis_routes if r["verb"] == "fused"]
+    assert fused and all(
+        (r["route"], r["reason"]) == ("sparse", "forced") for r in fused
+    )
+    counters = obs.metrics.snapshot()["counters"]
+    assert counters.get("analysis.sched.dispatch.host", 0) >= len(fused)

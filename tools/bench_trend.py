@@ -111,6 +111,43 @@ def extract_metrics(doc: dict) -> dict[str, tuple[float, str, str]]:
     put("delta_tier.delta_speedup", dtier.get("delta_speedup"), "higher", "ratio")
     put("delta_tier.grown_fraction", dtier.get("grown_fraction"), "lower", "ratio")
     put("delta_tier.cache_mb", dtier.get("cache_mb"), "lower", "mb_cache")
+    # Shard tier (ISSUE 7): mesh-scaling regressions — a width's analysis
+    # wall creeping up, scaling efficiency collapsing, the per-bucket
+    # gather wall growing, or the scheduler's steal behavior flipping.
+    # Walls get the "s_fast" floor: the whole point of an 8-way mesh is
+    # being far under the seconds-scale noise floor, so sub-noise walls
+    # can't flag, but a real 2x regression of a 0.5 s analysis can.
+    shard = doc.get("shard_tier") or {}
+    for w, row in sorted((shard.get("widths") or {}).items()):
+        if isinstance(row, dict):
+            put(f"shard_tier.w{w}.analysis_s", row.get("analysis_s"), "lower", "s_fast")
+            put(f"shard_tier.w{w}.gather_s", row.get("gather_s"), "lower", "s_fast")
+    put("shard_tier.speedup_widest", shard.get("speedup_widest"), "higher", "ratio")
+    put(
+        "shard_tier.scaling_efficiency_widest",
+        shard.get("scaling_efficiency_widest"),
+        "higher",
+        "ratio",
+    )
+    ssched = shard.get("sched") or {}
+    put("shard_tier.sched.analysis_s", ssched.get("analysis_s"), "lower", "s_fast")
+    # Steal fraction comes from the CROSSOVER row (platform pin dropped —
+    # the only row where both lanes and stealing can actually move; the
+    # production-auto row's fraction is structurally 0 on a CPU child).
+    # A steal-rate flip in EITHER direction is a scheduling change worth
+    # eyes (the route-split precedent): absolute-shift compare.
+    sx = shard.get("sched_crossover") or {}
+    put("shard_tier.sched_crossover.analysis_s", sx.get("analysis_s"), "lower", "s_fast")
+    if isinstance(sx.get("jobs"), (int, float)) and sx.get("jobs"):
+        steals = float(sx.get("steal_device", 0) or 0) + float(
+            sx.get("steal_host", 0) or 0
+        )
+        put(
+            "shard_tier.sched_crossover.steal_fraction",
+            steals / sx["jobs"],
+            "split",
+            "ratio",
+        )
     figures = doc.get("figures") or {}
     put(
         "figures.e2e_warm_all_figures_s",

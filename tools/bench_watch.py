@@ -146,6 +146,42 @@ def main() -> int:
     if proc.returncode != 0 or "error" in summary:
         return 1
 
+    # MULTICHIP refresh (ISSUE 7): when the healthy window exposes a real
+    # multi-device mesh, measure REAL mesh scaling on it — the shard tier's
+    # measurement child pointed at the device platform instead of virtual
+    # CPU devices — and save it as MULTICHIP.json next to the BENCH capture.
+    # Best effort: a scaling capture must never fail the bench capture.
+    if int(healthy.get("n", 1)) > 1:
+        mc_path = os.path.join(out_dir, "MULTICHIP.json")
+        plog(f"multi-device window ({healthy['n']} chips): capturing mesh scaling")
+        mc_env = dict(os.environ)
+        # Leave the platform selection alone — the tunnel device is only
+        # reachable through the default selection (bench.py child notes).
+        mc_env["NEMO_BENCH_SHARD_PLATFORM"] = "auto"
+        try:
+            mc_proc = subprocess.run(
+                [sys.executable, os.path.join(REPO_ROOT, "bench.py"), "--shard-child"],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+                timeout=1800,
+                env=mc_env,
+                cwd=REPO_ROOT,
+            )
+            mc_lines = (mc_proc.stdout or "").strip().splitlines()
+            json_line = next(
+                (ln for ln in reversed(mc_lines) if ln.startswith("{")), None
+            )
+            with open(mc_path, "w", encoding="utf-8") as fh:
+                if json_line:
+                    fh.write(json_line + "\n")
+                else:
+                    json.dump({"rc": mc_proc.returncode, "ok": False,
+                               "tail": "\n".join(mc_lines[-5:])}, fh)
+            plog(f"mesh scaling capture (rc={mc_proc.returncode}) -> {mc_path}")
+        except Exception as ex:
+            plog(f"mesh scaling capture skipped: {type(ex).__name__}: {ex}")
+
     # Regression sentinel: append this capture to the trailing history and
     # compare against the per-metric medians; a flagged regression turns
     # the watcher's exit code to 2 so the cron/tmux wrapper can page.
