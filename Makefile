@@ -5,7 +5,7 @@ NATIVE_LIB := native/build/libnemo_native.so
 REPORT_SRC := native/nemo_report.cpp
 REPORT_LIB := native/build/libnemo_report.so
 
-.PHONY: all native test bench bench-watch bench-trend prewarm validate trace-smoke obs-smoke store-smoke delta-smoke shard-smoke lint-print clean reset proto neo4j-up neo4j-validate neo4j-down
+.PHONY: all native test bench bench-watch bench-trend prewarm validate trace-smoke obs-smoke store-smoke delta-smoke shard-smoke serve-smoke lint-print clean reset proto neo4j-up neo4j-validate neo4j-down
 
 all: native
 
@@ -72,6 +72,15 @@ store-smoke:
 # from-scratch run (analysis/delta.py, nemo_tpu/store/rcache.py).
 delta-smoke:
 	python -m nemo_tpu.utils.validate_smoke --delta-smoke
+
+# Serving-tier smoke (also the tail of `make validate`; ISSUE 8): boot a
+# --max-inflight 2 sidecar subprocess, fire 6 concurrent clients (3
+# identical), assert single-flight coalescing served the identical trio
+# with EXACTLY ONE underlying analysis and byte-equal responses, serve.*
+# series live on /metrics, and a clean SIGTERM drain (in-flight request
+# completes, /healthz NOT_SERVING, exit 0) — nemo_tpu/serve.
+serve-smoke:
+	python -m nemo_tpu.utils.validate_smoke --serve-smoke
 
 # Structured-logging contract: no bare print() in nemo_tpu/ outside the
 # CLI/harness allowlist (tools/lint_no_print.py).

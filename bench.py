@@ -492,6 +492,152 @@ def child_main() -> None:
     except Exception as ex:  # the shard tier must never sink the bench
         log(f"shard tier skipped: {type(ex).__name__}: {ex}")
 
+    # Serve tier (ISSUE 8): the multi-tenant serving path under real
+    # concurrency — M concurrent synthetic clients (mixed identical and
+    # distinct AnalyzeDir requests) against a SIDECAR SUBPROCESS with the
+    # admission controller, single-flight coalescing, and streaming in
+    # play.  Reports p50/p99 request latency, sustained throughput, the
+    # coalesce ratio (identical concurrent requests deduped into one
+    # analysis), and the reject count — all of which must hold at M >= 16
+    # without a failed request (the acceptance bar).  The sidecar runs
+    # with the result cache OFF so the dedup measured is attributable to
+    # COALESCING, and a dedicated corpus-cache root keeps ingest warm
+    # across rounds without touching the e2e tiers' store.
+    serve_tier = None
+    try:
+        import importlib.util as _ilu
+        import signal as _signal
+        import threading as _threading
+
+        if _ilu.find_spec("grpc") is None:
+            raise RuntimeError("grpcio not installed")
+        from nemo_tpu.models.synth import SynthSpec as _SSpec
+        from nemo_tpu.models.synth import write_corpus as _swrite
+        from nemo_tpu.service.client import RemoteAnalyzer as _RA
+        from nemo_tpu.utils.subproc import free_port as _free_port
+        from nemo_tpu.utils.subproc import wait_listening as _wait_listening
+
+        m_clients = int(os.environ.get("NEMO_BENCH_SERVE_CLIENTS", "16"))
+        rounds = int(os.environ.get("NEMO_BENCH_SERVE_ROUNDS", "3"))
+        serve_tmp = os.path.join(tmp, "serve_tier")
+        os.makedirs(serve_tmp, exist_ok=True)
+        shared_dir = _swrite(_SSpec(n_runs=6, seed=91, name="serve_shared"), serve_tmp)
+        n_distinct = max(1, m_clients // 2)
+        distinct_dirs = [
+            _swrite(_SSpec(n_runs=6, seed=92 + i, name=f"serve_d{i}"), serve_tmp)
+            for i in range(n_distinct)
+        ]
+
+        sport = _free_port()
+        senv = dict(
+            os.environ,
+            NEMO_CORPUS_CACHE=os.path.join(serve_tmp, "cc"),
+            NEMO_RESULT_CACHE="off",
+            # A small pinned linger keeps the measured coalesce ratio
+            # stable across default changes: with rc off, stragglers that
+            # clear admission just after their round's leader finished
+            # still dedup.
+            NEMO_SERVE_COALESCE_LINGER_S="2",
+        )
+        sidecar_log = os.path.join(serve_tmp, "sidecar.stderr")
+        sidecar_log_fh = open(sidecar_log, "w")
+        sproc = subprocess.Popen(
+            [sys.executable, "-m", "nemo_tpu.service.server",
+             "--port", str(sport), "--platform", platform if platform else "cpu"],
+            stdout=sidecar_log_fh,
+            stderr=subprocess.STDOUT,
+            env=senv,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        try:
+            target = f"127.0.0.1:{sport}"
+            # Wait for the LISTENING SOCKET before creating any channel:
+            # this environment's grpc wedges channels whose first connect
+            # raced the bind (utils/subproc.py).
+            try:
+                _wait_listening(sport, deadline_s=180.0, proc=sproc)
+            except Exception:
+                if os.path.exists(sidecar_log):
+                    with open(sidecar_log, "r", encoding="utf-8") as fh:
+                        log("serve tier sidecar log tail:\n" + fh.read()[-2000:])
+                raise
+            with _RA(target=target) as probe:
+                probe.wait_ready(120.0)
+                # One warm-up request compiles the (shared) program shape so
+                # the measured rounds see serving costs, not one-off jit.
+                probe.analyze_dir_remote(shared_dir)
+
+            latencies: list[float] = []
+            failures: list[str] = []
+            lat_lock = _threading.Lock()
+
+            def serve_client(idx: int, barrier) -> None:
+                # Even client indices hammer the SHARED corpus (the
+                # coalescing population); odd ones get distinct corpora.
+                d = shared_dir if idx % 2 == 0 else distinct_dirs[(idx // 2) % n_distinct]
+                try:
+                    with _RA(target=target, tenant=f"bench{idx % 4}") as c:
+                        for _ in range(rounds):
+                            barrier.wait(timeout=120)
+                            t0 = time.perf_counter()
+                            c.analyze_dir_remote(d)
+                            dt = time.perf_counter() - t0
+                            with lat_lock:
+                                latencies.append(dt)
+                except Exception as ex:
+                    with lat_lock:
+                        failures.append(f"client {idx}: {type(ex).__name__}: {ex}")
+
+            barrier = _threading.Barrier(m_clients)
+            t_wall0 = time.perf_counter()
+            threads = [
+                _threading.Thread(target=serve_client, args=(i, barrier))
+                for i in range(m_clients)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=600)
+            wall = time.perf_counter() - t_wall0
+            if failures:
+                raise RuntimeError("; ".join(failures[:3]))
+            n_requests = m_clients * rounds
+            if len(latencies) != n_requests:
+                raise RuntimeError(
+                    f"only {len(latencies)}/{n_requests} requests completed"
+                )
+            with _RA(target=target) as c:
+                counters = c.health().get("metrics", {}).get("counters", {})
+            coalesce_hits = int(counters.get("serve.coalesce.hit", 0))
+            serve_tier = {
+                "clients": m_clients,
+                "rounds": rounds,
+                "requests": n_requests,
+                "p50_s": round(float(np.percentile(latencies, 50)), 4),
+                "p99_s": round(float(np.percentile(latencies, 99)), 4),
+                "throughput_rps": round(n_requests / wall, 2),
+                "analyses": int(counters.get("serve.analyze_chunks", 0)),
+                "coalesce_hits": coalesce_hits,
+                "coalesce_ratio": round(coalesce_hits / n_requests, 3),
+                "rejects": int(counters.get("serve.rejected", 0)),
+                "throttled_retries": int(
+                    obs.metrics.snapshot()["counters"].get("rpc.throttled", 0)
+                ),
+                "failed": 0,
+            }
+            log(f"serve tier ({m_clients} concurrent clients): {json.dumps(serve_tier)}")
+        finally:
+            if sproc.poll() is None:
+                sproc.send_signal(_signal.SIGTERM)
+                try:
+                    sproc.wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    sproc.kill()
+                    sproc.wait(timeout=15)
+            sidecar_log_fh.close()
+    except Exception as ex:  # the serve tier must never sink the bench
+        log(f"serve tier skipped: {type(ex).__name__}: {ex}")
+
     # Warm up (one compile per family's shape signature), then time the full
     # sweep end to end.  Every timed dispatch gets DISTINCT input bytes (a
     # poke in a masked padding slot — results unchanged): the device tunnel
@@ -1207,6 +1353,7 @@ def child_main() -> None:
         "ingest_tier": ingest_tier,
         "delta_tier": delta_tier,
         "shard_tier": shard_tier,
+        "serve_tier": serve_tier,
         "stress_10x": stress_10x,
         # Whole-process obs registry at bench end: the scattered per-layer
         # counters (kernel dispatch/compile split, upload bytes, render

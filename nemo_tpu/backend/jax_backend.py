@@ -558,6 +558,21 @@ class LocalExecutor:
             rows_real = min(int(rows), b_in) if rows is not None else b_in
             obs.metrics.observe("kernel.batch_rows", rows_real)
             span_attrs["rows"] = rows_real
+        elif rows is not None and verb in ("condition", "simplify", "proto"):
+            # Serve-tier merged dispatches (nemo_tpu/serve/batch.py) pad
+            # the run axis to a stable bucket and attest the REAL merged
+            # row count here, so the cost accounting scales by rows_frac
+            # exactly like the shard-padded fused dispatches (ISSUE 7
+            # satellite 2).  No kernel.batch_rows observation: that
+            # histogram is the fused/giant batch-width signal, and these
+            # verbs also dispatch per-graph where is_goal is a node
+            # vector — the explicit rows hint is the only trustworthy
+            # batch attestation, and it feeds the cost table, not the
+            # width histogram.
+            b_in = int(np.shape(arrays["is_goal"])[0]) if arrays.get("is_goal") is not None else None
+            if b_in is not None:
+                rows_real = min(int(rows), b_in)
+                span_attrs["rows"] = rows_real
         obs.metrics.inc(f"kernel.dispatches.{verb}")
         obs.metrics.inc("kernel.upload_bytes", upload)
         # Run-axis mesh sharding (ISSUE 7 tentpole): under NEMO_SHARD the
@@ -845,25 +860,36 @@ def _max_batch_env():
     service backends so the semantics can never diverge): _NO_OVERRIDE
     when unset, None for 0 (unbounded), else a positive bound.
 
-    Deliberately LOUD on junk, unlike the warn-and-default boolean knobs
-    (NEMO_PACK_XFER / NEMO_NARROW_XFER, ADVICE r5 #4): those toggle
-    between two correct, measured configurations, so any junk spelling
-    safely degrades to the platform default.  A batch bound has no such
-    safe reading — a typo ("20 48", "2O48") silently falling back to the
-    platform default would change dispatch granularity, program count,
-    and peak memory in exactly the dimension the operator was explicitly
-    trying to control, so it raises at init_graph_db instead."""
+    Junk spellings WARN and fall back to the platform default — the same
+    policy as the transfer knobs (NEMO_PACK_XFER / NEMO_NARROW_XFER).
+    ADVICE r5 #4 originally kept this knob loud (a typo'd bound silently
+    becoming the platform default changes dispatch granularity, program
+    count, and peak memory in exactly the dimension the operator pinned),
+    and on a one-shot CLI run a crash at init_graph_db was the right
+    tripwire.  ISSUE 8 changed the calculus: the same env now reaches a
+    long-lived multi-tenant sidecar, where raising per dispatch turns one
+    typo into a crash loop that takes EVERY tenant's traffic down —
+    strictly worse than serving correct results at the measured platform
+    default under a warning that still names the junk value."""
     env = os.environ.get("NEMO_MAX_BATCH", "").strip()
     if not env:
         return _NO_OVERRIDE
     try:
         n = int(env)
     except ValueError:
-        raise ValueError(
-            f"NEMO_MAX_BATCH={env!r} is not an integer (0 = unbounded)"
-        ) from None
+        warnings.warn(
+            f"NEMO_MAX_BATCH={env!r} is not an integer (0 = unbounded); "
+            "using the platform default",
+            stacklevel=2,
+        )
+        return _NO_OVERRIDE
     if n < 0:
-        raise ValueError(f"NEMO_MAX_BATCH={n} must be >= 0 (0 = unbounded)")
+        warnings.warn(
+            f"NEMO_MAX_BATCH={n} must be >= 0 (0 = unbounded); "
+            "using the platform default",
+            stacklevel=2,
+        )
+        return _NO_OVERRIDE
     return None if n == 0 else n
 
 

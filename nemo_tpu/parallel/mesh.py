@@ -143,7 +143,9 @@ def _shard_env() -> str:
 def _shard_devices_cap() -> int | None:
     """Parse + validate NEMO_SHARD_DEVICES (None = no cap).  Loud on junk:
     a typo silently lifting the cap would change the mesh width in exactly
-    the dimension the operator was pinning (the NEMO_MAX_BATCH policy)."""
+    the dimension the operator was pinning (the NEMO_ANALYSIS_IMPL policy;
+    NEMO_MAX_BATCH moved to warn-and-default for the serving tier, but
+    this knob is read at mesh construction, not per admitted request)."""
     cap = os.environ.get("NEMO_SHARD_DEVICES", "").strip()
     if not cap:
         return None

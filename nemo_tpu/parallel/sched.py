@@ -112,6 +112,11 @@ class Job:
     execute: Callable[[str, str, bool], dict]
     pinned: str | None = None
     reason: str = "sched"
+    #: Who submitted the job — "pipeline" for the analysis drain, "serve"
+    #: for the serving tier's cross-request merged kernel launches
+    #: (nemo_tpu/serve/batch.py) — recorded per decision so telemetry can
+    #: split a sidecar's own corpus work from its serving traffic.
+    source: str = "pipeline"
     #: Set True BY the execute callable when the measured wall includes a
     #: one-off cost that must not feed the cost model — a jit compile
     #: (seconds) folded into a warm-execution EWMA (tens of ms) would price
@@ -295,6 +300,7 @@ class HeterogeneousScheduler:
                 "lane": lane,
                 "planned": planned_lane,
                 "reason": reason,
+                "source": job.source,
                 "stolen": stolen,
                 "pinned": job.pinned is not None,
                 "tainted": job.wall_tainted,

@@ -1,0 +1,54 @@
+"""Multi-tenant async serving tier (ISSUE 8).
+
+The gRPC sidecar (service/server.py) was one-request-at-a-time; "millions
+of users" means many concurrent sessions sharing one accelerator.  This
+package is the policy layer the sidecar threads every work RPC through —
+the same shape an LLM inference server puts in front of its model:
+
+  * :mod:`nemo_tpu.serve.admission` — bounded admission queue with
+    per-tenant round-robin fairness, a configurable in-flight cap,
+    RESOURCE_EXHAUSTED + retry-after load shedding, and the graceful-drain
+    flag the SIGTERM handler flips;
+  * :mod:`nemo_tpu.serve.coalesce` — single-flight deduplication of
+    concurrent identical requests, keyed on the result cache's content
+    address (store segment fingerprints + config + ABI versions): N
+    subscribers, ONE analysis, byte-identical responses (the dedup covers
+    the dispatch/serialization; each request's ingest still runs — a
+    milliseconds mmap against a warm corpus store);
+  * :mod:`nemo_tpu.serve.batch` — cross-request continuous batching:
+    compatible kernel dispatches from different in-flight requests merge
+    into one padded device launch through ``parallel/sched.py``'s job
+    queue, with per-request demux and rows-hinted cost accounting.
+
+Streaming (the ``AnalyzeDirStream`` RPC) and the serving metrics
+(``serve.*`` on the Prometheus surface) live in service/server.py, which
+composes these three.  Import cost is tiny (numpy + obs); jax loads only
+when a merged launch executes.
+"""
+
+from __future__ import annotations
+
+from .admission import (
+    AdmissionController,
+    AdmissionRejected,
+    Ticket,
+    controller,
+    reset_controller,
+)
+from .batch import BATCHABLE_VERBS, KernelBatcher, batcher, reset_batcher
+from .coalesce import SingleFlight, flights, reset_flights
+
+__all__ = [
+    "AdmissionController",
+    "AdmissionRejected",
+    "BATCHABLE_VERBS",
+    "KernelBatcher",
+    "SingleFlight",
+    "Ticket",
+    "batcher",
+    "controller",
+    "flights",
+    "reset_batcher",
+    "reset_controller",
+    "reset_flights",
+]

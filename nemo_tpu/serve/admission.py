@@ -1,0 +1,367 @@
+"""Admission control for the multi-tenant serving tier (ISSUE 8).
+
+The sidecar serves many concurrent sessions over one accelerator, so the
+first thing between a request and the device is a policy, not a mutex:
+
+  * **bounded concurrency** — at most ``max_inflight`` requests execute at
+    once (``--max-inflight`` / ``NEMO_SERVE_INFLIGHT``); the device
+    serializes dispatches anyway, and everything past a small in-flight
+    window only adds memory pressure and tail latency;
+  * **bounded queueing** — at most ``max_queue`` requests wait
+    (``--max-queue`` / ``NEMO_SERVE_QUEUE``).  Past that the request is
+    REJECTED with a retry-after estimate instead of queued into a timeout:
+    load shedding at admission is the difference between a slow server and
+    a wedged one;
+  * **per-tenant fairness** — queued tickets are granted round-robin
+    ACROSS tenants (the ``nemo-tenant`` request metadata), so a greedy
+    tenant's burst of N requests cannot starve another tenant's single
+    one: the burst waits its turns, the singleton rides the next rotation;
+  * **graceful drain** — ``begin_drain()`` refuses new admissions (the
+    sidecar's SIGTERM handler flips ``/healthz`` to NOT_SERVING through
+    this flag) while granted requests finish, bounded by
+    ``NEMO_SERVE_DRAIN_S``.
+
+Everything is observable on the PR-4 Prometheus surface:
+``serve.queue_depth`` / ``serve.inflight`` gauges, ``serve.admitted`` /
+``serve.rejected.<reason>`` counters plus per-tenant
+``serve.tenant.<t>.requests|rejected|coalesced`` (bounded by the registry's
+``NEMO_METRICS_MAX_SERIES`` cardinality cap — an adversarial tenant string
+cannot mint unbounded series), and the queued-vs-executing latency split as
+two histograms: ``serve.queued_s`` (admission wait) and ``serve.exec_s``
+(slot-held execution).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+import time
+from collections import deque
+
+from nemo_tpu import obs
+
+_log = obs.log.get_logger("nemo.serve")
+
+#: Tenant strings ride metric names; anything outside this set becomes '_'
+#: and the name is truncated so one tenant = one bounded family of series.
+_TENANT_SAFE = re.compile(r"[^A-Za-z0-9_.-]")
+
+DEFAULT_TENANT = "anon"
+
+
+def sanitize_tenant(tenant: str | None) -> str:
+    if not tenant:
+        return DEFAULT_TENANT
+    return _TENANT_SAFE.sub("_", str(tenant))[:32] or DEFAULT_TENANT
+
+
+def _env_int(name: str, default: int) -> int:
+    """Serving knobs follow the warn-and-default policy (the NEMO_PACK_XFER
+    precedent): a typo'd env on a long-lived sidecar must degrade to the
+    measured default, never crash-loop every admission."""
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        n = int(raw)
+    except ValueError:
+        _log.warning("serve.bad_env", name=name, value=raw, using=default)
+        return default
+    if n < 0:
+        _log.warning("serve.bad_env", name=name, value=raw, using=default)
+        return default
+    return n
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        v = float(raw)
+    except ValueError:
+        _log.warning("serve.bad_env", name=name, value=raw, using=default)
+        return default
+    return v if v >= 0 else default
+
+
+def max_inflight_default() -> int:
+    return _env_int("NEMO_SERVE_INFLIGHT", 4) or 1
+
+
+def max_queue_default() -> int:
+    return _env_int("NEMO_SERVE_QUEUE", 64)
+
+
+def drain_seconds() -> float:
+    """How long a SIGTERM'd sidecar waits for in-flight work
+    (``NEMO_SERVE_DRAIN_S``, default 30 s)."""
+    return _env_float("NEMO_SERVE_DRAIN_S", 30.0)
+
+
+def stream_workers_default() -> int:
+    """Per-AnalyzeDirStream-request concurrency (``NEMO_SERVE_STREAM_WORKERS``,
+    default 2): how many of one stream's directories may hold admission
+    tickets at once."""
+    return max(1, _env_int("NEMO_SERVE_STREAM_WORKERS", 2))
+
+
+def queue_timeout_seconds() -> float:
+    """Upper bound on one ticket's admission wait (``NEMO_SERVE_QUEUE_S``,
+    default 120 s): a queue that cannot drain within this is overload the
+    client should hear about as a reject, not a hung RPC."""
+    return _env_float("NEMO_SERVE_QUEUE_S", 120.0)
+
+
+class AdmissionRejected(Exception):
+    """Raised by :meth:`AdmissionController.enqueue` when the request
+    cannot even wait.  ``reason`` is ``queue_full`` or ``draining``;
+    ``retry_after_s`` is the controller's load-derived backoff estimate."""
+
+    def __init__(self, reason: str, retry_after_s: float) -> None:
+        super().__init__(
+            f"admission rejected ({reason}); retry after ~{retry_after_s:.1f}s"
+        )
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+
+
+class Ticket:
+    """One request's admission state.  ``wait()`` until granted, then
+    ``release()`` exactly once when the work (or the hand-off to a
+    coalesced flight) is done; ``cancel()`` abandons a still-queued ticket
+    (client disconnect, queue timeout)."""
+
+    __slots__ = ("tenant", "_ctl", "_granted", "enqueued_at", "granted_at", "_done")
+
+    def __init__(self, ctl: "AdmissionController", tenant: str) -> None:
+        self.tenant = tenant
+        self._ctl = ctl
+        self._granted = threading.Event()
+        self.enqueued_at = time.monotonic()
+        self.granted_at: float | None = None
+        self._done = False
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """True once the ticket holds an execution slot."""
+        return self._granted.wait(timeout)
+
+    def position(self) -> int:
+        """0 when granted, else 1-based position in the grant order (the
+        round-robin projection — what a queued streaming client is told)."""
+        return self._ctl._position(self)
+
+    def release(self) -> None:
+        self._ctl._release(self)
+
+    def cancel(self) -> None:
+        self._ctl._cancel(self)
+
+
+class AdmissionController:
+    """Bounded, tenant-fair admission queue in front of the execution path.
+
+    Grants are round-robin across tenants with waiting tickets; within one
+    tenant, FIFO.  All state changes happen under one lock; grant events
+    wake the winning ticket's waiter.  The controller never runs work —
+    handlers hold a granted ticket for the duration of their execution and
+    release it in a ``finally``.
+    """
+
+    def __init__(
+        self, max_inflight: int | None = None, max_queue: int | None = None
+    ) -> None:
+        self.max_inflight = max_inflight if max_inflight is not None else max_inflight_default()
+        self.max_queue = max_queue if max_queue is not None else max_queue_default()
+        self._lock = threading.Lock()
+        self._queues: dict[str, deque[Ticket]] = {}
+        self._rr: deque[str] = deque()  # tenant rotation (head = next up)
+        self._queued = 0
+        self._inflight = 0
+        self._draining = False
+        #: EWMA of executed-slot seconds — the retry-after estimator's view
+        #: of how fast one slot turns over.
+        self._exec_ewma = 0.5
+
+    # ------------------------------------------------------------- state
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def inflight(self) -> int:
+        return self._inflight
+
+    @property
+    def queued(self) -> int:
+        return self._queued
+
+    def retry_after_s(self) -> float:
+        """Load-derived backoff hint: the queue's worth of slot turnovers
+        ahead of a would-be new arrival, clamped to a sane window."""
+        with self._lock:
+            pending = self._queued + self._inflight
+            est = (pending + 1) / max(self.max_inflight, 1) * max(self._exec_ewma, 0.1)
+        return min(max(est, 0.5), 60.0)
+
+    # ----------------------------------------------------------- enqueue
+
+    def enqueue(self, tenant: str | None = None) -> Ticket:
+        """Admit-or-reject.  Returns a ticket (possibly already granted);
+        raises :class:`AdmissionRejected` when draining or the queue is
+        full."""
+        tenant = sanitize_tenant(tenant)
+        obs.metrics.inc("serve.requests")
+        obs.metrics.inc(f"serve.tenant.{tenant}.requests")
+        with self._lock:
+            if self._draining:
+                reason = "draining"
+            elif self._queued >= self.max_queue and self._inflight >= self.max_inflight:
+                reason = "queue_full"
+            else:
+                t = Ticket(self, tenant)
+                q = self._queues.get(tenant)
+                if q is None:
+                    q = self._queues[tenant] = deque()
+                    self._rr.append(tenant)
+                q.append(t)
+                self._queued += 1
+                self._grant_locked()
+                self._gauges_locked()
+                return t
+        obs.metrics.inc("serve.rejected")
+        obs.metrics.inc(f"serve.rejected.{reason}")
+        obs.metrics.inc(f"serve.tenant.{tenant}.rejected")
+        retry = self.retry_after_s() if reason == "queue_full" else 1.0
+        _log.warning(
+            "serve.rejected", tenant=tenant, reason=reason,
+            retry_after_s=round(retry, 2),
+        )
+        raise AdmissionRejected(reason, retry)
+
+    # ------------------------------------------------------- grant logic
+
+    def _grant_locked(self) -> None:
+        """Hand free slots to queued tickets, one tenant per rotation
+        step.  Caller holds the lock."""
+        while self._inflight < self.max_inflight and self._queued > 0:
+            # Rotate to the next tenant with a waiter; drop empty queues
+            # from the rotation as they surface.
+            for _ in range(len(self._rr)):
+                tenant = self._rr[0]
+                q = self._queues.get(tenant)
+                if q:
+                    break
+                self._rr.popleft()
+                self._queues.pop(tenant, None)
+            else:
+                return  # rotation empty (stale counters cannot happen: _queued > 0 implies a waiter)
+            self._rr.rotate(-1)
+            t = q.popleft()
+            self._queued -= 1
+            self._inflight += 1
+            t.granted_at = time.monotonic()
+            obs.metrics.inc("serve.admitted")
+            obs.metrics.observe("serve.queued_s", t.granted_at - t.enqueued_at)
+            t._granted.set()
+
+    def _gauges_locked(self) -> None:
+        obs.metrics.gauge("serve.queue_depth", self._queued)
+        obs.metrics.gauge("serve.inflight", self._inflight)
+
+    def _position(self, ticket: Ticket) -> int:
+        with self._lock:
+            if ticket._granted.is_set():
+                return 0
+            # Project the round-robin grant order: tenants are served one
+            # ticket per rotation, so ticket k (0-based) of its tenant's
+            # queue goes out in rotation k.
+            q = self._queues.get(ticket.tenant)
+            if q is None or ticket not in q:
+                return 0
+            k = list(q).index(ticket)
+            ahead = k  # own tenant's earlier tickets
+            for tenant, other in self._queues.items():
+                if tenant != ticket.tenant:
+                    ahead += min(len(other), k + 1)
+            return ahead + 1
+
+    def _release(self, ticket: Ticket) -> None:
+        with self._lock:
+            if ticket._done:
+                return
+            ticket._done = True
+            if not ticket._granted.is_set():
+                # Released while still queued (cancel path alias).
+                self._remove_queued_locked(ticket)
+                self._gauges_locked()
+                return
+            self._inflight -= 1
+            if ticket.granted_at is not None:
+                held = time.monotonic() - ticket.granted_at
+                obs.metrics.observe("serve.exec_s", held)
+                self._exec_ewma = 0.7 * self._exec_ewma + 0.3 * held
+            self._grant_locked()
+            self._gauges_locked()
+
+    def _cancel(self, ticket: Ticket) -> None:
+        self._release(ticket)
+
+    def _remove_queued_locked(self, ticket: Ticket) -> None:
+        q = self._queues.get(ticket.tenant)
+        if q is not None:
+            try:
+                q.remove(ticket)
+                self._queued -= 1
+            except ValueError:
+                pass
+
+    # -------------------------------------------------------------- drain
+
+    def begin_drain(self) -> None:
+        """Refuse all new admissions from now on (idempotent).  In-flight
+        and already-queued work still completes — drain is about new
+        arrivals, not abandoning accepted ones."""
+        with self._lock:
+            if self._draining:
+                return
+            self._draining = True
+        obs.metrics.inc("serve.drain_begun")
+        _log.info("serve.draining", inflight=self._inflight, queued=self._queued)
+
+    def drain_wait(self, timeout_s: float) -> bool:
+        """Wait until nothing is in flight or queued; True when drained."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            with self._lock:
+                if self._inflight == 0 and self._queued == 0:
+                    return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.05)
+
+
+# --------------------------------------------------------------- singleton
+
+_controller: AdmissionController | None = None
+_controller_lock = threading.Lock()
+
+
+def controller() -> AdmissionController:
+    """The process-wide admission controller (created on first use from the
+    env knobs — the sidecar's ``main()`` writes its CLI flags into the env
+    before the first access, the corpus-cache precedent)."""
+    global _controller
+    with _controller_lock:
+        if _controller is None:
+            _controller = AdmissionController()
+        return _controller
+
+
+def reset_controller() -> None:
+    """Drop the singleton so the next access re-reads the env (tests)."""
+    global _controller
+    with _controller_lock:
+        _controller = None

@@ -48,17 +48,18 @@ def _metadata_value(md, key: str):
 
 
 def _drive_stream(
-    stream_callable, requests_iter, timeout: float, target: str, out: list
+    stream_callable, requests_iter, timeout: float, target: str, out: list,
+    extra_md: tuple = (),
 ) -> None:
     """Drive one AnalyzeStream call under the trace contract shared by
     analyze_chunks and the pipelined producer paths: one rpc:AnalyzeStream
-    span, trace metadata attached only when tracing (untraced calls keep
-    the bare signature — test fakes and old stubs stay compatible),
+    span, trace/tenant metadata attached only when present (bare calls
+    keep the bare signature — test fakes and old stubs stay compatible),
     per-chunk ordinal checks filling `out`, and the sidecar's spans adopted
     from trailing metadata once the stream completes."""
     n = len(out)
     with obs.span("rpc:AnalyzeStream", target=target, chunks=n):
-        md = _trace_metadata()
+        md = (_trace_metadata() or ()) + tuple(extra_md or ())
         stream = stream_callable(
             requests_iter, timeout=timeout, **({"metadata": md} if md else {})
         )
@@ -89,13 +90,23 @@ def _adopt_remote(call) -> None:
 
 @dataclass
 class RemoteAnalyzer:
-    """Thin, retrying client over the NemoAnalysis service."""
+    """Thin, retrying client over the NemoAnalysis service.
+
+    ``tenant`` identifies this client to the sidecar's admission
+    controller (per-tenant fairness and metrics, ISSUE 8) via the
+    ``nemo-tenant`` request metadata; defaults to ``$NEMO_TENANT`` or the
+    shared anonymous tenant."""
 
     target: str = "127.0.0.1:50051"
     timeout: float = 300.0
     retries: int = 3
+    tenant: str | None = None
 
     def __post_init__(self):
+        import os as _os
+
+        if self.tenant is None:
+            self.tenant = _os.environ.get("NEMO_TENANT") or None
         self._channel = grpc.insecure_channel(
             self.target,
             options=[
@@ -133,6 +144,13 @@ class RemoteAnalyzer:
             f"/{SERVICE}/AnalyzeDir",
             request_serializer=lambda d: _json.dumps(d).encode("utf-8"),
             response_deserializer=pb.AnalyzeResponse.FromString,
+        )
+        # Server-streaming variant: JSON request, JSON event stream back
+        # (results carry the serialized AnalyzeResponse base64-embedded).
+        self._analyze_dir_stream = self._channel.unary_stream(
+            f"/{SERVICE}/AnalyzeDirStream",
+            request_serializer=lambda d: _json.dumps(d).encode("utf-8"),
+            response_deserializer=lambda b: _json.loads(b.decode("utf-8")),
         )
 
     def close(self) -> None:
@@ -185,14 +203,25 @@ class RemoteAnalyzer:
                 time.sleep(0.2)
         raise SidecarError(f"sidecar not ready after {deadline}s: {last}")
 
+    def _request_metadata(self) -> tuple | None:
+        """Outgoing metadata: trace context plus the tenant identity the
+        sidecar's admission controller schedules by."""
+        md = _trace_metadata() or ()
+        if self.tenant:
+            md = md + (("nemo-tenant", self.tenant),)
+        return md or None
+
     def _call(self, method, request, timeout: float | None = None, name: str = "rpc"):
-        """One unary RPC with bounded UNAVAILABLE retries; returns
-        (response, call) — with_call so trailing metadata (sidecar spans,
-        metrics) is readable.  Every attempt gets a span and a latency
-        observation; retries/backoffs land in the metrics registry so a
-        benchmark that silently absorbed reconnects shows it."""
+        """One unary RPC with bounded retries; returns (response, call) —
+        with_call so trailing metadata (sidecar spans, metrics) is
+        readable.  UNAVAILABLE retries with exponential backoff;
+        RESOURCE_EXHAUSTED (admission rejection, ISSUE 8) honors the
+        sidecar's `nemo-retry-after-s` trailing-metadata hint — counted as
+        `rpc.throttled`, so a load-shedding server shows up in the client's
+        metrics rather than as silent latency.  Every attempt gets a span
+        and a latency observation."""
         delay = 0.2
-        md = _trace_metadata()
+        md = self._request_metadata()
         for attempt in range(self.retries):
             try:
                 t0 = time.perf_counter()
@@ -229,9 +258,42 @@ class RemoteAnalyzer:
                 _adopt_remote(call)
                 return resp, call
             except grpc.RpcError as ex:
-                if ex.code() != grpc.StatusCode.UNAVAILABLE or attempt == self.retries - 1:
+                code = ex.code()
+                # RESOURCE_EXHAUSTED is only the sidecar's admission
+                # rejection when it carries the retry-after hint; grpc
+                # itself uses the same code for DETERMINISTIC failures
+                # (e.g. a message over the 1 GiB channel cap), which must
+                # raise immediately — sleep-retrying an oversized payload
+                # would mask the bug as server load.
+                retry_after = None
+                if code == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                    try:
+                        raw = _metadata_value(
+                            ex.trailing_metadata(), "nemo-retry-after-s"
+                        )
+                        retry_after = float(raw) if raw else None
+                    except Exception:
+                        retry_after = None
+                throttled = retry_after is not None
+                if (
+                    code != grpc.StatusCode.UNAVAILABLE and not throttled
+                ) or attempt == self.retries - 1:
                     obs.metrics.inc("rpc.errors")
                     raise
+                if throttled:
+                    # Admission rejection: back off by the server's own
+                    # load estimate, bounded so a wild hint cannot park
+                    # the client.
+                    wait = min(retry_after, 10.0)
+                    obs.metrics.inc("rpc.throttled")
+                    obs.metrics.inc("rpc.backoff_s", wait)
+                    _log.info(
+                        "rpc.throttled", rpc=name, target=self.target,
+                        retry_after_s=round(wait, 2), attempt=attempt,
+                    )
+                    time.sleep(wait)
+                    delay *= 2
+                    continue
                 obs.metrics.inc("rpc.retries")
                 obs.metrics.inc("rpc.backoff_s", delay)
                 time.sleep(delay)
@@ -290,16 +352,62 @@ class RemoteAnalyzer:
         resp, call = self._call(self._analyze_dir, req, name="AnalyzeDir")
         obs.metrics.inc("rpc.bytes_received", resp.ByteSize())
         try:
-            status = dict(call.trailing_metadata() or ()).get("nemo-rcache")
+            trailing = dict(call.trailing_metadata() or ())
         except Exception:
-            status = None
+            trailing = {}
+        status = trailing.get("nemo-rcache")
         if status:
             obs.metrics.inc(f"rpc.analyze_dir_rcache.{status}")
             if status == "hit":
                 _log.info(
                     "rpc.analyze_dir_cached", dir=molly_dir, target=self.target
                 )
+        coalesce = trailing.get("nemo-coalesce")
+        if coalesce:
+            # "hit" = this request rode another client's identical
+            # in-flight analysis (ISSUE 8 single-flight).
+            obs.metrics.inc(f"rpc.analyze_dir_coalesce.{coalesce}")
         return codec.outputs_from_pb(resp)
+
+    def analyze_dir_stream(self, molly_dirs, corpus_cache=None, result_cache=None):
+        """Server-streaming corpus analysis (ISSUE 8): ship the directory
+        PATHS; the sidecar analyzes them concurrently under its admission
+        controller and pushes progress + per-family results as each
+        completes.  Yields the server's JSON events in arrival order;
+        ``result`` events gain a decoded ``outputs`` dict (the same arrays
+        ``analyze_dir_remote`` returns) in place of the raw payload.
+
+        Event shapes (service/server.py:analyze_dir_stream): ``queued``
+        (with the admission queue position), ``admitted``, ``phase``,
+        ``result`` (with ``rcache``/``coalesce`` statuses), per-family
+        ``error`` (an admission rejection or failure of ONE directory —
+        the stream continues), and a terminal ``done``."""
+        import base64
+        import os
+
+        if isinstance(molly_dirs, str):
+            molly_dirs = [molly_dirs]
+        req: dict = {"dirs": [os.path.abspath(d) for d in molly_dirs]}
+        if corpus_cache is not None:
+            req["corpus_cache"] = corpus_cache
+        if result_cache is not None:
+            req["result_cache"] = result_cache
+        obs.metrics.inc("rpc.bytes_sent", len(_json.dumps(req).encode("utf-8")))
+        md = self._request_metadata()
+        with obs.span("rpc:AnalyzeDirStream", target=self.target, dirs=len(req["dirs"])):
+            stream = self._analyze_dir_stream(
+                req, timeout=self.timeout, **({"metadata": md} if md else {})
+            )
+            for ev in stream:
+                obs.metrics.inc("rpc.stream_events")
+                if ev.get("event") == "result":
+                    payload = base64.b64decode(ev.pop("response_b64"))
+                    obs.metrics.inc("rpc.bytes_received", len(payload))
+                    ev["outputs"] = codec.outputs_from_pb(
+                        pb.AnalyzeResponse.FromString(payload)
+                    )
+                yield ev
+            _adopt_remote(stream)
 
     def analyze_chunks(
         self, chunks: list[tuple[object, object, dict]]
@@ -318,7 +426,10 @@ class RemoteAnalyzer:
                 yield req
 
         out: list[dict[str, np.ndarray] | None] = [None] * len(chunks)
-        _drive_stream(self._analyze_stream, requests(), self.timeout, self.target, out)
+        _drive_stream(
+            self._analyze_stream, requests(), self.timeout, self.target, out,
+            **({"extra_md": (("nemo-tenant", self.tenant),)} if self.tenant else {}),
+        )
         missing = [i for i, o in enumerate(out) if o is None]
         if missing:
             raise SidecarError(f"missing responses for chunks {missing}")
@@ -465,6 +576,11 @@ def _stream_pipelined(
                 _drive_stream(
                     client._analyze_stream, requests_inline(), client.timeout,
                     target, results,
+                    **(
+                        {"extra_md": (("nemo-tenant", t),)}
+                        if (t := getattr(client, "tenant", None))
+                        else {}
+                    ),
                 )
                 timings["stream_s"] = time.perf_counter() - t0
         except BaseException as ex:
@@ -516,7 +632,12 @@ def _stream_pipelined(
             client.wait_ready(ready_deadline)
             t0 = time.perf_counter()
             _drive_stream(
-                client._analyze_stream, requests(), client.timeout, target, results
+                client._analyze_stream, requests(), client.timeout, target, results,
+                **(
+                        {"extra_md": (("nemo-tenant", t),)}
+                        if (t := getattr(client, "tenant", None))
+                        else {}
+                    ),
             )
             timings["stream_s"] = time.perf_counter() - t0
     except BaseException as ex:

@@ -19,6 +19,22 @@ structured JSON record (obs/log.py) carrying the client's propagated trace
 id where one exists, and every RPC lands in a `serve.rpc_latency_s.<rpc>`
 histogram.
 
+Serving tier (ISSUE 8, nemo_tpu/serve): every work RPC (Analyze,
+AnalyzeStream, AnalyzeDir, AnalyzeDirStream, Kernel — Health stays
+ungated) passes the admission controller first: a bounded queue with
+per-tenant round-robin fairness (`nemo-tenant` request metadata), an
+in-flight cap (`--max-inflight`/`NEMO_SERVE_INFLIGHT`), and
+RESOURCE_EXHAUSTED rejection carrying a `nemo-retry-after-s` hint when the
+queue is full.  Concurrent AnalyzeDir requests with the same content
+address (store segment fingerprints + statics + wire/ABI versions — the
+rcache tier-3 key) coalesce into ONE analysis with byte-identical
+responses; compatible Kernel dispatches from different in-flight requests
+merge into one padded device launch (continuous batching).
+`AnalyzeDirStream` streams per-directory results and queue/phase progress
+events as each completes.  SIGTERM drains gracefully: new admissions are
+refused (`/healthz` -> NOT_SERVING), in-flight requests finish inside
+`NEMO_SERVE_DRAIN_S`, then the process exits 0.
+
 Run:  python -m nemo_tpu.service.server --port 50051 --metrics-port 9464
 """
 
@@ -34,6 +50,7 @@ from concurrent import futures
 import grpc
 
 from nemo_tpu import obs
+from nemo_tpu import serve
 from nemo_tpu.obs import log as obs_log
 from nemo_tpu.obs import trace as obs_trace
 from nemo_tpu.service import codec
@@ -48,16 +65,29 @@ log = obs_log.get_logger("nemo.sidecar")
 def _health_state() -> dict:
     """The `/healthz` document: a JSON mirror of the gRPC Health response
     (same fields a `health()` client sees), computed per request so an
-    operator's curl reflects live device state."""
+    operator's curl reflects live device state.  A draining sidecar
+    (SIGTERM received, in-flight work finishing) reports NOT_SERVING —
+    promexp answers it with a 503, which is what pulls a replica out of a
+    load balancer's rotation before the process exits."""
     import jax
 
+    ctl = serve.controller()
     devs = jax.devices()
     return {
-        "status": "SERVING",
+        "status": "NOT_SERVING" if ctl.draining else "SERVING",
         "platform": devs[0].platform,
         "device_count": len(devs),
         "version": VERSION,
+        "inflight": ctl.inflight,
+        "queue_depth": ctl.queued,
     }
+
+
+def _tenant_of(context) -> str:
+    """The caller's tenant identity from 'nemo-tenant' request metadata
+    (sanitized; absent -> the shared 'anon' tenant)."""
+    md = dict(context.invocation_metadata() or ())
+    return serve.admission.sanitize_tenant(md.get("nemo-tenant"))
 
 
 def _rpc_observed(name: str, t0: float, trace_id: str | None) -> None:
@@ -166,8 +196,55 @@ class _Impl:
     """Method implementations; one fused-step jit cache per process.
 
     Trace-context propagation is per request via _SpanCollection; every
-    handler acquires one and releases it in a finally.
+    handler acquires one and releases it in a finally.  Every WORK handler
+    additionally holds an admission ticket (nemo_tpu/serve) for the span
+    of its execution — Health stays ungated so readiness probes and
+    wait_ready() always answer.
     """
+
+    def __init__(self) -> None:
+        self.admission = serve.controller()
+        self.flights = serve.flights()
+        self.batcher = serve.batcher()
+
+    def _admit(self, context, rpc: str) -> serve.Ticket:
+        """Enqueue-or-reject, then wait for an execution slot.  Rejections
+        abort with RESOURCE_EXHAUSTED (queue full — the client should shed
+        or back off by the `nemo-retry-after-s` trailing-metadata hint) or
+        UNAVAILABLE (draining — the client should find another replica).
+        While queued, the wait polls so a dead client's slot request is
+        abandoned instead of granted to a hung handler."""
+        tenant = _tenant_of(context)
+        try:
+            ticket = self.admission.enqueue(tenant)
+        except serve.AdmissionRejected as ex:
+            context.set_trailing_metadata(
+                (("nemo-retry-after-s", f"{ex.retry_after_s:.3f}"),)
+            )
+            context.abort(
+                grpc.StatusCode.UNAVAILABLE
+                if ex.reason == "draining"
+                else grpc.StatusCode.RESOURCE_EXHAUSTED,
+                f"{rpc} not admitted: {ex.reason}; "
+                f"retry after ~{ex.retry_after_s:.1f}s",
+            )
+        deadline = time.monotonic() + serve.admission.queue_timeout_seconds()
+        while not ticket.wait(0.2):
+            if not context.is_active():
+                ticket.cancel()
+                context.abort(grpc.StatusCode.CANCELLED, "client went away while queued")
+            if time.monotonic() > deadline:
+                ticket.cancel()
+                obs.metrics.inc("serve.rejected")
+                obs.metrics.inc("serve.rejected.queue_timeout")
+                context.set_trailing_metadata(
+                    (("nemo-retry-after-s", f"{self.admission.retry_after_s():.3f}"),)
+                )
+                context.abort(
+                    grpc.StatusCode.RESOURCE_EXHAUSTED,
+                    f"{rpc} queued past the admission timeout",
+                )
+        return ticket
 
     def health(self, request: pb.HealthRequest, context) -> pb.HealthResponse:
         col = _SpanCollection(context)
@@ -246,8 +323,9 @@ class _Impl:
         return self._run_step(pre, post, static, int(request.chunk), trace_id)
 
     def analyze(self, request: pb.AnalyzeRequest, context) -> pb.AnalyzeResponse:
-        col = _SpanCollection(context)
         t0 = time.perf_counter()
+        ticket = self._admit(context, "Analyze")
+        col = _SpanCollection(context)
         try:
             resp = self._analyze_one(request, trace_id=col.tid)
             md = col.trailing()
@@ -257,12 +335,16 @@ class _Impl:
         finally:
             _rpc_observed("Analyze", t0, col.tid)
             col.release()
+            ticket.release()
 
     def analyze_stream(self, request_iterator, context):
         # Sequential device dispatch preserves chunk arrival order; gRPC's
         # flow control provides the backpressure (SURVEY.md §7 hard part 6).
-        col = _SpanCollection(context)
+        # One admission ticket covers the whole stream: a streaming session
+        # is one continuous occupancy of the device, not per-chunk work.
         t0 = time.perf_counter()
+        ticket = self._admit(context, "AnalyzeStream")
+        col = _SpanCollection(context)
         try:
             for request in request_iterator:
                 yield self._analyze_one(request, trace_id=col.tid)
@@ -272,6 +354,7 @@ class _Impl:
         finally:
             _rpc_observed("AnalyzeStream", t0, col.tid)
             col.release()
+            ticket.release()
 
     def analyze_dir(self, request: dict, context) -> pb.AnalyzeResponse:
         """Server-side corpus analysis: the request names a Molly directory
@@ -297,122 +380,325 @@ class _Impl:
         trailing metadata (hit/miss/off streams back on every call).
         ``result_cache`` in the request can only opt OUT ("off"), like
         ``corpus_cache``."""
-        col = _SpanCollection(context)
         t0 = time.perf_counter()
+        if not isinstance(request, dict):
+            # Valid JSON but not an object ('[1]', '"x"') — the
+            # deserializer accepted it; fail with the clear status, not
+            # an AttributeError surfacing as UNKNOWN.
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "AnalyzeDir request must be a JSON object",
+            )
+        d = request.get("dir", "")
+        if not d or not os.path.isdir(d):
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"not a directory on the sidecar host: {d!r}",
+            )
+        ticket = self._admit(context, "AnalyzeDir")
+        col = _SpanCollection(context)
         try:
-            if not isinstance(request, dict):
-                # Valid JSON but not an object ('[1]', '"x"') — the
-                # deserializer accepted it; fail with the clear status, not
-                # an AttributeError surfacing as UNKNOWN.
-                context.abort(
-                    grpc.StatusCode.INVALID_ARGUMENT,
-                    "AnalyzeDir request must be a JSON object",
-                )
-            d = request.get("dir", "")
-            if not d or not os.path.isdir(d):
-                context.abort(
-                    grpc.StatusCode.INVALID_ARGUMENT,
-                    f"not a directory on the sidecar host: {d!r}",
-                )
-            from nemo_tpu.analysis.pipeline import _ingest
-            from nemo_tpu.models.pipeline_model import BatchArrays
-            from nemo_tpu.store import corpus_cache_dir, resolve_store
-
-            with obs.span(
-                "serve:AnalyzeDir", dir=os.path.basename(d), trace_id=col.tid
-            ):
-                # Store authority is the OPERATOR's (--corpus-cache /
-                # NEMO_CORPUS_CACHE): a client may opt OUT for its request
-                # (corpus_cache="off"), but can never enable or redirect a
-                # server-side store the operator disabled — the request
-                # names a client-chosen server path a full corpus mirror
-                # would be written to.
-                req_cache = request.get("corpus_cache")
-                client_opt_out = (
-                    req_cache is not None and corpus_cache_dir(req_cache) is None
-                )
-                store = None if client_opt_out else resolve_store()
-                # Warm array-only path first: the handler dispatches arrays
-                # + statics, so a hit skips the per-run MollyOutput build.
-                nc = store.load_corpus(d) if store is not None else None
-                if nc is None:
-                    # Cold/stale (already counted by load_corpus above):
-                    # the pipeline's canonical parse+populate with a
-                    # pre-parse snapshot — one policy, shared, not a
-                    # server-side copy; consult_store=False so the miss is
-                    # not probed and counted a second time.
-                    molly = _ingest(d, use_packed=True, store=store, consult_store=False)
-                    nc = getattr(molly, "native_corpus", None)
-                if nc is not None:
-                    from nemo_tpu.ingest.native import corpus_step_static
-
-                    pre = BatchArrays.from_packed(nc.pre)
-                    post = BatchArrays.from_packed(nc.post)
-                    static = corpus_step_static(nc)
-                    seg_meta = getattr(nc, "store_segments", None)
-                else:  # object-loader fallback (no native lib, cold store)
-                    from nemo_tpu.models.pipeline_model import pack_molly_for_step
-
-                    pre, post, static = pack_molly_for_step(molly)
-                    seg_meta = getattr(molly, "store_segments", None)
-                obs.metrics.inc("serve.analyze_dir")
-
-                # Response cache: operator authority like the store —
-                # resolved from the sidecar's own env, request can only
-                # opt out.  Keyed on segment fingerprints + statics + wire
-                # version, so a stale store or a kernel ABI bump can never
-                # serve old bytes.
-                from nemo_tpu.analysis.delta import blob_cache_key
-                from nemo_tpu.store.rcache import (
-                    resolve_result_cache,
-                    result_cache_dir,
-                )
-
-                req_rc = request.get("result_cache")
-                rc_opt_out = req_rc is not None and result_cache_dir(req_rc) is None
-                rc = None if rc_opt_out else resolve_result_cache()
-                blob_key = (
-                    blob_cache_key(
-                        "analyze_dir",
-                        seg_meta,
-                        {"static": {k: int(v) for k, v in static.items()}, "wire": VERSION},
-                    )
-                    if rc is not None
-                    else None
-                )
-                rc_status = "off"
-                resp = None
-                if blob_key is not None:
-                    payload = rc.load_blob("analyze_dir", blob_key)
-                    if payload is not None:
-                        resp = pb.AnalyzeResponse.FromString(payload)
-                        # The stored wall is the POPULATING run's; a served
-                        # hit dispatched nothing.
-                        resp.step_seconds = 0.0
-                        rc_status = "hit"
-                        obs.metrics.inc("serve.analyze_dir_cached")
-                    else:
-                        rc_status = "miss"
-                if resp is None:
-                    resp = self._run_step(pre, post, static, chunk=0, trace_id=col.tid)
-                    if blob_key is not None:
-                        rc.put_blob("analyze_dir", blob_key, resp.SerializeToString())
-            md = col.trailing() + (("nemo-rcache", rc_status),)
+            payload, meta = self._dir_payload(request, d, col.tid, ticket, context)
+            resp = pb.AnalyzeResponse.FromString(payload)
+            md = col.trailing() + (
+                ("nemo-rcache", meta["rcache"]),
+                ("nemo-coalesce", meta["coalesce"]),
+            )
             context.set_trailing_metadata(md)
             return resp
         finally:
             _rpc_observed("AnalyzeDir", t0, col.tid)
+            col.release()
+            ticket.release()
+
+    def _ingest_dir(self, request: dict, d: str):
+        """Resolve a directory request to dispatchable arrays:
+        (pre, post, static, seg_meta).  Store authority is the OPERATOR's
+        (--corpus-cache / NEMO_CORPUS_CACHE): a client may opt OUT for its
+        request (corpus_cache="off"), but can never enable or redirect a
+        server-side store the operator disabled — the request names a
+        client-chosen server path a full corpus mirror would be written
+        to."""
+        from nemo_tpu.analysis.pipeline import _ingest
+        from nemo_tpu.models.pipeline_model import BatchArrays
+        from nemo_tpu.store import corpus_cache_dir, resolve_store
+
+        req_cache = request.get("corpus_cache")
+        client_opt_out = req_cache is not None and corpus_cache_dir(req_cache) is None
+        store = None if client_opt_out else resolve_store()
+        # Warm array-only path first: the handler dispatches arrays
+        # + statics, so a hit skips the per-run MollyOutput build.
+        nc = store.load_corpus(d) if store is not None else None
+        if nc is None:
+            # Cold/stale (already counted by load_corpus above): the
+            # pipeline's canonical parse+populate with a pre-parse
+            # snapshot — one policy, shared, not a server-side copy;
+            # consult_store=False so the miss is not probed and counted a
+            # second time.
+            molly = _ingest(d, use_packed=True, store=store, consult_store=False)
+            nc = getattr(molly, "native_corpus", None)
+        if nc is not None:
+            from nemo_tpu.ingest.native import corpus_step_static
+
+            pre = BatchArrays.from_packed(nc.pre)
+            post = BatchArrays.from_packed(nc.post)
+            static = corpus_step_static(nc)
+            seg_meta = getattr(nc, "store_segments", None)
+        else:  # object-loader fallback (no native lib, cold store)
+            from nemo_tpu.models.pipeline_model import pack_molly_for_step
+
+            pre, post, static = pack_molly_for_step(molly)
+            seg_meta = getattr(molly, "store_segments", None)
+        obs.metrics.inc("serve.analyze_dir")
+        return pre, post, static, seg_meta
+
+    def _dir_payload(
+        self,
+        request: dict,
+        d: str,
+        trace_id: str | None,
+        ticket: serve.Ticket,
+        context=None,
+    ) -> tuple[bytes, dict]:
+        """One directory request -> (serialized AnalyzeResponse, meta with
+        'rcache' and 'coalesce' statuses).  Shared by AnalyzeDir and
+        AnalyzeDirStream.
+
+        Coalescing (ISSUE 8): the corpus's content address — the exact key
+        the result cache blobs under (segment fingerprints + statics +
+        wire version + analysis ABI, analysis/delta.py:blob_cache_key) —
+        keys a single-flight table.  Concurrent identical requests attach
+        as subscribers to the first arrival's execution and receive its
+        byte-identical payload; a subscriber RELEASES its admission slot
+        before waiting (it consumes no execution capacity) and its ticket
+        release is idempotent, so the handler's finally stays correct.
+        Anonymous corpora (no store -> no fingerprints) key to None:
+        uncacheable and uncoalesceable, exactly like the rcache tiers."""
+        from nemo_tpu.analysis.delta import blob_cache_key
+        from nemo_tpu.store.rcache import resolve_result_cache, result_cache_dir
+
+        with obs.span("serve:AnalyzeDir", dir=os.path.basename(d), trace_id=trace_id):
+            pre, post, static, seg_meta = self._ingest_dir(request, d)
+
+            # Response cache: operator authority like the store — resolved
+            # from the sidecar's own env, request can only opt out.  Keyed
+            # on segment fingerprints + statics + wire version, so a stale
+            # store or a kernel ABI bump can never serve old bytes.
+            req_rc = request.get("result_cache")
+            rc_opt_out = req_rc is not None and result_cache_dir(req_rc) is None
+            rc = None if rc_opt_out else resolve_result_cache()
+            content_key = blob_cache_key(
+                "analyze_dir",
+                seg_meta,
+                {"static": {k: int(v) for k, v in static.items()}, "wire": VERSION},
+            )
+
+            def _execute() -> tuple[bytes, dict]:
+                rc_status = "off"
+                payload = None
+                if rc is not None and content_key is not None:
+                    cached = rc.load_blob("analyze_dir", content_key)
+                    if cached is not None:
+                        resp = pb.AnalyzeResponse.FromString(cached)
+                        # The stored wall is the POPULATING run's; a
+                        # served hit dispatched nothing.
+                        resp.step_seconds = 0.0
+                        payload = resp.SerializeToString()
+                        rc_status = "hit"
+                        obs.metrics.inc("serve.analyze_dir_cached")
+                    else:
+                        rc_status = "miss"
+                if payload is None:
+                    resp = self._run_step(pre, post, static, chunk=0, trace_id=trace_id)
+                    payload = resp.SerializeToString()
+                    if rc is not None and content_key is not None:
+                        rc.put_blob("analyze_dir", content_key, payload)
+                return payload, {"rcache": rc_status}
+
+            if content_key is None:
+                payload, meta = _execute()
+                meta["coalesce"] = "off"
+                obs.metrics.inc("serve.coalesce.off")
+                return payload, meta
+            role, flight = self.flights.join(content_key)
+            if role == "leader":
+                try:
+                    payload, meta = _execute()
+                except BaseException as ex:
+                    self.flights.fail(flight, ex)
+                    raise
+                self.flights.complete(flight, payload, meta)
+                meta = dict(meta, coalesce="leader")
+                obs.metrics.inc("serve.coalesce.leader")
+                return payload, meta
+            # Subscriber: free the execution slot — we only wait on bytes.
+            # The wait is liveness-checked (a dead client's thread returns
+            # to the pool) and bounded at the client's own RPC deadline;
+            # live subscribers DO each hold one handler-pool thread, which
+            # the pool sized from the admission capacity bounds.
+            ticket.release()
+            obs.metrics.inc("serve.coalesce.hit")
+            obs.metrics.inc(f"serve.tenant.{ticket.tenant}.coalesced")
+            log.debug(
+                "serve.coalesced", dir=d, key=content_key[:12], trace_id=trace_id
+            )
+            payload, meta = flight.wait_result(
+                is_alive=context.is_active if context is not None else None
+            )
+            return payload, dict(meta, coalesce="hit")
+
+    def analyze_dir_stream(self, request: dict, context):
+        """Server-streaming AnalyzeDir (ISSUE 8): the request names one or
+        more directories (``{"dirs": [...]}``, or the unary ``{"dir":
+        ...}`` shape) and the response stream pushes JSON events as the
+        work progresses instead of one terminal blob:
+
+          ``{"event": "queued", "dir", "position"}``   admission wait
+          ``{"event": "admitted", "dir"}``             slot granted
+          ``{"event": "phase", "dir", "phase"}``       ingest/analyze
+          ``{"event": "result", "dir", "ordinal", "rcache", "coalesce",
+             "response_b64"}``                         one family done
+          ``{"event": "error", "dir", "status", "detail", ...}``
+          ``{"event": "done", "results", "errors"}``   terminal marker
+
+        Directories are analyzed CONCURRENTLY (a small per-request worker
+        pool, ``NEMO_SERVE_STREAM_WORKERS``), each under its OWN admission
+        ticket, so results stream in completion order — a cached or
+        coalesced family lands while a cold one is still compiling — and
+        per-directory admission rejections surface as per-family error
+        events, not a dead stream."""
+        import base64
+        import queue as _queue
+        import threading
+
+        t0 = time.perf_counter()
+        if not isinstance(request, dict):
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "AnalyzeDirStream request must be a JSON object",
+            )
+        dirs = request.get("dirs")
+        if dirs is None:
+            dirs = [request["dir"]] if request.get("dir") else []
+        if not isinstance(dirs, list) or not dirs:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "AnalyzeDirStream request needs a non-empty 'dirs' list",
+            )
+        for d in dirs:
+            if not isinstance(d, str) or not os.path.isdir(d):
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    f"not a directory on the sidecar host: {d!r}",
+                )
+        tenant = _tenant_of(context)
+        col = _SpanCollection(context)
+        events: _queue.Queue = _queue.Queue()
+        n_workers = min(len(dirs), serve.admission.stream_workers_default())
+        work: _queue.Queue = _queue.Queue()
+        for i, d in enumerate(dirs):
+            work.put((i, d))
+
+        def worker() -> None:
+            while True:
+                try:
+                    i, d = work.get_nowait()
+                except _queue.Empty:
+                    return
+                ticket = None
+                try:
+                    ticket = self.admission.enqueue(tenant)
+                    last_pos = None
+                    deadline = time.monotonic() + serve.admission.queue_timeout_seconds()
+                    while not ticket.wait(0.2):
+                        if not context.is_active() or time.monotonic() > deadline:
+                            ticket.cancel()
+                            raise serve.AdmissionRejected(
+                                "queue_timeout", self.admission.retry_after_s()
+                            )
+                        pos = ticket.position()
+                        if pos != last_pos:
+                            last_pos = pos
+                            events.put(
+                                {"event": "queued", "dir": d, "position": pos}
+                            )
+                    events.put({"event": "admitted", "dir": d})
+                    events.put({"event": "phase", "dir": d, "phase": "analyze"})
+                    payload, meta = self._dir_payload(
+                        {**request, "dir": d}, d, col.tid, ticket, context
+                    )
+                    obs.metrics.inc("serve.stream.results")
+                    events.put(
+                        {
+                            "event": "result",
+                            "dir": d,
+                            "ordinal": i,
+                            "rcache": meta.get("rcache", "off"),
+                            "coalesce": meta.get("coalesce", "off"),
+                            "response_b64": base64.b64encode(payload).decode("ascii"),
+                        }
+                    )
+                except serve.AdmissionRejected as ex:
+                    events.put(
+                        {
+                            "event": "error",
+                            "dir": d,
+                            "ordinal": i,
+                            "status": "RESOURCE_EXHAUSTED",
+                            "detail": ex.reason,
+                            "retry_after_s": round(ex.retry_after_s, 3),
+                        }
+                    )
+                except BaseException as ex:  # one family's failure, not the stream's
+                    events.put(
+                        {
+                            "event": "error",
+                            "dir": d,
+                            "ordinal": i,
+                            "status": "INTERNAL",
+                            "detail": f"{type(ex).__name__}: {ex}",
+                        }
+                    )
+                finally:
+                    if ticket is not None:
+                        ticket.release()
+
+        threads = [
+            threading.Thread(target=worker, daemon=True, name=f"nemo-serve-stream-{k}")
+            for k in range(n_workers)
+        ]
+        try:
+            for t in threads:
+                t.start()
+            done = errors = 0
+            while done + errors < len(dirs):
+                ev = events.get()
+                if ev["event"] == "result":
+                    done += 1
+                elif ev["event"] == "error":
+                    errors += 1
+                obs.metrics.inc("serve.stream.events")
+                yield ev
+            yield {"event": "done", "results": done, "errors": errors}
+        finally:
+            for t in threads:
+                t.join(timeout=5.0)
+            _rpc_observed("AnalyzeDirStream", t0, col.tid)
             col.release()
 
     def kernel(self, request: pb.KernelRequest, context) -> pb.KernelResponse:
         """Named device-kernel dispatch for the ServiceBackend: the request's
         (verb, arrays, params) triple runs through the same LocalExecutor the
         in-process JaxBackend uses, so both deployments execute identical
-        device code."""
+        device code.  Row-independent verbs route through the serving
+        tier's continuous batcher (nemo_tpu/serve/batch.py): compatible
+        dispatches from DIFFERENT in-flight requests merge into one padded
+        device launch and demux per request."""
         from nemo_tpu.backend.jax_backend import LocalExecutor
 
-        col = _SpanCollection(context)
         t_rpc = time.perf_counter()
+        ticket = self._admit(context, "Kernel")
+        col = _SpanCollection(context)
         try:
             verb, arrays, params = codec.kernel_request_from_pb(request)
             if verb not in LocalExecutor.VERBS:
@@ -423,7 +709,7 @@ class _Impl:
                 # module-level kernel functions.  Its own kernel:<verb> span
                 # rides home in the trailing metadata.
                 with obs.span("serve:Kernel", verb=verb, trace_id=col.tid):
-                    out = LocalExecutor().run(verb, arrays, params)
+                    out = self.batcher.run(LocalExecutor(), verb, arrays, params)
             except KeyError as ex:
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"missing kernel input: {ex}")
             obs.metrics.inc("serve.kernel_calls")
@@ -434,11 +720,25 @@ class _Impl:
         finally:
             _rpc_observed("Kernel", t_rpc, col.tid)
             col.release()
+            ticket.release()
 
 
-def make_server(port: int = 0, max_workers: int = 4) -> tuple[grpc.Server, int]:
-    """Build (but don't start) the sidecar server; returns (server, port)."""
+def make_server(port: int = 0, max_workers: int | None = None) -> tuple[grpc.Server, int]:
+    """Build (but don't start) the sidecar server; returns (server, port).
+
+    max_workers is the gRPC HANDLER pool; the default is derived from the
+    admission tier's FULL capacity (max_inflight + max_queue + headroom
+    for Health/streams, capped at 256): every request the admission
+    contract promises to count, position, fair-schedule, or shed with a
+    retry-after must actually reach a handler — a narrower pool would park
+    the excess invisibly in grpc's work queue, uncounted and untimed,
+    which is exactly the failure mode the admission queue exists to
+    prevent.  The pool threads are cheap (all but max_inflight of them are
+    parked in the admission wait)."""
     impl = _Impl()
+    if max_workers is None:
+        ctl = impl.admission
+        max_workers = min(ctl.max_inflight + ctl.max_queue + 8, 256)
     handlers = {
         "Health": grpc.unary_unary_rpc_method_handler(
             impl.health,
@@ -461,6 +761,15 @@ def make_server(port: int = 0, max_workers: int = 4) -> tuple[grpc.Server, int]:
             impl.analyze_dir,
             request_deserializer=lambda b: json.loads(b.decode("utf-8")),
             response_serializer=pb.AnalyzeResponse.SerializeToString,
+        ),
+        # Server-streaming AnalyzeDir (ISSUE 8): JSON request, a stream of
+        # JSON progress/result events back (results carry the serialized
+        # AnalyzeResponse base64-embedded) — per-family push instead of
+        # one terminal blob, still protoc-free.
+        "AnalyzeDirStream": grpc.unary_stream_rpc_method_handler(
+            impl.analyze_dir_stream,
+            request_deserializer=lambda b: json.loads(b.decode("utf-8")),
+            response_serializer=lambda d: json.dumps(d).encode("utf-8"),
         ),
         "Kernel": grpc.unary_unary_rpc_method_handler(
             impl.kernel,
@@ -486,7 +795,39 @@ def make_server(port: int = 0, max_workers: int = 4) -> tuple[grpc.Server, int]:
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="nemo-tpu-sidecar")
     parser.add_argument("--port", type=int, default=50051)
-    parser.add_argument("--max-workers", type=int, default=4)
+    parser.add_argument(
+        "--max-workers",
+        type=int,
+        default=None,
+        help="gRPC handler pool size (default: admission capacity — "
+        "max-inflight + max-queue + headroom, capped at 256)",
+    )
+    parser.add_argument(
+        "--max-inflight",
+        type=int,
+        default=None,
+        metavar="N",
+        help="admission cap: at most N work RPCs execute concurrently "
+        "(default $NEMO_SERVE_INFLIGHT or 4); excess requests queue up to "
+        "--max-queue, then reject RESOURCE_EXHAUSTED with a retry-after hint",
+    )
+    parser.add_argument(
+        "--max-queue",
+        type=int,
+        default=None,
+        metavar="N",
+        help="admission queue bound across tenants (default $NEMO_SERVE_QUEUE "
+        "or 64); 0 = reject anything that cannot start immediately",
+    )
+    parser.add_argument(
+        "--drain-s",
+        type=float,
+        default=None,
+        metavar="S",
+        help="graceful-drain window on SIGTERM: refuse new admissions "
+        "(/healthz -> NOT_SERVING), finish in-flight requests up to S "
+        "seconds, then exit (default $NEMO_SERVE_DRAIN_S or 30)",
+    )
     parser.add_argument(
         "--profiler-port",
         type=int,
@@ -550,6 +891,14 @@ def main(argv: list[str] | None = None) -> int:
         os.environ["NEMO_CORPUS_CACHE"] = args.corpus_cache
     if args.result_cache is not None:
         os.environ["NEMO_RESULT_CACHE"] = args.result_cache
+    # Serving knobs are env-carried too (the admission controller reads the
+    # env on first access, which is after these writes).
+    if args.max_inflight is not None:
+        os.environ["NEMO_SERVE_INFLIGHT"] = str(args.max_inflight)
+    if args.max_queue is not None:
+        os.environ["NEMO_SERVE_QUEUE"] = str(args.max_queue)
+    if args.drain_s is not None:
+        os.environ["NEMO_SERVE_DRAIN_S"] = str(args.drain_s)
     from nemo_tpu.utils.jax_config import (
         PlatformUnavailableError,
         enable_compilation_cache,
@@ -588,13 +937,48 @@ def main(argv: list[str] | None = None) -> int:
         log.info("metrics.listening", port=mport, paths=["/metrics", "/healthz"])
     server, port = make_server(args.port, args.max_workers)
     server.start()
-    log.info("sidecar.listening", port=port)
+    ctl = serve.controller()
+    log.info(
+        "sidecar.listening", port=port,
+        max_inflight=ctl.max_inflight, max_queue=ctl.max_queue,
+    )
+    # Graceful drain (ISSUE 8 satellite): SIGTERM refuses new admissions
+    # (the admission controller's drain flag, which /healthz mirrors as
+    # NOT_SERVING so load balancers stop routing here), lets in-flight
+    # requests finish up to NEMO_SERVE_DRAIN_S, then exits 0 — where the
+    # pre-serve sidecar died mid-request.
+    import signal
+
+    term = threading.Event()
+
+    def _on_term(signum, frame):  # signal-safe: just flag and wake
+        term.set()
+
+    prev_handler = signal.signal(signal.SIGTERM, _on_term)
     try:
-        server.wait_for_termination()
+        # Poll the term flag rather than wait_for_termination: grpc's
+        # timeout return value is version-ambiguous, and nothing else
+        # stops this server (SIGINT raises KeyboardInterrupt through the
+        # wait, landing in the finally like before).
+        while not term.wait(0.5):
+            pass
+        drain_s = serve.admission.drain_seconds()
+        log.info(
+            "sidecar.drain_begin", drain_s=drain_s,
+            inflight=ctl.inflight, queued=ctl.queued,
+        )
+        ctl.begin_drain()
+        # grpc's own grace: no new RPCs, in-flight handlers run on.
+        stopped = server.stop(grace=drain_s)
+        drained = ctl.drain_wait(drain_s)
+        stopped.wait(timeout=5.0)
+        obs.metrics.inc("serve.drained" if drained else "serve.drain_timeout")
+        log.info("sidecar.drained", clean=drained, inflight=ctl.inflight)
+        return 0 if drained else 1
     finally:
+        signal.signal(signal.SIGTERM, prev_handler)
         if metrics_httpd is not None:
             metrics_httpd.shutdown()
-    return 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,50 @@
+"""Shared subprocess-service plumbing: free-port probe + listen gate.
+
+Every harness that boots a sidecar subprocess (bench serve tier,
+`make serve-smoke`, the obs/trace smokes) needs the same two primitives,
+and one of them encodes an environment quirk worth centralizing: this
+environment's grpc WEDGES channels whose first connect races the server's
+bind, so the listening socket must be observed BEFORE any channel is
+created — polling Health on an eagerly-created channel spins UNAVAILABLE
+forever against a perfectly healthy server.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+
+
+def free_port() -> int:
+    """An OS-assigned currently-free TCP port (the usual bind-to-0 probe;
+    the tiny TOCTOU window to the consumer's own bind is accepted)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def wait_listening(
+    port: int,
+    deadline_s: float = 120.0,
+    proc=None,
+    host: str = "127.0.0.1",
+    poll_s: float = 0.5,
+) -> None:
+    """Block until (host, port) accepts a TCP connection.
+
+    Raises RuntimeError when the deadline passes or ``proc`` (a Popen,
+    optional) exits first — with the exit code, so a crashed server is
+    distinguishable from a slow one."""
+    deadline = time.monotonic() + deadline_s
+    while True:
+        try:
+            socket.create_connection((host, port), 2.0).close()
+            return
+        except OSError:
+            rc = proc.poll() if proc is not None else None
+            if time.monotonic() > deadline or rc is not None:
+                raise RuntimeError(
+                    f"server never listened on {host}:{port} "
+                    f"(rc={rc}, waited {deadline_s:.0f}s)"
+                ) from None
+            time.sleep(poll_s)

@@ -259,14 +259,13 @@ def obs_smoke() -> int:
         a record carrying the client's propagated trace id.
     """
     import importlib.util
-    import socket
     import subprocess
     import sys as _sys
-    import time as _time
     import urllib.request
 
     from nemo_tpu.obs import trace as obs_trace
     from nemo_tpu.utils.jax_config import pin_platform
+    from nemo_tpu.utils.subproc import free_port, wait_listening
 
     if importlib.util.find_spec("grpc") is None:
         print(
@@ -283,11 +282,6 @@ def obs_smoke() -> int:
         # the smoke must not write into the user's results cache).
         os.environ["NEMO_RESULT_CACHE"] = "off"
         log_path = os.path.join(tmp, "sidecar_log.jsonl")
-
-        def free_port() -> int:
-            with socket.socket() as s:
-                s.bind(("127.0.0.1", 0))
-                return s.getsockname()[1]
 
         port, mport = free_port(), free_port()
         env = dict(os.environ, NEMO_LOG_FILE=log_path, NEMO_LOG_LEVEL="debug")
@@ -318,19 +312,10 @@ def obs_smoke() -> int:
         tid = t.trace_id
         problems: list[str] = []
         try:
-            # Same listening-socket gate as trace_smoke: this environment's
-            # grpc wedges channels whose first connect raced the bind.
-            deadline = _time.monotonic() + 120.0
-            while True:
-                try:
-                    socket.create_connection(("127.0.0.1", port), 2.0).close()
-                    break
-                except OSError:
-                    if _time.monotonic() > deadline or proc.poll() is not None:
-                        raise RuntimeError(
-                            f"sidecar never listened on port {port} (rc={proc.poll()})"
-                        )
-                    _time.sleep(0.5)
+            # Same listening-socket gate as trace_smoke (utils/subproc.py):
+            # this environment's grpc wedges channels whose first connect
+            # raced the bind.
+            wait_listening(port, deadline_s=120.0, proc=proc)
 
             from nemo_tpu.analysis.pipeline import run_debug
             from nemo_tpu.backend.service_backend import ServiceBackend
@@ -758,6 +743,270 @@ def shard_smoke() -> int:
     return 0
 
 
+def serve_smoke() -> int:
+    """Serving-tier smoke (`make serve-smoke`, also the tail of `make
+    validate`; ISSUE 8): boot a `--max-inflight 2` sidecar SUBPROCESS and
+
+      * fire 6 concurrent clients (3 identical directories, 3 distinct)
+        and assert EXACTLY ONE underlying analysis served the identical
+        trio (single-flight coalescing: serve.analyze_chunks == 4,
+        serve.coalesce.hit == 2), with the trio's responses byte-equal
+        and zero failed/rejected requests;
+      * assert the serve.* series (queue/inflight gauges, coalesce
+        counters, queued-vs-executing latency histograms) are live on
+        `/metrics`;
+      * send SIGTERM while one more request is in flight and assert the
+        drain contract: `/healthz` flips NOT_SERVING, the in-flight
+        request completes, and the process exits 0.
+    """
+    import importlib.util
+    import signal
+    import subprocess
+    import sys as _sys
+    import threading
+    import time as _time
+    import urllib.error
+    import urllib.request
+
+    from nemo_tpu.utils.jax_config import pin_platform
+    from nemo_tpu.utils.subproc import free_port, wait_listening
+
+    if importlib.util.find_spec("grpc") is None:
+        print(
+            "serve-smoke: grpcio not installed; skipping (the smoke's whole "
+            "surface is the sidecar)",
+            file=sys.stderr,
+        )
+        return 0
+    pin_platform("cpu")
+    # The assertions depend on the serving defaults; an operator's own
+    # NEMO_SERVE_* pins must not red a healthy validate (the obs_smoke
+    # NEMO_ANALYSIS_IMPL precedent).  Saved and restored.
+    serve_knobs = (
+        "NEMO_SERVE_INFLIGHT",
+        "NEMO_SERVE_QUEUE",
+        "NEMO_SERVE_DRAIN_S",
+        "NEMO_SERVE_COALESCE_LINGER_S",
+        "NEMO_SERVE_BATCH_WINDOW_MS",
+        "NEMO_RESULT_CACHE",
+        "NEMO_CORPUS_CACHE",
+    )
+    prior_knobs = {k: os.environ.pop(k, None) for k in serve_knobs}
+    try:
+        with tempfile.TemporaryDirectory(prefix="nemo_serve_smoke_") as tmp:
+            from nemo_tpu.models.synth import SynthSpec, write_corpus
+            from nemo_tpu.obs import promexp
+            from nemo_tpu.service.client import RemoteAnalyzer
+
+            shared = write_corpus(SynthSpec(n_runs=5, seed=41, name="shared"), tmp)
+            distinct = [
+                write_corpus(SynthSpec(n_runs=5, seed=42 + i, name=f"solo{i}"), tmp)
+                for i in range(3)
+            ]
+            drain_dir = write_corpus(SynthSpec(n_runs=12, seed=49, name="drain"), tmp)
+
+            port, mport = free_port(), free_port()
+            log_path = os.path.join(tmp, "sidecar_log.jsonl")
+            env = dict(
+                os.environ,
+                NEMO_LOG_FILE=log_path,
+                # Server-side corpus store ON (the content address the
+                # single-flight keys on needs segment fingerprints);
+                # result cache OFF so the dedup below is attributable to
+                # COALESCING alone; a generous linger makes the trio
+                # deterministic even if admission staggers them.
+                NEMO_CORPUS_CACHE=os.path.join(tmp, "corpus_cache"),
+                NEMO_RESULT_CACHE="off",
+                NEMO_SERVE_COALESCE_LINGER_S="60",
+            )
+            env.pop("NEMO_TRACE", None)
+            sidecar_log = os.path.join(tmp, "sidecar.stderr")
+            log_fh = open(sidecar_log, "w")
+            proc = subprocess.Popen(
+                [_sys.executable, "-m", "nemo_tpu.service.server",
+                 "--port", str(port), "--platform", "cpu",
+                 "--metrics-port", str(mport), "--max-inflight", "2"],
+                stdout=log_fh,
+                stderr=subprocess.STDOUT,
+                env=env,
+            )
+            problems: list[str] = []
+            try:
+                # Socket gate before any channel (utils/subproc.py: this
+                # env's grpc wedges channels that raced the bind).
+                wait_listening(port, deadline_s=120.0, proc=proc)
+
+                target = f"127.0.0.1:{port}"
+                with RemoteAnalyzer(target=target) as probe:
+                    probe.wait_ready(60.0)
+
+                def scrape() -> dict:
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{mport}/metrics", timeout=15
+                    ) as resp:
+                        return promexp.parse_prometheus_text(resp.read().decode("utf-8"))
+
+                def sample(fams: dict, name: str) -> float:
+                    fam = fams.get(name)
+                    if not fam:
+                        return 0.0
+                    return fam["samples"][0][2]
+
+                # 6 concurrent clients: 3 identical (the coalescing trio)
+                # + 3 distinct, all against a --max-inflight 2 sidecar.
+                payloads: list = [None] * 6
+                failures: list = []
+
+                def client_thread(i: int, d: str) -> None:
+                    try:
+                        with RemoteAnalyzer(target=target, tenant=f"t{i % 2}") as c:
+                            resp, _ = c._call(
+                                c._analyze_dir, {"dir": d}, name="AnalyzeDir"
+                            )
+                            payloads[i] = resp.SerializeToString()
+                    except Exception as ex:
+                        failures.append(f"client {i}: {type(ex).__name__}: {ex}")
+
+                dirs = [shared, shared, shared] + distinct
+                threads = [
+                    threading.Thread(target=client_thread, args=(i, d))
+                    for i, d in enumerate(dirs)
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=300)
+                if failures:
+                    problems.append("; ".join(failures))
+                elif any(p is None for p in payloads):
+                    problems.append("a client thread never finished")
+                else:
+                    trio = set(payloads[:3])
+                    if len(trio) != 1:
+                        problems.append(
+                            "identical trio responses are NOT byte-equal"
+                        )
+                    fams = scrape()
+                    chunks = sample(fams, "nemo_serve_analyze_chunks_total")
+                    if chunks != 4:
+                        problems.append(
+                            f"expected exactly 4 underlying analyses (1 shared "
+                            f"+ 3 distinct), metrics say {chunks}"
+                        )
+                    hits = sample(fams, "nemo_serve_coalesce_hit_total")
+                    if hits != 2:
+                        problems.append(f"expected 2 coalesce hits, got {hits}")
+                    if sample(fams, "nemo_serve_rejected_total"):
+                        problems.append("requests were rejected under the default queue")
+                    for series in (
+                        "nemo_serve_queue_depth",
+                        "nemo_serve_inflight",
+                        "nemo_serve_coalesce_leader_total",
+                        "nemo_serve_queued_s",
+                        "nemo_serve_exec_s",
+                        "nemo_serve_tenant_t0_requests_total",
+                    ):
+                        if series not in fams:
+                            problems.append(f"/metrics missing serve series {series}")
+
+                # Drain: one more (cold, so slow) request in flight, then
+                # SIGTERM — NOT_SERVING on /healthz, request completes,
+                # clean exit.
+                drained_result: list = []
+
+                def drain_client() -> None:
+                    try:
+                        with RemoteAnalyzer(target=target) as c:
+                            drained_result.append(c.analyze_dir_remote(drain_dir))
+                    except Exception as ex:
+                        drained_result.append(ex)
+
+                admitted_before = sample(scrape(), "nemo_serve_admitted_total")
+                dt = threading.Thread(target=drain_client)
+                dt.start()
+                deadline = _time.monotonic() + 60.0
+                while sample(scrape(), "nemo_serve_admitted_total") <= admitted_before:
+                    if _time.monotonic() > deadline:
+                        problems.append("drain request never admitted")
+                        break
+                    _time.sleep(0.05)
+                proc.send_signal(signal.SIGTERM)
+                not_serving = False
+                deadline = _time.monotonic() + 30.0
+                while _time.monotonic() < deadline and proc.poll() is None:
+                    try:
+                        with urllib.request.urlopen(
+                            f"http://127.0.0.1:{mport}/healthz", timeout=5
+                        ) as resp:
+                            doc = json.loads(resp.read().decode("utf-8"))
+                            if doc.get("status") == "NOT_SERVING":
+                                not_serving = True
+                                break
+                    except urllib.error.HTTPError as ex:
+                        if ex.code == 503:
+                            not_serving = True
+                            break
+                    except OSError:
+                        break  # httpd already down: rely on rc + log below
+                    _time.sleep(0.05)
+                dt.join(timeout=120)
+                rc = proc.wait(timeout=120)
+                if not drained_result or isinstance(drained_result[0], Exception):
+                    problems.append(
+                        f"in-flight request did not survive the drain: "
+                        f"{drained_result[:1]}"
+                    )
+                if rc != 0:
+                    problems.append(f"sidecar exited rc={rc} after SIGTERM drain")
+                drain_logged = False
+                if os.path.exists(log_path):
+                    with open(log_path, "r", encoding="utf-8") as fh:
+                        for line in fh:
+                            try:
+                                rec = json.loads(line)
+                            except json.JSONDecodeError:
+                                continue
+                            if rec.get("event") == "sidecar.drained" and rec.get("clean"):
+                                drain_logged = True
+                if not (not_serving or drain_logged):
+                    problems.append(
+                        "no NOT_SERVING observed during drain and no clean "
+                        "sidecar.drained log record"
+                    )
+            except Exception as ex:
+                if os.path.exists(sidecar_log):
+                    with open(sidecar_log, "r", encoding="utf-8") as fh:
+                        print(
+                            "serve-smoke: sidecar log tail:\n" + fh.read()[-3000:],
+                            file=sys.stderr,
+                        )
+                print(f"serve-smoke: {type(ex).__name__}: {ex}", file=sys.stderr)
+                return 1
+            finally:
+                if proc.poll() is None:
+                    proc.terminate()
+                    try:
+                        proc.wait(timeout=15)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()
+                        proc.wait(timeout=15)
+                log_fh.close()
+            if problems:
+                print("serve-smoke: " + "; ".join(problems), file=sys.stderr)
+                return 1
+            print(
+                "serve-smoke: ok — 3 identical concurrent requests coalesced "
+                "into 1 analysis (2 hits, byte-equal responses), 4 analyses "
+                "total for 6 clients, serve.* series live on /metrics, and a "
+                "SIGTERM drain finished its in-flight request and exited clean"
+            )
+            return 0
+    finally:
+        for k, v in prior_knobs.items():
+            if v is not None:
+                os.environ[k] = v
+
+
 def main() -> int:
     from nemo_tpu.analysis.pipeline import run_debug
     from nemo_tpu.backend.jax_backend import JaxBackend
@@ -926,7 +1175,13 @@ def main() -> int:
     # Result-cache + incremental-delta contract (also standalone: make
     # delta-smoke): warm repeat = full-report hit with zero kernel
     # dispatches; grown corpus maps only the new runs, byte-identical.
-    return delta_smoke()
+    rc = delta_smoke()
+    if rc:
+        return rc
+    # Serving-tier contract (also standalone: make serve-smoke): concurrent
+    # identical requests coalesce into one analysis with byte-equal
+    # responses, serve.* metrics live, SIGTERM drains cleanly.
+    return serve_smoke()
 
 
 if __name__ == "__main__":
@@ -940,4 +1195,6 @@ if __name__ == "__main__":
         sys.exit(delta_smoke())
     if "--shard-smoke" in sys.argv:
         sys.exit(shard_smoke())
+    if "--serve-smoke" in sys.argv:
+        sys.exit(serve_smoke())
     sys.exit(main())

@@ -1,0 +1,566 @@
+"""Serving tier (ISSUE 8, nemo_tpu/serve): admission control + fairness,
+single-flight coalescing (byte-identical responses, one analysis),
+cross-request continuous batching with exact demux, the streaming RPC's
+completion-order push, and drain semantics."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+from nemo_tpu import obs, serve  # noqa: E402
+from nemo_tpu.serve.admission import AdmissionController, AdmissionRejected  # noqa: E402
+
+
+@pytest.fixture
+def fresh_serve_singletons():
+    """Reset the process singletons before AND after: tests that pin tight
+    env caps must not leave them for the session sidecar fixture."""
+    serve.reset_controller()
+    serve.reset_flights()
+    serve.reset_batcher()
+    yield
+    serve.reset_controller()
+    serve.reset_flights()
+    serve.reset_batcher()
+
+
+# ---------------------------------------------------------------- admission
+
+
+def test_admission_tenant_fairness_round_robin():
+    """A greedy tenant's burst cannot starve another tenant's single
+    request: grants rotate across tenants."""
+    ctl = AdmissionController(max_inflight=1, max_queue=10)
+    t1 = ctl.enqueue("greedy")
+    assert t1.wait(1.0)
+    a2, a3, a4 = (ctl.enqueue("greedy") for _ in range(3))
+    b1 = ctl.enqueue("blue")
+    # blue's single ticket is behind exactly ONE greedy ticket (one per
+    # rotation), never behind the whole burst.
+    assert b1.position() <= 2
+    order = []
+    for t in (t1,):
+        t.release()
+    for expected in (a2, b1, a3, a4):
+        assert expected.wait(1.0), "grant order diverged from round-robin"
+        order.append(expected)
+        # Only the expected ticket may hold the single slot.
+        others = [x for x in (a2, b1, a3, a4) if x not in order]
+        assert not any(o.wait(0) for o in others)
+        expected.release()
+    assert ctl.inflight == 0 and ctl.queued == 0
+
+
+def test_admission_queue_full_rejects_with_metrics():
+    ctl = AdmissionController(max_inflight=1, max_queue=2)
+    t1 = ctl.enqueue("a")
+    assert t1.wait(1.0)
+    q1 = ctl.enqueue("a")
+    q2 = ctl.enqueue("b")
+    with pytest.raises(AdmissionRejected) as ei:
+        ctl.enqueue("c")
+    assert ei.value.reason == "queue_full"
+    assert ei.value.retry_after_s > 0
+    snap = obs.metrics.snapshot()
+    assert snap["gauges"]["serve.queue_depth"] == 2.0
+    assert snap["gauges"]["serve.inflight"] == 1.0
+    assert snap["counters"].get("serve.rejected.queue_full", 0) >= 1
+    assert snap["counters"].get("serve.tenant.c.rejected", 0) >= 1
+    for t in (t1, q1, q2):
+        t.release()
+        t.wait(1.0)
+        t.release()
+
+
+def test_admission_drain_refuses_and_drains():
+    ctl = AdmissionController(max_inflight=2, max_queue=4)
+    t1 = ctl.enqueue("a")
+    assert t1.wait(1.0)
+    ctl.begin_drain()
+    with pytest.raises(AdmissionRejected) as ei:
+        ctl.enqueue("b")
+    assert ei.value.reason == "draining"
+    assert not ctl.drain_wait(0.05)  # t1 still holds a slot
+    t1.release()
+    assert ctl.drain_wait(1.0)
+
+
+def test_admission_release_is_idempotent_and_cancel_unqueues():
+    ctl = AdmissionController(max_inflight=1, max_queue=4)
+    t1 = ctl.enqueue("a")
+    q1 = ctl.enqueue("a")
+    q1.cancel()
+    assert ctl.queued == 0
+    t1.release()
+    t1.release()  # second release must not free a phantom slot
+    assert ctl.inflight == 0
+    t2 = ctl.enqueue("a")
+    assert t2.wait(1.0)
+    t2.release()
+
+
+# --------------------------------------------------- server-level admission
+
+
+def test_server_rejects_at_cap_with_retry_after(
+    corpus_dir, monkeypatch, fresh_serve_singletons
+):
+    """With the inflight slot held and a zero queue, a work RPC is shed
+    with RESOURCE_EXHAUSTED and a nemo-retry-after-s hint; releasing the
+    slot lets the same request through."""
+    from nemo_tpu.service.client import RemoteAnalyzer
+    from nemo_tpu.service.server import make_server
+
+    monkeypatch.setenv("NEMO_SERVE_INFLIGHT", "1")
+    monkeypatch.setenv("NEMO_SERVE_QUEUE", "0")
+    serve.reset_controller()
+    server, port = make_server(port=0)
+    server.start()
+    try:
+        ctl = serve.controller()
+        assert ctl.max_inflight == 1 and ctl.max_queue == 0
+        hog = ctl.enqueue("hog")
+        assert hog.wait(1.0)
+        with RemoteAnalyzer(target=f"127.0.0.1:{port}", retries=1) as client:
+            client.wait_ready()  # Health is never gated
+            with pytest.raises(grpc.RpcError) as ei:
+                client.analyze_dir_remote(corpus_dir)
+            assert ei.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+            md = dict(ei.value.trailing_metadata() or ())
+            assert float(md["nemo-retry-after-s"]) > 0
+            hog.release()
+            out = client.analyze_dir_remote(corpus_dir)
+            assert "proto_bits" in out
+    finally:
+        server.stop(grace=None)
+
+
+def test_server_tenant_metadata_counted(corpus_dir, fresh_serve_singletons):
+    from nemo_tpu.service.client import RemoteAnalyzer
+    from nemo_tpu.service.server import make_server
+
+    server, port = make_server(port=0)
+    server.start()
+    try:
+        m0 = obs.metrics.snapshot()
+        with RemoteAnalyzer(target=f"127.0.0.1:{port}", tenant="team-a") as client:
+            client.wait_ready()
+            client.analyze_dir_remote(corpus_dir)
+        mc = obs.Metrics.delta(obs.metrics.snapshot(), m0)["counters"]
+        assert mc.get("serve.tenant.team-a.requests", 0) >= 1
+    finally:
+        server.stop(grace=None)
+
+
+# -------------------------------------------------------------- coalescing
+
+
+def test_coalesced_responses_byte_identical_single_analysis(
+    tmp_path, monkeypatch, fresh_serve_singletons
+):
+    """Three concurrent identical AnalyzeDir requests -> ONE underlying
+    analysis, three byte-identical responses, and (after the flight ages
+    out) byte-identical to a solo execution modulo step_seconds."""
+    from nemo_tpu.models.synth import SynthSpec, write_corpus
+    from nemo_tpu.service.client import RemoteAnalyzer
+    from nemo_tpu.service.server import SERVICE, make_server
+    from nemo_tpu.service.proto import nemo_service_pb2 as pb
+
+    corpus = write_corpus(SynthSpec(n_runs=5, seed=11, name="coalesce"), str(tmp_path))
+    # The content address needs store segment fingerprints: server-side
+    # corpus store ON (hermetic root), result cache OFF so only the
+    # single-flight can dedup.
+    monkeypatch.setenv("NEMO_CORPUS_CACHE", str(tmp_path / "cc"))
+    monkeypatch.setenv("NEMO_RESULT_CACHE", "off")
+    monkeypatch.setenv("NEMO_SERVE_COALESCE_LINGER_S", "30")
+    serve.reset_flights()
+    server, port = make_server(port=0)
+    server.start()
+    target = f"127.0.0.1:{port}"
+    try:
+        with RemoteAnalyzer(target=target) as probe:
+            probe.wait_ready()
+
+        def raw_analyze(results, i):
+            with RemoteAnalyzer(target=target) as client:
+                resp, call = client._call(
+                    client._analyze_dir, {"dir": corpus}, name="AnalyzeDir"
+                )
+                results[i] = (
+                    resp.SerializeToString(),
+                    dict(call.trailing_metadata() or ()),
+                )
+
+        m0 = obs.metrics.snapshot()
+        results: list = [None] * 3
+        threads = [
+            threading.Thread(target=raw_analyze, args=(results, i)) for i in range(3)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert all(r is not None for r in results)
+        mc = obs.Metrics.delta(obs.metrics.snapshot(), m0)["counters"]
+        assert mc.get("serve.analyze_chunks", 0) == 1, mc
+        assert mc.get("serve.coalesce.leader", 0) == 1
+        assert mc.get("serve.coalesce.hit", 0) == 2
+        payloads = {r[0] for r in results}
+        assert len(payloads) == 1, "coalesced responses are not byte-identical"
+        roles = sorted(r[1].get("nemo-coalesce") for r in results)
+        assert roles == ["hit", "hit", "leader"]
+
+        # Solo execution (flights cleared so nothing lingers): identical
+        # bytes once the measured wall is normalized out.
+        serve.flights().clear()
+        solo: list = [None]
+        raw_analyze(solo, 0)
+        mc2 = obs.Metrics.delta(obs.metrics.snapshot(), m0)["counters"]
+        assert mc2.get("serve.analyze_chunks", 0) == 2
+
+        def normalized(payload: bytes) -> bytes:
+            r = pb.AnalyzeResponse.FromString(payload)
+            r.step_seconds = 0.0
+            return r.SerializeToString()
+
+        assert normalized(solo[0][0]) == normalized(results[0][0])
+    finally:
+        server.stop(grace=None)
+
+
+# --------------------------------------------------------------- streaming
+
+
+def test_stream_yields_families_in_completion_order(
+    tmp_path, monkeypatch, fresh_serve_singletons
+):
+    """AnalyzeDirStream pushes each family as it completes: a result-cached
+    directory lands while a cold one is still compiling, regardless of
+    request order."""
+    from nemo_tpu.models.synth import SynthSpec, write_corpus
+    from nemo_tpu.service.client import RemoteAnalyzer
+    from nemo_tpu.service.server import make_server
+
+    monkeypatch.setenv("NEMO_CORPUS_CACHE", str(tmp_path / "cc"))
+    monkeypatch.setenv("NEMO_RESULT_CACHE", str(tmp_path / "rc"))
+    warm = write_corpus(SynthSpec(n_runs=4, seed=21, name="warm"), str(tmp_path))
+    cold = write_corpus(SynthSpec(n_runs=9, seed=22, name="cold"), str(tmp_path))
+    server, port = make_server(port=0)
+    server.start()
+    try:
+        with RemoteAnalyzer(target=f"127.0.0.1:{port}") as client:
+            client.wait_ready()
+            client.analyze_dir_remote(warm)  # populate the response cache
+            events = list(client.analyze_dir_stream([cold, warm]))
+        kinds = [e["event"] for e in events]
+        assert kinds[-1] == "done"
+        assert events[-1]["results"] == 2 and events[-1]["errors"] == 0
+        results = [e for e in events if e["event"] == "result"]
+        assert [r["dir"] for r in results] == [warm, cold]
+        # The warm family was served from a dedup tier — the persistent
+        # response cache, or the unary request's still-lingering flight
+        # (both are content-addressed; which one wins is a timing detail).
+        assert results[0]["rcache"] == "hit" or results[0]["coalesce"] == "hit"
+        # Progress events precede the first result.
+        assert any(k in ("admitted", "phase", "queued") for k in kinds[: kinds.index("result")])
+        # Decoded outputs match the unary path.
+        unary = None
+        with RemoteAnalyzer(target=f"127.0.0.1:{port}") as client2:
+            client2.wait_ready()
+            unary = client2.analyze_dir_remote(cold)
+        by_dir = {r["dir"]: r["outputs"] for r in results}
+        assert set(by_dir[cold]) == set(unary)
+        for k in unary:
+            np.testing.assert_array_equal(by_dir[cold][k], unary[k], err_msg=k)
+    finally:
+        server.stop(grace=None)
+
+
+def test_stream_admission_rejection_is_per_family(
+    corpus_dir, monkeypatch, fresh_serve_singletons
+):
+    """A stream whose directories cannot all be admitted reports per-family
+    error events with retry-after, not a dead stream."""
+    from nemo_tpu.service.client import RemoteAnalyzer
+    from nemo_tpu.service.server import make_server
+
+    monkeypatch.setenv("NEMO_SERVE_INFLIGHT", "1")
+    monkeypatch.setenv("NEMO_SERVE_QUEUE", "0")
+    serve.reset_controller()
+    server, port = make_server(port=0)
+    server.start()
+    try:
+        ctl = serve.controller()
+        hog = ctl.enqueue("hog")
+        assert hog.wait(1.0)
+        with RemoteAnalyzer(target=f"127.0.0.1:{port}") as client:
+            client.wait_ready()
+            events = list(client.analyze_dir_stream([corpus_dir]))
+        hog.release()
+        errors = [e for e in events if e["event"] == "error"]
+        assert len(errors) == 1
+        assert errors[0]["status"] == "RESOURCE_EXHAUSTED"
+        assert errors[0]["retry_after_s"] > 0
+        assert events[-1] == {"event": "done", "results": 0, "errors": 1}
+    finally:
+        server.stop(grace=None)
+
+
+# ------------------------------------------------------------------- drain
+
+
+def test_drain_semantics_in_process(corpus_dir, fresh_serve_singletons):
+    """begin_drain: /healthz flips NOT_SERVING, new work RPCs are refused
+    UNAVAILABLE, in-flight work still completes.  (The full SIGTERM path —
+    signal, in-flight completion, clean exit — is `make serve-smoke`.)"""
+    from nemo_tpu.service.client import RemoteAnalyzer
+    from nemo_tpu.service.server import _health_state, make_server
+
+    server, port = make_server(port=0)
+    server.start()
+    try:
+        ctl = serve.controller()
+        with RemoteAnalyzer(target=f"127.0.0.1:{port}", retries=1) as client:
+            client.wait_ready()
+            assert _health_state()["status"] == "SERVING"
+            ctl.begin_drain()
+            assert _health_state()["status"] == "NOT_SERVING"
+            with pytest.raises(grpc.RpcError) as ei:
+                client.analyze_dir_remote(corpus_dir)
+            assert ei.value.code() == grpc.StatusCode.UNAVAILABLE
+            # Health stays answerable for probes while draining.
+            assert client.health()["platform"]
+        assert ctl.drain_wait(2.0)
+    finally:
+        server.stop(grace=None)
+
+
+# ---------------------------------------------------- continuous batching
+
+
+def _condition_request(packed, rows):
+    pre, post, static = packed
+    arrays = {
+        n: np.asarray(getattr(post, n))[rows]
+        for n in ("edge_src", "edge_dst", "edge_mask", "is_goal", "table_id", "node_mask")
+    }
+    params = {
+        "v": static["v"],
+        "cond_tid": static["post_tid"],
+        "num_tables": static["num_tables"],
+    }
+    return arrays, params
+
+
+class _GateExecutor:
+    """LocalExecutor wrapper whose FIRST dispatch blocks until released —
+    deterministically parks the batcher's in-flight launch so concurrent
+    requests accumulate into one merged launch."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.calls: list[tuple[str, int, int | None]] = []
+        self.started = threading.Event()
+        self.release = threading.Event()
+
+    def run(self, verb, arrays, params, rows=None):
+        first = not self.calls
+        lead = int(np.shape(next(iter(arrays.values())))[0])
+        self.calls.append((verb, lead, rows))
+        if first:
+            self.started.set()
+            assert self.release.wait(30)
+        return self.inner.run(verb, arrays, params, rows=rows)
+
+
+@pytest.fixture(scope="module")
+def packed(corpus_dir):
+    from nemo_tpu.ingest.molly import load_molly_output
+    from nemo_tpu.models.pipeline_model import pack_molly_for_step
+
+    return pack_molly_for_step(load_molly_output(corpus_dir))
+
+
+def test_cross_request_batch_demuxes_exactly(packed):
+    """Two requests accumulated behind an in-flight launch merge into ONE
+    padded device launch, rows tagged per request: each demuxed result is
+    bit-identical to its solo execution and the rows hint carries the real
+    merged count."""
+    from nemo_tpu.backend.jax_backend import LocalExecutor
+    from nemo_tpu.graphs.packed import bucket_size
+    from nemo_tpu.parallel import sched
+    from nemo_tpu.serve.batch import KernelBatcher, dispatch_signature
+
+    a_rows, b_rows, c_rows = slice(0, 3), slice(3, 5), slice(5, 8)
+    req_a, params = _condition_request(packed, a_rows)
+    req_b, _ = _condition_request(packed, b_rows)
+    req_c, _ = _condition_request(packed, c_rows)
+
+    gate = _GateExecutor(LocalExecutor())
+    batcher = KernelBatcher(window_s=0)
+    sig = dispatch_signature("condition", req_a, params)
+    results: dict = {}
+    errors: list = []
+
+    def submit(name, arrays):
+        try:
+            results[name] = batcher.run(gate, "condition", arrays, params)
+        except BaseException as ex:  # surfaced by the final assert
+            errors.append(ex)
+
+    m0 = obs.metrics.snapshot()
+    ta = threading.Thread(target=submit, args=("a", req_a))
+    ta.start()
+    assert gate.started.wait(10), "leader launch never started"
+    tb = threading.Thread(target=submit, args=("b", req_b))
+    tc = threading.Thread(target=submit, args=("c", req_c))
+    tb.start()
+    tc.start()
+    deadline = time.monotonic() + 10
+    while len(batcher._groups[sig].pending) < 2:
+        assert time.monotonic() < deadline, "requests never accumulated"
+        time.sleep(0.01)
+    gate.release.set()
+    for t in (ta, tb, tc):
+        t.join(timeout=60)
+    assert not errors, errors
+
+    # One solo launch (the gated leader) + ONE merged launch for b+c.
+    assert len(gate.calls) == 2
+    merged_verb, merged_lead, merged_rows = gate.calls[1]
+    assert merged_rows == 2 + 3  # real rows, attested through the hint
+    assert merged_lead == bucket_size(5, minimum=1)  # padded to the bucket
+
+    solo = LocalExecutor()
+    for name, arrays in (("a", req_a), ("b", req_b), ("c", req_c)):
+        want = solo.run("condition", arrays, params)
+        got = results[name]
+        assert set(got) == set(want)
+        for k in want:
+            np.testing.assert_array_equal(
+                np.asarray(got[k]), np.asarray(want[k]), err_msg=f"{name}:{k}"
+            )
+
+    mc = obs.Metrics.delta(obs.metrics.snapshot(), m0)["counters"]
+    assert mc.get("serve.batch.launches", 0) == 2
+    assert mc.get("serve.batch.merged_requests", 0) == 3
+    assert mc.get("serve.batch.coalesced_requests", 0) == 1
+    # The merged launch rode parallel/sched.py's job queue, tagged "serve".
+    assert mc.get("analysis.sched.dispatch.device", 0) >= 2
+    serve_recs = [r for r in sched.sched_snapshot() if r.get("source") == "serve"]
+    assert serve_recs and serve_recs[-1]["verb"] == "condition"
+    assert serve_recs[-1]["pinned"] is True
+
+
+def test_batcher_never_merges_per_graph_dispatches():
+    """The same verbs also dispatch PER-GRAPH (is_goal a 1-D node vector,
+    adj a 2-D matrix) where the leading axis is nodes, not runs — the rank
+    gate must route those solo; merging two unrelated graphs along the
+    node axis would corrupt both."""
+    from nemo_tpu.serve.batch import _eligible_rows
+
+    assert (
+        _eligible_rows(
+            "condition",
+            {"is_goal": np.zeros(8, bool), "edge_src": np.zeros(8, np.int32)},
+        )
+        is None
+    )
+    assert (
+        _eligible_rows(
+            "condition",
+            {"is_goal": np.zeros((3, 8), bool), "edge_src": np.zeros((3, 5), np.int32)},
+        )
+        == 3
+    )
+    assert (
+        _eligible_rows(
+            "proto", {"adj": np.zeros((8, 8), bool), "is_goal": np.zeros(8, bool)}
+        )
+        is None
+    )
+    assert (
+        _eligible_rows(
+            "proto",
+            {"adj": np.zeros((2, 8, 8), bool), "is_goal": np.zeros((2, 8), bool)},
+        )
+        == 2
+    )
+    assert _eligible_rows("fused", {"pre_is_goal": np.zeros((2, 8))}) is None
+    # Inconsistent leading dims: solo.
+    assert (
+        _eligible_rows(
+            "condition",
+            {"is_goal": np.zeros((3, 8), bool), "edge_src": np.zeros((2, 5), np.int32)},
+        )
+        is None
+    )
+
+
+def test_batcher_passes_through_non_batchable_verbs(packed):
+    """fused/giant/diff never merge (baseline-row and good-graph semantics);
+    they execute directly and count serve.batch.solo."""
+    from nemo_tpu.serve.batch import KernelBatcher
+
+    calls = []
+
+    class Spy:
+        def run(self, verb, arrays, params, rows=None):
+            calls.append(verb)
+            return {"ok": np.zeros(1)}
+
+    m0 = obs.metrics.snapshot()
+    KernelBatcher(window_s=0).run(Spy(), "fused", {"pre_is_goal": np.zeros((2, 4))}, {})
+    assert calls == ["fused"]
+    mc = obs.Metrics.delta(obs.metrics.snapshot(), m0)["counters"]
+    assert mc.get("serve.batch.solo", 0) == 1
+
+
+def test_batch_leader_failure_propagates_and_frees_token(packed):
+    req_a, params = _condition_request(packed, slice(0, 3))
+
+    class Boom:
+        def run(self, verb, arrays, params, rows=None):
+            raise RuntimeError("device fell over")
+
+    from nemo_tpu.serve.batch import KernelBatcher
+
+    batcher = KernelBatcher(window_s=0)
+    with pytest.raises(RuntimeError, match="device fell over"):
+        batcher.run(Boom(), "condition", req_a, params)
+    # The in-flight token was handed back: a later good dispatch proceeds.
+    from nemo_tpu.backend.jax_backend import LocalExecutor
+
+    out = batcher.run(LocalExecutor(), "condition", req_a, params)
+    assert "holds" in out
+
+
+# ------------------------------------------------- satellite: NEMO_MAX_BATCH
+
+
+def test_max_batch_env_warns_and_defaults_on_junk(monkeypatch):
+    """NEMO_MAX_BATCH junk now follows the warn-and-default policy of the
+    transfer knobs (ISSUE 8 satellite): under concurrent serving a
+    crash-at-init for a typo'd env would crash-loop every tenant."""
+    import warnings
+
+    from nemo_tpu.backend.jax_backend import _NO_OVERRIDE, _max_batch_env
+
+    monkeypatch.setenv("NEMO_MAX_BATCH", "8")
+    assert _max_batch_env() == 8
+    monkeypatch.setenv("NEMO_MAX_BATCH", "0")
+    assert _max_batch_env() is None  # unbounded
+    monkeypatch.delenv("NEMO_MAX_BATCH")
+    assert _max_batch_env() is _NO_OVERRIDE
+    for junk in ("banana", "2O48", "-3"):
+        monkeypatch.setenv("NEMO_MAX_BATCH", junk)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            assert _max_batch_env() is _NO_OVERRIDE
+        assert any("NEMO_MAX_BATCH" in str(x.message) for x in w), junk

@@ -22,7 +22,9 @@ Metrics compared (direction-aware; anything missing on either side skips):
   * **analysis route splits**: the sparse fraction of each verb's routed
     dispatches in the warm tier — a route FLIP on the same platform is
     exactly the silent regression the crossover machinery can produce, so
-    any shift past the threshold (absolute) flags in either direction.
+    any shift past the threshold (absolute) flags in either direction;
+  * serving-tier p50/p99 latency, throughput, coalesce ratio and rejects
+    under the standard concurrent-client load (``serve_tier``, ISSUE 8).
 
 Accepts both raw bench result lines and the repo's ``BENCH_rNN.json``
 wrapper shape (``{"parsed": {...}}``).  Entries whose result carries an
@@ -148,6 +150,23 @@ def extract_metrics(doc: dict) -> dict[str, tuple[float, str, str]]:
             "split",
             "ratio",
         )
+    # Serve tier (ISSUE 8): tail latency creeping up under the standard
+    # M-concurrent-client load, throughput collapsing, the coalesce ratio
+    # dropping (identical concurrent requests no longer deduped into one
+    # analysis), or rejects appearing under the default queue all flag.
+    # p50/p99 get the "s_fast" floor — the whole point of coalescing +
+    # admission is sub-second request latency, so the seconds-scale floor
+    # would mask a 5x regression of it.
+    sv = doc.get("serve_tier") or {}
+    put("serve_tier.p50_s", sv.get("p50_s"), "lower", "s_fast")
+    put("serve_tier.p99_s", sv.get("p99_s"), "lower", "s_fast")
+    put("serve_tier.throughput_rps", sv.get("throughput_rps"), "higher", "ratio")
+    put("serve_tier.coalesce_ratio", sv.get("coalesce_ratio"), "higher", "ratio")
+    # Rejects compare as an ABSOLUTE shift ("split"): the healthy history
+    # is all-zero, where a relative compare divides by a 0 median and can
+    # never flag the 0 -> N jump this metric exists to catch (any shift
+    # past the threshold count flags, in either direction).
+    put("serve_tier.rejects", sv.get("rejects"), "split", "ratio")
     figures = doc.get("figures") or {}
     put(
         "figures.e2e_warm_all_figures_s",
