@@ -5,7 +5,7 @@ NATIVE_LIB := native/build/libnemo_native.so
 REPORT_SRC := native/nemo_report.cpp
 REPORT_LIB := native/build/libnemo_report.so
 
-.PHONY: all native test bench bench-watch bench-trend prewarm validate trace-smoke obs-smoke store-smoke delta-smoke shard-smoke serve-smoke lint-print clean reset proto neo4j-up neo4j-validate neo4j-down
+.PHONY: all native test bench bench-watch bench-trend prewarm validate trace-smoke obs-smoke store-smoke delta-smoke shard-smoke serve-smoke chaos-smoke lint-print clean reset proto neo4j-up neo4j-validate neo4j-down
 
 all: native
 
@@ -21,11 +21,12 @@ $(REPORT_LIB): $(REPORT_SRC)
 test:
 	python -m pytest tests/ -x -q
 
-# Everything a reviewer needs in one command: the print lint, the full
-# suite, the driver's multi-chip dry run (8 virtual CPU devices), and a CLI
-# smoke whose jax report is byte-compared against the Python oracle backend
-# (whose tail runs the trace, operational-observability, corpus-store and
-# result-cache/delta smokes).
+# Everything a reviewer needs in one command: the print + silent-except
+# lint, the full suite, the driver's multi-chip dry run (8 virtual CPU
+# devices), and a CLI smoke whose jax report is byte-compared against the
+# Python oracle backend (whose tail runs the trace,
+# operational-observability, corpus-store, result-cache/delta, serving-tier
+# and chaos/fault-tolerance smokes).
 validate: lint-print test
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 		python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
@@ -81,6 +82,15 @@ delta-smoke:
 # completes, /healthz NOT_SERVING, exit 0) — nemo_tpu/serve.
 serve-smoke:
 	python -m nemo_tpu.utils.validate_smoke --serve-smoke
+
+# Fault-tolerance smoke (also the tail of `make validate`; ISSUE 9): the
+# chaos harness (nemo_tpu/utils/chaos.py) injects corrupt runs, device-lane
+# dispatch failures, and a mid-sweep SIGKILL into real pipeline runs and
+# asserts quarantine isolation, host-lane failover + circuit breaker
+# degradation, and crash-safe resume — every degraded report byte-identical
+# to its healthy twin.
+chaos-smoke:
+	python -m nemo_tpu.utils.validate_smoke --chaos-smoke
 
 # Structured-logging contract: no bare print() in nemo_tpu/ outside the
 # CLI/harness allowlist (tools/lint_no_print.py).

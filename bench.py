@@ -458,6 +458,158 @@ def child_main() -> None:
     except Exception as ex:  # the delta tier must never sink the bench
         log(f"delta tier skipped: {type(ex).__name__}: {ex}")
 
+    # Chaos tier (ISSUE 9): the fault-tolerance layer's COST, measured.
+    # Three walls over one corpus with both scheduler lanes live
+    # (NEMO_ANALYSIS_IMPL=crossover + NEMO_SCHED=on): healthy, FAULTED
+    # (injected device-dispatch failures -> host-lane failover + breaker
+    # trip; the report must stay byte-identical and zero requests fail),
+    # and DEGRADED (breaker held open -> host-only routing).  Plus the
+    # crash-recovery leg: a subprocess SIGKILLed after its first segment
+    # checkpoint, then resumed — recovery overhead is the resumed wall
+    # against an uninterrupted from-scratch wall.
+    chaos_tier = None
+    try:
+        from nemo_tpu.analysis.pipeline import report_tree_bytes as _ctree
+        from nemo_tpu.analysis.pipeline import run_debug as _crun
+        from nemo_tpu.backend.jax_backend import JaxBackend as _ChaosJB
+        from nemo_tpu.models.synth import grow_corpus_dir as _cgrow
+        from nemo_tpu.parallel import sched as _sched
+        from nemo_tpu.utils import chaos as _chaos
+
+        n = min(per_family, 200)
+        chaos_full = write_case_study(
+            families[0], n_runs=n, seed=29, out_dir=os.path.join(tmp, "chaos_full")
+        )
+        chaos_env = {
+            "NEMO_ANALYSIS_IMPL": "crossover",
+            "NEMO_SCHED": "on",
+            "NEMO_BREAKER_FAILURES": "1",
+            "NEMO_BREAKER_COOLDOWN_S": "3600",
+            "NEMO_RESULT_CACHE": "off",
+            "NEMO_CORPUS_CACHE": "off",
+        }
+        prior_env = {k: os.environ.get(k) for k in chaos_env}
+        os.environ.update(chaos_env)
+        try:
+
+            def _chaos_pass(label: str, **kw):
+                _chaos.reset()
+                _sched.reset_session_models()
+                m0 = obs.metrics.snapshot()
+                t0 = time.perf_counter()
+                res = _crun(
+                    chaos_full,
+                    os.path.join(tmp, "chaos_results", label),
+                    _ChaosJB(),
+                    figures="none",
+                    **kw,
+                )
+                wall = time.perf_counter() - t0
+                return wall, obs.Metrics.delta(obs.metrics.snapshot(), m0)["counters"], res
+
+            _sched.reset_device_breaker()
+            # Warmup pass: the first device dispatch pays the jit compile,
+            # which would land in healthy_s and make every overhead ratio
+            # read as a speedup; the ratios compare WARM walls.
+            _chaos_pass("warmup")
+            healthy_s, _, healthy_res = _chaos_pass("healthy")
+            os.environ["NEMO_CHAOS"] = "fail_dispatch:8"
+            faulted_s, m_f, faulted_res = _chaos_pass("faulted")
+            os.environ.pop("NEMO_CHAOS", None)
+            if _ctree(healthy_res.report_dir) != _ctree(faulted_res.report_dir):
+                raise RuntimeError("faulted report differs from healthy")
+            # Breaker is now open (cooldown pinned long): host-only mode.
+            degraded_s, m_d, degraded_res = _chaos_pass("degraded")
+            if _ctree(degraded_res.report_dir) != _ctree(healthy_res.report_dir):
+                raise RuntimeError("degraded report differs from healthy")
+        finally:
+            for k, v in prior_env.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            os.environ.pop("NEMO_CHAOS", None)
+            _chaos.reset()
+            _sched.reset_device_breaker()
+            _sched.reset_session_models()
+
+        # Crash-recovery leg: 3-segment store, child killed after the first
+        # checkpoint, resume in-process; scratch = uninterrupted run.
+        import subprocess as _sp
+
+        from nemo_tpu.store import CorpusStore as _CStore
+
+        rec_cc = os.path.join(tmp, "chaos_cc")
+        rec_rc = os.path.join(tmp, "chaos_rc")
+        staged = os.path.join(tmp, "chaos_staged", os.path.basename(chaos_full))
+        n_seg0 = max(1, int(n * 0.8))
+        _cgrow(chaos_full, staged, n_seg0)
+        _cstore = _CStore(rec_cc)
+        from nemo_tpu.analysis.pipeline import _ingest as _cingest
+
+        _cingest(staged, True, _cstore)
+        for frac in (0.9, 1.0):
+            _cgrow(chaos_full, staged, max(n_seg0 + 1, int(n * frac)))
+            _cstore.load_packed(staged)
+        child_env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            NEMO_CHAOS="kill_after_segments:1",
+            NEMO_CORPUS_CACHE=rec_cc,
+            NEMO_RESULT_CACHE=rec_rc,
+            NEMO_RENDER_WORKERS="1",
+        )
+        code = (
+            "from nemo_tpu.analysis.pipeline import run_debug\n"
+            "from nemo_tpu.backend.jax_backend import JaxBackend\n"
+            f"run_debug({staged!r}, {os.path.join(tmp, 'chaos_rec')!r}, "
+            "JaxBackend(), figures='none')\n"
+        )
+        proc = _sp.run(
+            [sys.executable, "-c", code], env=child_env,
+            capture_output=True, text=True, timeout=900,
+        )
+        if proc.returncode != -9:
+            raise RuntimeError(f"chaos kill child rc={proc.returncode}")
+        t0 = time.perf_counter()
+        m0 = obs.metrics.snapshot()
+        resumed = _crun(
+            staged, os.path.join(tmp, "chaos_rec"), _ChaosJB(), figures="none",
+            corpus_cache=rec_cc, result_cache=rec_rc,
+        )
+        resume_s = time.perf_counter() - t0
+        m_r = obs.Metrics.delta(obs.metrics.snapshot(), m0)["counters"]
+        t0 = time.perf_counter()
+        scratch = _crun(
+            staged, os.path.join(tmp, "chaos_scratch"), _ChaosJB(), figures="none",
+            corpus_cache="off", result_cache="off",
+        )
+        scratch_s = time.perf_counter() - t0
+        if _ctree(resumed.report_dir) != _ctree(scratch.report_dir):
+            raise RuntimeError("resumed report differs from uninterrupted")
+        chaos_tier = {
+            "family": families[0],
+            "runs": n,
+            "healthy_s": round(healthy_s, 3),
+            "faulted_s": round(faulted_s, 3),
+            "degraded_s": round(degraded_s, 3),
+            "degraded_overhead": round(degraded_s / healthy_s, 3) if healthy_s else None,
+            "faulted_overhead": round(faulted_s / healthy_s, 3) if healthy_s else None,
+            "failovers": int(m_f.get("analysis.sched.failover", 0)),
+            "breaker_trips": int(m_f.get("sched.breaker.trip", 0)),
+            "breaker_short_circuits": int(m_d.get("sched.breaker.short_circuit", 0)),
+            "failed_requests": 0,  # every pass above completed or raised
+            "resume_s": round(resume_s, 3),
+            "scratch_s": round(scratch_s, 3),
+            "recovery_overhead": round(resume_s / scratch_s, 3) if scratch_s else None,
+            "resumed_segments_cached": int(m_r.get("delta.segments_cached", 0)),
+            "resumed_segments_mapped": int(m_r.get("delta.segments_mapped", 0)),
+            "byte_identical": True,
+        }
+        log(f"chaos tier (healthy vs faulted vs degraded + resume): {json.dumps(chaos_tier)}")
+    except Exception as ex:  # the chaos tier must never sink the bench
+        log(f"chaos tier skipped: {type(ex).__name__}: {ex}")
+
     # Shard tier (ISSUE 7): the mesh-sharded fused analysis at 1/2/4/8
     # virtual CPU devices over the same big corpus (NEMO_SHARD_DEVICES caps
     # one 8-virtual-device process — mesh width is the only variable), plus
@@ -1352,6 +1504,7 @@ def child_main() -> None:
         "analysis_tier": analysis_tier,
         "ingest_tier": ingest_tier,
         "delta_tier": delta_tier,
+        "chaos_tier": chaos_tier,
         "shard_tier": shard_tier,
         "serve_tier": serve_tier,
         "stress_10x": stress_10x,

@@ -162,14 +162,21 @@ def _key(doc: dict) -> str:
 
 def report_cache_key(molly, figures: str) -> str | None:
     """Content address of the full report tree: every segment fingerprint +
-    the config/ABI blob.  None when any segment is anonymous (no store —
+    the config/ABI blob + the quarantine set (the report's "Degraded runs"
+    section — quarantine.json — is part of the tree, and two corpora with
+    identical healthy segments but different quarantined runs must not
+    share an entry).  None when any segment is anonymous (no store —
     nothing fingerprints the content, so a hit is impossible)."""
     segs = corpus_segments(molly)
     if any(s.fingerprint is None for s in segs):
         return None
     return _key(
         {"kind": "report", "config": config_blob(figures),
-         "segments": [s.fingerprint for s in segs]}
+         "segments": [s.fingerprint for s in segs],
+         "quarantined": [
+             [q["position"], q.get("file"), q.get("error")]
+             for q in getattr(molly, "quarantined", None) or ()
+         ]}
     )
 
 
@@ -408,6 +415,32 @@ class MapOutput:
     #: filled instead of the per-run dicts when the backend has no per-run
     #: decomposition (supports_delta False): the legacy global verb outputs
     legacy: dict | None = None
+
+    def merge(self, other: "MapOutput") -> None:
+        """Fold another map's artifacts in (the incremental checkpoint path
+        maps one segment at a time, analysis/pipeline.py).  Per-run dicts
+        are iteration-keyed and disjoint across segments; the anchor-verb
+        results (corrections/extensions) are functions of the anchor runs,
+        which ride in EVERY view, so any segment's copy is the corpus's."""
+        self.own_iters.extend(other.own_iters)
+        for name in (
+            "proto_ordered",
+            "present",
+            "missing",
+            "achieved",
+            "hazard",
+            "pre",
+            "post",
+            "pre_clean",
+            "post_clean",
+            "diff",
+            "diff_failed",
+        ):
+            getattr(self, name).update(getattr(other, name))
+        self.corrections = list(other.corrections)
+        self.extensions = list(other.extensions)
+        if other.legacy is not None:
+            self.legacy = other.legacy
 
     def as_partial(self, seg: Segment, molly) -> SegmentPartial:
         """Slice this map's artifacts down to one segment's runs."""

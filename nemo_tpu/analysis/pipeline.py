@@ -98,7 +98,7 @@ def _write_telemetry(report_dir: str, timings: dict, figure_stats: dict | None) 
             if costs:
                 doc["kernel_cost"] = costs
             doc["memory"] = jb.sample_memory_watermarks()
-        except Exception:
+        except Exception:  # lint: allow-silent-except — telemetry must never fail a report (docstring)
             pass
     # Scheduler decision table (ISSUE 7): one record per scheduled bucket —
     # lane, reason, stolen, predicted-vs-measured walls — same sys.modules
@@ -109,7 +109,7 @@ def _write_telemetry(report_dir: str, timings: dict, figure_stats: dict | None) 
             table = sch.sched_snapshot()
             if table:
                 doc["sched"] = table
-        except Exception:
+        except Exception:  # lint: allow-silent-except — telemetry must never fail a report (docstring)
             pass
     try:
         with open(os.path.join(report_dir, "telemetry.json"), "w", encoding="utf-8") as fh:
@@ -294,7 +294,27 @@ def _ingest(fault_inj_out: str, use_packed: bool, store=None, consult_store=True
         # re-parses instead of serving a HIT over mixed content.
         snap = store.snapshot(fault_inj_out) if store is not None else None
         if native_available():
-            molly = load_molly_output_packed(fault_inj_out)
+            try:
+                molly = load_molly_output_packed(fault_inj_out)
+            except Exception as ex:
+                # Quarantine fallback (ISSUE 9): the C++ engine parses the
+                # whole directory in one pass and aborts on the first
+                # malformed run; the Python object loader isolates per run,
+                # so one truncated provenance file degrades that run to the
+                # quarantine instead of sinking a 10k-run ingest.
+                from nemo_tpu.utils.env import quarantine_enabled
+
+                if not quarantine_enabled():
+                    raise
+                _log.warning(
+                    "ingest.native_failed_quarantine_fallback",
+                    corpus=fault_inj_out,
+                    error=f"{type(ex).__name__}: {ex}",
+                    detail="re-parsing with the per-run-isolating object "
+                    "loader (NEMO_QUARANTINE=off restores fail-fast)",
+                )
+                obs.metrics.inc("ingest.native_fallback")
+                molly = load_molly_output(fault_inj_out)
         else:
             # Lib-less host (or a corrupt store that just fell back): the
             # object loader serves any backend, and the populate below
@@ -340,8 +360,8 @@ def _attach_ingest_dir(ex: BaseException, d: str) -> BaseException:
         args.append(note)
     try:
         ex.args = tuple(args)
-    except Exception:
-        pass  # exotic exception types keep their args; attribution best-effort
+    except Exception:  # lint: allow-silent-except — exotic exception types keep their args; attribution best-effort
+        pass
     return ex
 
 
@@ -507,7 +527,7 @@ def run_debug_dirs(
         # loop's contract); the original exception stays the one raised.
         try:
             scheduler.drain()
-        except Exception:
+        except Exception:  # lint: allow-silent-except — best-effort settle on the failure path; the original exception stays the one raised
             pass
         scheduler.close()
     for r in results:
@@ -688,45 +708,91 @@ def run_debug(
         )
 
     mo = delta.MapOutput()
+    checkpointed: dict[str, object] = {}  # seg name -> already-published partial
     if to_map:
+        from nemo_tpu.utils import chaos
+        from nemo_tpu.utils.env import env_flag
+
         pos_by_iter = {}
         for pos, r in enumerate(molly.runs):
             pos_by_iter.setdefault(r.iteration, pos)
-        own_rows = sorted(r for s in to_map for r in range(s.start, s.stop))
-        own_row_set = set(own_rows)
-        own_set = {molly.runs[r].iteration for r in own_rows}
-        # Anchor runs ride along as CONTEXT when they live in a cached
-        # segment: the differential verbs diff against the good run's
-        # graph and extensions read the baseline run's antecedent, so the
-        # map's view must contain them even though their per-run artifacts
-        # come from the cached partials.
-        anchor_rows = {
-            pos_by_iter[it]
-            for it in (good_iter, baseline_iter)
-            if it is not None and pos_by_iter[it] not in own_row_set
-        }
-        view_rows = sorted(own_row_set | anchor_rows)
-        molly_view = (
-            molly
-            if len(view_rows) == len(molly.runs)
-            else delta.subset_molly(molly, view_rows)
+        # Crash-safe resume (ISSUE 9): when several segments need mapping
+        # and their partials will be cached anyway, map them ONE AT A TIME
+        # and publish each segment's partial (figures included) to the
+        # result cache as soon as it completes — a SIGKILL mid-sweep then
+        # loses only the in-flight segment, and the rerun's tier-2 consult
+        # serves the finished ones (delta.segments_cached) and maps only
+        # the rest, producing a byte-identical report.  NEMO_CHECKPOINT=0
+        # restores the single-map sweep (marginally fewer dispatches: the
+        # anchor verbs re-run per segment on this path).
+        incremental = (
+            len(to_map) > 1
+            and bool(partial_keys)
+            and rcache is not None
+            and env_flag("NEMO_CHECKPOINT", True)
         )
-        with timer.phase("init"):
-            backend.init_graph_db(conn, molly_view)
-        try:
-            with trace_ctx:
-                mo = delta.map_runs(
-                    backend,
-                    molly_view,
-                    fault_inj_out,
-                    good_iter,
-                    fig_set,
-                    own_set,
-                    timer,
-                    publish=bool(partial_keys),
+        map_groups = [[s] for s in to_map] if incremental else [to_map]
+        with trace_ctx:
+            for group in map_groups:
+                own_rows = sorted(r for s in group for r in range(s.start, s.stop))
+                own_row_set = set(own_rows)
+                own_set = {molly.runs[r].iteration for r in own_rows}
+                # Anchor runs ride along as CONTEXT when they live in a
+                # cached (or another group's) segment: the differential
+                # verbs diff against the good run's graph and extensions
+                # read the baseline run's antecedent, so the map's view
+                # must contain them even though their per-run artifacts
+                # come from elsewhere.
+                anchor_rows = {
+                    pos_by_iter[it]
+                    for it in (good_iter, baseline_iter)
+                    if it is not None and pos_by_iter[it] not in own_row_set
+                }
+                view_rows = sorted(own_row_set | anchor_rows)
+                molly_view = (
+                    molly
+                    if len(view_rows) == len(molly.runs)
+                    else delta.subset_molly(molly, view_rows)
                 )
-        finally:
-            backend.close_db()
+                with timer.phase("init"):
+                    backend.init_graph_db(conn, molly_view)
+                try:
+                    group_mo = delta.map_runs(
+                        backend,
+                        molly_view,
+                        fault_inj_out,
+                        good_iter,
+                        fig_set,
+                        own_set,
+                        timer,
+                        publish=bool(partial_keys),
+                    )
+                finally:
+                    backend.close_db()
+                mo.merge(group_mo)
+                if incremental:
+                    seg = group[0]
+                    key = partial_keys.get(seg.name)
+                    if key is not None:
+                        partial = group_mo.as_partial(seg, molly)
+                        # Marked checkpointed ONLY on a successful publish:
+                        # a transiently failing cache write must leave the
+                        # segment in `fresh`, so the end-of-run flush gets
+                        # a second chance at it (the pre-checkpoint
+                        # behavior) instead of dropping it entirely.
+                        if _publish_segment_checkpoint(rcache, key, partial, group_mo):
+                            checkpointed[seg.name] = partial
+                            obs.metrics.inc("delta.partial_checkpoints")
+                            _log.info(
+                                "delta.checkpoint",
+                                corpus=fault_inj_out,
+                                segment=seg.name,
+                                published=len(checkpointed),
+                                remaining=len(to_map) - len(checkpointed),
+                            )
+                            # Chaos kill point: SIGKILL after N published
+                            # checkpoints (the resume scenario's crash).
+                            chaos.on_segment_published(len(checkpointed))
 
     with timer.phase("reduce"):
         if legacy:
@@ -759,8 +825,19 @@ def run_debug(
                 )
             ]
         else:
-            fresh = {s.name: mo.as_partial(s, molly) for s in to_map}
-            partials = [p for _, p in cached] + list(fresh.values())
+            # Checkpointed segments were published mid-map (crash-safe
+            # resume); keep them out of the end-of-run puts but in the
+            # reduce (order-insensitive, so the split cannot matter).
+            fresh = {
+                s.name: mo.as_partial(s, molly)
+                for s in to_map
+                if s.name not in checkpointed
+            }
+            partials = (
+                [p for _, p in cached]
+                + [checkpointed[s.name] for s in to_map if s.name in checkpointed]
+                + list(fresh.values())
+            )
         red = delta.reduce_partials(partials, molly, good_iter, legacy=mo.legacy)
 
     # Recommendation assembly, 4-way priority (main.go:190-217).  The
@@ -827,30 +904,25 @@ def run_debug(
                 fh.write(_run_json_str(r, good_iter))
             fh.write("]")
 
+        # Degraded-runs sidecar (ISSUE 9): the quarantined set, rendered by
+        # the frontend as the "Degraded runs" section.  Deterministic (part
+        # of the cached report tree; report_cache_key covers it), absent on
+        # healthy corpora.
+        quarantined = getattr(molly, "quarantined", None)
+        if quarantined:
+            with open(
+                os.path.join(this_results_dir, "quarantine.json"), "w", encoding="utf-8"
+            ) as fh:
+                json.dump(
+                    sorted(quarantined, key=lambda r: r["position"]), fh, indent=1
+                )
+
         try:
             # Freshly mapped runs render through the scheduler; cached
             # segments' figures restore from the partial entries (rendered
             # by the run that populated them — same renderer version, part
             # of the cache key, so byte-identical).
-            own_fig = [i for i in fig_iters if i in mo.hazard]
-
-            def dots(d: dict) -> list:
-                return [d[i] for i in own_fig]
-
-            reporter.generate_figures(own_fig, "spacetime", dots(mo.hazard))
-            reporter.generate_figures(own_fig, "pre_prov", dots(mo.pre))
-            reporter.generate_figures(own_fig, "post_prov", dots(mo.post))
-            reporter.generate_figures(own_fig, "pre_prov_clean", dots(mo.pre_clean))
-            reporter.generate_figures(own_fig, "post_prov_clean", dots(mo.post_clean))
-            diff_fig_iters = [f for f in fig_iters if f in mo.diff]
-            reporter.generate_figures(
-                diff_fig_iters, "diff_post_prov-diff", [mo.diff[f] for f in diff_fig_iters]
-            )
-            reporter.generate_figures(
-                diff_fig_iters,
-                "diff_post_prov-failed",
-                [mo.diff_failed[f] for f in diff_fig_iters],
-            )
+            _generate_map_figures(reporter, fig_iters, mo)
             for _seg, p in cached:
                 rcache.restore_figures(p, reporter.figures_dir)
 
@@ -892,6 +964,74 @@ def run_debug(
         if drained:
             _flush_result_cache(result)
     return result
+
+
+def _generate_map_figures(reporter, fig_iters, mo) -> None:
+    """Render one MapOutput's figure families through ``reporter`` — THE
+    kind-by-kind sequence, shared by the report phase and the segment
+    checkpoint publisher so a new figure family can never reach one and
+    silently miss the other (the resumed run's restore-vs-render parity
+    depends on the two emitting identical file sets)."""
+    own_fig = [i for i in fig_iters if i in mo.hazard]
+
+    def dots(d: dict) -> list:
+        return [d[i] for i in own_fig]
+
+    reporter.generate_figures(own_fig, "spacetime", dots(mo.hazard))
+    reporter.generate_figures(own_fig, "pre_prov", dots(mo.pre))
+    reporter.generate_figures(own_fig, "post_prov", dots(mo.post))
+    reporter.generate_figures(own_fig, "pre_prov_clean", dots(mo.pre_clean))
+    reporter.generate_figures(own_fig, "post_prov_clean", dots(mo.post_clean))
+    diff_fig_iters = [f for f in fig_iters if f in mo.diff]
+    reporter.generate_figures(
+        diff_fig_iters, "diff_post_prov-diff", [mo.diff[f] for f in diff_fig_iters]
+    )
+    reporter.generate_figures(
+        diff_fig_iters,
+        "diff_post_prov-failed",
+        [mo.diff_failed[f] for f in diff_fig_iters],
+    )
+
+
+def _publish_segment_checkpoint(rcache, key: str, partial, seg_mo) -> bool:
+    """Crash-safe resume (ISSUE 9): publish one freshly mapped segment's
+    partial to the result cache IMMEDIATELY, figures included, so a killed
+    process resumes from it.  The segment's figures render here into a
+    throwaway staging dir through the standard render pipeline (dedup +
+    persistent SVG content cache), so the report phase's later render of
+    the same figures is a cache hit and byte-identical.  Best-effort like
+    every cache write — but the caller must know whether it WORKED (False):
+    a failed checkpoint leaves the segment for the end-of-run flush rather
+    than silently unpublished."""
+    import shutil
+    import tempfile
+
+    try:
+        if not partial.fig_files:
+            return bool(rcache.put_partial(key, partial, figures_dir=""))
+        from nemo_tpu.report.render import RenderScheduler
+        from nemo_tpu.report.writer import Reporter
+
+        stage = tempfile.mkdtemp(prefix="nemo-ckpt-figs-")
+        try:
+            rs = RenderScheduler()
+            rep = Reporter(scheduler=rs)
+            rep.figures_dir = stage
+            try:
+                _generate_map_figures(rep, seg_mo.own_iters, seg_mo)
+                rs.drain()
+            finally:
+                rs.close()
+            return bool(rcache.put_partial(key, partial, stage))
+        finally:
+            shutil.rmtree(stage, ignore_errors=True)
+    except Exception as ex:
+        obs.metrics.inc("rcache.checkpoint_failed")
+        _log.warning(
+            "delta.checkpoint_failed", key=key[:12],
+            error=f"{type(ex).__name__}: {ex}",
+        )
+        return False
 
 
 def _flush_result_cache(result: DebugResult) -> None:

@@ -25,6 +25,7 @@ import numpy as np
 
 from nemo_tpu import obs
 from nemo_tpu.obs import log as _obs_log
+from nemo_tpu.utils import chaos as _chaos
 from nemo_tpu.analysis.corrections import synthesize_corrections, synthesize_extensions
 from nemo_tpu.analysis.protos import intersect_proto, missing_from, union_proto, wrap_code
 from nemo_tpu.analysis.queries import (
@@ -227,7 +228,7 @@ def _kernel_cost_analysis(verb: str, fn, args, statics) -> dict:
         if isinstance(ca, dict):
             out["flops"] = float(ca.get("flops", 0.0)) or None
             out["bytes_accessed"] = float(ca.get("bytes accessed", 0.0)) or None
-    except Exception:
+    except Exception:  # lint: allow-silent-except — cost numbers are observability; Nones are the documented fallback
         pass
     return out
 
@@ -347,7 +348,7 @@ def _index_cost_class(verb: str, arrays: dict, params: dict) -> None:
             return
         e = int(np.shape(arrays["pre_edge_src"])[1]) if verb in ("fused", "giant") else 0
         _COST_BY_CLASS[(verb, int(params["v"]), e)] = rec
-    except Exception:
+    except Exception:  # lint: allow-silent-except — cost indexing is best-effort observability (docstring)
         pass
 
 
@@ -404,8 +405,8 @@ def sample_memory_watermarks() -> dict:
             out["device_bytes_in_use"] = in_use
             obs.metrics.gauge("mem.device_peak_bytes", peak)
             obs.metrics.gauge("mem.device_bytes_in_use", in_use)
-    except Exception:
-        pass  # watermarks are observability; never fail the caller
+    except Exception:  # lint: allow-silent-except — watermarks are observability; never fail the caller
+        pass
     return out
 
 
@@ -533,6 +534,11 @@ class LocalExecutor:
         width stands in, exactly the pre-sharding behavior."""
         if verb not in self.VERBS:
             raise ValueError(f"unknown kernel verb {verb!r}")
+        # Chaos injection point (utils/chaos.py): with NEMO_CHAOS unset
+        # this is one env lookup; armed, it can fail or wedge the first N
+        # device dispatches — the scheduler's failover/breaker/deadline
+        # machinery is exercised against exactly this boundary.
+        _chaos.on_device_dispatch(verb)
         fn, array_names, param_names, out_names = self.VERBS[verb]
         if verb in ("fused", "giant") and "pack_out" not in params:
             params = dict(params, pack_out=_pack_out_default())

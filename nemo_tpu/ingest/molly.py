@@ -24,7 +24,12 @@ import os
 import re
 from dataclasses import dataclass, field
 
+from nemo_tpu import obs
+from nemo_tpu.obs import log as _obs_log
+
 from .datatypes import ProvData, RunData
+
+_log = _obs_log.get_logger("nemo.ingest")
 
 _CLOCK_TIME_WILD = re.compile(r", (\d+), __WILDCARD__\)")
 _CLOCK_TIME_TWO = re.compile(r", (\d+), (\d+)\)")
@@ -74,6 +79,14 @@ class MollyOutput:
     runs_iters: list[int] = field(default_factory=list)
     success_runs_iters: list[int] = field(default_factory=list)
     failed_runs_iters: list[int] = field(default_factory=list)
+    #: Quarantined runs (ISSUE 9): source positions whose entry or
+    #: provenance files failed to parse, isolated instead of aborting the
+    #: corpus (NEMO_QUARANTINE, on by default).  One record per position:
+    #: {"position", "iteration" (None when the entry itself was bad),
+    #: "file" (the failing file, or "runs.json"), "error"} — rendered as
+    #: the report's "Degraded runs" section (quarantine.json) and carried
+    #: through the corpus store so warm loads reproduce the same set.
+    quarantined: list[dict] = field(default_factory=list)
 
     # -- FaultInjector getters (reference: faultinjectors/molly.go:166-201) --
 
@@ -122,23 +135,83 @@ def attach_run_metadata(out: MollyOutput, run, tables: dict | None = None) -> No
         out.failed_runs_iters.append(run.iteration)
 
 
-def load_molly_output(output_dir: str) -> MollyOutput:
-    """Load a Molly output directory.  Reference: faultinjectors/molly.go:15-163."""
+def quarantine_record(position: int, iteration, file: str, ex: BaseException) -> dict:
+    """One quarantined run's record — the single shape shared by the
+    python loader, the store header, and the report's quarantine.json."""
+    return {
+        "position": int(position),
+        "iteration": None if iteration is None else int(iteration),
+        "file": file,
+        "error": f"{type(ex).__name__}: {ex}",
+    }
+
+
+def load_molly_output(output_dir: str, quarantine: bool | None = None) -> MollyOutput:
+    """Load a Molly output directory.  Reference: faultinjectors/molly.go:15-163.
+
+    Per-run error isolation (ISSUE 9): with ``quarantine`` on (default:
+    ``NEMO_QUARANTINE``, enabled), a run whose runs.json entry or
+    provenance file is malformed/truncated/schema-violating is QUARANTINED
+    — recorded on ``MollyOutput.quarantined`` with its parse error, counted
+    as ``ingest.quarantined`` — instead of aborting the whole corpus; the
+    healthy runs analyze normally.  A corpus with no healthy runs at all
+    still raises (there is nothing to analyze).  runs.json itself failing
+    to parse always raises: there is no per-run boundary to isolate."""
+    from nemo_tpu.utils.env import quarantine_enabled
+
+    if quarantine is None:
+        quarantine = quarantine_enabled()
     out = MollyOutput(run_name=os.path.basename(os.path.normpath(output_dir)), output_dir=output_dir)
 
     runs_path = os.path.join(output_dir, "runs.json")
     with open(runs_path, "r", encoding="utf-8") as f:
         raw_runs = json.load(f)
 
-    out.runs = [RunData.from_json(r) for r in raw_runs]
-
-    for i, run in enumerate(out.runs):
+    for i, raw in enumerate(raw_runs):
+        try:
+            run = RunData.from_json(raw)
+        except Exception as ex:
+            if not quarantine:
+                raise
+            _quarantine(out, quarantine_record(i, None, "runs.json", ex))
+            continue
+        try:
+            # Per-run provenance files are indexed by position i, not by the
+            # iteration field (molly.go:59-60).
+            load_run_prov(output_dir, i, run)
+        except Exception as ex:
+            if not quarantine:
+                raise
+            cond = "post" if run.pre_prov is not None else "pre"
+            _quarantine(
+                out,
+                quarantine_record(
+                    i, run.iteration, f"run_{i}_{cond}_provenance.json", ex
+                ),
+            )
+            continue
+        out.runs.append(run)
         attach_run_metadata(out, run)
-        # Per-run provenance files are indexed by position i, not by the
-        # iteration field (molly.go:59-60).
-        load_run_prov(output_dir, i, run)
 
+    if out.quarantined and not out.runs:
+        raise RuntimeError(
+            f"every run in {output_dir} failed to parse "
+            f"({len(out.quarantined)} quarantined; first: "
+            f"{out.quarantined[0]['error']})"
+        )
     return out
+
+
+def _quarantine(out: MollyOutput, rec: dict) -> None:
+    out.quarantined.append(rec)
+    obs.metrics.inc("ingest.quarantined")
+    _log.warning(
+        "ingest.quarantined",
+        corpus=out.output_dir,
+        position=rec["position"],
+        file=rec["file"],
+        error=rec["error"],
+    )
 
 
 def load_run_prov(output_dir: str, position: int, run) -> None:

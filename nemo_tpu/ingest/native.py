@@ -136,7 +136,7 @@ class CorpusHandle:
     def __del__(self) -> None:  # best-effort; close() is the real contract
         try:
             self.close()
-        except Exception:
+        except Exception:  # lint: allow-silent-except — __del__ must never raise; close() is the real contract
             pass
 
 

@@ -43,6 +43,22 @@ serial pre-PR loop, kept as the debugging fallback), NEMO_SCHED_HOST_UNIT /
 NEMO_SCHED_DEVICE_UNIT (seconds per work unit), NEMO_SCHED_DEVICE_FIXED
 (seconds per dispatch; default derives from the crossover budget so an
 unmeasured scheduler reproduces PR 3's routing exactly).
+
+Fault tolerance (ISSUE 9): the two lanes compute IDENTICAL results, which
+makes the host lane a hot standby for the device lane.  A device-lane
+failure (XLA/OOM error, a dead sidecar, a dispatch past the hard
+``NEMO_DISPATCH_TIMEOUT_S`` deadline — the escalation past the log-only
+``NEMO_SLOW_DISPATCH_MS`` watchdog) re-runs the job on the sparse-host
+lane after a jittered backoff (``analysis.sched.failover`` + a route
+record with reason "failover") instead of failing the request.  Repeated
+device failures trip a process-global CIRCUIT BREAKER
+(``sched.breaker.*`` metrics): while OPEN, planning short-circuits every
+non-operator-forced job to the host lane (degraded host-only mode); after
+``NEMO_BREAKER_COOLDOWN_S`` one HALF-OPEN probe job tries the device
+again and a success closes the breaker.  An operator-FORCED device route
+(NEMO_ANALYSIS_IMPL=dense) never fails over and never short-circuits: an
+explicit pin is a correctness decision, and masking its failures would
+hide exactly what the operator is testing.
 """
 
 from __future__ import annotations
@@ -55,6 +71,12 @@ from dataclasses import dataclass, field
 from typing import Callable
 
 from nemo_tpu import obs
+from nemo_tpu.utils.backoff import FAILOVER_POLICY
+from nemo_tpu.utils.env import (
+    breaker_cooldown_s,
+    breaker_failures,
+    dispatch_timeout_s,
+)
 
 _log = obs.log.get_logger("nemo.sched")
 
@@ -200,6 +222,201 @@ def default_models(
     }
 
 
+# ---------------------------------------------------------------------------
+# lane failure classification + circuit breaker (ISSUE 9)
+# ---------------------------------------------------------------------------
+
+
+class DispatchTimeout(TimeoutError):
+    """A device-lane dispatch exceeded NEMO_DISPATCH_TIMEOUT_S and was
+    abandoned (the wedged thread cannot be cancelled mid-XLA; it is left
+    behind as a daemon and its eventual result discarded)."""
+
+
+#: Exception type-name fragments that read as INFRASTRUCTURE failures of
+#: the device lane (XLA runtime, jax backend, the sidecar RPC stack) as
+#: opposed to programming errors, which must propagate.
+_LANE_FAILURE_TYPES = (
+    "XlaRuntimeError",
+    "JaxRuntimeError",
+    "RpcError",
+    "SidecarError",
+    "ChaosFault",
+    "InternalError",
+)
+
+
+def is_lane_failure(ex: BaseException) -> bool:
+    """Whether ``ex`` is a DEVICE-LANE infrastructure failure the scheduler
+    may recover from by re-running the job on the host lane.  Conservative:
+    anything that smells like a bug in our own code (ValueError, KeyError,
+    assertion...) propagates — failing over a deterministic bug would just
+    recompute the crash more slowly, or worse, mask it."""
+    if isinstance(ex, (DispatchTimeout, MemoryError)):
+        return True
+    for klass in type(ex).__mro__:
+        if any(frag in klass.__name__ for frag in _LANE_FAILURE_TYPES):
+            return True
+    if isinstance(ex, RuntimeError):
+        msg = str(ex).lower()
+        return (
+            "resource_exhausted" in msg
+            or "out of memory" in msg
+            or "resource exhausted" in msg
+        )
+    return False
+
+
+class CircuitBreaker:
+    """Closed / open / half-open breaker over the device lane.
+
+    ``record_failure`` past the consecutive-failure threshold
+    (``NEMO_BREAKER_FAILURES``) trips it OPEN: ``allow()`` then answers
+    False (planning short-circuits to the host lane — degraded host-only
+    mode, requests keep succeeding) until ``NEMO_BREAKER_COOLDOWN_S`` has
+    passed, when exactly ONE caller is let through HALF-OPEN as a probe; a
+    probe success closes the breaker, a failure re-opens it for another
+    cooldown.  All transitions are metrics (``sched.breaker.*``) and
+    structured logs; state is also a gauge (0 closed, 1 open, 2 half-open)
+    so a degraded sidecar is visible on the Prometheus surface."""
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(
+        self, failures: int | None = None, cooldown_s: float | None = None
+    ) -> None:
+        self.failures = failures if failures is not None else breaker_failures()
+        self.cooldown_s = (
+            cooldown_s if cooldown_s is not None else breaker_cooldown_s()
+        )
+        self._lock = threading.Lock()
+        self.state = self.CLOSED
+        self._consecutive = 0
+        self._opened_at = 0.0
+        self._probe_at = 0.0
+
+    def _gauge(self) -> None:
+        obs.metrics.gauge(
+            "sched.breaker.state",
+            {self.CLOSED: 0, self.OPEN: 1, self.HALF_OPEN: 2}[self.state],
+        )
+
+    def allow(self) -> bool:
+        """May the device lane take another job right now?  Consumes the
+        half-open probe when one is due — callers that only want to LOOK
+        use :meth:`peek` (no transitions, no counters)."""
+        with self._lock:
+            now = time.monotonic()
+            if self.state == self.CLOSED:
+                return True
+            if self.state == self.OPEN:
+                if now - self._opened_at >= self.cooldown_s:
+                    self.state = self.HALF_OPEN
+                    self._probe_at = now
+                    self._gauge()
+                    obs.metrics.inc("sched.breaker.probe")
+                    _log.info("sched.breaker_probe", cooldown_s=self.cooldown_s)
+                    return True  # exactly this caller probes
+                obs.metrics.inc("sched.breaker.short_circuit")
+                return False
+            # HALF_OPEN: one probe is in flight; everyone else stays on the
+            # host lane until it reports.  A probe can be LOST without a
+            # device execution reporting back (the probe job was stolen by
+            # the host lane, or the probing worker found nothing left to
+            # run) — re-arm after another cooldown so a long-lived process
+            # can never wedge in HALF_OPEN/host-only forever.
+            if now - self._probe_at >= self.cooldown_s:
+                self._probe_at = now
+                obs.metrics.inc("sched.breaker.probe")
+                _log.info("sched.breaker_probe_rearmed", cooldown_s=self.cooldown_s)
+                return True
+            obs.metrics.inc("sched.breaker.short_circuit")
+            return False
+
+    def peek(self) -> bool:
+        """Whether :meth:`allow` WOULD grant right now — no state
+        transition, no metrics.  The device worker's wait loop polls this
+        every ~10 ms; counting those polls as short-circuits would turn a
+        per-job degradation metric into a spin counter."""
+        with self._lock:
+            now = time.monotonic()
+            if self.state == self.CLOSED:
+                return True
+            if self.state == self.OPEN:
+                return now - self._opened_at >= self.cooldown_s
+            return now - self._probe_at >= self.cooldown_s
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._consecutive += 1
+            obs.metrics.inc("sched.breaker.failures")
+            if self.state == self.HALF_OPEN or (
+                self.state == self.CLOSED and self._consecutive >= self.failures
+            ):
+                self.state = self.OPEN
+                self._opened_at = time.monotonic()
+                self._gauge()
+                obs.metrics.inc("sched.breaker.trip")
+                _log.error(
+                    "sched.breaker_open",
+                    consecutive_failures=self._consecutive,
+                    cooldown_s=self.cooldown_s,
+                    detail="device lane degraded; routing host-only until a "
+                    "half-open probe succeeds",
+                )
+
+    def record_success(self) -> None:
+        with self._lock:
+            closed = self.state != self.CLOSED
+            self.state = self.CLOSED
+            self._consecutive = 0
+            if closed:
+                self._gauge()
+                obs.metrics.inc("sched.breaker.close")
+                _log.info("sched.breaker_closed")
+
+
+#: Pin reasons whose execute closures implement BOTH lanes (the
+#: jax_backend fused/giant closures): the breaker may reroute them and a
+#: device failure may re-run them on the host lane.  NOT "forced" (an
+#: operator pin is a correctness decision whose failures must surface) and
+#: NOT "serve_batch" (the serving tier's merged launches are device-only
+#: closures — handing them a host lane would still dispatch on the broken
+#: device while recording host).
+_DUAL_LANE_PIN_REASONS = frozenset({"platform", "giant_impl"})
+
+
+def _may_reroute(job: Job) -> bool:
+    """May the breaker/failover machinery run this job on the OTHER lane?
+    True only when the job's execute closure actually honors the lane
+    argument with a bit-identical host implementation."""
+    return job.pinned is None or job.reason in _DUAL_LANE_PIN_REASONS
+
+
+#: Process-global device-lane breaker: device health is a property of the
+#: process's accelerator (or its tunnel), not of one corpus — a long-lived
+#: sidecar that tripped it stays host-only across requests until a probe
+#: heals it.
+_DEVICE_BREAKER: CircuitBreaker | None = None
+_BREAKER_LOCK = threading.Lock()
+
+
+def device_breaker() -> CircuitBreaker:
+    global _DEVICE_BREAKER
+    with _BREAKER_LOCK:
+        if _DEVICE_BREAKER is None:
+            _DEVICE_BREAKER = CircuitBreaker()
+        return _DEVICE_BREAKER
+
+
+def reset_device_breaker() -> None:
+    """Forget breaker state (tests, or an operator bouncing after fixing
+    the tunnel without waiting out the cooldown)."""
+    global _DEVICE_BREAKER
+    with _BREAKER_LOCK:
+        _DEVICE_BREAKER = None
+
+
 #: Process-global lane models: measured walls persist across corpora in one
 #: session (a long-lived sidecar keeps learning), like the jit cache.
 _SESSION_MODELS: dict[str, LaneModel] | None = None
@@ -250,14 +467,72 @@ class HeterogeneousScheduler:
         self.models = models or session_models()
         self.steals = {lane: 0 for lane in LANES}
         self.dispatched = {lane: 0 for lane in LANES}
+        self.failovers = 0
+        self.breaker = device_breaker()
+        #: Shared jittered-backoff session for this drain's failovers
+        #: (utils/backoff.py — budget-bounded, so a burst of failing
+        #: device jobs cannot stall the whole drain sleeping).
+        self._backoff = FAILOVER_POLICY.session()
 
     def plan(self, job: Job) -> tuple[str, str, dict]:
-        """(lane, reason, predictions) for one job."""
+        """(lane, reason, predictions) for one job.  An OPEN device-lane
+        circuit breaker short-circuits every non-operator-forced device
+        plan to the host lane (degraded host-only mode); a forced route
+        keeps the device — an explicit pin is a correctness decision, and
+        its failure should be seen, not masked."""
         preds = {lane: self.models[lane].predict(job) for lane in LANES}
         if job.pinned:
-            return job.pinned, job.reason, preds
-        lane = "device" if preds["device"] <= preds["host"] else "host"
-        return lane, "sched", preds
+            lane, reason = job.pinned, job.reason
+        else:
+            lane = "device" if preds["device"] <= preds["host"] else "host"
+            reason = "sched"
+        if lane == "device" and _may_reroute(job) and not self.breaker.allow():
+            return "host", "breaker", preds
+        return lane, reason, preds
+
+    def _execute_deadline(self, job: Job, lane: str, reason: str, stolen: bool):
+        """Run one job, with the hard ``NEMO_DISPATCH_TIMEOUT_S`` deadline
+        on DEVICE-lane executions (0 = off, the default).  A mid-XLA (or
+        mid-RPC) dispatch cannot be cancelled, so a timed-out dispatch is
+        ABANDONED: its thread is left behind as a daemon, its eventual
+        result discarded, and :class:`DispatchTimeout` raised for the
+        failover path — the escalation ladder's last rung (the
+        NEMO_SLOW_DISPATCH_MS watchdog logs, this cancels + fails over)."""
+        timeout = dispatch_timeout_s()
+        if lane != "device" or not timeout:
+            return job.execute(lane, reason, stolen)
+        box: dict = {}
+        done = threading.Event()
+
+        def target() -> None:
+            try:
+                box["res"] = job.execute(lane, reason, stolen)
+            except BaseException as ex:
+                box["ex"] = ex
+            finally:
+                done.set()
+
+        t = threading.Thread(
+            target=target, daemon=True, name=f"nemo-sched-dispatch-{job.index}"
+        )
+        t.start()
+        if not done.wait(timeout):
+            obs.metrics.inc("watchdog.dispatch_timeout")
+            _log.error(
+                "sched.dispatch_timeout",
+                verb=job.verb,
+                index=job.index,
+                timeout_s=timeout,
+                detail="abandoning the wedged dispatch thread (daemon); "
+                "failing the job over to the host lane",
+            )
+            raise DispatchTimeout(
+                f"device dispatch of job {job.index} ({job.verb}) exceeded "
+                f"NEMO_DISPATCH_TIMEOUT_S={timeout}"
+            )
+        if "ex" in box:
+            raise box["ex"]
+        return box["res"]
 
     def run(self, jobs: list[Job], serial: bool = False) -> list[dict]:
         results: list[dict | None] = [None] * len(jobs)
@@ -277,14 +552,57 @@ class HeterogeneousScheduler:
             if stolen:
                 reason = "steal"
             t0 = time.perf_counter()
-            res = job.execute(lane, reason, stolen)
+            failed_over = False
+            try:
+                res = self._execute_deadline(job, lane, reason, stolen)
+                if lane == "device":
+                    self.breaker.record_success()
+            except BaseException as ex:
+                # Lane failover (ISSUE 9): a device-lane INFRASTRUCTURE
+                # failure re-runs the job on the host lane — the two lanes
+                # are bit-identical, so the request degrades to host speed
+                # instead of failing.  Host-lane failures, programming
+                # errors, operator-FORCED device routes, and device-only
+                # closures (serve-batch launches) propagate.
+                if lane == "device" and is_lane_failure(ex):
+                    # Device health signal recorded even when the job
+                    # cannot reroute (forced pin, device-only closure):
+                    # its failure still means the lane is sick.
+                    self.breaker.record_failure()
+                    obs.metrics.inc("analysis.sched.lane_failure.device")
+                if (
+                    lane != "device"
+                    or not _may_reroute(job)
+                    or not is_lane_failure(ex)
+                ):
+                    raise
+                with lock:
+                    wait = self._backoff.delay()
+                _log.warning(
+                    "sched.failover",
+                    index=job.index,
+                    verb=job.verb,
+                    error=f"{type(ex).__name__}: {ex}",
+                    backoff_s=round(wait, 3) if wait else 0.0,
+                    detail="re-running on the sparse-host lane",
+                )
+                if wait:
+                    time.sleep(wait)
+                obs.metrics.inc("analysis.sched.failover")
+                failed_over = True
+                lane, reason = "host", "failover"
+                res = job.execute("host", "failover", stolen)
             wall = time.perf_counter() - t0
             with lock:
-                if not job.wall_tainted:
+                # A failed-over wall (device failure + backoff + host run)
+                # describes neither lane; keep it out of the cost models.
+                if not job.wall_tainted and not failed_over:
                     self.models[lane].observe(job, wall)
                 self.dispatched[lane] += 1
                 if stolen:
                     self.steals[lane] += 1
+                if failed_over:
+                    self.failovers += 1
                 results[job.index] = res
             obs.metrics.inc(f"analysis.sched.dispatch.{lane}")
             if stolen:
@@ -304,15 +622,23 @@ class HeterogeneousScheduler:
                 "stolen": stolen,
                 "pinned": job.pinned is not None,
                 "tainted": job.wall_tainted,
+                "failed_over": failed_over,
                 "predicted_s": {k: round(v, 6) for k, v in preds.items()},
                 "wall_s": round(wall, 6),
             }
             with _RECORDS_LOCK:
                 _RECORDS.append(rec)
 
-        def take(lane: str) -> tuple[Job, bool] | None:
-            """Pop the next job for `lane`: its own queue's head, else steal
-            an unpinned job from the other lane's tail."""
+        def take(lane: str):
+            """Pop (job, stolen) for `lane`: its own queue's head, else
+            steal an unpinned job from the other lane's tail.  An idle
+            DEVICE worker consults the circuit breaker before stealing
+            (ISSUE 9): with the breaker open, pulling host-planned work
+            onto the broken lane would bypass the degraded-mode routing —
+            the worker gets the "breaker_wait" sentinel instead (it parks
+            briefly and retries, so when the cooldown elapses mid-drain
+            the then-allowed steal IS the half-open probe).  None = no
+            work left for this lane at all."""
             other = "host" if lane == "device" else "device"
             with lock:
                 if queues[lane]:
@@ -320,6 +646,15 @@ class HeterogeneousScheduler:
                 for i in range(len(queues[other]) - 1, -1, -1):
                     job = queues[other][i]
                     if job.pinned is None:
+                        # A stealable job EXISTS — only now consult the
+                        # breaker (peek first: the wait loop must not
+                        # consume the half-open probe, nor count its
+                        # 10 ms polls as short-circuits; allow() takes
+                        # the probe only when the steal really happens).
+                        if lane == "device" and (
+                            not self.breaker.peek() or not self.breaker.allow()
+                        ):
+                            return "breaker_wait"
                         del queues[other][i]
                         return job, True
             return None
@@ -345,6 +680,13 @@ class HeterogeneousScheduler:
                 nxt = take(lane)
                 if nxt is None:
                     return
+                if nxt == "breaker_wait":
+                    # Open breaker, host still draining: park instead of
+                    # exiting so this worker is around to probe the device
+                    # once the cooldown elapses (bounded spin — the host
+                    # lane empties its queue regardless).
+                    time.sleep(0.01)
+                    continue
                 job, stolen = nxt
                 try:
                     run_one(job, lane, stolen)

@@ -301,8 +301,38 @@ async function telemetry() {
   document.getElementById("telemetry").hidden = false;
 }
 
+async function quarantine() {
+  // Degraded runs (ISSUE 9): quarantine.json lists ingest-quarantined runs
+  // (position, iteration when known, failing file, parse error).  Healthy
+  // corpora have no such file: keep the section hidden.
+  let entries;
+  try {
+    const resp = await fetch("quarantine.json");
+    if (!resp.ok) return;
+    entries = await resp.json();
+  } catch (e) {
+    return;
+  }
+  if (!Array.isArray(entries) || !entries.length) return;
+  const tbody = document.querySelector("#quarantine-table tbody");
+  for (const q of entries) {
+    tbody.append(
+      el(
+        "tr",
+        {},
+        el("td", {}, String(q.position)),
+        el("td", {}, q.iteration == null ? "—" : String(q.iteration)),
+        el("td", {}, q.file || "—"),
+        el("td", { class: "status-fail" }, q.error || "")
+      )
+    );
+  }
+  document.getElementById("quarantine").hidden = false;
+}
+
 async function main() {
   telemetry(); // independent of the run data; never blocks the report
+  quarantine(); // likewise — a healthy corpus has no quarantine.json
   const resp = await fetch("debugging.json");
   const runs = await resp.json();
 

@@ -290,7 +290,7 @@ class RenderScheduler:
                 from .native import build_native
 
                 build_native()
-            except Exception:
+            except Exception:  # lint: allow-silent-except — opportunistic native build; the python renderer is the fallback
                 pass
 
             # spawn, not fork: the submitting process holds a live JAX

@@ -33,7 +33,6 @@ two histograms: ``serve.queued_s`` (admission wait) and ``serve.exec_s``
 
 from __future__ import annotations
 
-import os
 import re
 import threading
 import time
@@ -56,34 +55,13 @@ def sanitize_tenant(tenant: str | None) -> str:
     return _TENANT_SAFE.sub("_", str(tenant))[:32] or DEFAULT_TENANT
 
 
-def _env_int(name: str, default: int) -> int:
-    """Serving knobs follow the warn-and-default policy (the NEMO_PACK_XFER
-    precedent): a typo'd env on a long-lived sidecar must degrade to the
-    measured default, never crash-loop every admission."""
-    raw = os.environ.get(name, "").strip()
-    if not raw:
-        return default
-    try:
-        n = int(raw)
-    except ValueError:
-        _log.warning("serve.bad_env", name=name, value=raw, using=default)
-        return default
-    if n < 0:
-        _log.warning("serve.bad_env", name=name, value=raw, using=default)
-        return default
-    return n
-
-
-def _env_float(name: str, default: float) -> float:
-    raw = os.environ.get(name, "").strip()
-    if not raw:
-        return default
-    try:
-        v = float(raw)
-    except ValueError:
-        _log.warning("serve.bad_env", name=name, value=raw, using=default)
-        return default
-    return v if v >= 0 else default
+# Serving knobs follow the warn-and-default policy (the NEMO_PACK_XFER
+# precedent): a typo'd env on a long-lived sidecar must degrade to the
+# measured default, never crash-loop every admission.  The parsers now
+# live in nemo_tpu/utils/env.py (ISSUE 9 satellite) — ONE home for the
+# loud-vs-quiet policy; these aliases keep the serve-layer call sites.
+from nemo_tpu.utils.env import env_float as _env_float  # noqa: E402
+from nemo_tpu.utils.env import env_int as _env_int  # noqa: E402
 
 
 def max_inflight_default() -> int:
@@ -179,6 +157,7 @@ class AdmissionController:
         self._rr: deque[str] = deque()  # tenant rotation (head = next up)
         self._queued = 0
         self._inflight = 0
+        self._streams = 0
         self._draining = False
         #: EWMA of executed-slot seconds — the retry-after estimator's view
         #: of how fast one slot turns over.
@@ -197,6 +176,30 @@ class AdmissionController:
     @property
     def queued(self) -> int:
         return self._queued
+
+    @property
+    def streams(self) -> int:
+        return self._streams
+
+    # ------------------------------------------------------ stream presence
+
+    def begin_stream(self) -> None:
+        """Register one live server-streaming RPC (AnalyzeDirStream).  The
+        stream handler holds no admission ticket itself — its per-directory
+        workers do — so without this presence a SIGTERM drain could see
+        inflight==0 between a worker's release and the handler's terminal
+        ``done`` event and stop the server mid-stream, severing the stream
+        instead of finishing it (ISSUE 9 satellite).  Streams are admitted
+        even while draining ONLY in the sense that an already-started one
+        finishes; new per-directory tickets still reject."""
+        with self._lock:
+            self._streams += 1
+        obs.metrics.gauge("serve.streams", self._streams)
+
+    def end_stream(self) -> None:
+        with self._lock:
+            self._streams = max(0, self._streams - 1)
+        obs.metrics.gauge("serve.streams", self._streams)
 
     def retry_after_s(self) -> float:
         """Load-derived backoff hint: the queue's worth of slot turnovers
@@ -332,11 +335,15 @@ class AdmissionController:
         _log.info("serve.draining", inflight=self._inflight, queued=self._queued)
 
     def drain_wait(self, timeout_s: float) -> bool:
-        """Wait until nothing is in flight or queued; True when drained."""
+        """Wait until nothing is in flight, queued, OR mid-stream; True
+        when drained.  Streams count (ISSUE 9): an AnalyzeDirStream must
+        emit its terminal ``done`` event before the server stops — a
+        drained-by-tickets-only wait could sever it between its last
+        worker's release and that final yield."""
         deadline = time.monotonic() + timeout_s
         while True:
             with self._lock:
-                if self._inflight == 0 and self._queued == 0:
+                if self._inflight == 0 and self._queued == 0 and self._streams == 0:
                     return True
             if time.monotonic() >= deadline:
                 return False

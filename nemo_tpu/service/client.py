@@ -20,6 +20,7 @@ from nemo_tpu.obs import log as obs_log
 from nemo_tpu.service import codec
 from nemo_tpu.service.proto import nemo_service_pb2 as pb
 from nemo_tpu.service.server import SERVICE
+from nemo_tpu.utils.backoff import RPC_POLICY
 
 _log = obs_log.get_logger("nemo.client")
 
@@ -181,8 +182,8 @@ class RemoteAnalyzer:
                 out["metrics"] = _json.loads(
                     raw.decode("utf-8") if isinstance(raw, bytes) else raw
                 )
-        except Exception:
-            pass  # an old server without the metadata is still healthy
+        except Exception:  # lint: allow-silent-except — optional metadata; an old server without it is still healthy
+            pass
         return out
 
     def wait_ready(self, deadline: float = 30.0) -> dict:
@@ -214,13 +215,16 @@ class RemoteAnalyzer:
     def _call(self, method, request, timeout: float | None = None, name: str = "rpc"):
         """One unary RPC with bounded retries; returns (response, call) —
         with_call so trailing metadata (sidecar spans, metrics) is
-        readable.  UNAVAILABLE retries with exponential backoff;
-        RESOURCE_EXHAUSTED (admission rejection, ISSUE 8) honors the
-        sidecar's `nemo-retry-after-s` trailing-metadata hint — counted as
-        `rpc.throttled`, so a load-shedding server shows up in the client's
-        metrics rather than as silent latency.  Every attempt gets a span
-        and a latency observation."""
-        delay = 0.2
+        readable.  UNAVAILABLE retries and the RESOURCE_EXHAUSTED
+        throttle path (admission rejection, ISSUE 8 — the sidecar's
+        `nemo-retry-after-s` trailing-metadata hint, counted as
+        `rpc.throttled`) share ONE jittered-exponential policy with a
+        total retry BUDGET (utils/backoff.py:RPC_POLICY, ISSUE 9
+        satellite): a server hint replaces the exponential term for that
+        attempt (clamped by the policy), and cumulative waiting past the
+        budget raises instead of accumulating unbounded latency.  Every
+        attempt gets a span and a latency observation."""
+        backoff = RPC_POLICY.session()
         md = self._request_metadata()
         for attempt in range(self.retries):
             try:
@@ -280,24 +284,30 @@ class RemoteAnalyzer:
                 ) or attempt == self.retries - 1:
                     obs.metrics.inc("rpc.errors")
                     raise
+                # Shared policy: the throttle hint (when present) replaces
+                # the exponential term, clamped by the policy's max delay
+                # so a wild hint cannot park the client; None means the
+                # total retry budget is spent — fail now, loudly, instead
+                # of waiting forever.
+                wait = backoff.delay(hint_s=retry_after if throttled else None)
+                if wait is None:
+                    obs.metrics.inc("rpc.errors")
+                    obs.metrics.inc("rpc.retry_budget_exhausted")
+                    _log.warning(
+                        "rpc.retry_budget_exhausted", rpc=name,
+                        target=self.target, spent_s=round(backoff.spent_s, 1),
+                    )
+                    raise
                 if throttled:
-                    # Admission rejection: back off by the server's own
-                    # load estimate, bounded so a wild hint cannot park
-                    # the client.
-                    wait = min(retry_after, 10.0)
                     obs.metrics.inc("rpc.throttled")
-                    obs.metrics.inc("rpc.backoff_s", wait)
                     _log.info(
                         "rpc.throttled", rpc=name, target=self.target,
                         retry_after_s=round(wait, 2), attempt=attempt,
                     )
-                    time.sleep(wait)
-                    delay *= 2
-                    continue
-                obs.metrics.inc("rpc.retries")
-                obs.metrics.inc("rpc.backoff_s", delay)
-                time.sleep(delay)
-                delay *= 2
+                else:
+                    obs.metrics.inc("rpc.retries")
+                obs.metrics.inc("rpc.backoff_s", wait)
+                time.sleep(wait)
         raise SidecarError("unreachable")
 
     # ------------------------------------------------------------- kernel
@@ -394,20 +404,44 @@ class RemoteAnalyzer:
             req["result_cache"] = result_cache
         obs.metrics.inc("rpc.bytes_sent", len(_json.dumps(req).encode("utf-8")))
         md = self._request_metadata()
-        with obs.span("rpc:AnalyzeDirStream", target=self.target, dirs=len(req["dirs"])):
-            stream = self._analyze_dir_stream(
-                req, timeout=self.timeout, **({"metadata": md} if md else {})
-            )
-            for ev in stream:
-                obs.metrics.inc("rpc.stream_events")
-                if ev.get("event") == "result":
-                    payload = base64.b64decode(ev.pop("response_b64"))
-                    obs.metrics.inc("rpc.bytes_received", len(payload))
-                    ev["outputs"] = codec.outputs_from_pb(
-                        pb.AnalyzeResponse.FromString(payload)
+        # Same shared retry policy as the unary path (ISSUE 9): the JSON
+        # request is replayable, so an UNAVAILABLE BEFORE the first event
+        # restarts the stream after a jittered wait; mid-stream errors
+        # propagate (the consumer already observed events).
+        backoff = RPC_POLICY.session()
+        while True:
+            got_any = False
+            try:
+                with obs.span(
+                    "rpc:AnalyzeDirStream", target=self.target, dirs=len(req["dirs"])
+                ):
+                    stream = self._analyze_dir_stream(
+                        req, timeout=self.timeout, **({"metadata": md} if md else {})
                     )
-                yield ev
-            _adopt_remote(stream)
+                    for ev in stream:
+                        got_any = True
+                        obs.metrics.inc("rpc.stream_events")
+                        if ev.get("event") == "result":
+                            payload = base64.b64decode(ev.pop("response_b64"))
+                            obs.metrics.inc("rpc.bytes_received", len(payload))
+                            ev["outputs"] = codec.outputs_from_pb(
+                                pb.AnalyzeResponse.FromString(payload)
+                            )
+                        yield ev
+                    _adopt_remote(stream)
+                return
+            except grpc.RpcError as ex:
+                wait = backoff.delay()
+                if (
+                    got_any
+                    or ex.code() != grpc.StatusCode.UNAVAILABLE
+                    or wait is None
+                ):
+                    obs.metrics.inc("rpc.errors")
+                    raise
+                obs.metrics.inc("rpc.retries")
+                obs.metrics.inc("rpc.backoff_s", wait)
+                time.sleep(wait)
 
     def analyze_chunks(
         self, chunks: list[tuple[object, object, dict]]
@@ -425,11 +459,32 @@ class RemoteAnalyzer:
                 req.static.CopyFrom(codec.static_to_pb(static))
                 yield req
 
-        out: list[dict[str, np.ndarray] | None] = [None] * len(chunks)
-        _drive_stream(
-            self._analyze_stream, requests(), self.timeout, self.target, out,
-            **({"extra_md": (("nemo-tenant", self.tenant),)} if self.tenant else {}),
-        )
+        # Stream retry rides the same shared policy as the unary RPCs
+        # (ISSUE 9 satellite): the request list is replayable, so a
+        # CONNECTION-level UNAVAILABLE — nothing received yet — restarts
+        # the stream after a jittered wait; once any chunk has landed the
+        # error propagates (replaying would double-dispatch server-side).
+        backoff = RPC_POLICY.session()
+        while True:
+            out: list[dict[str, np.ndarray] | None] = [None] * len(chunks)
+            try:
+                _drive_stream(
+                    self._analyze_stream, requests(), self.timeout, self.target, out,
+                    **({"extra_md": (("nemo-tenant", self.tenant),)} if self.tenant else {}),
+                )
+                break
+            except grpc.RpcError as ex:
+                wait = backoff.delay()
+                if (
+                    ex.code() != grpc.StatusCode.UNAVAILABLE
+                    or any(o is not None for o in out)
+                    or wait is None
+                ):
+                    obs.metrics.inc("rpc.errors")
+                    raise
+                obs.metrics.inc("rpc.retries")
+                obs.metrics.inc("rpc.backoff_s", wait)
+                time.sleep(wait)
         missing = [i for i, o in enumerate(out) if o is None]
         if missing:
             raise SidecarError(f"missing responses for chunks {missing}")

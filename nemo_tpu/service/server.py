@@ -667,6 +667,12 @@ class _Impl:
             threading.Thread(target=worker, daemon=True, name=f"nemo-serve-stream-{k}")
             for k in range(n_workers)
         ]
+        # Stream presence (ISSUE 9 satellite): the handler itself holds no
+        # admission ticket (its per-directory workers do), so it registers
+        # with the controller's stream counter — the SIGTERM drain waits
+        # for it, guaranteeing the terminal `done` event is yielded before
+        # the server stops instead of severing a mid-flight stream.
+        self.admission.begin_stream()
         try:
             for t in threads:
                 t.start()
@@ -683,6 +689,7 @@ class _Impl:
         finally:
             for t in threads:
                 t.join(timeout=5.0)
+            self.admission.end_stream()
             _rpc_observed("AnalyzeDirStream", t0, col.tid)
             col.release()
 
@@ -968,9 +975,15 @@ def main(argv: list[str] | None = None) -> int:
             inflight=ctl.inflight, queued=ctl.queued,
         )
         ctl.begin_drain()
-        # grpc's own grace: no new RPCs, in-flight handlers run on.
-        stopped = server.stop(grace=drain_s)
+        # Drain ORDER matters (ISSUE 9 satellite): wait for the admission
+        # tier — in-flight tickets, queued waiters, AND live streams (an
+        # AnalyzeDirStream's terminal `done` event must go out, not be
+        # severed) — BEFORE asking grpc to stop.  New arrivals during the
+        # wait still reach handlers and are refused by admission
+        # (UNAVAILABLE), so nothing accumulates; grpc's own stop then only
+        # has stragglers that ignored the drain window.
         drained = ctl.drain_wait(drain_s)
+        stopped = server.stop(grace=5.0)
         stopped.wait(timeout=5.0)
         obs.metrics.inc("serve.drained" if drained else "serve.drain_timeout")
         log.info("sidecar.drained", clean=drained, inflight=ctl.inflight)

@@ -39,12 +39,17 @@ from nemo_tpu.store.npack import (
     payload_from_molly,
     payload_from_runs,
     fingerprint_mode,
+    quarantine_changed,
+    quarantine_file_names,
+    quarantine_files_from_snapshot,
     segment_fingerprint,
     segment_source_fp,
+    segment_source_fp_positions,
     snapshot_source,
     snapshot_source_appended,
     source_from_snapshot,
     store_workers_default,
+    stored_positions,
     write_segment,
     write_vocab,
 )
@@ -257,14 +262,40 @@ class CorpusStore:
                     store_dir, header, verify=_verify_on_load()
                 )
                 corpus = build_corpus(store_dir, header, seg_readers, vocab_rd)
+                # Row -> source-position mapping for the lazy runs.json
+                # trio: quarantine/repair stores hold a row SUBSET, so the
+                # identity mapping would read the wrong entries (ISSUE 9).
+                pos = (
+                    stored_positions(header)
+                    if (header.get("quarantined") or any(
+                        "positions" in s for s in header["segments"]
+                    ))
+                    else None
+                )
                 out = (
-                    molly_from_corpus(corpus, corpus_dir) if build_molly else corpus
+                    molly_from_corpus(corpus, corpus_dir, positions=pos)
+                    if build_molly
+                    else corpus
                 )
                 # Segment identities ride on the loaded object: the result
                 # cache (store/rcache.py) keys analysis outputs on them.
                 attach_store_provenance(corpus, store_dir, header)
                 if out is not corpus:
                     attach_store_provenance(out, store_dir, header)
+                # The quarantine set rides too (ISSUE 9): a warm load must
+                # reproduce the cold parse's "Degraded runs" section
+                # byte-for-byte (the per-file stat fingerprints are store
+                # bookkeeping, not report content — stripped here).
+                qrecs = header.get("quarantined") or ()
+                if qrecs:
+                    q = [
+                        {k: v for k, v in rec.items() if k != "files"}
+                        for rec in qrecs
+                    ]
+                    corpus.quarantined = q
+                    if out is not corpus:
+                        out.quarantined = q
+                    obs.metrics.inc("ingest.quarantined", len(q))
             except (StoreCorrupt, OSError, ValueError, KeyError) as ex:
                 obs.metrics.inc("store.stale")
                 _log.error(
@@ -334,18 +365,48 @@ class CorpusStore:
         with obs.span("ingest:store_populate", dir=os.path.basename(corpus_dir)):
             payload = payload_from_molly(molly)
             snap = snapshot or snapshot_source(corpus_dir)
-            source = source_from_snapshot(snap, payload.n_runs)
+            # Quarantined runs (ISSUE 9): the store persists only the
+            # HEALTHY rows but records the quarantine set — each record
+            # carries the stats of its run's files, so a later load serves
+            # the same degraded corpus until the operator repairs a file,
+            # which classifies GROWN and re-ingests exactly those
+            # positions via the append path.  Their files are excluded
+            # from the class fingerprints (source_from_snapshot) — a
+            # repair must read as GROWN, not STALE.
+            qsrc = list(getattr(molly, "quarantined", None) or [])
+            qrecs = [
+                dict(
+                    rec,
+                    files=[]
+                    if rec.get("file") == "runs.json"
+                    else quarantine_files_from_snapshot(snap, rec["position"]),
+                )
+                for rec in qsrc
+            ]
+            qpos = {rec["position"] for rec in qrecs}
+            n_positions = payload.n_runs + len(qpos)
+            source = source_from_snapshot(
+                snap, n_positions, exclude=quarantine_file_names(qrecs)
+            )
             source["dir"] = os.path.realpath(corpus_dir)
             final = self.store_dir(corpus_dir)
             tmp = f"{final}.tmp-{os.getpid()}-{uuid.uuid4().hex[:8]}"
             os.makedirs(tmp, exist_ok=True)
             try:
                 seg_entry = write_segment(os.path.join(tmp, "seg-000"), payload, workers)
+                from nemo_tpu.utils import chaos
+
+                chaos.on_store_publish()
                 # Per-segment SOURCE fingerprint: the run files these rows
                 # came from (spacetime DOTs included — content the packed
                 # arrays don't mirror); part of the segment's identity for
                 # the result cache.
-                seg_entry["source_fp"] = segment_source_fp(snap, 0, payload.n_runs)
+                if qpos:
+                    healthy = sorted(set(range(n_positions)) - qpos)
+                    seg_entry["positions"] = healthy
+                    seg_entry["source_fp"] = segment_source_fp_positions(snap, healthy)
+                else:
+                    seg_entry["source_fp"] = segment_source_fp(snap, 0, payload.n_runs)
                 vshard = write_vocab(
                     os.path.join(tmp, "vocab-0001.bin"), _VocabView(payload.vocab)
                 )
@@ -358,6 +419,8 @@ class CorpusStore:
                     "vocab_shard": vshard,
                     "segments": [seg_entry],
                 }
+                if qrecs:
+                    header["quarantined"] = qrecs
                 with open(os.path.join(tmp, "header.json"), "w", encoding="utf-8") as fh:
                     json.dump(header, fh, indent=1)
                 with self._lock(final):
@@ -503,22 +566,32 @@ class CorpusStore:
                 return None
             src = header["source"]
             n_old = int(src["n_runs"])
+            # Repair candidates (ISSUE 9): quarantined positions whose
+            # watched files' stats moved — the operator repaired them, and
+            # this append re-ingests exactly those positions alongside any
+            # appended tail.
+            qrecs_old = list(header.get("quarantined") or ())
+            repair_pos = {
+                int(r["position"]) for r in quarantine_changed(corpus_dir, qrecs_old)
+            }
             # Snapshot BEFORE parsing anything: a file mutated while the
             # tail parse below runs then mismatches the fingerprint this
             # append publishes, so the NEXT load re-parses (fail-safe).
             # In fast fingerprint mode the snapshot is PARTIAL — names
             # enumeration + stats for only runs.json, the new run files,
-            # and the load-check sample — so the append wall scales with
-            # the growth, not the corpus (a full per-file stat pass is
-            # ~40 s on a 9p-mounted 10x corpus).
+            # the repair candidates, and the load-check sample — so the
+            # append wall scales with the growth, not the corpus (a full
+            # per-file stat pass is ~40 s on a 9p-mounted 10x corpus).
             snap = (
                 snapshot_source(corpus_dir)
                 if fingerprint_mode() == "full"
-                else snapshot_source_appended(corpus_dir, n_old)
+                else snapshot_source_appended(
+                    corpus_dir, n_old, extra_positions=repair_pos
+                )
             )
             with open(os.path.join(corpus_dir, "runs.json"), "r", encoding="utf-8") as fh:
                 raw_runs = json.load(fh)
-            if len(raw_runs) <= n_old:
+            if len(raw_runs) < n_old or (len(raw_runs) == n_old and not repair_pos):
                 return None
             # Old-entry confirmation: prefer the strong byte-prefix check (a
             # stable serializer keeps the first n entries' bytes identical).
@@ -547,18 +620,24 @@ class CorpusStore:
                         detail=why,
                     )
 
-                for i in range(n_old):
-                    r = raw_runs[i]
-                    if int(r.get("iteration", 0)) != int(old.iteration[i]) or (
-                        (r.get("status", "") == "success") != bool(old.success[i])
+                # Stored row -> source position: identity for legacy
+                # stores, explicit per-segment position lists once
+                # quarantine/repair segments exist (ISSUE 9).
+                rows_pos = stored_positions(header)
+                n_stored = len(rows_pos)
+                for row, pos in enumerate(rows_pos):
+                    r = raw_runs[pos]
+                    if int(r.get("iteration", 0)) != int(old.iteration[row]) or (
+                        (r.get("status", "") == "success") != bool(old.success[row])
                     ):
-                        refused(i, "old runs.json entries changed; store is stale")
+                        refused(pos, "old runs.json entries changed; store is stale")
                         return None
-                stride = max(1, n_old // 64)
-                check = sorted(set(range(0, n_old, stride)) | {0, n_old - 1})
-                for i in check:
-                    if _head_bytes(_RunData.from_json(raw_runs[i])) != old.run_head_json(i):
-                        refused(i, "old run head fragment changed; store is stale")
+                stride = max(1, n_stored // 64)
+                check = sorted(set(range(0, n_stored, stride)) | {0, n_stored - 1})
+                for row in check:
+                    pos = rows_pos[row]
+                    if _head_bytes(_RunData.from_json(raw_runs[pos])) != old.run_head_json(row):
+                        refused(pos, "old run head fragment changed; store is stale")
                         return None
             # Stored vocabulary, extended in place by the new graphs ("pre"/
             # "post" re-intern to their pinned 0/1).
@@ -569,40 +648,102 @@ class CorpusStore:
                 v = getattr(vocab, part)
                 for s in _decode_vocab(vocab_rd, part):
                     v.intern(s)
-            new_runs = []
-            for pos in range(n_old, len(raw_runs)):
-                run = RunData.from_json(raw_runs[pos])
-                load_run_prov(corpus_dir, pos, run)
+            # Candidate positions: the appended tail plus any repaired
+            # quarantined positions; each parses under the same per-run
+            # isolation as the loader (ISSUE 9) — a malformed candidate
+            # joins/stays in the quarantine instead of failing the append.
+            from nemo_tpu.ingest.molly import quarantine_record
+            from nemo_tpu.utils.env import quarantine_enabled
+
+            quarantine = quarantine_enabled()
+            candidates = sorted(repair_pos | set(range(n_old, len(raw_runs))))
+            new_runs, new_positions, new_q = [], [], []
+            for pos in candidates:
+                try:
+                    run = RunData.from_json(raw_runs[pos])
+                except Exception as ex:
+                    if not quarantine:
+                        return None  # stale -> the caller reparses, loudly
+                    new_q.append(quarantine_record(pos, None, "runs.json", ex))
+                    continue
+                try:
+                    load_run_prov(corpus_dir, pos, run)
+                except Exception as ex:
+                    if not quarantine:
+                        return None
+                    cond = "post" if run.pre_prov is not None else "pre"
+                    new_q.append(
+                        quarantine_record(
+                            pos, run.iteration, f"run_{pos}_{cond}_provenance.json", ex
+                        )
+                    )
+                    continue
                 new_runs.append(run)
-            payload = payload_from_runs(new_runs, vocab)
-            workers = store_workers_default()
-            seg_name = f"seg-{len(header['segments']):03d}"
-            tmp_seg = os.path.join(store_dir, f"{seg_name}.tmp-{uuid.uuid4().hex[:8]}")
-            try:
-                seg_entry = write_segment(tmp_seg, payload, workers)
-                seg_entry["name"] = seg_name
-                seg_entry["source_fp"] = segment_source_fp(
-                    snap, n_old, len(raw_runs)
+                new_positions.append(pos)
+            for rec in new_q:
+                rec["files"] = (
+                    []
+                    if rec["file"] == "runs.json"
+                    else quarantine_files_from_snapshot(snap, rec["position"])
                 )
-                os.rename(tmp_seg, os.path.join(store_dir, seg_name))
-            except BaseException:
-                shutil.rmtree(tmp_seg, ignore_errors=True)
-                raise
+                obs.metrics.inc("ingest.quarantined")
+            kept_q = [r for r in qrecs_old if int(r["position"]) not in repair_pos]
+            final_q = sorted(kept_q + new_q, key=lambda r: int(r["position"]))
+
+            seg_name = f"seg-{len(header['segments']):03d}"
+            segments = header["segments"]
+            if new_runs:
+                payload = payload_from_runs(new_runs, vocab)
+                workers = store_workers_default()
+                tmp_seg = os.path.join(
+                    store_dir, f"{seg_name}.tmp-{uuid.uuid4().hex[:8]}"
+                )
+                try:
+                    seg_entry = write_segment(tmp_seg, payload, workers)
+                    seg_entry["name"] = seg_name
+                    # Position-set fingerprint: equals the old contiguous
+                    # range fp when the segment IS the contiguous tail.
+                    seg_entry["source_fp"] = segment_source_fp_positions(
+                        snap, new_positions
+                    )
+                    if final_q or qrecs_old or new_positions != list(
+                        range(n_old, n_old + len(new_positions))
+                    ):
+                        seg_entry["positions"] = list(new_positions)
+                    os.rename(tmp_seg, os.path.join(store_dir, seg_name))
+                except BaseException:
+                    shutil.rmtree(tmp_seg, ignore_errors=True)
+                    raise
+                segments = segments + [seg_entry]
+            elif not new_q and not repair_pos:
+                return None
             # New vocab generation (old file kept: an in-flight reader of the
             # old header still resolves), then the atomic commit point: the
-            # header swap.
-            gen = len(header["segments"]) + 1
-            vshard = write_vocab(
-                os.path.join(store_dir, f"vocab-{gen:04d}.bin"), _VocabView(vocab)
+            # header swap.  A no-new-runs publish (every candidate still
+            # quarantined) interned nothing — keep the current vocab shard
+            # untouched (rewriting it in place would race live readers) and
+            # update only source + quarantine bookkeeping so the next load
+            # doesn't re-attempt the same repairs.
+            if new_runs:
+                vshard = write_vocab(
+                    os.path.join(store_dir, f"vocab-{len(segments):04d}.bin"),
+                    _VocabView(vocab),
+                )
+            else:
+                vshard = header["vocab_shard"]
+            source = source_from_snapshot(
+                snap, len(raw_runs), exclude=quarantine_file_names(final_q)
             )
-            source = source_from_snapshot(snap, len(raw_runs))
             source["dir"] = os.path.realpath(corpus_dir)
             header = dict(
                 header,
                 source=source,
                 vocab_shard=vshard,
-                segments=header["segments"] + [seg_entry],
+                segments=segments,
             )
+            header["quarantined"] = final_q
+            if not final_q:
+                header.pop("quarantined", None)
             tmp_header = os.path.join(store_dir, f"header.json.tmp-{uuid.uuid4().hex[:8]}")
             with open(tmp_header, "w", encoding="utf-8") as fh:
                 json.dump(header, fh, indent=1)
@@ -612,8 +753,10 @@ class CorpusStore:
             "store.appended",
             corpus=corpus_dir,
             new_runs=len(new_runs),
+            repaired=len([p for p in new_positions if p < n_old]),
+            quarantined=len(final_q),
             total_runs=len(raw_runs),
-            segment=seg_name,
+            segment=seg_name if new_runs else None,
         )
         return header
 

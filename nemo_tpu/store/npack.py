@@ -62,6 +62,20 @@ repack-from-scratch (same vocabulary SET, same report bytes); raw integer
 ids may differ because interning order differs, which nothing downstream
 observes (everything resolves through the vocab).
 
+Quarantine (ISSUE 9): a store populated from a quarantining ingest
+persists only the HEALTHY rows, plus a ``quarantined`` header list whose
+records carry per-file stat fingerprints.  Those files are EXCLUDED from
+the class fingerprints, watched individually instead: unchanged -> the
+same degraded corpus serves as a HIT; changed (repaired) -> GROWN, and
+the append path re-ingests exactly the repaired positions as a new
+segment (segment entries gain an explicit ``positions`` list once rows
+are non-contiguous).  Caveat: repaired runs land in APPEND order, so a
+post-repair load equals a from-scratch reparse up to run ordering — the
+next full repopulate restores source order.  A quarantined runs.json
+ENTRY (as opposed to a provenance file) is repaired by editing runs.json
+itself, which the prefix-sha/stat checks classify STALE -> loud full
+repopulate, the always-correct path.
+
 Concurrency: writers serialize on an ``fcntl`` lock file and publish via
 atomic rename, so concurrent populates of one corpus cannot tear a store;
 readers never lock (POSIX keeps their mmaps alive across a concurrent
@@ -372,7 +386,9 @@ def snapshot_source(corpus_dir: str, with_stats: bool = True) -> dict:
     }
 
 
-def snapshot_source_appended(corpus_dir: str, n_old: int) -> dict:
+def snapshot_source_appended(
+    corpus_dir: str, n_old: int, extra_positions: set | None = None
+) -> dict:
     """Partial pre-parse snapshot for the APPEND path in ``fast``
     fingerprint mode: one names-only enumeration plus stats for exactly
     the files the published fingerprint will read — runs.json, the NEW
@@ -411,7 +427,14 @@ def snapshot_source_appended(corpus_dir: str, n_old: int) -> dict:
             if name.startswith("run_"):
                 cut = name.find("_", 4)
                 idx = name[4:cut] if cut > 4 else ""
-            if idx.isdigit() and int(idx) >= n_old:
+            # New-run files get stats (their segment's source_fp); so do
+            # repair-candidate positions (``extra_positions`` — the
+            # quarantine records being re-ingested need fresh per-file
+            # fingerprints, ISSUE 9).
+            if idx.isdigit() and (
+                int(idx) >= n_old
+                or (extra_positions and int(idx) in extra_positions)
+            ):
                 st = entry.stat()
                 entries.append((name, st.st_size, st.st_mtime_ns))
             else:
@@ -439,7 +462,7 @@ def snapshot_source_appended(corpus_dir: str, n_old: int) -> dict:
     }
 
 
-def source_from_snapshot(snap: dict, n_old: int) -> dict:
+def source_from_snapshot(snap: dict, n_old: int, exclude: set | None = None) -> dict:
     """Snapshot -> fingerprint dict, classed so GROWN (runs appended by an
     incremental sweep) is distinguishable from STALE (anything else
     changed):
@@ -456,11 +479,20 @@ def source_from_snapshot(snap: dict, n_old: int) -> dict:
     when the snapshot carried stats) and a names-only fingerprint
     (``*_names_fp``) are produced; ``sample`` is a deterministic
     <=:data:`_SAMPLE_FILES` spread of (name, size, mtime_ns) triples over
-    the old+other classes for the fast load check."""
+    the old+other classes for the fast load check.
+
+    ``exclude`` (ISSUE 9) removes QUARANTINED runs' files from every class
+    and from the sample: their stats legitimately change when an operator
+    repairs them, and that change must classify as GROWN (re-ingest the
+    repaired runs via the append path), not STALE.  The excluded files are
+    fingerprinted separately, per quarantine record, in the store header."""
+    exclude = exclude or frozenset()
     classes: dict[str, list] = {"old": [], "new": [], "other": []}
     old, new, other = classes["old"], classes["new"], classes["other"]
     for rec in snap["entries"]:
         name = rec[0]
+        if name in exclude:
+            continue
         # Hand-rolled ^run_(\d+)_ classification: the regex engine costs
         # ~1 µs/name, and a 10x corpus directory holds 300k+ entries.
         if name.startswith("run_"):
@@ -489,14 +521,22 @@ def source_from_snapshot(snap: dict, n_old: int) -> dict:
         out["sample"] = [list(rec) for rec in _select_sample(old + other)]
     elif snap.get("sample") is not None:
         # Partial append snapshot (snapshot_source_appended): the sample
-        # was selected and statted at snapshot time, pre-parse.
-        out["sample"] = [list(rec) for rec in snap["sample"]]
+        # was selected and statted at snapshot time, pre-parse.  Excluded
+        # (quarantined) files are filtered here too — their repair must
+        # not fail the sample check.
+        out["sample"] = [
+            list(rec) for rec in snap["sample"] if rec[0] not in exclude
+        ]
     return out
 
 
-def scan_source(corpus_dir: str, n_old: int, with_stats: bool = True) -> dict:
+def scan_source(
+    corpus_dir: str, n_old: int, with_stats: bool = True, exclude: set | None = None
+) -> dict:
     """One-shot snapshot + classification (the load-side compare path)."""
-    return source_from_snapshot(snapshot_source(corpus_dir, with_stats), n_old)
+    return source_from_snapshot(
+        snapshot_source(corpus_dir, with_stats), n_old, exclude=exclude
+    )
 
 
 def _runs_prefix_sha(corpus_dir: str, nbytes: int) -> str | None:
@@ -541,6 +581,83 @@ def segment_source_fp(snapshot: dict, lo: int, hi: int) -> str:
     return _fp(lines)
 
 
+def segment_source_fp_positions(snapshot: dict, positions) -> str:
+    """:func:`segment_source_fp` over an explicit POSITION SET instead of a
+    contiguous range — the quarantine-repair append path's segments carry
+    non-contiguous source positions (ISSUE 9)."""
+    want = {int(p) for p in positions}
+    lines = []
+    for rec in snapshot["entries"]:
+        name = rec[0]
+        if not name.startswith("run_"):
+            continue
+        cut = name.find("_", 4)
+        idx = name[4:cut] if cut > 4 else ""
+        if idx.isdigit() and int(idx) in want:
+            lines.append(f"{rec[0]}\0{rec[1]}\0{rec[2]}")
+    return _fp(lines)
+
+
+# ---------------------------------------------------------------------------
+# quarantine bookkeeping (ISSUE 9)
+# ---------------------------------------------------------------------------
+
+
+def quarantine_file_names(qrecs) -> set:
+    """Every file name owned by the header's quarantine records."""
+    return {f[0] for rec in qrecs or () for f in rec.get("files") or ()}
+
+
+def quarantine_files_from_snapshot(snap: dict, position: int) -> list:
+    """All ``run_<position>_*`` files of one quarantined position, with the
+    snapshot's stats — the per-record fingerprint a repair is detected by.
+    Every file of the position is watched (not just the one that failed to
+    parse): a repair tool typically rewrites the whole run."""
+    out = []
+    prefix = f"run_{position}_"
+    for rec in snap["entries"]:
+        if rec[0].startswith(prefix):
+            out.append([rec[0], rec[1], rec[2]])
+    return sorted(out)
+
+
+def quarantine_changed(corpus_dir: str, qrecs) -> list:
+    """The quarantine records whose watched files' stats changed on disk —
+    repair candidates for the GROWN append path.  A record with no watched
+    files (the failure was a runs.json ENTRY, whose repair is caught by the
+    runs.json stat / prefix sha instead) never matches here."""
+    changed = []
+    for rec in qrecs or ():
+        files = rec.get("files") or ()
+        if not files:
+            continue
+        for name, size, mtime_ns in files:
+            try:
+                st = os.stat(os.path.join(corpus_dir, name))
+            except OSError:
+                changed.append(rec)
+                break
+            if st.st_size != size or st.st_mtime_ns != mtime_ns:
+                changed.append(rec)
+                break
+    return changed
+
+
+def stored_positions(header: dict) -> list[int]:
+    """Stored row -> source position, across all segments in append order.
+    Segments written before quarantine support (no ``positions`` key) are
+    contiguous from the first position after every earlier segment."""
+    out: list[int] = []
+    nxt = 0
+    for seg in header["segments"]:
+        pos = seg.get("positions")
+        if pos is None:
+            pos = range(nxt, nxt + int(seg["n_runs"]))
+        out.extend(int(p) for p in pos)
+        nxt = (max(out) + 1) if out else 0
+    return out
+
+
 def segment_fingerprint(entry: dict) -> str:
     """Content address of one store segment: its packed-shard checksums,
     its shape row, and its source-file fingerprint.  The analysis result
@@ -576,8 +693,16 @@ def classify_source(header: dict, corpus_dir: str) -> str:
     ``fast`` mode (default, :func:`fingerprint_mode`) compares names-only
     fingerprints plus runs.json's stat plus the stored stat sample — one
     scandir and <=~65 stats regardless of corpus size.  ``full`` mode
-    re-stats every file and compares the exhaustive fingerprints."""
+    re-stats every file and compares the exhaustive fingerprints.
+
+    Quarantined runs' files (ISSUE 9) are excluded from every class
+    fingerprint and statted individually instead: unchanged -> the store
+    still serves (same healthy rows, same quarantine list); changed (the
+    operator repaired a run) -> GROWN, so the append path re-ingests
+    exactly the repaired positions."""
     src = header.get("source") or {}
+    qrecs = header.get("quarantined") or ()
+    qnames = quarantine_file_names(qrecs)
     full = fingerprint_mode() == "full"
     if not full and src.get("dir_mtime_ns"):
         # Tier 0, no directory enumeration at all: entry creates/deletes/
@@ -595,10 +720,17 @@ def classify_source(header: dict, corpus_dir: str) -> str:
             and [rj.st_size, rj.st_mtime_ns] == src.get("runs_json")
             and _sample_ok(corpus_dir, src.get("sample"))
         ):
+            # An in-place repair of a quarantined file bumps neither the
+            # dir mtime nor runs.json — its bounded per-record stat check
+            # is the only tripwire at tier 0.
+            if qrecs and quarantine_changed(corpus_dir, qrecs):
+                return GROWN
             return HIT
         # Something moved: fall through to the name-level scan to tell
         # GROWN from STALE.
-    cur = scan_source(corpus_dir, int(src.get("n_runs", 0)), with_stats=full)
+    cur = scan_source(
+        corpus_dir, int(src.get("n_runs", 0)), with_stats=full, exclude=qnames
+    )
     if full:
         base_ok = cur["old_fp"] == src.get("old_fp") and cur["other_fp"] == src.get(
             "other_fp"
@@ -614,6 +746,10 @@ def classify_source(header: dict, corpus_dir: str) -> str:
     if not base_ok:
         return STALE
     if hit_ok and cur["runs_json"] == src.get("runs_json"):
+        # Healthy classes intact; a repaired quarantined run is the GROWN
+        # (re-ingest) case, an untouched quarantine set a plain HIT.
+        if qrecs and quarantine_changed(corpus_dir, qrecs):
+            return GROWN
         return HIT
     # Append candidate: every stored file untouched, runs.json changed, new
     # run files exist, and the store was written with none pending (a store

@@ -303,12 +303,17 @@ def _store_run_cls():
     return StoreRunData
 
 
-def molly_from_corpus(corpus, corpus_dir: str):
+def molly_from_corpus(corpus, corpus_dir: str, positions: list[int] | None = None):
     """StoreCorpus -> MollyOutput, mirroring load_molly_output_packed's
     product (RawProv placeholders, lazy head-carrying runs, iteration
     bookkeeping) without touching any source JSON.  The per-run Python work
     is kept near zero — template-dict construction, lazy holds/trio — so a
-    warm load stays mmap-bound even at 100k-run scale."""
+    warm load stays mmap-bound even at 100k-run scale.
+
+    ``positions`` maps stored row -> SOURCE position (npack.stored_positions
+    — identity when omitted): quarantine/repair stores hold a row subset,
+    so the lazy runs.json trio (failure_spec/model/messages) must index the
+    source file by position, not by row (ISSUE 9)."""
     LazyRunData, _, _, RawProv = _import_native()
     from nemo_tpu.ingest.datatypes import RunData
     from nemo_tpu.ingest.molly import MollyOutput
@@ -318,7 +323,8 @@ def molly_from_corpus(corpus, corpus_dir: str):
         run_name=os.path.basename(os.path.normpath(corpus_dir)),
         output_dir=corpus_dir,
     )
-    raws = _RawRuns(os.path.join(corpus_dir, "runs.json"), corpus.n_runs)
+    expected_n = (max(positions) + 1) if positions else corpus.n_runs
+    raws = _RawRuns(os.path.join(corpus_dir, "runs.json"), expected_n)
     strings = corpus.strings
     # Every RunData default (future fields included), captured once from the
     # real constructor; mutable containers are copied per run below.
@@ -344,7 +350,9 @@ def molly_from_corpus(corpus, corpus_dir: str):
                 d[k] = v.copy()
             d["iteration"] = iters_list[row]
             d["status"] = statuses[local].decode()
-            d["_raw"] = _RawProxy(raws, row)
+            d["_raw"] = _RawProxy(
+                raws, positions[row] if positions else row
+            )
             d["_lazy"] = dict(sentinels)
             d["_head_corpus"] = corpus
             d["_head_row"] = row

@@ -1,0 +1,92 @@
+"""The shared jittered-exponential-backoff policy (ISSUE 9 satellite).
+
+Before this module, every retry loop hand-rolled its own waits: the RPC
+client's UNAVAILABLE backoff (0.2 s doubling, no jitter), its
+``nemo-retry-after-s`` throttle path (server hint clamped at 10 s, no
+budget), and the scheduler's failover pause.  One policy now produces every
+wait so the shapes cannot drift:
+
+  * **jittered exponential**: attempt k sleeps ``base * multiplier**k``
+    scaled by a uniform ``1 ± jitter`` factor — jitter is what keeps a herd
+    of clients rejected together from re-arriving together;
+  * **server hints win** (bounded): a ``retry-after`` hint from the server
+    replaces the exponential term for that attempt (the server knows its
+    own queue), clamped to ``max_delay`` so a wild hint cannot park the
+    client;
+  * **total budget**: cumulative sleep across one logical operation is
+    capped (``budget_s``); past it the next ``delay()`` returns None and
+    the caller gives up — bounded worst-case latency instead of "retries
+    exhausted eventually".
+
+Deterministic under test: pass ``rng`` (a ``random.Random``) to pin the
+jitter.
+"""
+
+from __future__ import annotations
+
+import random
+
+
+class BackoffPolicy:
+    """Stateless policy half: knows the shape of the waits."""
+
+    def __init__(
+        self,
+        base_s: float = 0.2,
+        multiplier: float = 2.0,
+        max_delay_s: float = 10.0,
+        jitter: float = 0.25,
+        budget_s: float = 60.0,
+    ) -> None:
+        self.base_s = float(base_s)
+        self.multiplier = float(multiplier)
+        self.max_delay_s = float(max_delay_s)
+        self.jitter = float(jitter)
+        self.budget_s = float(budget_s)
+
+    def session(self, rng: random.Random | None = None) -> "BackoffSession":
+        return BackoffSession(self, rng)
+
+
+class BackoffSession:
+    """Stateful half: one logical operation's attempt counter and spent
+    budget.  ``delay(hint_s=...)`` returns the next sleep in seconds, or
+    None when the budget is exhausted (the caller should stop retrying and
+    surface the last error)."""
+
+    def __init__(self, policy: BackoffPolicy, rng: random.Random | None = None) -> None:
+        self.policy = policy
+        self.attempt = 0
+        self.spent_s = 0.0
+        self._rng = rng or random
+
+    def delay(self, hint_s: float | None = None) -> float | None:
+        p = self.policy
+        if hint_s is not None and hint_s >= 0:
+            raw = float(hint_s)
+        else:
+            raw = p.base_s * (p.multiplier ** self.attempt)
+        raw = min(raw, p.max_delay_s)
+        factor = 1.0 + p.jitter * (2.0 * self._rng.random() - 1.0)
+        wait = max(0.0, raw * factor)
+        if self.spent_s + wait > p.budget_s:
+            return None
+        self.attempt += 1
+        self.spent_s += wait
+        return wait
+
+
+#: The RPC client's policy (service/client.py): the historic 0.2 s doubling
+#: start, the historic 10 s throttle clamp, and a 60 s total budget — a
+#: request that cannot land inside a minute of waiting should fail loudly,
+#: not accumulate unbounded latency.
+RPC_POLICY = BackoffPolicy(
+    base_s=0.2, multiplier=2.0, max_delay_s=10.0, jitter=0.25, budget_s=60.0
+)
+
+#: The scheduler's lane-failover pause (parallel/sched.py): short — the
+#: host lane is local and healthy, the pause only de-synchronizes a burst
+#: of failing device jobs — with a tight budget so a drain never stalls.
+FAILOVER_POLICY = BackoffPolicy(
+    base_s=0.05, multiplier=2.0, max_delay_s=1.0, jitter=0.5, budget_s=5.0
+)

@@ -1,0 +1,225 @@
+"""Chaos fault injector (ISSUE 9 tentpole part 4).
+
+Nemo's whole purpose is debugging distributed protocols under injected
+faults; this module points the same discipline at Nemo itself.  Faults are
+armed via the ``NEMO_CHAOS`` env — a ``;``-separated list of modes — and
+fire at named injection points compiled into the production code paths.
+With ``NEMO_CHAOS`` unset every hook is a single dict lookup on a None
+module global (measured noise-level), so the hooks stay in the hot paths
+permanently, exactly like the obs spans.
+
+Modes (``name`` or ``name:arg``):
+
+  ``fail_dispatch:N``        the first N device-lane kernel dispatches
+                             raise :class:`ChaosFault` (an "XLA error" for
+                             the scheduler's failover/breaker machinery)
+  ``wedge_dispatch:N``       the first N device-lane dispatches SLEEP far
+                             past any deadline (exercises
+                             ``NEMO_DISPATCH_TIMEOUT_S`` abandonment)
+  ``kill_after_segments:N``  SIGKILL this process right after the Nth
+                             segment partial is published (crash-safe
+                             resume scenario — no cleanup handlers run,
+                             exactly like a real OOM kill)
+  ``kill_in_store_publish``  SIGKILL mid store-segment write (the
+                             store-writer crash-recovery scenario: tmp
+                             wreckage + the fcntl lock are all that's left)
+  ``slow_io:S``              sleep S seconds at the store/cache IO points
+
+Counters are process-global and monotonic: ``fail_dispatch:2`` means "the
+first 2 matching calls ever in this process", which is what makes the
+injected schedule deterministic.  Helpers below (``corrupt_run_file``,
+``corrupt_rcache_entry``) are for harnesses that corrupt state ON DISK
+before a run, rather than injecting at a point in time.
+
+Every fired injection logs a ``chaos.injected`` record and bumps a
+``chaos.injected.<point>`` counter, so a chaos run's report/telemetry is
+self-describing.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+
+from nemo_tpu import obs
+from nemo_tpu.obs import log as obs_log
+
+_log = obs_log.get_logger("nemo.chaos")
+
+
+class ChaosFault(RuntimeError):
+    """An injected fault.  Deliberately a RuntimeError: the scheduler's
+    lane-failure classification must treat it like the real XLA/OOM errors
+    it stands in for."""
+
+
+_lock = threading.Lock()
+#: mode -> remaining budget (int) or parameter (float); None = chaos off.
+_spec: dict[str, float] | None = None
+_spec_env: str | None = object()  # sentinel: not yet parsed
+
+
+def _parse(env: str) -> dict[str, float]:
+    spec: dict[str, float] = {}
+    for part in env.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, arg = part.partition(":")
+        name = name.strip().lower()
+        try:
+            val = float(arg) if arg else 1.0
+        except ValueError:
+            _log.warning("chaos.bad_mode", mode=part, detail="argument not a number")
+            continue
+        spec[name] = val
+    return spec
+
+
+def _active() -> dict[str, float] | None:
+    """The parsed NEMO_CHAOS spec, re-parsed when the env changes (tests
+    flip it per-case; production sets it once at launch)."""
+    global _spec, _spec_env
+    env = os.environ.get("NEMO_CHAOS") or None
+    if env == _spec_env:
+        return _spec
+    with _lock:
+        _spec_env = env
+        _spec = _parse(env) if env else None
+    return _spec
+
+
+def reset() -> None:
+    """Forget consumed budgets (tests)."""
+    global _spec, _spec_env
+    with _lock:
+        _spec = None
+        _spec_env = object()
+
+
+def _consume(spec: dict, mode: str) -> bool:
+    """Atomically take one unit of a counted mode's budget."""
+    with _lock:
+        left = spec.get(mode, 0)
+        if left <= 0:
+            return False
+        spec[mode] = left - 1
+    return True
+
+
+def _fired(point: str, **ctx) -> None:
+    obs.metrics.inc(f"chaos.injected.{point}")
+    _log.warning("chaos.injected", point=point, **ctx)
+
+
+# ---------------------------------------------------------------------------
+# injection points
+# ---------------------------------------------------------------------------
+
+
+def on_device_dispatch(verb: str) -> None:
+    """Hook at the top of every device-lane kernel dispatch
+    (backend/jax_backend.py:LocalExecutor.run).  May raise ChaosFault
+    (``fail_dispatch``) or sleep past any deadline (``wedge_dispatch``)."""
+    spec = _active()
+    if not spec:
+        return
+    if "fail_dispatch" in spec and _consume(spec, "fail_dispatch"):
+        _fired("fail_dispatch", verb=verb)
+        raise ChaosFault(f"injected device dispatch failure (verb={verb})")
+    if "wedge_dispatch" in spec and _consume(spec, "wedge_dispatch"):
+        _fired("wedge_dispatch", verb=verb)
+        # Far past any sane NEMO_DISPATCH_TIMEOUT_S; the abandoning
+        # scheduler leaves this thread behind as a daemon.
+        time.sleep(3600.0)
+
+
+def on_segment_published(n_published: int) -> None:
+    """Hook after the pipeline publishes one segment partial
+    (analysis/pipeline.py checkpoint loop).  ``kill_after_segments:N``
+    SIGKILLs the process once N partials are on disk — no atexit, no
+    finally blocks, the honest crash."""
+    spec = _active()
+    if not spec:
+        return
+    n = spec.get("kill_after_segments")
+    if n is not None and n_published >= n:
+        _fired("kill_after_segments", published=n_published)
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def on_store_publish() -> None:
+    """Hook inside the store's populate, after shard bytes are written but
+    BEFORE the atomic rename publishes them (store/__init__.py:_put)."""
+    spec = _active()
+    if not spec:
+        return
+    if "kill_in_store_publish" in spec and _consume(spec, "kill_in_store_publish"):
+        _fired("kill_in_store_publish")
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def on_slow_io(point: str) -> None:
+    """Hook at store/cache IO boundaries: ``slow_io:S`` sleeps S seconds,
+    modeling a degraded network filesystem."""
+    spec = _active()
+    if not spec:
+        return
+    s = spec.get("slow_io")
+    if s:
+        _fired("slow_io", point=point, seconds=s)
+        time.sleep(s)
+
+
+# ---------------------------------------------------------------------------
+# on-disk corruption helpers (used by harnesses, not injection points)
+# ---------------------------------------------------------------------------
+
+
+def corrupt_run_file(corpus_dir: str, position: int, kind: str = "truncate") -> str:
+    """Corrupt one run's post-provenance JSON in place; returns the file
+    name.  ``truncate`` cuts the file mid-token; ``garbage`` replaces it
+    with non-JSON bytes — both are quarantine-class parse failures."""
+    name = f"run_{position}_post_provenance.json"
+    path = os.path.join(corpus_dir, name)
+    with open(path, "rb") as fh:
+        data = fh.read()
+    with open(path, "wb") as fh:
+        if kind == "garbage":
+            fh.write(b"\xff\xfenot json{{{")
+        else:
+            fh.write(data[: max(1, len(data) // 2)])
+    return name
+
+
+def corrupt_rcache_entry(cache_root: str, kind: str = "partial") -> str | None:
+    """Flip bytes in the first ``<kind>/`` entry's payload under a result
+    cache root; returns the entry dir or None when none exists.  The next
+    load must fail the manifest verify and recompute loudly."""
+    kdir = os.path.join(cache_root, kind)
+    try:
+        entries = sorted(
+            d for d in os.listdir(kdir) if ".tmp-" not in d
+        )
+    except OSError:
+        return None
+    if not entries:
+        return None
+    d = os.path.join(kdir, entries[0])
+    for dirpath, _, files in os.walk(d):
+        for f in files:
+            if f == "entry.json":
+                continue
+            p = os.path.join(dirpath, f)
+            with open(p, "r+b") as fh:
+                fh.seek(0)
+                first = fh.read(1)
+                fh.seek(0)
+                fh.write(bytes([first[0] ^ 0xFF]) if first else b"\xff")
+            return d
+    # Entry with no payload files: corrupt the entry.json itself.
+    with open(os.path.join(d, "entry.json"), "ab") as fh:
+        fh.write(b"garbage")
+    return d

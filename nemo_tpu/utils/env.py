@@ -1,0 +1,147 @@
+"""One home for env-knob parsing — and THE documented loud-vs-quiet policy.
+
+Every ``NEMO_*`` knob in this codebase falls into one of two failure
+policies, chosen by what a junk value would otherwise do:
+
+  * **loud** (``policy="raise"``): knobs that pin an ALGORITHM or a
+    correctness-relevant execution dimension (``NEMO_ANALYSIS_IMPL``,
+    ``NEMO_SCHED``, ``NEMO_GIANT_IMPL``, the scheduler cost seeds).  A typo
+    silently resolving to the default would change which code analyzes the
+    corpus in exactly the dimension the operator was pinning — crash at
+    startup instead.
+  * **quiet** (``policy="warn"``, the default here): observability,
+    serving, cache and robustness knobs on paths that may be a LONG-LIVED
+    multi-tenant sidecar (``NEMO_SERVE_*``, ``NEMO_METRICS_*``,
+    ``NEMO_STORE_*``, the fault-tolerance knobs below).  Raising per
+    request would turn one typo'd env into a crash loop taking every
+    tenant down — strictly worse than serving correct results at the
+    measured default under a warning that names the junk value
+    (the ``NEMO_MAX_BATCH`` / ADVICE r5 #4 precedent, revisited by
+    ISSUE 8).
+
+Callers that still carry their own parser (pre-dating this module) are
+being converged here; new knobs must use these helpers so the policy table
+above stays the single statement of intent.
+"""
+
+from __future__ import annotations
+
+import os
+
+from nemo_tpu.obs import log as obs_log
+
+_log = obs_log.get_logger("nemo.env")
+
+
+def _reject(name: str, raw: str, why: str, default, policy: str):
+    if policy == "raise":
+        raise ValueError(f"{name}={raw!r} {why}")
+    _log.warning("env.bad_value", name=name, value=raw, detail=why, using=default)
+    return default
+
+
+def env_int(
+    name: str, default: int, minimum: int | None = 0, policy: str = "warn"
+) -> int:
+    """Integer knob.  ``minimum`` is inclusive (None = unbounded)."""
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        n = int(raw)
+    except ValueError:
+        return _reject(name, raw, "is not an integer", default, policy)
+    if minimum is not None and n < minimum:
+        return _reject(name, raw, f"must be >= {minimum}", default, policy)
+    return n
+
+
+def env_float(
+    name: str, default: float, minimum: float | None = 0.0, policy: str = "warn"
+) -> float:
+    """Float knob.  ``minimum`` is inclusive (None = unbounded)."""
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        v = float(raw)
+    except ValueError:
+        return _reject(name, raw, "is not a number", default, policy)
+    if minimum is not None and v < minimum:
+        return _reject(name, raw, f"must be >= {minimum}", default, policy)
+    return v
+
+
+_TRUE = ("1", "true", "yes", "on")
+_FALSE = ("0", "false", "no", "off")
+
+
+def env_flag(name: str, default: bool, policy: str = "warn") -> bool:
+    """Boolean knob accepting the usual spellings (1/true/yes/on,
+    0/false/no/off)."""
+    raw = os.environ.get(name, "").strip().lower()
+    if not raw:
+        return default
+    if raw in _TRUE:
+        return True
+    if raw in _FALSE:
+        return False
+    return _reject(name, raw, "is not a recognized boolean", default, policy)
+
+
+def env_choice(
+    name: str, default: str, choices: tuple, policy: str = "raise"
+) -> str:
+    """Enumerated knob.  Loud by default: enum knobs pin algorithms."""
+    raw = os.environ.get(name, "").strip().lower()
+    if not raw:
+        return default
+    if raw in choices:
+        return raw
+    return _reject(
+        name, raw, f"(expected one of {', '.join(choices)})", default, policy
+    )
+
+
+# ---------------------------------------------------------------------------
+# fault-tolerance knobs (ISSUE 9) — all quiet policy: they gate DEGRADED
+# operation, and a crash loop over a typo'd robustness knob would be ironic.
+# ---------------------------------------------------------------------------
+
+
+def quarantine_enabled() -> bool:
+    """``NEMO_QUARANTINE`` (default on): per-run ingest error isolation — a
+    malformed/truncated run is quarantined (recorded in the report's
+    "Degraded runs" section) instead of aborting the whole corpus.  Off
+    restores the fail-fast pre-ISSUE-9 behavior (a CI gate that WANTS a
+    corrupt corpus to abort)."""
+    return env_flag("NEMO_QUARANTINE", True)
+
+
+def dispatch_timeout_s() -> float:
+    """``NEMO_DISPATCH_TIMEOUT_S`` (default 0 = disabled): hard wall-clock
+    deadline on one device-lane dispatch.  Past it the scheduler ABANDONS
+    the wedged dispatch thread (it cannot be cancelled mid-XLA), counts a
+    breaker failure, and fails the job over to the sparse-host lane — the
+    escalation past the PR-4 log-only watchdog (``NEMO_SLOW_DISPATCH_MS``)."""
+    return env_float("NEMO_DISPATCH_TIMEOUT_S", 0.0)
+
+
+def breaker_failures() -> int:
+    """``NEMO_BREAKER_FAILURES`` (default 3): consecutive device-lane
+    failures that trip the circuit breaker into host-only degraded mode."""
+    return max(1, env_int("NEMO_BREAKER_FAILURES", 3, minimum=1))
+
+
+def breaker_cooldown_s() -> float:
+    """``NEMO_BREAKER_COOLDOWN_S`` (default 30): how long an OPEN breaker
+    short-circuits the device lane before letting one half-open probe
+    through."""
+    return env_float("NEMO_BREAKER_COOLDOWN_S", 30.0)
+
+
+def failover_backoff_s() -> float:
+    """``NEMO_FAILOVER_BACKOFF_S`` (default 0.05): base of the jittered
+    backoff slept before re-running a failed device job on the host lane
+    (gives a transiently wedged tunnel a beat without stalling the drain)."""
+    return env_float("NEMO_FAILOVER_BACKOFF_S", 0.05)

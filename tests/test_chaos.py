@@ -1,0 +1,486 @@
+"""Fault-tolerance layer (ISSUE 9): quarantine ingest, scheduler lane
+failover + circuit breaker + dispatch deadline, crash-safe checkpoint
+publication, the shared env/backoff utilities, the chaos injector itself,
+and the silent-except lint.
+
+The end-to-end scenarios (corrupt corpus -> degraded report, injected
+device faults -> host failover byte-parity, SIGKILL -> resume) live in
+`make chaos-smoke` (utils/validate_smoke.py); these are the unit seams.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import shutil
+
+import pytest
+
+from nemo_tpu import obs
+from nemo_tpu.ingest.molly import load_molly_output
+from nemo_tpu.models.synth import SynthSpec, write_corpus
+from nemo_tpu.parallel import sched
+from nemo_tpu.store import CorpusStore
+from nemo_tpu.utils import chaos
+from nemo_tpu.utils.backoff import BackoffPolicy
+from nemo_tpu.utils.env import env_flag, env_float, env_int
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos_and_breaker():
+    chaos.reset()
+    sched.reset_device_breaker()
+    yield
+    chaos.reset()
+    sched.reset_device_breaker()
+
+
+def _delta(fn):
+    m0 = obs.metrics.snapshot()
+    out = fn()
+    return out, obs.Metrics.delta(obs.metrics.snapshot(), m0)["counters"]
+
+
+# ------------------------------------------------------------ env parsers
+
+
+def test_env_parsers_warn_policy_defaults(monkeypatch):
+    monkeypatch.setenv("NEMO_X_INT", "junk")
+    assert env_int("NEMO_X_INT", 7) == 7
+    monkeypatch.setenv("NEMO_X_INT", "-3")
+    assert env_int("NEMO_X_INT", 7) == 7  # below the default minimum 0
+    monkeypatch.setenv("NEMO_X_INT", "12")
+    assert env_int("NEMO_X_INT", 7) == 12
+    monkeypatch.setenv("NEMO_X_F", "nan-ish")
+    assert env_float("NEMO_X_F", 1.5) == 1.5
+    monkeypatch.setenv("NEMO_X_B", "maybe")
+    assert env_flag("NEMO_X_B", True) is True
+    monkeypatch.setenv("NEMO_X_B", "off")
+    assert env_flag("NEMO_X_B", True) is False
+
+
+def test_env_parsers_raise_policy(monkeypatch):
+    monkeypatch.setenv("NEMO_X_INT", "junk")
+    with pytest.raises(ValueError):
+        env_int("NEMO_X_INT", 7, policy="raise")
+    monkeypatch.delenv("NEMO_X_INT")
+    assert env_int("NEMO_X_INT", 7, policy="raise") == 7  # unset stays default
+
+
+# ---------------------------------------------------------------- backoff
+
+
+def test_backoff_jitter_bounds_and_budget():
+    p = BackoffPolicy(base_s=1.0, multiplier=2.0, max_delay_s=3.0, jitter=0.25,
+                      budget_s=20.0)
+    s = p.session(rng=random.Random(42))
+    d0 = s.delay()
+    assert 0.75 <= d0 <= 1.25
+    d1 = s.delay()
+    assert 1.5 <= d1 <= 2.5
+    d2 = s.delay()
+    assert d2 is not None and d2 <= 3.0 * 1.25  # clamped at max_delay
+    # Budget: cumulative sleep can never exceed it; eventually None.
+    tight = BackoffPolicy(base_s=1.0, multiplier=2.0, max_delay_s=3.0,
+                          jitter=0.25, budget_s=5.0).session(rng=random.Random(7))
+    total = 0.0
+    while True:
+        d = tight.delay()
+        if d is None:
+            break
+        total += d
+    assert total <= 5.0
+
+
+def test_backoff_server_hint_wins_but_is_clamped():
+    p = BackoffPolicy(base_s=0.2, max_delay_s=10.0, jitter=0.0, budget_s=100.0)
+    s = p.session(rng=random.Random(1))
+    assert s.delay(hint_s=4.0) == pytest.approx(4.0)
+    assert s.delay(hint_s=99.0) == pytest.approx(10.0)  # wild hint clamped
+
+
+# ------------------------------------------------------- chaos injector
+
+
+def test_chaos_spec_counts_down_and_resets(monkeypatch):
+    monkeypatch.setenv("NEMO_CHAOS", "fail_dispatch:2")
+    chaos.reset()
+    with pytest.raises(chaos.ChaosFault):
+        chaos.on_device_dispatch("fused")
+    with pytest.raises(chaos.ChaosFault):
+        chaos.on_device_dispatch("fused")
+    chaos.on_device_dispatch("fused")  # budget spent: no-op
+    chaos.reset()
+    with pytest.raises(chaos.ChaosFault):
+        chaos.on_device_dispatch("fused")
+
+
+def test_chaos_off_is_noop(monkeypatch):
+    monkeypatch.delenv("NEMO_CHAOS", raising=False)
+    chaos.reset()
+    chaos.on_device_dispatch("fused")
+    chaos.on_segment_published(99)
+    chaos.on_store_publish()
+    chaos.on_slow_io("store_load")
+
+
+# ------------------------------------------------------------- quarantine
+
+
+def test_quarantine_isolates_malformed_runs(tmp_path):
+    d = write_corpus(SynthSpec(n_runs=6, seed=2), str(tmp_path))
+    chaos.corrupt_run_file(d, 1, kind="truncate")
+    chaos.corrupt_run_file(d, 4, kind="garbage")
+    m, mc = _delta(lambda: load_molly_output(d))
+    assert [q["position"] for q in m.quarantined] == [1, 4]
+    assert all(q["error"] for q in m.quarantined)
+    assert len(m.runs) == 4
+    assert {r.iteration for r in m.runs} == {0, 2, 3, 5}
+    assert mc.get("ingest.quarantined") == 2
+
+
+def test_quarantine_off_restores_fail_fast(tmp_path, monkeypatch):
+    d = write_corpus(SynthSpec(n_runs=4, seed=2), str(tmp_path))
+    chaos.corrupt_run_file(d, 1)
+    monkeypatch.setenv("NEMO_QUARANTINE", "0")
+    with pytest.raises(Exception):
+        load_molly_output(d)
+    monkeypatch.setenv("NEMO_QUARANTINE", "1")
+    assert len(load_molly_output(d).runs) == 3
+
+
+def test_quarantine_everything_still_raises(tmp_path):
+    d = write_corpus(SynthSpec(n_runs=2, seed=2), str(tmp_path))
+    for pos in (0, 1):
+        chaos.corrupt_run_file(d, pos, kind="garbage")
+    with pytest.raises(RuntimeError, match="every run"):
+        load_molly_output(d)
+
+
+def test_quarantine_store_round_trip_and_repair_via_grown(tmp_path):
+    """The store persists the quarantine set (warm load == cold parse),
+    an untouched quarantined file stays a HIT, and a REPAIRED file
+    classifies GROWN — the append path re-ingests exactly the repaired
+    position and shrinks the quarantine."""
+    full = write_corpus(SynthSpec(n_runs=6, seed=2), str(tmp_path / "full"))
+    d = os.path.join(str(tmp_path / "cor"), os.path.basename(full))
+    shutil.copytree(full, d)
+    chaos.corrupt_run_file(d, 2, kind="truncate")
+    store = CorpusStore(str(tmp_path / "cache"))
+    m = load_molly_output(d)
+    header = store.put(d, m)
+    assert [q["position"] for q in header["quarantined"]] == [2]
+    assert store.probe(d) == "hit"
+    warm, mc = _delta(lambda: store.load_packed(d))
+    assert warm.quarantined == m.quarantined
+    assert mc.get("store.hit") == 1
+    # The lazy runs.json trio must resolve by SOURCE POSITION, not stored
+    # row: past the quarantine hole the two differ by one (regression for
+    # the row-indexed _RawProxy bug).
+    def assert_lazy_metadata_matches(loaded, oracle_runs):
+        oracle = {r.iteration: r for r in oracle_runs}
+        for r in loaded.runs:
+            o = oracle[r.iteration]
+            assert (r.failure_spec.to_json() if r.failure_spec else None) == (
+                o.failure_spec.to_json() if o.failure_spec else None
+            ), r.iteration
+            assert [m.to_json() for m in r.messages] == [
+                m.to_json() for m in o.messages
+            ], r.iteration
+
+    assert_lazy_metadata_matches(warm, m.runs)
+    # Repair: restore the pristine provenance file.
+    shutil.copy(
+        os.path.join(full, "run_2_post_provenance.json"),
+        os.path.join(d, "run_2_post_provenance.json"),
+    )
+    assert store.probe(d) == "grown"
+    repaired, mc2 = _delta(lambda: store.load_packed(d))
+    assert mc2.get("store.append") == 1
+    assert repaired.quarantined == []
+    assert len(repaired.runs) == 6
+    assert {r.iteration for r in repaired.runs} == set(range(6))
+    # The repaired store is a plain HIT again, and the repaired run's
+    # metadata (appended out of position order) still resolves correctly.
+    assert store.probe(d) == "hit"
+    assert_lazy_metadata_matches(store.load_packed(d), load_molly_output(full).runs)
+
+
+def test_quarantine_report_has_degraded_runs_sidecar(tmp_path):
+    from nemo_tpu.analysis.pipeline import run_debug
+    from nemo_tpu.backend.python_ref import PythonBackend
+
+    d = write_corpus(SynthSpec(n_runs=6, seed=2), str(tmp_path))
+    chaos.corrupt_run_file(d, 3)
+    res = run_debug(
+        d, str(tmp_path / "res"), PythonBackend(), figures="none",
+        corpus_cache="off", result_cache="off",
+    )
+    with open(os.path.join(res.report_dir, "quarantine.json")) as fh:
+        q = json.load(fh)
+    assert [e["position"] for e in q] == [3]
+    assert q[0]["file"] == "run_3_post_provenance.json"
+    with open(os.path.join(res.report_dir, "debugging.json")) as fh:
+        assert {r["iteration"] for r in json.load(fh)} == {0, 1, 2, 4, 5}
+
+
+def test_report_cache_key_covers_quarantine_set():
+    from nemo_tpu.analysis.delta import report_cache_key
+
+    class M:
+        store_segments = [{"name": "seg-000", "n_runs": 2, "fingerprint": "f0"}]
+        runs = [object(), object()]
+        quarantined = []
+
+    a = M()
+    b = M()
+    b.quarantined = [{"position": 1, "file": "x", "error": "e"}]
+    ka, kb = report_cache_key(a, "all"), report_cache_key(b, "all")
+    assert ka and kb and ka != kb
+
+
+# ------------------------------------- scheduler failover + breaker
+
+
+def _job(index, fail_on_device=0, wedge_s=0.0):
+    """A two-lane test job: `fail_on_device` first device executions raise
+    an XLA-looking RuntimeError; the host lane always succeeds."""
+
+    class XlaRuntimeError(RuntimeError):
+        pass
+
+    state = {"device_attempts": 0}
+
+    def execute(lane, reason, stolen):
+        if lane == "device":
+            state["device_attempts"] += 1
+            if wedge_s:
+                import time
+
+                time.sleep(wedge_s)
+            if state["device_attempts"] <= fail_on_device:
+                raise XlaRuntimeError("jit died")
+        return {"lane": lane, "reason": reason, "index": index}
+
+    return sched.Job(
+        index=index, verb="fused", rows=4, v=16, e=16, work=4 * 32,
+        execute=execute,
+    ), state
+
+
+def test_is_lane_failure_classification():
+    class XlaRuntimeError(RuntimeError):
+        pass
+
+    assert sched.is_lane_failure(XlaRuntimeError("boom"))
+    assert sched.is_lane_failure(chaos.ChaosFault("injected"))
+    assert sched.is_lane_failure(sched.DispatchTimeout("late"))
+    assert sched.is_lane_failure(RuntimeError("RESOURCE_EXHAUSTED: oom"))
+    assert sched.is_lane_failure(MemoryError())
+    assert not sched.is_lane_failure(ValueError("bad arg"))
+    assert not sched.is_lane_failure(KeyError("missing"))
+    assert not sched.is_lane_failure(RuntimeError("some logic bug"))
+
+
+def test_failover_reroutes_device_failure_to_host():
+    models = sched.default_models()
+    s = sched.HeterogeneousScheduler(models)
+    s.breaker = sched.CircuitBreaker(failures=99, cooldown_s=1000)
+    job, state = _job(0, fail_on_device=1)
+    job.pinned = "device"
+    job.reason = "platform"  # platform pin: failover allowed
+    _, mc = _delta(lambda: s.run([job], serial=True))
+    res = s.run([_job(0)[0]], serial=True)  # scheduler still healthy
+    assert res[0]["index"] == 0
+    assert mc.get("analysis.sched.failover") == 1
+    assert state["device_attempts"] == 1
+
+
+def test_forced_pin_never_fails_over():
+    s = sched.HeterogeneousScheduler(sched.default_models())
+    s.breaker = sched.CircuitBreaker(failures=99, cooldown_s=1000)
+    job, _ = _job(0, fail_on_device=1)
+    job.pinned = "device"
+    job.reason = "forced"
+    with pytest.raises(RuntimeError, match="jit died"):
+        s.run([job], serial=True)
+
+
+def test_programming_error_propagates_not_failed_over():
+    s = sched.HeterogeneousScheduler(sched.default_models())
+    s.breaker = sched.CircuitBreaker(failures=99, cooldown_s=1000)
+
+    def execute(lane, reason, stolen):
+        raise ValueError("a real bug")
+
+    job = sched.Job(index=0, verb="fused", rows=1, v=16, e=16, work=32,
+                    execute=execute, pinned="device", reason="platform")
+    with pytest.raises(ValueError):
+        s.run([job], serial=True)
+
+
+def test_breaker_trips_degrades_and_half_open_probe_closes():
+    br = sched.CircuitBreaker(failures=2, cooldown_s=0.05)
+    assert br.allow()
+    br.record_failure()
+    assert br.state == br.CLOSED
+    br.record_failure()
+    assert br.state == br.OPEN
+    assert not br.allow()  # short-circuit inside the cooldown
+    import time
+
+    time.sleep(0.06)
+    assert br.allow()  # the half-open probe
+    assert br.state == br.HALF_OPEN
+    assert not br.allow()  # only ONE probe at a time
+    br.record_success()
+    assert br.state == br.CLOSED
+    # A half-open probe FAILURE re-opens immediately (no threshold).
+    br2 = sched.CircuitBreaker(failures=2, cooldown_s=0.01)
+    br2.record_failure()
+    br2.record_failure()
+    time.sleep(0.02)
+    assert br2.allow()
+    br2.record_failure()
+    assert br2.state == br2.OPEN
+
+
+def test_half_open_probe_rearms_after_lost_probe():
+    """A granted probe whose device execution never reports (the probe job
+    was stolen by the host lane, or its worker found nothing to run) must
+    not wedge the breaker HALF_OPEN forever: after another cooldown a new
+    probe is granted.  peek() meanwhile never transitions or counts."""
+    import time
+
+    br = sched.CircuitBreaker(failures=1, cooldown_s=0.05)
+    br.record_failure()
+    assert br.state == br.OPEN
+    time.sleep(0.06)
+    assert br.peek()  # would grant — but no transition
+    assert br.state == br.OPEN
+    assert br.allow()  # probe granted, consumed... and then lost
+    assert br.state == br.HALF_OPEN
+    assert not br.allow()  # inside the re-arm window: still one probe
+    _, mc = _delta(lambda: [br.peek() for _ in range(50)])
+    assert not mc.get("sched.breaker.short_circuit")  # peeks never count
+    time.sleep(0.06)
+    assert br.allow()  # re-armed probe: liveness restored
+    br.record_success()
+    assert br.state == br.CLOSED
+
+
+def test_open_breaker_short_circuits_planning_to_host():
+    s = sched.HeterogeneousScheduler(sched.default_models())
+    s.breaker = sched.CircuitBreaker(failures=1, cooldown_s=1000)
+    s.breaker.record_failure()  # trip
+    big_work = 10**9  # would plan device on cost alone
+    job = sched.Job(index=0, verb="fused", rows=64, v=64, e=64, work=big_work,
+                    execute=lambda l, r, st: {"lane": l}, pinned=None)
+    lane, reason, _ = s.plan(job)
+    assert (lane, reason) == ("host", "breaker")
+    # An operator-forced device pin is NOT overridden.
+    forced = sched.Job(index=1, verb="fused", rows=1, v=16, e=16, work=32,
+                       execute=lambda l, r, st: {"lane": l},
+                       pinned="device", reason="forced")
+    lane2, reason2, _ = s.plan(forced)
+    assert (lane2, reason2) == ("device", "forced")
+
+
+def test_device_only_closure_never_rerouted_or_failed_over():
+    """A serve-batch job's execute ignores the lane (device-only closure):
+    the open breaker must NOT plan it onto host (it would still dispatch
+    on the device while recording host), and its device failure must
+    propagate instead of 'failing over' into the same broken dispatch."""
+    s = sched.HeterogeneousScheduler(sched.default_models())
+    s.breaker = sched.CircuitBreaker(failures=1, cooldown_s=1000)
+    s.breaker.record_failure()  # OPEN
+
+    def device_only(lane, reason, stolen):  # pragma: no cover — plan-only
+        return {"lane": lane}
+
+    job = sched.Job(index=0, verb="condition", rows=4, v=16, e=0, work=64,
+                    execute=device_only, pinned="device", reason="serve_batch",
+                    source="serve")
+    lane, reason, _ = s.plan(job)
+    assert (lane, reason) == ("device", "serve_batch")
+
+    class XlaRuntimeError(RuntimeError):
+        pass
+
+    def failing(lane, reason, stolen):
+        assert lane == "device"
+        raise XlaRuntimeError("merged launch died")
+
+    job2 = sched.Job(index=0, verb="condition", rows=4, v=16, e=0, work=64,
+                     execute=failing, pinned="device", reason="serve_batch",
+                     source="serve")
+    s2 = sched.HeterogeneousScheduler(sched.default_models())
+    s2.breaker = sched.CircuitBreaker(failures=99, cooldown_s=1000)
+    _, mc = _delta(lambda: pytest.raises(XlaRuntimeError, s2.run, [job2], True))
+    # The failure still feeds the breaker's health signal.
+    assert mc.get("sched.breaker.failures") == 1
+    assert not mc.get("analysis.sched.failover")
+
+
+def test_dispatch_deadline_abandons_and_fails_over(monkeypatch):
+    monkeypatch.setenv("NEMO_DISPATCH_TIMEOUT_S", "0.1")
+    s = sched.HeterogeneousScheduler(sched.default_models())
+    s.breaker = sched.CircuitBreaker(failures=99, cooldown_s=1000)
+    job, _ = _job(0, wedge_s=5.0)
+    job.pinned = "device"
+    job.reason = "platform"
+    _, mc = _delta(lambda: s.run([job], serial=True))
+    assert mc.get("watchdog.dispatch_timeout") == 1
+    assert mc.get("analysis.sched.failover") == 1
+
+
+def test_sched_records_carry_failover(monkeypatch):
+    sched.reset_session_models()
+    s = sched.HeterogeneousScheduler(sched.default_models())
+    s.breaker = sched.CircuitBreaker(failures=99, cooldown_s=1000)
+    job, _ = _job(0, fail_on_device=1)
+    job.pinned = "device"
+    job.reason = "platform"
+    s.run([job], serial=True)
+    rec = sched.sched_snapshot()[-1]
+    assert rec["failed_over"] is True
+    assert rec["lane"] == "host" and rec["reason"] == "failover"
+
+
+# --------------------------------------------------------------- lint
+
+
+def test_lint_flags_silent_excepts(tmp_path):
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    try:
+        import lint_no_print
+    finally:
+        _sys.path.pop(0)
+    src = (
+        "try:\n    x = 1\nexcept:\n    pass\n"
+        "try:\n    y = 2\nexcept Exception:\n    pass\n"
+        "try:\n    z = 3\nexcept Exception:  # lint: allow-silent-except — reason\n    pass\n"
+        "try:\n    w = 4\nexcept OSError:\n    pass\n"
+        "try:\n    v = 5\nexcept Exception as ex:\n    print_like = ex\n"
+    )
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    problems = lint_no_print.check_file(str(p), "mod.py")
+    assert len(problems) == 2  # the bare except + the silent Exception
+    assert any("bare 'except:'" in m for m in problems)
+    assert any("swallows failures" in m for m in problems)
+
+
+def test_nemo_tpu_tree_passes_lint():
+    import subprocess
+    import sys as _sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [_sys.executable, os.path.join(repo, "tools", "lint_no_print.py")],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]

@@ -390,7 +390,9 @@ def test_rpc_retry_counted_in_metrics():
     d = obs.Metrics.delta(obs.metrics.snapshot(), before)["counters"]
     assert d.get("rpc.retries") == 1  # retries - 1 sleeps before the final raise
     assert d.get("rpc.errors") == 1
-    assert d.get("rpc.backoff_s") == pytest.approx(0.2)
+    # One jittered exponential wait from the shared policy (ISSUE 9:
+    # utils/backoff.py:RPC_POLICY — base 0.2 s, ±25% jitter).
+    assert 0.2 * 0.75 <= d.get("rpc.backoff_s") <= 0.2 * 1.25
 
 
 # ------------------------------------------------------------- structured log

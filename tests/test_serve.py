@@ -341,6 +341,54 @@ def test_drain_semantics_in_process(corpus_dir, fresh_serve_singletons):
         server.stop(grace=None)
 
 
+def test_drain_waits_for_mid_flight_stream(
+    tmp_path, monkeypatch, fresh_serve_singletons
+):
+    """Regression (ISSUE 9 satellite): a SIGTERM drain that begins while an
+    AnalyzeDirStream is mid-flight must FINISH the stream — terminal `done`
+    event delivered — not sever it.  The stream handler holds no admission
+    ticket itself, so before the stream-presence counter existed,
+    drain_wait could report drained between a worker's ticket release and
+    the final yield, and main() would stop the server under the stream."""
+    from nemo_tpu.models.synth import SynthSpec, write_corpus
+    from nemo_tpu.service.client import RemoteAnalyzer
+    from nemo_tpu.service.server import make_server
+
+    monkeypatch.setenv("NEMO_CORPUS_CACHE", "off")
+    monkeypatch.setenv("NEMO_RESULT_CACHE", "off")
+    monkeypatch.setenv("NEMO_SERVE_INFLIGHT", "1")
+    serve.reset_controller()
+    d = write_corpus(SynthSpec(n_runs=4, seed=33, name="draining"), str(tmp_path))
+    server, port = make_server(port=0)
+    server.start()
+    try:
+        ctl = serve.controller()
+        # Hog the only slot so the stream's worker stays QUEUED — the
+        # deterministic "mid-flight when drain begins" state.
+        hog = ctl.enqueue("hog")
+        assert hog.wait(1.0)
+        with RemoteAnalyzer(target=f"127.0.0.1:{port}") as client:
+            client.wait_ready()
+            stream = client.analyze_dir_stream([d])
+            first = next(stream)  # the worker enqueued; stream registered
+            assert first["event"] == "queued"
+            assert ctl.streams == 1
+            ctl.begin_drain()
+            # The live stream must hold the drain open...
+            assert not ctl.drain_wait(0.1)
+            # ... and its already-queued work still completes after the
+            # slot frees (drain refuses NEW arrivals, not accepted ones).
+            hog.release()
+            events = [first] + list(stream)
+        assert events[-1]["event"] == "done"
+        assert events[-1]["results"] == 1 and events[-1]["errors"] == 0
+        assert any(e["event"] == "result" for e in events)
+        assert ctl.streams == 0
+        assert ctl.drain_wait(5.0)
+    finally:
+        server.stop(grace=None)
+
+
 # ---------------------------------------------------- continuous batching
 
 

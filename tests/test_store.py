@@ -669,6 +669,64 @@ def test_service_analyze_dir_server_side(tmp_path, monkeypatch):
         np.testing.assert_array_equal(out1[k], out2[k], err_msg=k)
 
 
+def test_writer_killed_mid_populate_recovers_cleanly(tmp_path):
+    """Store-writer crash recovery (ISSUE 9 satellite): SIGKILL a populate
+    mid-write (between the shard writes and the atomic rename — the chaos
+    harness's kill_in_store_publish point) and assert the crash leaves only
+    tmp wreckage behind the fcntl lock, the NEXT populate succeeds and
+    serves a clean HIT, and the aged wreckage is GC'd.  (The pre-existing
+    wreckage test only covered synthetic aged leftovers; this one makes a
+    real writer die.)"""
+    import subprocess
+
+    corpus = write_corpus(SynthSpec(n_runs=4, seed=7), str(tmp_path))
+    root = str(tmp_path / "cache")
+    code = (
+        "from nemo_tpu.ingest.molly import load_molly_output\n"
+        "from nemo_tpu.store import CorpusStore\n"
+        f"store = CorpusStore({root!r})\n"
+        f"store.put({corpus!r}, load_molly_output({corpus!r}))\n"
+        "print('COMPLETED')\n"
+    )
+    env = dict(os.environ, NEMO_CHAOS="kill_in_store_publish")
+    proc = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True, text=True,
+        timeout=300,
+    )
+    assert proc.returncode == -9, proc.stderr[-500:]
+    assert "COMPLETED" not in proc.stdout
+    store = CorpusStore(root)
+    final = store.store_dir(corpus)
+    # The crash left the shard bytes in a tmp dir, never a half-published
+    # store: no header at the final path, wreckage beside it.
+    assert not os.path.exists(os.path.join(final, "header.json"))
+    wreck = [n for n in os.listdir(root) if ".npack.tmp-" in n]
+    assert wreck, os.listdir(root)
+    assert store.probe(corpus) == "miss"
+    # The next invocation repopulates cleanly under the same lock...
+    header, mc = _store_delta(
+        lambda: store.put(corpus, load_molly_output(corpus))
+    )
+    assert isinstance(header, dict) and mc.get("store.populate") == 1
+    loaded, mc2 = _store_delta(lambda: store.load_packed(corpus))
+    assert loaded is not None and mc2.get("store.hit") == 1
+    # ... and once the wreckage ages past the guard, populate-time GC
+    # sweeps it (fresh wreckage was left alone above: it could have been a
+    # live concurrent writer).
+    import time as _time
+
+    aged = CorpusStore._WRECKAGE_MAX_AGE_S + 60
+    for n in wreck:
+        p = os.path.join(root, n)
+        os.utime(p, (os.path.getatime(p), _time.time() - aged))
+    _, mc3 = _store_delta(lambda: store.put(corpus, load_molly_output(corpus)))
+    assert mc3.get("store.gc_wreckage", 0) >= 1
+    assert not any(".npack.tmp-" in n for n in os.listdir(root) if n in wreck)
+    # The lock file survives every sweep (deleting one a live writer holds
+    # would break the mutual exclusion).
+    assert os.path.exists(f"{final}.lock")
+
+
 def test_populate_sweeps_aged_wreckage(tmp_path):
     """Crash leftovers (interrupted populate tmp dirs / replace victims)
     older than the age guard are swept at populate time; fresh ones — a

@@ -113,6 +113,20 @@ def extract_metrics(doc: dict) -> dict[str, tuple[float, str, str]]:
     put("delta_tier.delta_speedup", dtier.get("delta_speedup"), "higher", "ratio")
     put("delta_tier.grown_fraction", dtier.get("grown_fraction"), "lower", "ratio")
     put("delta_tier.cache_mb", dtier.get("cache_mb"), "lower", "mb_cache")
+    # Chaos tier (ISSUE 9): fault-tolerance cost regressions — the degraded
+    # host-only wall (or the failover-path wall) creeping up against the
+    # healthy wall, the crash-recovery resume approaching a from-scratch
+    # rerun, or failed requests appearing under injected faults all flag.
+    # failed_requests compares as an absolute shift like serve_tier.rejects
+    # (an all-zero healthy history can never flag 0 -> N under relative
+    # math); overhead ratios are already normalized so they carry their own
+    # signal regardless of the box's absolute speed.
+    chaos = doc.get("chaos_tier") or {}
+    put("chaos_tier.healthy_s", chaos.get("healthy_s"), "lower", "s_fast")
+    put("chaos_tier.degraded_overhead", chaos.get("degraded_overhead"), "lower", "ratio")
+    put("chaos_tier.faulted_overhead", chaos.get("faulted_overhead"), "lower", "ratio")
+    put("chaos_tier.recovery_overhead", chaos.get("recovery_overhead"), "lower", "ratio")
+    put("chaos_tier.failed_requests", chaos.get("failed_requests"), "split", "ratio")
     # Shard tier (ISSUE 7): mesh-scaling regressions — a width's analysis
     # wall creeping up, scaling efficiency collapsing, the per-bucket
     # gather wall growing, or the scheduler's steal behavior flipping.

@@ -1,4 +1,5 @@
-"""Lint: no bare ``print(`` in ``nemo_tpu/`` outside the allowlist.
+"""Lint: no bare ``print(`` and no silent exception swallowing in
+``nemo_tpu/`` outside the allowlists.
 
 The library's operational output contract is structured JSON-lines logging
 (nemo_tpu/obs/log.py) — leveled, machine-parseable, trace-correlated.  A
@@ -11,6 +12,16 @@ outside:
   * the validate/prewarm harnesses (operator-facing one-shot tools);
   * lines carrying a ``# lint: allow-print`` pragma (e.g. the log sink's
     own stderr write).
+
+The fault-tolerance layer (ISSUE 9) extends the same discipline to error
+handling: a bare ``except:`` — and an ``except Exception/BaseException:``
+whose entire body is ``pass``/``...`` — silently discards failures the
+robustness machinery exists to SURFACE (quarantine records, breaker
+counts, degraded-mode logs), so both flag unless the ``except`` line
+carries a ``# lint: allow-silent-except`` pragma stating why best-effort
+swallowing is correct there (e.g. observability code that must never fail
+its caller).  Handlers that log, count, re-raise, or return are fine —
+only the silent-discard shape flags.
 
 Usage: python tools/lint_no_print.py [root]   (default: repo's nemo_tpu/)
 """
@@ -31,6 +42,23 @@ ALLOWLIST = {
 }
 
 PRAGMA = "# lint: allow-print"
+EXCEPT_PRAGMA = "# lint: allow-silent-except"
+
+#: Broad exception names whose silent-discard handlers flag; a narrow
+#: ``except OSError: pass`` is a deliberate, typed decision and passes.
+_BROAD_EXC = {"Exception", "BaseException"}
+
+
+def _is_silent_body(body: list) -> bool:
+    """True when a handler body discards the error without a trace: only
+    ``pass``/``...`` statements (docstring-only bodies count too)."""
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue  # '...' or a stray string literal
+        return False
+    return True
 
 
 def check_file(path: str, rel: str) -> list[str]:
@@ -42,19 +70,42 @@ def check_file(path: str, rel: str) -> list[str]:
         return [f"{rel}:{ex.lineno}: unparseable: {ex.msg}"]
     lines = source.splitlines()
     problems = []
+
+    def line_of(lineno: int) -> str:
+        return lines[lineno - 1] if lineno - 1 < len(lines) else ""
+
     for node in ast.walk(tree):
         if (
             isinstance(node, ast.Call)
             and isinstance(node.func, ast.Name)
             and node.func.id == "print"
         ):
-            line = lines[node.lineno - 1] if node.lineno - 1 < len(lines) else ""
-            if PRAGMA in line:
+            if PRAGMA in line_of(node.lineno):
                 continue
             problems.append(
                 f"{rel}:{node.lineno}: bare print() — use nemo_tpu.obs.log "
                 f"(or add '{PRAGMA}' if this file IS a CLI surface)"
             )
+        elif isinstance(node, ast.ExceptHandler):
+            if EXCEPT_PRAGMA in line_of(node.lineno):
+                continue
+            broad = node.type is None or (
+                isinstance(node.type, ast.Name) and node.type.id in _BROAD_EXC
+            )
+            if not broad:
+                continue
+            if node.type is None:
+                problems.append(
+                    f"{rel}:{node.lineno}: bare 'except:' — name the "
+                    f"exception type (or add '{EXCEPT_PRAGMA}' with a "
+                    "reason if swallowing is deliberate)"
+                )
+            elif _is_silent_body(node.body):
+                problems.append(
+                    f"{rel}:{node.lineno}: 'except {node.type.id}: pass' "
+                    "swallows failures silently — log/count it via "
+                    f"nemo_tpu.obs, or add '{EXCEPT_PRAGMA}' with a reason"
+                )
     return problems
 
 
