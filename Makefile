@@ -5,7 +5,7 @@ NATIVE_LIB := native/build/libnemo_native.so
 REPORT_SRC := native/nemo_report.cpp
 REPORT_LIB := native/build/libnemo_report.so
 
-.PHONY: all native test bench bench-watch bench-trend prewarm validate trace-smoke obs-smoke store-smoke delta-smoke shard-smoke serve-smoke chaos-smoke lint-print clean reset proto neo4j-up neo4j-validate neo4j-down
+.PHONY: all native test bench bench-watch bench-trend prewarm validate trace-smoke obs-smoke store-smoke delta-smoke shard-smoke sparse-device-smoke serve-smoke chaos-smoke lint-print clean reset proto neo4j-up neo4j-validate neo4j-down
 
 all: native
 
@@ -40,6 +40,16 @@ validate: lint-print test
 shard-smoke:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 		python -m nemo_tpu.utils.validate_smoke --shard-smoke
+
+# Sparse-CSR device-kernel smoke (also the tail of `make validate`;
+# ISSUE 10): a forced NEMO_ANALYSIS_IMPL=sparse_device pipeline must be
+# byte-identical to the forced-dense oracle with analysis.route.*.
+# sparse_device recorded per verb, giant-V runs must dispatch on the
+# device sparse route instead of the host fallback, and the giant-V
+# analysis memory watermark must sit >=5x below the dense route's
+# (nemo_tpu/ops/sparse_device.py).
+sparse-device-smoke:
+	python -m nemo_tpu.utils.validate_smoke --sparse-device-smoke
 
 # Observability smoke (also the tail of `make validate`): a traced
 # two-family pipeline run + one sidecar RPC, whose emitted Chrome-trace

@@ -644,6 +644,83 @@ def child_main() -> None:
     except Exception as ex:  # the shard tier must never sink the bench
         log(f"shard tier skipped: {type(ex).__name__}: {ex}")
 
+    # Sparse-device tier (ISSUE 10): the dense [B,V,V] device route vs the
+    # sparse-CSR device kernels (ops/sparse_device.py), each measured in a
+    # SUBPROCESS (peak RSS is process-monotone, so per-route watermarks
+    # need per-route processes) on this bench's own platform — at the 1x
+    # case-study shape (small V, where dense should keep the route) and at
+    # a giant-V corpus (the dense memory wall the sparse route removes).
+    # Reports analysis walls, analysis-phase peak-memory deltas (device
+    # peaks where the backend exposes them, host RSS always), the
+    # watermark ratio, and each child's route split.
+    sparse_device_tier = None
+    try:
+        from nemo_tpu.models.synth import SynthSpec, write_corpus
+
+        sd_tmp = os.path.join(tmp, "sparse_device_tier")
+        os.makedirs(sd_tmp, exist_ok=True)
+        sd_runs = int(os.environ.get("NEMO_BENCH_SPARSE_DEVICE_RUNS", "512"))
+        sd_x1 = write_corpus(
+            SynthSpec(n_runs=sd_runs, seed=6, name="sd_x1"), sd_tmp
+        )
+        sd_giant = write_corpus(
+            SynthSpec(n_runs=3, seed=3, eot=4800, name="sd_giantv"), sd_tmp
+        )
+
+        def sd_child(impl: str, d: str) -> dict:
+            env = dict(
+                os.environ,
+                NEMO_ANALYSIS_IMPL=impl,
+                NEMO_GIANT_V="1024",
+                NEMO_RESULT_CACHE="off",
+                NEMO_CORPUS_CACHE="off",
+            )
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--sparse-device-child", impl, d],
+                stdout=subprocess.PIPE,
+                text=True,
+                timeout=float(os.environ.get("NEMO_BENCH_SPARSE_DEVICE_TIMEOUT", "900")),
+                env=env,
+            )
+            lines = (proc.stdout or "").strip().splitlines()
+            if proc.returncode != 0 or not lines:
+                raise RuntimeError(f"{impl} child rc={proc.returncode}")
+            return json.loads(lines[-1])
+
+        sparse_device_tier = {}
+        for label, d in (("x1", sd_x1), ("giant_v", sd_giant)):
+            dense_c = sd_child("dense", d)
+            sparse_c = sd_child("sparse_device", d)
+            sparse_device_tier[label] = {
+                "runs": dense_c["runs"],
+                "v_max": dense_c["v_max"],
+                "dense_wall_s": dense_c["wall_s"],
+                "sparse_device_wall_s": sparse_c["wall_s"],
+                "dense_peak_mb": dense_c["analysis_peak_delta_bytes"] >> 20,
+                "sparse_device_peak_mb": sparse_c["analysis_peak_delta_bytes"] >> 20,
+                # Floor the sparse delta at 1 MB: an analysis that never
+                # grew the process peak would print an absurd ratio.
+                "watermark_ratio": round(
+                    dense_c["analysis_peak_delta_bytes"]
+                    / max(sparse_c["analysis_peak_delta_bytes"], 1 << 20),
+                    1,
+                ),
+                "dense_routes": dense_c["routes"],
+                "sparse_device_routes": sparse_c["routes"],
+            }
+            dev_peaks = {
+                k: c.get("device_peak_bytes")
+                for k, c in (("dense", dense_c), ("sparse_device", sparse_c))
+                if c.get("device_peak_bytes") is not None
+            }
+            if dev_peaks:
+                sparse_device_tier[label]["device_peak_bytes"] = dev_peaks
+        log(f"sparse-device tier (dense vs CSR device): {json.dumps(sparse_device_tier)}")
+    except Exception as ex:  # the sparse-device tier must never sink the bench
+        log(f"sparse-device tier skipped: {type(ex).__name__}: {ex}")
+        sparse_device_tier = None
+
     # Serve tier (ISSUE 8): the multi-tenant serving path under real
     # concurrency — M concurrent synthetic clients (mixed identical and
     # distinct AnalyzeDir requests) against a SIDECAR SUBPROCESS with the
@@ -1506,6 +1583,7 @@ def child_main() -> None:
         "delta_tier": delta_tier,
         "chaos_tier": chaos_tier,
         "shard_tier": shard_tier,
+        "sparse_device_tier": sparse_device_tier,
         "serve_tier": serve_tier,
         "stress_10x": stress_10x,
         # Whole-process obs registry at bench end: the scattered per-layer
@@ -1534,6 +1612,66 @@ def child_main() -> None:
     if note:
         result["note"] = note
     print(json.dumps(result))
+
+
+def sparse_device_child_main() -> None:
+    """The sparse-device tier's measurement process
+    (`bench.py --sparse-device-child IMPL DIR`): the analysis phase (the
+    _fused drain) of the production JaxBackend over DIR with
+    NEMO_ANALYSIS_IMPL=IMPL (set by the parent), reporting the wall, the
+    analysis-phase peak-memory delta (host RSS always, device peaks where
+    the PJRT backend exposes memory_stats), and the route split.  One
+    JSON line on stdout; runs on the bench's own platform."""
+    import resource
+
+    from nemo_tpu import obs
+    from nemo_tpu.backend.jax_backend import JaxBackend, sample_memory_watermarks
+    from nemo_tpu.ingest.molly import load_molly_output as _lmo
+    from nemo_tpu.ingest.native import (
+        load_molly_output_packed as _lmop,
+        native_available as _nat_avail,
+    )
+
+    impl = sys.argv[sys.argv.index("--sparse-device-child") + 1]
+    d = sys.argv[sys.argv.index("--sparse-device-child") + 2]
+    molly = _lmop(d) if _nat_avail() else _lmo(d)
+    be = JaxBackend()
+    be.init_graph_db("", molly)
+    r0 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    # Cold pass pays the compiles; the timed pass re-dispatches against the
+    # warm jit cache (the trendable number).  The watermark spans both —
+    # peak RSS is monotone, and the analysis buffers ARE the peak.  Route
+    # counters are the WARM pass's delta (both passes record routes; a
+    # whole-process snapshot would double every count).
+    be._fused()
+    be._fused_out = None
+    m0 = obs.metrics.snapshot()
+    t0 = time.perf_counter()
+    be._fused()
+    wall = time.perf_counter() - t0
+    wm = sample_memory_watermarks()
+    mc = obs.Metrics.delta(obs.metrics.snapshot(), m0)["counters"]
+    v_max = max(
+        (job["v"] for job in be.analysis_routes if job["verb"] in ("fused", "giant")),
+        default=0,
+    )
+    print(
+        json.dumps(
+            {
+                "impl": impl,
+                "runs": len(molly.runs),
+                "v_max": v_max,
+                "wall_s": round(wall, 2),
+                "analysis_peak_delta_bytes": wm["host_peak_rss_bytes"] - r0,
+                "device_peak_bytes": wm.get("device_peak_bytes"),
+                "routes": {
+                    k[len("analysis.route."):]: int(v)
+                    for k, v in mc.items()
+                    if k.startswith("analysis.route.")
+                },
+            }
+        )
+    )
 
 
 def shard_child_main() -> None:
@@ -1763,6 +1901,8 @@ def closure_microbench(family_batch) -> dict:
 if __name__ == "__main__":
     if "--shard-child" in sys.argv:
         shard_child_main()
+    elif "--sparse-device-child" in sys.argv:
+        sparse_device_child_main()
     elif "--child" in sys.argv:
         child_main()
     else:

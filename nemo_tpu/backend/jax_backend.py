@@ -163,6 +163,43 @@ def _k_fused(*args):
     )
 
 
+def _k_sparse_fused(*args):
+    """Sparse-device fused step (ISSUE 10 tentpole): the same per-bucket
+    analysis as _k_fused computed as gather/scatter frontier waves over the
+    packed [B,E] edge planes (ops/sparse_device.py) — O(B*(V+E)) device
+    memory instead of the dense [B,V,V] adjacency wall, with the clean
+    adjacency returned as a contracted edge list the backend densifies
+    per figure-selected row (CsrAdjRows)."""
+    from nemo_tpu.models.pipeline_model import BatchArrays
+    from nemo_tpu.ops.sparse_device import sparse_device_step
+
+    pre = BatchArrays(*args[:8])
+    post = BatchArrays(*args[8:16])
+    (v, pre_tid, post_tid, num_tables, comp_linear, pack_out) = args[16:]
+    return sparse_device_step(
+        pre,
+        post,
+        v=v,
+        pre_tid=pre_tid,
+        post_tid=post_tid,
+        num_tables=num_tables,
+        comp_linear=bool(comp_linear),
+        pack_out=bool(pack_out),
+    )
+
+
+def _k_sparse_diff(edge_src, edge_dst, edge_mask, is_goal, node_mask, label_id, fail_bits, v):
+    """Sparse-device differential provenance: the diff verb's frontier
+    waves over the good run's edge list (ops/sparse_device.py), edge_keep
+    returned as a mask over the edge list (the diff_masks_host convention)
+    instead of dense [B,V,V] planes."""
+    from nemo_tpu.ops.sparse_device import diff_masks_sparse_device
+
+    return diff_masks_sparse_device(
+        edge_src, edge_dst, edge_mask, is_goal, node_mask, label_id, fail_bits, v
+    )
+
+
 def _device_annotation(name: str):
     """A ``jax.profiler.TraceAnnotation`` bracketing one kernel dispatch, so
     a jax.profiler device capture running alongside (CLI --profile, sidecar
@@ -214,7 +251,7 @@ def _kernel_cost_analysis(verb: str, fn, args, statics) -> dict:
     out = {"flops": None, "bytes_accessed": None}
     try:
         target = fn
-        if verb in ("fused", "giant"):
+        if verb in ("fused", "giant", "sparse_fused", "sparse_diff"):
             target = _COST_JITS.get(verb)
             if target is None:
                 n_arr = len(LocalExecutor.VERBS[verb][1])
@@ -331,10 +368,15 @@ def _record_kernel_cost(
 #: dispatch) — mirror of parallel/mesh.py:run_step_sharded's corpus_level.
 _CORPUS_LEVEL_OUTPUTS = frozenset({"proto_inter", "proto_union"})
 
-#: (verb, v, e) -> latest cost-table record of that shape class: the
-#: scheduler's device-lane hint reads this to price a bucket the session
-#: has costed (FLOPs from the XLA estimate) but not yet measured.
-_COST_BY_CLASS: dict[tuple[str, int, int], dict] = {}
+#: (verb, v, e) -> (latest cost-table record of that shape class, the
+#: dispatched batch width of that record's signature): the scheduler's
+#: device-lane hint reads this to price a bucket the session has costed
+#: (FLOPs from the XLA estimate) but not yet measured.  The rows ride
+#: along because the class key deliberately ignores the batch dim (the
+#: jit-sharing axis) while the FLOPs estimate scales with it — a hint
+#: priced off a wider signature must normalize per row or it overprices
+#: every narrower bucket of the same class by the width ratio.
+_COST_BY_CLASS: dict[tuple[str, int, int], tuple[dict, int]] = {}
 
 
 def _index_cost_class(verb: str, arrays: dict, params: dict) -> None:
@@ -346,8 +388,17 @@ def _index_cost_class(verb: str, arrays: dict, params: dict) -> None:
         rec = _KERNEL_COSTS.get(sig)
         if rec is None or "v" not in params:
             return
-        e = int(np.shape(arrays["pre_edge_src"])[1]) if verb in ("fused", "giant") else 0
-        _COST_BY_CLASS[(verb, int(params["v"]), e)] = rec
+        e = (
+            int(np.shape(arrays["pre_edge_src"])[1])
+            if verb in ("fused", "giant", "sparse_fused")
+            else 0
+        )
+        rows = (
+            int(np.shape(arrays["pre_is_goal"])[0])
+            if arrays.get("pre_is_goal") is not None
+            else 1
+        )
+        _COST_BY_CLASS[(verb, int(params["v"]), e)] = (rec, max(rows, 1))
     except Exception:  # lint: allow-silent-except — cost indexing is best-effort observability (docstring)
         pass
 
@@ -355,17 +406,29 @@ def _index_cost_class(verb: str, arrays: dict, params: dict) -> None:
 def sched_device_hint(job) -> float | None:
     """Device-lane cost hint for the heterogeneous scheduler
     (parallel/sched.py): the PR-4 cost table's FLOPs estimate for the job's
-    shape class, priced at NEMO_SCHED_FLOPS_PER_S (default 5e9 — a host-CPU
-    XLA ballpark; on a real accelerator the measured-wall EWMA takes over
-    after one bucket anyway).  None when the class was never costed."""
-    rec = _COST_BY_CLASS.get((job.verb, job.v, job.e))
-    if rec is None or rec.get("flops") is None:
+    shape class, normalized PER ROW of the costed signature and scaled to
+    the job's DISPATCHED batch width — the class key shares one compiled
+    program across batch widths, but FLOPs scale with the width, and the
+    dispatch pays for the PADDED program: an un-normalized hint from a
+    wide signature would overprice every narrower bucket off the device
+    lane, while scaling by the real-run count would underprice a padded
+    dispatch by the pad ratio.  Priced at NEMO_SCHED_FLOPS_PER_S (default
+    5e9 — a host-CPU XLA ballpark; on a real accelerator the measured-wall
+    EWMA takes over after one bucket anyway).  None when the class was
+    never costed."""
+    entry = _COST_BY_CLASS.get((job.verb, job.v, job.e))
+    if entry is None:
+        return None
+    rec, rec_rows = entry
+    if rec.get("flops") is None:
         return None
     try:
         rate = float(os.environ.get("NEMO_SCHED_FLOPS_PER_S", "5e9"))
     except ValueError:
         rate = 5e9
-    return float(rec["flops"]) / max(rate, 1.0)
+    per_row = float(rec["flops"]) / rec_rows
+    rows = int(getattr(job, "rows_dispatch", 0)) or int(getattr(job, "rows", 1))
+    return per_row * max(rows, 1) / max(rate, 1.0)
 
 
 def kernel_cost_snapshot() -> list[dict]:
@@ -418,6 +481,10 @@ def _jit_cache_size(verb: str, fn) -> int:
     compile-vs-execute boundary the obs metrics record."""
     if verb == "fused":
         from nemo_tpu.models.pipeline_model import _analysis_step_jit as fn
+    elif verb == "sparse_fused":
+        from nemo_tpu.ops.sparse_device import _sparse_step_jit as fn
+    elif verb == "sparse_diff":
+        from nemo_tpu.ops.sparse_device import _sparse_diff_jit as fn
     elif verb == "giant":
         return -1
     cs = getattr(fn, "_cache_size", None)
@@ -492,7 +559,23 @@ class LocalExecutor:
              "proto_depth", "pack_out"),
             None,  # dict-returning, fused-compatible keys (B=1)
         ),
+        "sparse_fused": (
+            _k_sparse_fused,
+            tuple(f"pre_{f}" for f in _BA_FIELDS) + tuple(f"post_{f}" for f in _BA_FIELDS),
+            ("v", "pre_tid", "post_tid", "num_tables", "comp_linear", "pack_out"),
+            None,  # dict-returning: summary keys + {cond}_clean_src/dst/mask
+        ),
+        "sparse_diff": (
+            _k_sparse_diff,
+            ("edge_src", "edge_dst", "edge_mask", "is_goal", "node_mask", "label_id", "fail_bits"),
+            ("v",),
+            ("node_keep", "edge_keep", "frontier_rule", "missing_goal"),
+        ),
     }
+
+    #: The run-axis-batched dict-returning verbs: batch-width metrics, the
+    #: pack_out default, and the run-mesh sharding all key off this set.
+    BATCHED_VERBS = frozenset({"fused", "giant", "sparse_fused"})
 
     #: Fused outputs that stay on DEVICE in-process: the [B,V,V] clean
     #: adjacencies (plus alive/type rows) are only ever consumed per-row by
@@ -540,7 +623,7 @@ class LocalExecutor:
         # machinery is exercised against exactly this boundary.
         _chaos.on_device_dispatch(verb)
         fn, array_names, param_names, out_names = self.VERBS[verb]
-        if verb in ("fused", "giant") and "pack_out" not in params:
+        if verb in self.BATCHED_VERBS and "pack_out" not in params:
             params = dict(params, pack_out=_pack_out_default())
         # Host->device transfer volume of this dispatch, as the bytes the
         # inputs occupy on entry (post-narrowing: _narrow_fused_arrays has
@@ -559,7 +642,7 @@ class LocalExecutor:
         # a batch size — observing it would corrupt the histogram.
         span_attrs = {"upload_bytes": upload}
         b_in = rows_real = None
-        if verb in ("fused", "giant") and arrays.get("pre_is_goal") is not None:
+        if verb in self.BATCHED_VERBS and arrays.get("pre_is_goal") is not None:
             b_in = int(np.shape(arrays["pre_is_goal"])[0])
             rows_real = min(int(rows), b_in) if rows is not None else b_in
             obs.metrics.observe("kernel.batch_rows", rows_real)
@@ -590,18 +673,29 @@ class LocalExecutor:
         # when the outputs materialize below.
         b_pad = b_in
         shard_n = 0
-        if verb == "fused" and b_in is not None:
+        if verb in ("fused", "sparse_fused") and b_in is not None:
             from nemo_tpu.parallel.mesh import pad_place_named_arrays, shard_plan
 
             place, n_dev = shard_plan()
             if place:
-                from nemo_tpu.ops.adjacency import resolve_closure_impl
+                # GSPMD cannot partition through a Mosaic pallas_call;
+                # honor the operator's kernel pin over the mesh.  Each
+                # verb checks only ITS kernel knob: the dense fused step
+                # closes over NEMO_CLOSURE_IMPL, the sparse-device step
+                # over NEMO_SPARSE_WAVE_IMPL (ops/sparse_device.py).
+                if verb == "fused":
+                    from nemo_tpu.ops.adjacency import resolve_closure_impl
 
-                if resolve_closure_impl() == "pallas":
-                    # GSPMD cannot partition through a Mosaic pallas_call;
-                    # honor the operator's closure pin over the mesh.
+                    pallas_pin = resolve_closure_impl() == "pallas"
+                    pin_knob = "NEMO_CLOSURE_IMPL"
+                else:
+                    from nemo_tpu.ops.sparse_device import resolve_wave_impl
+
+                    pallas_pin = resolve_wave_impl() == "pallas"
+                    pin_knob = "NEMO_SPARSE_WAVE_IMPL"
+                if pallas_pin:
                     warnings.warn(
-                        "NEMO_SHARD requested but NEMO_CLOSURE_IMPL=pallas "
+                        f"NEMO_SHARD requested but {pin_knob}=pallas "
                         "cannot shard; dispatching single-device",
                         stacklevel=2,
                     )
@@ -696,6 +790,24 @@ class LocalExecutor:
             res = {
                 n: (o if n in self.ON_DEVICE else np.asarray(o)) for n, o in out.items()
             }
+            if shard_n:
+                # The gather span times the TRANSFER only: under pack_out
+                # (the sharded default, _pack_out_default) the per-run bool
+                # summaries cross the shard gather as one bit-packed uint8
+                # vector per bucket — ~8x fewer gathered bool bytes
+                # (ROADMAP 3b) — and the host-side unpack below happens
+                # lazily, after the timed window closes.
+                obs.metrics.observe(
+                    "analysis.shard.gather_s", time.perf_counter() - t_gather
+                )
+                obs.metrics.inc(
+                    "analysis.shard.gather_bytes",
+                    sum(
+                        a.nbytes
+                        for n, a in res.items()
+                        if n not in self.ON_DEVICE and hasattr(a, "nbytes")
+                    ),
+                )
             if "packed_summary" in res:
                 res.update(
                     _unpack_summary(
@@ -708,9 +820,6 @@ class LocalExecutor:
                     )
                 )
             if shard_n:
-                obs.metrics.observe(
-                    "analysis.shard.gather_s", time.perf_counter() - t_gather
-                )
                 if b_pad != b_in:
                     # Shed the shard-multiple padding rows so callers see
                     # exactly the batch width they dispatched; corpus-level
@@ -733,7 +842,11 @@ def _pack_out_default() -> int:
     """Whether the fused verb should fold its bool summary outputs into one
     bit-packed device->host transfer: yes on device backends (the TPU
     tunnel serializes copies at ~an RTT each, so seven transfers collapse
-    to one 8x-smaller one), no on CPU where host "transfers" are free.
+    to one 8x-smaller one), no on CPU where host "transfers" are free —
+    UNLESS the run mesh is placing (shard_plan): the sharded gather
+    crosses device boundaries regardless of platform, so the per-run bool
+    summaries default to the bit-packed form there too and unpack lazily
+    on host after the timed gather (ROADMAP 3b, ISSUE 10 satellite).
     Resolved by the process that OWNS the device (the sidecar server, or
     the in-process backend) — remote clients never send it.
     NEMO_PACK_XFER=0/1 overrides."""
@@ -751,7 +864,11 @@ def _pack_out_default() -> int:
             "using the backend default",
             stacklevel=2,
         )
-    return int(jax.default_backend() != "cpu")
+    if jax.default_backend() != "cpu":
+        return 1
+    from nemo_tpu.parallel.mesh import shard_plan
+
+    return int(shard_plan()[0])
 
 
 def _unpack_summary(
@@ -812,32 +929,38 @@ def _giant_threshold() -> int:
 
 
 def _giant_impl_default() -> str:
-    """Crossover routing for the giant path (VERDICT r4 task 2), mirroring
-    the diff crossover one function up: "auto" resolves to the exact sparse
-    HOST analysis (parallel/giant.py:giant_analysis_host) when the device
-    backend is the host CPU, and to the node-sharded device step otherwise.
+    """Crossover routing for the giant path, mirroring the diff crossover
+    one function up.  Resolution order under "auto" (ISSUE 10):
 
-    Measured: on a CPU fallback the dense [V,V] device kernels are 5-6x
-    SLOWER than the sequential oracle (BENCH_r04 giant: 87.4 s vs 14.3 s
-    warm for the 10k-node run) — XLA:CPU pays the full dense V^2/V^3 work
-    the sparse host path avoids — while on the TPU the sharded dense step
-    is 10-14x FASTER than the oracle (BASELINE.md giant rows).  The device
-    platform is therefore the whole crossover signal; there is no
-    size-threshold term because every giant run is past NEMO_GIANT_V by
-    definition.  NEMO_GIANT_IMPL={auto,host,device} overrides (device on
-    CPU keeps the dense path testable; host on TPU serves a tunnel-less
-    degraded mode); with NEMO_GIANT_IMPL unset, an explicit
-    NEMO_ANALYSIS_IMPL umbrella (sparse -> host, dense -> device) covers
-    the giant verb too, so one knob forces a whole route."""
+      1. an explicit NEMO_ANALYSIS_IMPL umbrella covers the giant verb too
+         (sparse -> host, dense -> device, sparse_device -> sparse_device)
+         so one knob forces a whole route;
+      2. on a REAL device, DEVICE-SPARSE first: the sparse-CSR device step
+         (ops/sparse_device.py via the sparse_fused verb) analyzes a giant
+         run in O(V+E) device memory — no [V,V] adjacency, no node-sharded
+         dense closures — so giant-V runs stay on the accelerator instead
+         of escaping to the host;
+      3. on a CPU fallback, the exact sparse HOST analysis
+         (parallel/giant.py:giant_analysis_host): the dense [V,V] device
+         kernels there are 5-6x SLOWER than the sequential oracle
+         (BENCH_r04 giant: 87.4 s vs 14.3 s warm for the 10k-node run),
+         and the numpy engine beats XLA:CPU's scatter waves too.
+
+    Host is therefore no longer the only giant escape hatch — it is the
+    CPU-platform resolution and the degraded/failover mode.
+    NEMO_GIANT_IMPL={auto,host,device,sparse_device} overrides (device
+    keeps the dense node-sharded path — the pre-ISSUE-10 TPU default —
+    selectable; host on TPU serves a tunnel-less degraded mode)."""
     impl = _giant_impl_env()
     if impl == "auto":
         umbrella = _analysis_impl_env()
-        if umbrella in ("sparse", "dense"):
-            return "host" if umbrella == "sparse" else "device"
+        if umbrella in ("sparse", "dense", "sparse_device"):
+            return {"sparse": "host", "dense": "device"}.get(umbrella, umbrella)
         # auto AND crossover both land here: a giant's own crossover is the
         # platform inversion (dense giant on CPU loses to the oracle), so
-        # the per-bucket budget knob must not drag giants onto the device.
-        return "host" if jax.default_backend() == "cpu" else "device"
+        # the per-bucket budget knob must not drag giants onto the dense
+        # device path — but a real device DOES take them, sparse-first.
+        return "host" if jax.default_backend() == "cpu" else "sparse_device"
     return impl
 
 
@@ -903,9 +1026,10 @@ def _giant_impl_env() -> str:
     """Parse + validate NEMO_GIANT_IMPL (shared by the in-process and
     service backends so the accepted spellings can never diverge)."""
     impl = os.environ.get("NEMO_GIANT_IMPL", "auto").strip().lower()
-    if impl not in ("auto", "host", "device"):
+    if impl not in ("auto", "host", "device", "sparse_device"):
         raise ValueError(
-            f"NEMO_GIANT_IMPL={impl!r} (expected auto, host, or device)"
+            f"NEMO_GIANT_IMPL={impl!r} (expected auto, host, device, or "
+            "sparse_device)"
         )
     return impl
 
@@ -927,10 +1051,10 @@ def _analysis_impl_env() -> str:
     work stealing) be exercised and benched on a CPU-only box, where plain
     auto resolves every bucket to the sparse tier."""
     impl = os.environ.get("NEMO_ANALYSIS_IMPL", "auto").strip().lower()
-    if impl not in ("auto", "dense", "sparse", "crossover"):
+    if impl not in ("auto", "dense", "sparse", "sparse_device", "crossover"):
         raise ValueError(
             f"NEMO_ANALYSIS_IMPL={impl!r} (expected auto, dense, sparse, "
-            "or crossover)"
+            "sparse_device, or crossover)"
         )
     return impl
 
@@ -954,6 +1078,40 @@ def _analysis_host_work_budget() -> int:
     so the same order of magnitude holds; NEMO_ANALYSIS_HOST_WORK
     overrides for directly-attached devices (no RTT tax: lower it)."""
     return int(os.environ.get("NEMO_ANALYSIS_HOST_WORK", "100000"))
+
+
+def _sparse_device_mem_bytes() -> int:
+    """Dense-route memory watermark (ISSUE 10): buckets whose dense
+    footprint estimate — rows x V^2 x ~4 bytes (the bool [B,V,V] adjacency
+    plus its bf16 closure copies) — exceeds this route to the sparse-CSR
+    device step instead of materializing the dense planes.  The default
+    (256 MB) keeps every case-study bucket dense (V <= a few hundred:
+    megabytes) while giant-V buckets (V in the thousands: gigabytes) stay
+    on the device sparsely instead of OOMing or escaping to the host.
+    NEMO_SPARSE_DEVICE_MEM_MB overrides (0 disables the watermark)."""
+    return int(float(os.environ.get("NEMO_SPARSE_DEVICE_MEM_MB", "256")) * 1e6)
+
+
+def _sparse_device_density() -> float:
+    """Density crossover (ISSUE 10): below nnz/V^2 = this (and past
+    NEMO_SPARSE_DEVICE_MIN_V nodes), the auto device route prefers the
+    sparse-CSR step — each frontier wave costs O(E) instead of the dense
+    [B,V]x[B,V,V] einsum's O(V^2), so the crossover is where the MXU's
+    dense throughput stops covering the wasted zero work.  The default
+    1/256 is deliberately conservative: at case-study shapes (V=64,
+    E-bucket 256 -> density ~0.06) the dense MXU path is the measured
+    winner and keeps the route; the sparse win is the large-V, E ~ V
+    regime Molly's chain-heavy graphs produce.
+    NEMO_SPARSE_DEVICE_DENSITY overrides (0 disables the crossover)."""
+    return float(os.environ.get("NEMO_SPARSE_DEVICE_DENSITY", str(1.0 / 256.0)))
+
+
+def _sparse_device_min_v() -> int:
+    """Node floor for the density crossover: tiny-V buckets are always
+    effectively dense on the MXU regardless of nominal density (a [64,64]
+    matmul is one tile), so density alone must not route them sparse.
+    NEMO_SPARSE_DEVICE_MIN_V overrides."""
+    return int(os.environ.get("NEMO_SPARSE_DEVICE_MIN_V", "1024"))
 
 
 def _diff_host_work_budget() -> int:
@@ -1063,6 +1221,25 @@ def _verb_arrays(pre_b: PackedBatch, post_b: PackedBatch) -> dict[str, np.ndarra
     }
 
 
+def _wrap_sparse_clean(res: dict, v: int) -> dict:
+    """sparse_fused executor output -> fused-compatible result dict: the
+    contracted {cond}_clean_src/dst/mask edge planes become lazy
+    {cond}_adj_clean views (ops/sparse_device.py:CsrAdjRows) that densify
+    exactly the rows downstream consumers touch — the dense [B,V,V] plane
+    the figure row-gathers index is never materialized bucket-wide."""
+    from nemo_tpu.ops.sparse_device import CsrAdjRows
+
+    out = dict(res)
+    for cond in ("pre", "post"):
+        out[f"{cond}_adj_clean"] = CsrAdjRows(
+            out.pop(f"{cond}_clean_src"),
+            out.pop(f"{cond}_clean_dst"),
+            out.pop(f"{cond}_clean_mask"),
+            v=v,
+        )
+    return out
+
+
 class _LazyGraphs:
     """Mapping (run, cond) -> PGraph, materialized on first access.
 
@@ -1145,6 +1322,9 @@ class JaxBackend(GraphBackend):
         # reads jax.default_backend(), unsafe before the watchdog).
         self._analysis_impl: str | None = None
         self._analysis_host_work = _analysis_host_work_budget()
+        self._sparse_device_mem = _sparse_device_mem_bytes()
+        self._sparse_device_density = _sparse_device_density()
+        self._sparse_device_min_v = _sparse_device_min_v()
         #: impl the last _fused giant dispatch actually took (None = no
         #: giant runs in the corpus) — surfaced in the bench giant row.
         self.giant_impl_used = None
@@ -1189,19 +1369,45 @@ class JaxBackend(GraphBackend):
         # branch handles any impl that is neither sparse nor dense.
         return impl
 
-    def _analysis_route(self, rows: int, v: int, e: int) -> tuple[str, str, int]:
+    def _analysis_route(
+        self, rows: int, v: int, e: int, rows_dispatch: int | None = None
+    ) -> tuple[str, str, int]:
         """Per-bucket route decision: (route, reason, work).  `work` is the
         sparse engine's cost model B x (V + E) — the crossover input the
-        route records expose (analysis.route spans, bench JSON)."""
+        route records expose (analysis.route spans, bench JSON).
+        ``rows_dispatch`` is the PADDED batch width the dense dispatch
+        would materialize (run-axis bucket + shard multiple) — the memory
+        watermark must price what the device allocates, not the real-run
+        count, or a 1-run giant-adjacent bucket padded 8-wide slips past
+        the guard onto the dense route it would OOM.
+
+        Routes: "sparse" (the CSR host engine), "dense" (the fused [B,V,V]
+        device dispatch), "sparse_device" (the CSR device step, ISSUE 10).
+        Auto on a device backend decides in three steps: tiny buckets go
+        host (the dispatch-cost crossover); buckets whose dense footprint
+        would cross the memory watermark go sparse-device (reason "mem" —
+        the giant-V wall); very sparse large-V buckets go sparse-device
+        (reason "density"); everything else keeps the dense MXU dispatch."""
         work = rows * (v + e)
         impl = self._analysis_impl
-        if impl in ("sparse", "dense"):
+        if impl in ("sparse", "dense", "sparse_device"):
             return impl, "forced" if _analysis_impl_env() != "auto" else "platform", work
         # auto on a device backend: sparse only below the measured budget
         # (a device dispatch's fixed RTT/compile cost dominates tiny
         # buckets; the big padded batches belong on the accelerator).
         if work <= self._analysis_host_work:
             return "sparse", "crossover", work
+        if (
+            self._sparse_device_mem
+            and max(rows_dispatch or 0, rows) * v * v * 4 > self._sparse_device_mem
+        ):
+            return "sparse_device", "mem", work
+        if (
+            self._sparse_device_density
+            and v >= self._sparse_device_min_v
+            and e <= v * v * self._sparse_device_density
+        ):
+            return "sparse_device", "density", work
         return "dense", "crossover", work
 
     def _record_route(
@@ -1240,6 +1446,9 @@ class JaxBackend(GraphBackend):
         self._giant_impl = self._resolve_giant_impl()
         self._analysis_impl = self._resolve_analysis_impl()
         self._analysis_host_work = _analysis_host_work_budget()
+        self._sparse_device_mem = _sparse_device_mem_bytes()
+        self._sparse_device_density = _sparse_device_density()
+        self._sparse_device_min_v = _sparse_device_min_v()
         self.analysis_routes = []
         self._narrow_xfer = self._resolve_narrow_xfer()
         self._max_batch = (
@@ -1484,6 +1693,15 @@ class JaxBackend(GraphBackend):
             # at 1x the phase was 5-7 s of the 9.2 s e2e wall, and the
             # span shows the analysis dispatch — not this packing — is the
             # dominant term, which is what the sparse route removes.
+            # The shard multiple folds into the bucketizer's run-axis pad
+            # (ROADMAP 3b / ISSUE 10 satellite): batches leave here already
+            # a multiple of the run-mesh width, so pad_place_named_arrays
+            # places without copying on the hot path.  Resolved by the
+            # process that owns the device; RemoteExecutor deployments pad
+            # again sidecar-side if the meshes disagree (rare, harmless).
+            from nemo_tpu.parallel.mesh import shard_device_count
+
+            shard_mult = shard_device_count()
             with obs.span("analysis:pack", runs=n_dense):
                 if self._corpus is not None:
                     batches = bucketize_pairs_corpus(
@@ -1493,12 +1711,14 @@ class JaxBackend(GraphBackend):
                         self._max_batch,
                         min_v=min_v,
                         min_e=min_e,
+                        shard_multiple=shard_mult,
                     )
                 else:
                     pre = [self.packed[(i, "pre")] for i in run_ids]
                     post = [self.packed[(i, "post")] for i in run_ids]
                     batches = bucketize_pairs(
-                        run_ids, pre, post, self._max_batch, min_v=min_v, min_e=min_e
+                        run_ids, pre, post, self._max_batch, min_v=min_v,
+                        min_e=min_e, shard_multiple=shard_mult,
                     )
             from nemo_tpu.ops.simplify import pair_chains_linear
             from nemo_tpu.parallel import sched as sched_mod
@@ -1516,11 +1736,31 @@ class JaxBackend(GraphBackend):
             jobs: list = []
             serial_plan: list[tuple[str, str]] = []  # (lane, reason) sans scheduler
 
+            # Whether the sparse-device lane is schedulable for UNPINNED
+            # fused jobs: forced routes pin it regardless; the cost-model
+            # mixing (dense-device / sparse-device / sparse-host per
+            # bucket, ISSUE 10) engages only where a real accelerator
+            # backs both device lanes — on a CPU fallback the sparse HOST
+            # engine strictly dominates XLA:CPU scatter waves, so offering
+            # the lane there would only invite mispredicted steals.
+            sparse_dev_lanes = (
+                self._analysis_impl in ("auto", "crossover")
+                and jax.default_backend() != "cpu"
+            )
+
             def _add_fused_job(pre_b, post_b, linear):
                 n_rows = len(pre_b.run_ids)
-                route, reason, work = self._analysis_route(n_rows, pre_b.v, pre_b.e)
-                lane = "host" if route == "sparse" else "device"
-                pinned = lane if reason in ("forced", "platform") else None
+                route, reason, work = self._analysis_route(
+                    n_rows, pre_b.v, pre_b.e,
+                    rows_dispatch=int(pre_b.is_goal.shape[0]),
+                )
+                lane = sched_mod.LANE_OF_ROUTE[route]
+                # "mem" pins like the forced/platform reasons: a bucket
+                # past the dense memory watermark must never be stolen
+                # onto the dense device lane (it would OOM exactly where
+                # the route said it would); the breaker/failover machinery
+                # may still reroute it to the bit-identical host lane.
+                pinned = lane if reason in ("forced", "platform", "mem") else None
                 job = sched_mod.Job(
                     index=len(jobs),
                     verb="fused",
@@ -1531,6 +1771,12 @@ class JaxBackend(GraphBackend):
                     execute=None,  # assigned below (the closure marks `job`)
                     pinned=pinned,
                     reason=reason,
+                    lanes=(
+                        ("device", "sparse_device", "host")
+                        if sparse_dev_lanes or route == "sparse_device"
+                        else ("device", "host")
+                    ),
+                    rows_dispatch=int(pre_b.is_goal.shape[0]),
                 )
 
                 def execute(run_lane, rec_reason, stolen):
@@ -1566,6 +1812,37 @@ class JaxBackend(GraphBackend):
                                     num_tables=params_common["num_tables"],
                                     comp_linear=linear,
                                 )
+                        return (pre_b, post_b, res)
+                    if run_lane == "sparse_device":
+                        # Sparse-CSR DEVICE step (ISSUE 10): the same
+                        # executor boundary (RemoteExecutor ships the same
+                        # [B,E] planes over the Kernel RPC — never a dense
+                        # [B,V,V] — so the upload-narrowing savings
+                        # compound), clean adjacency returned as a
+                        # contracted edge list and densified lazily per
+                        # figure-selected row.
+                        with obs.span("analysis:route", **rec):
+                            res = self.executor.run(
+                                "sparse_fused",
+                                _narrow_fused_arrays(
+                                    _verb_arrays(pre_b, post_b),
+                                    v=pre_b.v,
+                                    num_tables=params_common["num_tables"],
+                                    with_diff=False,
+                                    narrow=self._narrow_xfer,
+                                ),
+                                dict(
+                                    v=pre_b.v,
+                                    pre_tid=params_common["pre_tid"],
+                                    post_tid=params_common["post_tid"],
+                                    num_tables=params_common["num_tables"],
+                                    comp_linear=int(linear),
+                                ),
+                                rows=n_rows,
+                            )
+                        res = _wrap_sparse_clean(res, pre_b.v)
+                        if getattr(self.executor, "last_dispatch_compiled", False):
+                            job.wall_tainted = True
                         return (pre_b, post_b, res)
                     with obs.span("analysis:route", **rec):
                         res = self.executor.run(
@@ -1637,7 +1914,10 @@ class JaxBackend(GraphBackend):
                 # diff crossover fixed one verb over.  Resolved per corpus
                 # in init_graph_db (_giant_impl_default).
                 self.giant_impl_used = self._giant_impl
-                giant_lane = "host" if self._giant_impl == "host" else "device"
+                giant_lane = {
+                    "host": "host",
+                    "sparse_device": "sparse_device",
+                }.get(self._giant_impl, "device")
                 for rid, (gpre, gpost) in zip(giant_ids, g_graphs):
                     g_job = sched_mod.Job(
                         index=len(jobs),
@@ -1649,6 +1929,7 @@ class JaxBackend(GraphBackend):
                         execute=None,  # assigned below (the closure marks it)
                         pinned=giant_lane,
                         reason="giant_impl",
+                        rows_dispatch=1,  # giants pack B=1, no run-axis pad
                     )
 
                     def g_execute(run_lane, rec_reason, stolen, gpre=gpre, gpost=gpost, rid=rid, job=g_job):
@@ -1685,6 +1966,37 @@ class JaxBackend(GraphBackend):
                                     pre_labels=pre_labels,
                                     post_labels=post_labels,
                                 )
+                            return (pre_b, post_b, res)
+                        if run_lane == "sparse_device":
+                            # Giant-V on the DEVICE, sparsely (ISSUE 10):
+                            # the CSR step's O(V+E) frontier waves replace
+                            # the node-sharded dense kernels — no [V,V]
+                            # adjacency, no closure labeling (the fix-point
+                            # min-label relaxation is exact for any member
+                            # structure, so giant_plan's union-find labels
+                            # need not ship).
+                            with obs.span("analysis:route", **rec):
+                                res = self.executor.run(
+                                    "sparse_fused",
+                                    _narrow_fused_arrays(
+                                        _verb_arrays(pre_b, post_b),
+                                        v=v_g,
+                                        num_tables=params_common["num_tables"],
+                                        with_diff=False,
+                                        narrow=self._narrow_xfer,
+                                    ),
+                                    dict(
+                                        v=v_g,
+                                        pre_tid=params_common["pre_tid"],
+                                        post_tid=params_common["post_tid"],
+                                        num_tables=params_common["num_tables"],
+                                        comp_linear=int(lin_pre and lin_post),
+                                    ),
+                                    rows=1,
+                                )
+                            res = _wrap_sparse_clean(res, v_g)
+                            if getattr(self.executor, "last_dispatch_compiled", False):
+                                job.wall_tainted = True
                             return (pre_b, post_b, res)
                         arrays = _verb_arrays(pre_b, post_b)
                         arrays["pre_comp_labels"] = pre_labels
@@ -1903,17 +2215,31 @@ class JaxBackend(GraphBackend):
         host_work = len(failed_iters) * (good.n_nodes + len(good.edges))
         umbrella = _analysis_impl_env()
         if good.n_nodes > self._giant_v:
-            use_host, route_reason = True, "giant"
-        elif umbrella in ("sparse", "dense"):
-            use_host, route_reason = umbrella == "sparse", "forced"
+            route, route_reason = "sparse", "giant"
+        elif umbrella in ("sparse", "dense", "sparse_device"):
+            route, route_reason = umbrella, "forced"
         elif self._analysis_impl == "sparse":
-            use_host, route_reason = True, "platform"
+            route, route_reason = "sparse", "platform"
+        elif host_work <= self._diff_host_work:
+            route, route_reason = "sparse", "crossover"
+        elif (
+            self._analysis_impl in ("auto", "crossover")
+            and self._sparse_device_mem
+            and bits.shape[0] * gb.v * gb.v > self._sparse_device_mem
+        ):
+            # The dense diff materializes edge_keep [F,V,V] planes; past
+            # the dense memory watermark the device stays sparse (the same
+            # guard as the fused route's "mem" reason, ISSUE 10).  Gated on
+            # auto/crossover — a resolved impl (incl. the ServiceBackend's
+            # auto->dense wire-compat resolution: a deployed sidecar one
+            # release behind has no sparse_diff verb) skipped the fused
+            # route's mem check and must skip this one too.
+            route, route_reason = "sparse_device", "mem"
         else:
-            use_host = host_work <= self._diff_host_work
-            route_reason = "crossover"
+            route, route_reason = "dense", "crossover"
         rec = self._record_route(
             "diff",
-            "sparse" if use_host else "dense",
+            route,
             len(failed_iters),
             good.n_nodes,
             len(good.edges),
@@ -1921,7 +2247,32 @@ class JaxBackend(GraphBackend):
             route_reason,
         )
         sparse_edges = None
-        if failed_iters and use_host:
+        if failed_iters and route == "sparse_device":
+            # Sparse-CSR DEVICE diff (ISSUE 10): same waves as the host
+            # path, batched over the failed runs on device; edge_keep comes
+            # back as a mask over the padded edge list — sliced to the real
+            # edges so the sparse-edge consumers below apply unchanged.
+            with obs.span("analysis:route", **rec):
+                out = self.executor.run(
+                    "sparse_diff",
+                    {
+                        "edge_src": gb.edge_src[0],
+                        "edge_dst": gb.edge_dst[0],
+                        "edge_mask": gb.edge_mask[0],
+                        "is_goal": gb.is_goal[0],
+                        "node_mask": gb.node_mask[0],
+                        "label_id": gb.label_id[0],
+                        "fail_bits": bits,
+                    },
+                    {"v": gb.v},
+                    rows=len(failed_iters),
+                )
+            node_keep = out["node_keep"]
+            edge_keep = out["edge_keep"][:, : len(good.edges)]
+            frontier_rule = out["frontier_rule"]
+            missing_goal = out["missing_goal"]
+            sparse_edges = good.edges
+        elif failed_iters and route == "sparse":
             # Sparse host diff: O(F * (V + E)) on the packed edge list and
             # exact (ops/diff.py:diff_masks_host).  edge_keep comes back as
             # a mask over `good.edges`, densified only for figure-selected

@@ -70,19 +70,23 @@ class ServiceBackend(JaxBackend):
         return True if override is None else bool(override)
 
     def _resolve_giant_impl(self) -> str:
-        """Giant crossover routing (VERDICT r4 task 2): "auto" keeps the
-        Kernel RPC — the sidecar owns the accelerator, so the client's own
-        jax platform is the wrong crossover signal.  Only an explicit
-        NEMO_GIANT_IMPL=host (or the NEMO_ANALYSIS_IMPL=sparse umbrella)
-        routes the exact sparse analysis client-side (useful when the
-        sidecar itself is known to be CPU-bound)."""
+        """Giant crossover routing: "auto" keeps the Kernel RPC — the
+        sidecar owns the accelerator, so the client's own jax platform is
+        the wrong crossover signal.  The RPC'd verb is the DENSE giant
+        dispatch for wire compatibility with deployed sidecars; the
+        sparse-device giant step (ISSUE 10, the in-process real-device
+        default) rides the same Kernel RPC under NEMO_GIANT_IMPL=
+        sparse_device or the NEMO_ANALYSIS_IMPL=sparse_device umbrella.
+        An explicit NEMO_GIANT_IMPL=host (or the NEMO_ANALYSIS_IMPL=sparse
+        umbrella) routes the exact sparse analysis client-side (useful
+        when the sidecar itself is known to be CPU-bound)."""
         from nemo_tpu.backend.jax_backend import _analysis_impl_env, _giant_impl_env
 
         impl = _giant_impl_env()
         if impl == "auto":
             umbrella = _analysis_impl_env()
-            if umbrella != "auto":
-                return "host" if umbrella == "sparse" else "device"
+            if umbrella in ("sparse", "dense", "sparse_device"):
+                return {"sparse": "host", "dense": "device"}.get(umbrella, umbrella)
             return "device"
         return impl
 

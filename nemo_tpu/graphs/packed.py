@@ -242,6 +242,25 @@ def bucketize(
     return batches
 
 
+def _pad_run_axis(n_runs: int, max_batch: int | None, shard_multiple: int) -> int:
+    """The run-axis pad shared by both bucketizers: power-of-two bucket
+    (capped at max_batch) so differently-sized corpora share compiled
+    programs, then rounded UP to the run-mesh shard multiple (ISSUE 10
+    satellite / ROADMAP 3b) so ``pad_place_named_arrays`` places the batch
+    on the mesh with ZERO host-side copies — the shard pad the executor
+    used to np.pad per dispatch is paid once here, inside the same
+    allocation pack_batch makes anyway.  The multiple may push b_pad past
+    max_batch by < shard_multiple rows: those rows were going to exist as
+    mesh padding regardless; max_batch bounds the DISPATCH count, and the
+    compiled width it implies, either way."""
+    b_pad = bucket_size(n_runs, 8)
+    if max_batch:
+        b_pad = min(b_pad, max_batch)
+    if shard_multiple > 1:
+        b_pad = ((b_pad + shard_multiple - 1) // shard_multiple) * shard_multiple
+    return b_pad
+
+
 def bucketize_pairs(
     run_ids: list[int],
     pre_graphs: list[PackedGraph],
@@ -249,6 +268,7 @@ def bucketize_pairs(
     max_batch: int | None = None,
     min_v: int = 16,
     min_e: int = 16,
+    shard_multiple: int = 1,
 ) -> list[tuple[PackedBatch, PackedBatch]]:
     """Joint size-bucketing over (pre, post) graph pairs: both conditions of
     a run share one bucket, padded to the pair's common (V, E) — the shape
@@ -256,7 +276,9 @@ def bucketize_pairs(
     takes the pre and post batches of the same runs in one dispatch.
     Preserves run order within each bucket.  min_v/min_e floor the bucket
     dims (compile-sharing knob: higher floors merge buckets, trading padded
-    FLOPs for fewer compiled programs)."""
+    FLOPs for fewer compiled programs).  shard_multiple rounds the run-axis
+    pad up to the run-mesh width so sharded placement never copies
+    (_pad_run_axis)."""
     groups: dict[tuple[int, int], tuple[list[int], list[PackedGraph], list[PackedGraph]]] = {}
     for rid, gpre, gpost in zip(run_ids, pre_graphs, post_graphs):
         key = (
@@ -272,11 +294,7 @@ def bucketize_pairs(
         step = max_batch or len(rids)
         for s in range(0, len(rids), step):
             chunk = rids[s : s + step]
-            # Pad the run axis to a power-of-two bucket (capped at max_batch)
-            # so differently-sized corpora share compiled programs.
-            b_pad = bucket_size(len(chunk), 8)
-            if max_batch:
-                b_pad = min(b_pad, max_batch)
+            b_pad = _pad_run_axis(len(chunk), max_batch, shard_multiple)
             batches.append(
                 (
                     pack_batch(chunk, pres[s : s + step], v, e, b_pad),
@@ -435,6 +453,7 @@ def bucketize_pairs_corpus(
     max_batch: int | None = None,
     min_v: int = 16,
     min_e: int = 16,
+    shard_multiple: int = 1,
 ) -> list[tuple[PackedBatch, PackedBatch]]:
     """bucketize_pairs over corpus rows: identical grouping/padding policy
     (joint pre/post bucket key, power-of-two run-axis pad, run order
@@ -465,9 +484,7 @@ def bucketize_pairs_corpus(
         step = max_batch or len(rws)
         for s in range(0, len(rws), step):
             chunk = rws[s : s + step]
-            b_pad = bucket_size(len(chunk), 8)
-            if max_batch:
-                b_pad = min(b_pad, max_batch)
+            b_pad = _pad_run_axis(len(chunk), max_batch, shard_multiple)
             run_ids = [int(iterations[r]) for r in chunk]
             depth = int(corpus.max_depth)
             batches.append(

@@ -17,10 +17,16 @@ on a shallow DAG.  This path analyzes ONE run with:
     O(proto_depth · V^2) (ops/proto.py:proto_rule_bits use_closure=False)
     — exact because the DIRECTED depth bound holds for directed BFS.
 
-The JaxBackend auto-dispatches here when a run's node count exceeds
-NEMO_GIANT_V (backend/jax_backend.py), so one oversized run in an
-otherwise normal corpus analyzes correctly end-to-end; outputs are
-row-compatible with the fused step's (B=1).
+The JaxBackend auto-dispatches a run past NEMO_GIANT_V out of the dense
+buckets (backend/jax_backend.py), so one oversized run in an otherwise
+normal corpus analyzes correctly end-to-end; outputs are row-compatible
+with the fused step's (B=1).  Routing order (ISSUE 10,
+backend/jax_backend.py:_giant_impl_default): on a REAL device the default
+giant route is now the sparse-CSR DEVICE step (ops/sparse_device.py —
+O(V+E) memory, no node-sharded dense closures); this module's dense
+node-sharded step remains the NEMO_GIANT_IMPL=device opt-in, and
+giant_analysis_host below is the CPU-platform resolution and the
+breaker/failover degraded mode — no longer the only giant escape hatch.
 """
 
 from __future__ import annotations
@@ -331,12 +337,16 @@ def giant_analysis_host(
     Same inputs (B=1 PackedBatch pair + giant_plan's padded union-find
     label planes), same output keys/shapes/dtypes — but every kernel runs
     as O(V + E) numpy edge-list scatters and fix-point BFS instead of
-    dense [V,V] device work.  This is the crossover target for the giant
-    dispatch: on a CPU fallback the dense node-sharded path is 5-6x
-    SLOWER than the sequential oracle (BENCH_r04: 87.4 s vs 14.3 s for
-    the 10k-node run), while this path does the same analysis in
-    milliseconds; on the TPU the dense path wins 10-14x vs the oracle
-    and stays the default (backend/jax_backend.py:_giant_impl_default).
+    dense [V,V] device work.  This is the CPU-platform resolution of the
+    giant crossover (backend/jax_backend.py:_giant_impl_default): on a CPU
+    fallback the dense node-sharded path is 5-6x SLOWER than the
+    sequential oracle (BENCH_r04: 87.4 s vs 14.3 s for the 10k-node run),
+    while this path does the same analysis in milliseconds.  On a REAL
+    device it is NO LONGER the only giant escape hatch (ISSUE 10): the
+    default there is the sparse-CSR DEVICE step (ops/sparse_device.py via
+    the sparse_fused verb — giant runs stay on the accelerator in O(V+E)
+    memory), and this host path serves the NEMO_GIANT_IMPL=host pin, the
+    breaker/failover degraded mode, and tunnel-less deployments.
 
     Exactness notes (vs the bounded device kernels):
       * BFS sweeps run to fix point, so no depth bound is needed;

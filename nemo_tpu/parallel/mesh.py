@@ -246,11 +246,24 @@ def pad_place_named_arrays(
 
     One host->device placement per array here, ONE gather per bucket on the
     way back (backend/jax_backend.py materializes outputs post-dispatch) —
-    the one-gather rule that keeps shard traffic off the per-verb paths."""
+    the one-gather rule that keeps shard traffic off the per-verb paths.
+
+    On the production path this NEVER copies host-side: the bucketizer
+    folds the shard multiple into its run-axis pad
+    (graphs/packed.py:_pad_run_axis, ISSUE 10 satellite / ROADMAP 3b), so
+    b is already a mesh multiple and every array goes straight to
+    device_put.  A batch that does still need the pad (hand-built batches,
+    a mesh wider than the bucketizer planned for) pays one np.pad per
+    array and counts ``analysis.shard.pad_copies`` — the regression signal
+    tests/test_shard.py watches."""
+    from nemo_tpu import obs
+
     mesh = run_mesh(n_devices)
     row_sharded = NamedSharding(mesh, P(RUN_AXIS))
     replicated = NamedSharding(mesh, P())
     b_pad = ((b + n_devices - 1) // n_devices) * n_devices
+    if b_pad != b:
+        obs.metrics.inc("analysis.shard.pad_copies")
     out: dict = {}
     for name, a in arrays.items():
         if a is None:

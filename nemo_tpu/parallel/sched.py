@@ -4,13 +4,19 @@ PR 3 gave every joint bucket a per-bucket ROUTE (dense device dispatch vs
 the sparse CSR host engine, backend/jax_backend.py:_analysis_route) but
 executed the routed buckets one at a time: while a device dispatch runs,
 the host cores idle, and vice versa.  This module turns the route decision
-into a two-lane schedule:
+into a multi-lane schedule:
 
   * **device lane**: one worker thread draining buckets into the (now
     mesh-sharded) fused executor dispatch — serialized per device, which is
     exactly what the accelerator wants;
   * **host lane**: one worker thread draining buckets into the sparse-CSR
-    host engine (ops/sparse_host.py).
+    host engine (ops/sparse_host.py);
+  * **sparse_device lane** (ISSUE 10): one worker thread draining buckets
+    into the sparse-CSR DEVICE engine (ops/sparse_device.py via the
+    sparse_fused executor verb) — offered per job via ``Job.lanes`` where
+    a real accelerator backs it, priced by the same LaneModel EWMA
+    feedback, so the scheduler can mix dense-device / sparse-device /
+    sparse-host per bucket.
 
 Buckets are assigned a PREFERRED lane by a cost model — wall ≈ fixed +
 unit x work per lane, seeded from the PR-3/PR-4 measured constants (the
@@ -80,11 +86,26 @@ from nemo_tpu.utils.env import (
 
 _log = obs.log.get_logger("nemo.sched")
 
-LANES = ("device", "host")
+#: All schedulable lanes, in tie-break preference order.  "sparse_device"
+#: (ISSUE 10) is the sparse-CSR device engine (ops/sparse_device.py via
+#: the sparse_fused executor verb): a THIRD lane the cost model may mix
+#: with the dense device dispatch and the sparse host engine per bucket.
+#: Jobs opt into it via Job.lanes — the backend offers it only where a
+#: real accelerator backs it — so a scheduler built from two-lane models
+#: behaves exactly as before.
+LANES = ("device", "sparse_device", "host")
 
 #: route vocabulary of the analysis.route records, per lane (the scheduler
-#: speaks "lane", the route records speak the PR-3 sparse/dense vocabulary).
-ROUTE_OF_LANE = {"device": "dense", "host": "sparse"}
+#: speaks "lane", the route records speak the PR-3 sparse/dense vocabulary,
+#: extended with the ISSUE-10 sparse_device route).
+ROUTE_OF_LANE = {"device": "dense", "host": "sparse", "sparse_device": "sparse_device"}
+LANE_OF_ROUTE = {route: lane for lane, route in ROUTE_OF_LANE.items()}
+
+#: Lanes that execute on the accelerator (or its tunnel): the circuit
+#: breaker, the dispatch deadline, and the failover machinery treat them
+#: as one health domain — a sick device is sick for both the dense and the
+#: sparse-CSR programs, and both fail over to the bit-identical host lane.
+DEVICE_SIDE_LANES = frozenset({"device", "sparse_device"})
 
 
 def sched_env() -> str:
@@ -145,6 +166,18 @@ class Job:
     #: every later same-class bucket off the device lane for the whole
     #: session.  The scheduler still records the wall; it skips observe().
     wall_tainted: bool = False
+    #: Lanes this job's execute closure implements.  The default is the
+    #: two-lane pre-ISSUE-10 contract; the backend adds "sparse_device"
+    #: where the CSR device engine is available, and only lanes in this
+    #: tuple are considered for unpinned planning or stealing (a pin
+    #: bypasses it — pinned jobs run their lane regardless).
+    lanes: tuple = ("device", "host")
+    #: PADDED batch width the device dispatch materializes (run-axis
+    #: bucket + shard multiple); 0 = unknown (falls back to `rows`).  The
+    #: device-lane FLOPs hint scales by THIS — the dispatch pays for the
+    #: padded program, not the real-run count
+    #: (backend/jax_backend.py:sched_device_hint).
+    rows_dispatch: int = 0
 
 
 class LaneModel:
@@ -216,8 +249,16 @@ def default_models(
     device_fixed = _env_float(
         "NEMO_SCHED_DEVICE_FIXED", budget * max(host_unit - device_unit, 1e-12)
     )
+    # The sparse-device lane (ISSUE 10) pays the same per-dispatch fixed
+    # cost class (RTT + program launch) but its per-unit work is
+    # E-proportional frontier waves — seeded between the dense device and
+    # the host engine so an unmeasured scheduler prefers the dense MXU
+    # dispatch (the measured small-V winner) and lets the EWMA feedback
+    # promote the sparse lane where it actually wins.
+    sparse_device_unit = _env_float("NEMO_SCHED_SPARSE_DEVICE_UNIT", 2.5e-7)
     return {
         "device": LaneModel(device_fixed, device_unit, hint=device_hint),
+        "sparse_device": LaneModel(device_fixed, sparse_device_unit),
         "host": LaneModel(0.0, host_unit),
     }
 
@@ -376,14 +417,17 @@ class CircuitBreaker:
                 _log.info("sched.breaker_closed")
 
 
-#: Pin reasons whose execute closures implement BOTH lanes (the
+#: Pin reasons whose execute closures implement the host lane too (the
 #: jax_backend fused/giant closures): the breaker may reroute them and a
-#: device failure may re-run them on the host lane.  NOT "forced" (an
+#: device failure may re-run them on the host lane.  "mem" (ISSUE 10) pins
+#: a bucket off the DENSE device lane because its [B,V,V] footprint would
+#: cross the memory watermark — the bit-identical host engine is a legal
+#: degraded target, the dense device lane is not.  NOT "forced" (an
 #: operator pin is a correctness decision whose failures must surface) and
 #: NOT "serve_batch" (the serving tier's merged launches are device-only
 #: closures — handing them a host lane would still dispatch on the broken
 #: device while recording host).
-_DUAL_LANE_PIN_REASONS = frozenset({"platform", "giant_impl"})
+_DUAL_LANE_PIN_REASONS = frozenset({"platform", "giant_impl", "mem"})
 
 
 def _may_reroute(job: Job) -> bool:
@@ -465,8 +509,13 @@ class HeterogeneousScheduler:
 
     def __init__(self, models: dict[str, LaneModel] | None = None) -> None:
         self.models = models or session_models()
-        self.steals = {lane: 0 for lane in LANES}
-        self.dispatched = {lane: 0 for lane in LANES}
+        #: Worker lanes, in LANES preference order: one worker thread per
+        #: modeled lane.  Two-lane model dicts (the pre-ISSUE-10 contract,
+        #: still what the unit suites build) get exactly the old two-lane
+        #: scheduler; the production session_models add sparse_device.
+        self.lanes = tuple(l for l in LANES if l in self.models) or tuple(self.models)
+        self.steals = {lane: 0 for lane in self.lanes}
+        self.dispatched = {lane: 0 for lane in self.lanes}
         self.failovers = 0
         self.breaker = device_breaker()
         #: Shared jittered-backoff session for this drain's failovers
@@ -480,13 +529,16 @@ class HeterogeneousScheduler:
         plan to the host lane (degraded host-only mode); a forced route
         keeps the device — an explicit pin is a correctness decision, and
         its failure should be seen, not masked."""
-        preds = {lane: self.models[lane].predict(job) for lane in LANES}
+        candidates = [l for l in self.lanes if l in job.lanes] or list(self.lanes)
+        preds = {lane: self.models[lane].predict(job) for lane in candidates}
         if job.pinned:
             lane, reason = job.pinned, job.reason
         else:
-            lane = "device" if preds["device"] <= preds["host"] else "host"
+            # Min predicted wall; ties break in LANES order (device first —
+            # the pre-ISSUE-10 behavior for the two-lane case).
+            lane = min(candidates, key=lambda l: (preds[l], candidates.index(l)))
             reason = "sched"
-        if lane == "device" and _may_reroute(job) and not self.breaker.allow():
+        if lane in DEVICE_SIDE_LANES and _may_reroute(job) and not self.breaker.allow():
             return "host", "breaker", preds
         return lane, reason, preds
 
@@ -499,7 +551,7 @@ class HeterogeneousScheduler:
         failover path — the escalation ladder's last rung (the
         NEMO_SLOW_DISPATCH_MS watchdog logs, this cancels + fails over)."""
         timeout = dispatch_timeout_s()
-        if lane != "device" or not timeout:
+        if lane not in DEVICE_SIDE_LANES or not timeout:
             return job.execute(lane, reason, stolen)
         box: dict = {}
         done = threading.Event()
@@ -536,10 +588,15 @@ class HeterogeneousScheduler:
 
     def run(self, jobs: list[Job], serial: bool = False) -> list[dict]:
         results: list[dict | None] = [None] * len(jobs)
-        queues: dict[str, deque[Job]] = {lane: deque() for lane in LANES}
+        queues: dict[str, deque[Job]] = {lane: deque() for lane in self.lanes}
         plans: dict[int, tuple[str, str, dict]] = {}
         for job in jobs:
             lane, reason, preds = self.plan(job)
+            if lane not in queues:
+                raise ValueError(
+                    f"job {job.index} planned for lane {lane!r} but the "
+                    f"scheduler models only {self.lanes}"
+                )
             plans[job.index] = (lane, reason, preds)
             queues[lane].append(job)
         obs.metrics.inc("analysis.sched.jobs", len(jobs))
@@ -555,7 +612,7 @@ class HeterogeneousScheduler:
             failed_over = False
             try:
                 res = self._execute_deadline(job, lane, reason, stolen)
-                if lane == "device":
+                if lane in DEVICE_SIDE_LANES:
                     self.breaker.record_success()
             except BaseException as ex:
                 # Lane failover (ISSUE 9): a device-lane INFRASTRUCTURE
@@ -564,14 +621,14 @@ class HeterogeneousScheduler:
                 # instead of failing.  Host-lane failures, programming
                 # errors, operator-FORCED device routes, and device-only
                 # closures (serve-batch launches) propagate.
-                if lane == "device" and is_lane_failure(ex):
+                if lane in DEVICE_SIDE_LANES and is_lane_failure(ex):
                     # Device health signal recorded even when the job
                     # cannot reroute (forced pin, device-only closure):
                     # its failure still means the lane is sick.
                     self.breaker.record_failure()
                     obs.metrics.inc("analysis.sched.lane_failure.device")
                 if (
-                    lane != "device"
+                    lane not in DEVICE_SIDE_LANES
                     or not _may_reroute(job)
                     or not is_lane_failure(ex)
                 ):
@@ -631,32 +688,35 @@ class HeterogeneousScheduler:
 
         def take(lane: str):
             """Pop (job, stolen) for `lane`: its own queue's head, else
-            steal an unpinned job from the other lane's tail.  An idle
-            DEVICE worker consults the circuit breaker before stealing
-            (ISSUE 9): with the breaker open, pulling host-planned work
-            onto the broken lane would bypass the degraded-mode routing —
-            the worker gets the "breaker_wait" sentinel instead (it parks
-            briefly and retries, so when the cooldown elapses mid-drain
-            the then-allowed steal IS the half-open probe).  None = no
-            work left for this lane at all."""
-            other = "host" if lane == "device" else "device"
+            steal from another lane's tail an unpinned job whose execute
+            closure implements this lane (Job.lanes).  An idle
+            DEVICE-SIDE worker consults the circuit breaker before
+            stealing (ISSUE 9): with the breaker open, pulling host-planned
+            work onto the broken lane would bypass the degraded-mode
+            routing — the worker gets the "breaker_wait" sentinel instead
+            (it parks briefly and retries, so when the cooldown elapses
+            mid-drain the then-allowed steal IS the half-open probe).
+            None = no work left for this lane at all."""
             with lock:
                 if queues[lane]:
                     return queues[lane].popleft(), False
-                for i in range(len(queues[other]) - 1, -1, -1):
-                    job = queues[other][i]
-                    if job.pinned is None:
-                        # A stealable job EXISTS — only now consult the
-                        # breaker (peek first: the wait loop must not
-                        # consume the half-open probe, nor count its
-                        # 10 ms polls as short-circuits; allow() takes
-                        # the probe only when the steal really happens).
-                        if lane == "device" and (
-                            not self.breaker.peek() or not self.breaker.allow()
-                        ):
-                            return "breaker_wait"
-                        del queues[other][i]
-                        return job, True
+                for other in self.lanes:
+                    if other == lane:
+                        continue
+                    for i in range(len(queues[other]) - 1, -1, -1):
+                        job = queues[other][i]
+                        if job.pinned is None and lane in job.lanes:
+                            # A stealable job EXISTS — only now consult the
+                            # breaker (peek first: the wait loop must not
+                            # consume the half-open probe, nor count its
+                            # 10 ms polls as short-circuits; allow() takes
+                            # the probe only when the steal really happens).
+                            if lane in DEVICE_SIDE_LANES and (
+                                not self.breaker.peek() or not self.breaker.allow()
+                            ):
+                                return "breaker_wait"
+                            del queues[other][i]
+                            return job, True
             return None
 
         # A job list pinned entirely to ONE lane has no concurrency to win
@@ -698,7 +758,7 @@ class HeterogeneousScheduler:
         with obs.span("analysis:sched", jobs=len(jobs)):
             threads = [
                 threading.Thread(target=worker, args=(lane,), name=f"nemo-sched-{lane}")
-                for lane in LANES
+                for lane in self.lanes
             ]
             for t in threads:
                 t.start()

@@ -277,6 +277,26 @@ async function telemetry() {
     );
   }
 
+  // Analysis routes (backend/jax_backend.py:_analysis_route): dispatches
+  // per (verb, route) — dense, sparse (host CSR), sparse_device (device
+  // CSR, ISSUE 10) — plus the scheduler's per-lane dispatch counts, so a
+  // report states which engine analyzed it.
+  const routeRows = Object.entries(allCounters)
+    .filter(
+      ([k]) =>
+        k.startsWith("analysis.route.") || k.startsWith("analysis.sched.dispatch.")
+    )
+    .sort()
+    .map(([k, v]) => [
+      k
+        .replace("analysis.route.", "route ")
+        .replace("analysis.sched.dispatch.", "sched lane "),
+      v,
+    ]);
+  if (routeRows.length) {
+    body.append(telemetryTable("Analysis routes", routeRows));
+  }
+
   // Memory watermarks (device peaks where the backend exposes them, host
   // peak RSS always).
   const mem = data.memory || {};

@@ -72,6 +72,11 @@ BATCH_RANK = {
 
 #: Verbs whose run-batched outputs are all per-row functions of per-row
 #: inputs (see module docstring for why fused/giant/diff are excluded).
+#: The sparse-CSR device verbs (ISSUE 10: "sparse_fused", "sparse_diff")
+#: are excluded for the fused/diff reasons exactly — sparse_fused carries
+#: the cross-run prototype reductions, sparse_diff diffs every row against
+#: one shared good graph — so they pass through solo like their dense
+#: twins.
 BATCHABLE_VERBS = frozenset(BATCH_RANK)
 
 

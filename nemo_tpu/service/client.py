@@ -541,6 +541,18 @@ class RemoteExecutor:
         elif verb in ("fused", "giant"):
             b, v = np.asarray(arrays["pre_is_goal"]).shape
             est_resp = 2 * b * v * v // 8 + 8 * b * v
+        elif verb == "sparse_fused":
+            # The sparse-CSR device step returns contracted EDGE LISTS
+            # ([B,E] int32 pairs + masks), never a dense [B,V,V] plane —
+            # the upload-narrowing savings compound on the response side.
+            b, v = np.asarray(arrays["pre_is_goal"]).shape
+            e = int(np.asarray(arrays["pre_edge_src"]).shape[1])
+            est_resp = 2 * b * (8 * e + e // 8) + 8 * b * v
+        elif verb == "sparse_diff":
+            f = int(np.asarray(arrays["fail_bits"]).shape[0])
+            v = int(params["v"])
+            e = int(np.asarray(arrays["edge_src"]).shape[0])
+            est_resp = f * (3 * v + e) // 8
         est = max(est_req, est_resp)
         if est > self.MAX_MESSAGE_BYTES:
             raise SidecarError(

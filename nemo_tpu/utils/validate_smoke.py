@@ -743,6 +743,207 @@ def shard_smoke() -> int:
     return 0
 
 
+def sparse_device_smoke() -> int:
+    """Sparse-CSR device-kernel smoke (`make sparse-device-smoke`, also the
+    tail of `make validate`; ISSUE 10):
+
+      * a forced NEMO_ANALYSIS_IMPL=sparse_device pipeline must produce a
+        report tree BYTE-identical to the forced-dense oracle (figures
+        included), with an ``analysis.route.<verb>.sparse_device`` record
+        for every dispatched verb (fused + diff);
+      * a giant-V corpus under the same umbrella must dispatch its giant
+        runs on the DEVICE sparse route (``analysis.route.giant.
+        sparse_device``) — not the host fallback — byte-identical to the
+        host-routed giant run;
+      * two watermark SUBPROCESSES analyzing a giant-V corpus (dense vs
+        sparse_device) must show the sparse route's analysis-phase memory
+        watermark (``mem.host_peak_rss_bytes`` delta — on a CPU container
+        the device buffers ARE host memory) at least 5x below the dense
+        route's.
+    """
+    import subprocess
+
+    from nemo_tpu import obs
+    from nemo_tpu.analysis.pipeline import run_debug
+    from nemo_tpu.backend.jax_backend import JaxBackend
+    from nemo_tpu.models.synth import SynthSpec, write_corpus
+    from nemo_tpu.utils.jax_config import pin_platform
+
+    pin_platform("cpu")
+    # Operator route/kernel pins must not red (or vacuously green) a
+    # healthy validate — the smoke owns these knobs for its duration.
+    prior_knobs = {
+        k: os.environ.pop(k, None)
+        for k in (
+            "NEMO_ANALYSIS_IMPL",
+            "NEMO_ANALYSIS_HOST_WORK",
+            "NEMO_GIANT_IMPL",
+            "NEMO_GIANT_V",
+            "NEMO_SPARSE_WAVE_IMPL",
+            "NEMO_SPARSE_DEVICE_MEM_MB",
+            "NEMO_SPARSE_DEVICE_DENSITY",
+            "NEMO_SPARSE_DEVICE_MIN_V",
+            "NEMO_SCHED",
+            "NEMO_MAX_BATCH",
+            "NEMO_SHARD",
+        )
+    }
+    problems: list[str] = []
+    try:
+        with tempfile.TemporaryDirectory(prefix="nemo_sdev_smoke_") as tmp:
+            os.environ["NEMO_SVG_CACHE"] = os.path.join(tmp, "svg_cache")
+            os.environ["NEMO_CORPUS_CACHE"] = "off"
+            os.environ["NEMO_RESULT_CACHE"] = "off"
+
+            # ---- (a) forced-route byte parity + per-verb route records
+            corpus = write_corpus(SynthSpec(n_runs=6, seed=3), tmp)
+            os.environ["NEMO_ANALYSIS_IMPL"] = "dense"
+            dense = run_debug(
+                corpus, os.path.join(tmp, "dense"), JaxBackend(), figures="all"
+            )
+            t_dense = _tree(dense.report_dir)
+            os.environ["NEMO_ANALYSIS_IMPL"] = "sparse_device"
+            m0 = obs.metrics.snapshot()
+            sd = run_debug(corpus, os.path.join(tmp, "sd"), JaxBackend(), figures="all")
+            mc = obs.Metrics.delta(obs.metrics.snapshot(), m0)["counters"]
+            t_sd = _tree(sd.report_dir)
+            if t_dense.keys() != t_sd.keys():
+                problems.append(
+                    f"(a) file sets diverge: {sorted(t_dense.keys() ^ t_sd.keys())[:5]}"
+                )
+            else:
+                bad = sorted(k for k in t_dense if t_dense[k] != t_sd[k])
+                if bad:
+                    problems.append(
+                        f"(a) sparse-device report DIVERGES from dense in "
+                        f"{len(bad)} file(s), e.g. {bad[:5]}"
+                    )
+            for verb in ("fused", "diff"):
+                if not mc.get(f"analysis.route.{verb}.sparse_device"):
+                    problems.append(
+                        f"(a) no analysis.route.{verb}.sparse_device recorded: "
+                        f"{ {k: v for k, v in mc.items() if 'route' in k} }"
+                    )
+
+            # ---- (b) giant bucket dispatches on DEVICE, not the host hatch
+            giant_dir = write_corpus(
+                SynthSpec(n_runs=5, seed=4, eot=40, name="giantish"), tmp
+            )
+            os.environ["NEMO_GIANT_V"] = "64"
+            os.environ.pop("NEMO_ANALYSIS_IMPL", None)
+            host_run = run_debug(
+                giant_dir, os.path.join(tmp, "giant_host"), JaxBackend(), figures="all"
+            )
+            os.environ["NEMO_ANALYSIS_IMPL"] = "sparse_device"
+            be = JaxBackend()
+            m0 = obs.metrics.snapshot()
+            sd_run = run_debug(
+                giant_dir, os.path.join(tmp, "giant_sd"), be, figures="all"
+            )
+            mg = obs.Metrics.delta(obs.metrics.snapshot(), m0)["counters"]
+            if not mg.get("analysis.route.giant.sparse_device"):
+                problems.append(
+                    f"(b) giant runs did not dispatch on the device sparse "
+                    f"route: { {k: v for k, v in mg.items() if 'giant' in k} }"
+                )
+            if mg.get("analysis.route.giant.sparse"):
+                problems.append("(b) giant runs still took the host fallback")
+            th, ts = _tree(host_run.report_dir), _tree(sd_run.report_dir)
+            bad = sorted(k for k in th if th.get(k) != ts.get(k))
+            if th.keys() != ts.keys() or bad:
+                problems.append(
+                    f"(b) giant sparse-device report diverges from host-routed "
+                    f"in {len(bad)} file(s), e.g. {bad[:5]}"
+                )
+            os.environ.pop("NEMO_ANALYSIS_IMPL", None)
+            os.environ.pop("NEMO_GIANT_V", None)
+
+            # ---- (c) watermark children: sparse >=5x below dense
+            child = r"""
+import json, os, resource, sys, tempfile, time
+impl = sys.argv[1]
+os.environ["NEMO_ANALYSIS_IMPL"] = impl
+os.environ["NEMO_GIANT_V"] = "1024"
+from nemo_tpu.ingest.molly import load_molly_output
+from nemo_tpu.backend.jax_backend import JaxBackend, sample_memory_watermarks
+def rss():
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+molly = load_molly_output(sys.argv[2])
+be = JaxBackend()
+be.init_graph_db("", molly)
+r0 = rss()
+t0 = time.time()
+be._fused()
+wm = sample_memory_watermarks()
+print(json.dumps({
+    "impl": impl,
+    "analysis_peak_delta_bytes": wm["host_peak_rss_bytes"] - r0,
+    "device_peak_bytes": wm.get("device_peak_bytes"),
+    "wall_s": round(time.time() - t0, 2),
+}))
+"""
+            wm_dir = write_corpus(
+                SynthSpec(n_runs=3, seed=3, eot=4800, name="giantv"), tmp
+            )
+            deltas: dict[str, dict] = {}
+            for impl in ("sparse_device", "dense"):
+                env = dict(os.environ, JAX_PLATFORMS="cpu")
+                proc = subprocess.run(
+                    [sys.executable, "-c", child, impl, wm_dir],
+                    capture_output=True,
+                    text=True,
+                    timeout=600,
+                    env=env,
+                )
+                if proc.returncode != 0:
+                    problems.append(
+                        f"(c) {impl} watermark child failed rc={proc.returncode}: "
+                        f"{proc.stderr[-500:]}"
+                    )
+                    continue
+                deltas[impl] = json.loads(proc.stdout.strip().splitlines()[-1])
+            if len(deltas) == 2:
+                d_dense = deltas["dense"]["analysis_peak_delta_bytes"]
+                d_sparse = deltas["sparse_device"]["analysis_peak_delta_bytes"]
+                if d_sparse * 5 > d_dense:
+                    problems.append(
+                        f"(c) sparse-device watermark not 5x below dense: "
+                        f"dense {d_dense >> 20} MB vs sparse {max(d_sparse, 0) >> 20} MB"
+                    )
+    finally:
+        for k in (
+            "NEMO_ANALYSIS_IMPL",
+            "NEMO_GIANT_IMPL",
+            "NEMO_GIANT_V",
+        ):
+            os.environ.pop(k, None)
+        for k, v in prior_knobs.items():
+            if v is not None:
+                os.environ[k] = v
+    if problems:
+        print("sparse-device-smoke: " + "; ".join(problems), file=sys.stderr)
+        return 1
+    # Readout ratio floors the sparse delta at 1 MB: a sparse analysis that
+    # never grew the process peak at all (the common case — ingest already
+    # peaked higher) would otherwise print a meaningless astronomic ratio.
+    ratio = (
+        deltas["dense"]["analysis_peak_delta_bytes"]
+        / max(deltas["sparse_device"]["analysis_peak_delta_bytes"], 1 << 20)
+        if len(deltas) == 2
+        else float("nan")
+    )
+    print(
+        "sparse-device-smoke: ok — forced sparse_device report byte-identical "
+        "to dense (routes recorded for fused+diff), giant runs dispatched on "
+        f"the device sparse route, and the giant-V watermark dropped {ratio:.1f}x "
+        f"(dense {deltas['dense']['analysis_peak_delta_bytes'] >> 20} MB wall "
+        f"{deltas['dense']['wall_s']} s vs sparse "
+        f"{deltas['sparse_device']['analysis_peak_delta_bytes'] >> 20} MB wall "
+        f"{deltas['sparse_device']['wall_s']} s)"
+    )
+    return 0
+
+
 def serve_smoke() -> int:
     """Serving-tier smoke (`make serve-smoke`, also the tail of `make
     validate`; ISSUE 8): boot a `--max-inflight 2` sidecar SUBPROCESS and
@@ -1423,6 +1624,13 @@ def main() -> int:
     rc = delta_smoke()
     if rc:
         return rc
+    # Sparse-CSR device-kernel contract (also standalone: make
+    # sparse-device-smoke; ISSUE 10): forced sparse_device byte-identical
+    # to the dense oracle with every verb's route recorded, giant runs on
+    # the device sparse route, giant-V watermark >=5x below dense.
+    rc = sparse_device_smoke()
+    if rc:
+        return rc
     # Serving-tier contract (also standalone: make serve-smoke): concurrent
     # identical requests coalesce into one analysis with byte-equal
     # responses, serve.* metrics live, SIGTERM drains cleanly.
@@ -1447,6 +1655,8 @@ if __name__ == "__main__":
         sys.exit(delta_smoke())
     if "--shard-smoke" in sys.argv:
         sys.exit(shard_smoke())
+    if "--sparse-device-smoke" in sys.argv:
+        sys.exit(sparse_device_smoke())
     if "--serve-smoke" in sys.argv:
         sys.exit(serve_smoke())
     if "--chaos-smoke" in sys.argv:

@@ -495,6 +495,11 @@ def test_kernel_cost_accounting_and_slow_dispatch_watchdog(
     path = str(tmp_path / "log.jsonl")
     monkeypatch.setenv("NEMO_LOG_FILE", path)
     monkeypatch.setenv("NEMO_ANALYSIS_IMPL", "dense")  # force executor dispatches
+    # Single-device: this test pins the cost-accounting/watchdog contract,
+    # not the mesh path — under the suite's 8-virtual-device shard default
+    # the packed gather makes warm dispatch walls hover at the 1 ms
+    # watchdog threshold, which is exactly the flake this pin removes.
+    monkeypatch.setenv("NEMO_SHARD", "0")
     monkeypatch.setenv("NEMO_SLOW_DISPATCH_MS", "1")
     before = obs.metrics.snapshot()
     res = run_debug(corpus_dir, str(tmp_path / "res"), jb.JaxBackend(), figures="none")

@@ -186,11 +186,21 @@ def test_pack_out_default_env_parsing(monkeypatch):
         assert _pack_out_default() == want, v
     monkeypatch.setenv("NEMO_PACK_XFER", "banana")
     import jax
-    default = int(jax.default_backend() != "cpu")
+
+    from nemo_tpu.parallel.mesh import shard_plan
+
+    # The backend default is shard-aware since ISSUE 10: a placing run
+    # mesh bit-packs the summaries so the shard gather ships one small
+    # vector (on this 8-virtual-device suite, auto places -> default 1).
+    default = int(jax.default_backend() != "cpu" or shard_plan()[0])
     with warnings.catch_warnings(record=True) as w:
         warnings.simplefilter("always")
         assert _pack_out_default() == default
     assert any("NEMO_PACK_XFER" in str(x.message) for x in w)
+    monkeypatch.setenv("NEMO_PACK_XFER", "")
+    monkeypatch.setenv("NEMO_SHARD", "0")
+    if jax.default_backend() == "cpu":
+        assert _pack_out_default() == 0, "no mesh, CPU: pack_out off"
 
 
 def test_narrowed_dispatch_parity(tmp_path, monkeypatch):

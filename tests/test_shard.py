@@ -115,6 +115,89 @@ def test_padding_rows_excluded_from_cost_accounting(monkeypatch):
     assert 8 - 5 in {r["pad_rows"] for r in recs}
 
 
+def test_shard_multiple_folds_into_bucketizer(monkeypatch):
+    """ISSUE 10 satellite (ROADMAP 3b): the bucketizer's run-axis pad
+    rounds up to the mesh width, so pad_place_named_arrays places batches
+    with ZERO host-side copies on the hot path."""
+    from nemo_tpu.graphs.packed import _pad_run_axis
+
+    assert _pad_run_axis(3, None, 1) == 8  # power-of-two floor, no mesh
+    assert _pad_run_axis(3, 3, 1) == 3  # max_batch cap
+    assert _pad_run_axis(3, 3, 8) == 8  # mesh multiple past the cap
+    assert _pad_run_axis(10, None, 4) == 16  # pow2 already a multiple
+    assert _pad_run_axis(12, 12, 8) == 16
+
+    # Zero-copy placement: a batch already at the mesh multiple goes
+    # straight to device_put; a non-multiple one pays the counted pad.
+    from nemo_tpu.backend.jax_backend import _BA_FIELDS
+    from nemo_tpu.models.pipeline_model import synth_batch_arrays
+    from nemo_tpu.parallel.mesh import pad_place_named_arrays
+
+    pre, post, _ = synth_batch_arrays(n_runs=8, seed=2)
+    arrays = {
+        f"{p}_{f}": np.asarray(getattr(b, f))
+        for p, b in (("pre", pre), ("post", post))
+        for f in _BA_FIELDS
+    }
+
+    def pads() -> int:
+        return obs.metrics.snapshot()["counters"].get("analysis.shard.pad_copies", 0)
+
+    before = pads()
+    _, b_pad = pad_place_named_arrays(arrays, 8, 4)
+    assert b_pad == 8 and pads() == before, "multiple-of-mesh batch still copied"
+    _, b_pad = pad_place_named_arrays(arrays, 7, 4)
+    assert b_pad == 8 and pads() == before + 1, "non-multiple pad not counted"
+
+
+def test_zero_copy_placement_through_fused_drain(corpus_dir, tmp_path, monkeypatch):
+    """End to end: a sharded dense run's batches leave bucketize_pairs
+    already mesh-multiple, so the drain records zero pad copies."""
+    from nemo_tpu.analysis.pipeline import run_debug
+    from nemo_tpu.backend.jax_backend import JaxBackend
+
+    monkeypatch.setenv("NEMO_ANALYSIS_IMPL", "dense")
+    monkeypatch.setenv("NEMO_SHARD", "1")
+    monkeypatch.setenv("NEMO_MAX_BATCH", "3")  # non-divisible bucket widths
+    m0 = obs.metrics.snapshot()
+    run_debug(corpus_dir, str(tmp_path / "zc"), JaxBackend(), figures="none")
+    mc = obs.Metrics.delta(obs.metrics.snapshot(), m0)["counters"]
+    assert mc.get("kernel.sharded_dispatches"), "mesh path did not engage"
+    assert not mc.get("analysis.shard.pad_copies"), (
+        "sharded placement copied on the hot path despite the bucketizer fold"
+    )
+
+
+def test_sharded_gather_defaults_to_packed_summaries(monkeypatch):
+    """ISSUE 10 satellite (ROADMAP 3b): under a placing mesh the per-run
+    bool summaries default to ONE bit-packed uint8 vector per bucket
+    (pack_out), shrinking the gathered bytes ~8x; the unpack happens
+    host-side after the timed gather."""
+    from nemo_tpu.backend.jax_backend import LocalExecutor, _pack_out_default
+
+    arrays, params = _fused_call(6, 0)
+    params = {k: v for k, v in params.items() if k != "pack_out"}
+    params["with_diff"] = 0
+    ex = LocalExecutor()
+    monkeypatch.setenv("NEMO_SHARD", "1")
+    monkeypatch.setenv("NEMO_SHARD_DEVICES", "4")
+    assert _pack_out_default() == 1, "placing mesh must default pack_out on"
+
+    def gather_bytes(run_params) -> int:
+        m0 = obs.metrics.snapshot()["counters"].get("analysis.shard.gather_bytes", 0)
+        out = ex.run("fused", dict(arrays), dict(run_params))
+        assert "packed_summary" not in out, "unpack must still happen"
+        return obs.metrics.snapshot()["counters"].get(
+            "analysis.shard.gather_bytes", 0
+        ) - m0
+
+    packed = gather_bytes(params)  # pack_out defaulted on
+    unpacked = gather_bytes(dict(params, pack_out=0))
+    assert 0 < packed < unpacked, (packed, unpacked)
+    monkeypatch.setenv("NEMO_SHARD", "0")
+    assert _pack_out_default() == 0, "no mesh, CPU: pack_out stays off"
+
+
 # ---------------------------------------------------------------------------
 # report-tree parity across mesh widths
 # ---------------------------------------------------------------------------
@@ -282,6 +365,44 @@ def test_worker_exception_propagates():
     jobs = [_job(0, body=boom)]
     with pytest.raises(RuntimeError, match="lane exploded"):
         sched_mod.HeterogeneousScheduler(_models()).run(jobs)
+
+
+def test_sched_device_hint_normalizes_per_row(monkeypatch):
+    """The cost-class hint prices a job per ROW of the costed signature:
+    the class key shares one (verb,V,E) across batch widths, so a hint
+    derived from a wide dispatch must not overprice a narrow bucket by the
+    width ratio (the regression that routed every tiny crossover bucket
+    off the device lane after an unrelated wide dense run)."""
+    from nemo_tpu.backend import jax_backend as jb
+
+    monkeypatch.delenv("NEMO_SCHED_FLOPS_PER_S", raising=False)
+    key = ("fused", 16, 16)
+    prior = jb._COST_BY_CLASS.get(key)
+    try:
+        jb._COST_BY_CLASS[key] = ({"flops": 1.0e6}, 8)  # costed at B=8
+        narrow = sched_mod.Job(
+            index=0, verb="fused", rows=2, v=16, e=16, work=64, execute=None
+        )
+        wide = sched_mod.Job(
+            index=1, verb="fused", rows=8, v=16, e=16, work=256, execute=None
+        )
+        h2, h8 = jb.sched_device_hint(narrow), jb.sched_device_hint(wide)
+        assert h8 == pytest.approx(1.0e6 / 5e9)
+        assert h2 == pytest.approx(h8 / 4), "hint did not scale per row"
+        # ... and by the DISPATCHED width when known: a 1-real-row job
+        # padded to 8 pays the full 8-row program.
+        padded = sched_mod.Job(
+            index=2, verb="fused", rows=1, v=16, e=16, work=32,
+            execute=None, rows_dispatch=8,
+        )
+        assert jb.sched_device_hint(padded) == pytest.approx(h8)
+        jb._COST_BY_CLASS[key] = ({"flops": None}, 8)
+        assert jb.sched_device_hint(narrow) is None
+    finally:
+        if prior is None:
+            jb._COST_BY_CLASS.pop(key, None)
+        else:
+            jb._COST_BY_CLASS[key] = prior
 
 
 def test_sched_env_parse(monkeypatch):

@@ -1,0 +1,439 @@
+"""Device-native sparse-CSR kernels (ISSUE 10): the sparse-device step must
+reproduce the dense fused step AND the sparse host engine bit-for-bit on
+every output plane — across every case-study family, the generative stress
+shapes (deep chains, wide fan-out, all-failed), and the non-linear zigzag
+members — with the pallas wave kernel bit-identical to the XLA scatter
+waves, the forced route byte-equal to the python_ref oracle end to end,
+and the density/memory crossover + env resolution pinned by units."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from nemo_tpu.ingest.molly import load_molly_output
+from nemo_tpu.models.case_studies import CASE_STUDIES, write_case_study
+from nemo_tpu.models.pipeline_model import analysis_step, pack_molly_for_step
+from nemo_tpu.models.synth import SynthSpec, write_corpus
+from nemo_tpu.ops.sparse_device import (
+    CsrAdjRows,
+    diff_masks_sparse_device,
+    resolve_wave_impl,
+    sparse_device_step,
+)
+
+
+def _sparse_device_out(pre, post, static, wave_impl=None):
+    """sparse_device_step adapted to the fused step's output keys (the
+    contracted edge lists densified through CsrAdjRows, exactly as the
+    backend consumes them)."""
+    out = dict(
+        sparse_device_step(
+            pre,
+            post,
+            v=static["v"],
+            pre_tid=static["pre_tid"],
+            post_tid=static["post_tid"],
+            num_tables=static["num_tables"],
+            comp_linear=static["comp_linear"],
+            wave_impl=wave_impl,
+        )
+    )
+    for cond in ("pre", "post"):
+        out[f"{cond}_adj_clean"] = np.asarray(
+            CsrAdjRows(
+                out.pop(f"{cond}_clean_src"),
+                out.pop(f"{cond}_clean_dst"),
+                out.pop(f"{cond}_clean_mask"),
+                v=static["v"],
+            )
+        )
+    return out
+
+
+def _assert_three_way_parity(pre, post, static, label, wave_impl=None):
+    """sparse-device == dense == sparse-host, every output plane."""
+    from nemo_tpu.ops.sparse_host import sparse_analysis_step
+
+    dense = analysis_step(pre, post, with_diff=False, **static)
+    host = sparse_analysis_step(pre, post, **static)
+    dev = _sparse_device_out(pre, post, static, wave_impl=wave_impl)
+    assert sorted(dense) == sorted(dev), label
+    for k in sorted(dense):
+        np.testing.assert_array_equal(
+            np.asarray(dense[k]), np.asarray(dev[k]), err_msg=f"{label} dev: {k}"
+        )
+        np.testing.assert_array_equal(
+            np.asarray(host[k]), np.asarray(dev[k]), err_msg=f"{label} host: {k}"
+        )
+
+
+# ------------------------------------------------------- per-verb parity
+
+
+@pytest.mark.parametrize("name", sorted(CASE_STUDIES))
+def test_sparse_device_matches_dense_case_studies(name, tmp_path):
+    """Every output key, every case-study family, against BOTH the dense
+    step and the sparse host engine."""
+    d = write_case_study(name, n_runs=8, seed=11, out_dir=str(tmp_path))
+    pre, post, static = pack_molly_for_step(load_molly_output(d))
+    _assert_three_way_parity(pre, post, static, name)
+
+
+@pytest.mark.parametrize(
+    "spec",
+    [
+        SynthSpec(n_runs=8, seed=2, eot=6),  # all four run kinds
+        SynthSpec(n_runs=3, seed=5, eot=60, name="deep"),  # deep chains
+        SynthSpec(n_runs=6, seed=7, fail_all_fraction=0.9, name="failall"),
+        SynthSpec(n_runs=5, seed=4, first_run_kind="fail", name="badfirst"),
+    ],
+    ids=lambda s: s.name + f"_s{s.seed}",
+)
+def test_sparse_device_matches_dense_synth(spec, tmp_path):
+    d = write_corpus(spec, str(tmp_path))
+    pre, post, static = pack_molly_for_step(load_molly_output(d))
+    _assert_three_way_parity(pre, post, static, spec.name)
+
+
+def test_sparse_device_matches_dense_zigzag(tmp_path):
+    """Non-linear member structure (comp_linear=False): the fix-point
+    min-label relaxation must agree with the dense all-pairs closure
+    labels — no depth bound covers a zigzag's undirected diameter."""
+    from tests.test_giant_nonlinear import _zigzag_prov
+
+    d = tmp_path / "zigzag"
+    d.mkdir()
+    with open(d / "runs.json", "w") as f:
+        json.dump([{"iteration": 0, "status": "success"}], f)
+    for cond in ("pre", "post"):
+        with open(d / f"run_0_{cond}_provenance.json", "w") as f:
+            json.dump(_zigzag_prov(cond), f)
+    pre, post, static = pack_molly_for_step(load_molly_output(str(d)))
+    assert not static["comp_linear"], "zigzag must reject the linear fast path"
+    _assert_three_way_parity(pre, post, static, "zigzag")
+
+
+def test_pallas_wave_matches_xla(tmp_path):
+    """The fused VMEM wave kernel (interpreter mode off-TPU) is
+    bit-identical to the XLA scatter waves through the whole step."""
+    d = write_corpus(SynthSpec(n_runs=6, seed=9, eot=12), str(tmp_path))
+    pre, post, static = pack_molly_for_step(load_molly_output(d))
+    xla = _sparse_device_out(pre, post, static, wave_impl="xla")
+    pal = _sparse_device_out(pre, post, static, wave_impl="pallas")
+    for k in sorted(xla):
+        np.testing.assert_array_equal(
+            np.asarray(xla[k]), np.asarray(pal[k]), err_msg=f"pallas wave: {k}"
+        )
+
+
+def test_edge_wave_pallas_unit():
+    """Direct kernel unit: fused n-step propagation == n sequential XLA
+    pushes on a hand-built graph (monotone >=0-hop semantics)."""
+    import jax.numpy as jnp
+
+    from nemo_tpu.ops.pallas_kernels import edge_wave_pallas
+    from nemo_tpu.ops.sparse_device import _push_any
+
+    rng = np.random.default_rng(3)
+    b, v, e = 5, 16, 24
+    src = jnp.asarray(rng.integers(0, v, (b, e)))
+    dst = jnp.asarray(rng.integers(0, v, (b, e)))
+    mask = jnp.asarray(rng.random((b, e)) < 0.7)
+    state = jnp.asarray(rng.random((b, v)) < 0.2)
+    want = state
+    for _ in range(3):
+        want = want | _push_any(want, src, dst, mask, v)
+    got = edge_wave_pallas(state, src, dst, mask, n_steps=3, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_diff_masks_sparse_device_parity():
+    """The sparse-device diff verb == the dense diff kernel (edge_keep
+    densified through the shared edge list)."""
+    from nemo_tpu.models.pipeline_model import synth_batch_arrays
+    from nemo_tpu.ops.adjacency import build_adjacency
+    from nemo_tpu.ops.diff import diff_masks
+
+    pre, post, static = synth_batch_arrays(n_runs=10, seed=3)
+    v = static["v"]
+    rng = np.random.default_rng(0)
+    fail_bits = rng.random((6, 8)) < 0.4
+    adj_good = build_adjacency(post.edge_src, post.edge_dst, post.edge_mask, v)[0]
+    nk, ek, fr, mg = diff_masks(
+        adj_good,
+        post.is_goal[0],
+        post.node_mask[0],
+        post.label_id[0],
+        np.asarray(fail_bits),
+        static["max_depth"],
+    )
+    nk2, ek2, fr2, mg2 = diff_masks_sparse_device(
+        post.edge_src[0],
+        post.edge_dst[0],
+        post.edge_mask[0],
+        post.is_goal[0],
+        post.node_mask[0],
+        np.asarray(post.label_id[0]),
+        fail_bits,
+        v,
+    )
+    src = np.asarray(post.edge_src[0])
+    dst = np.asarray(post.edge_dst[0])
+    ek2d = np.zeros((6, v, v), dtype=bool)
+    ekn = np.asarray(ek2)
+    for j in range(6):
+        ek2d[j, src[ekn[j]], dst[ekn[j]]] = True
+    np.testing.assert_array_equal(np.asarray(nk), np.asarray(nk2))
+    np.testing.assert_array_equal(np.asarray(ek), ek2d)
+    np.testing.assert_array_equal(np.asarray(fr), np.asarray(fr2))
+    np.testing.assert_array_equal(np.asarray(mg), np.asarray(mg2))
+
+
+def test_diff_sparse_device_terminates_on_cycles():
+    """A schema-valid but CYCLIC consequent graph must terminate (the
+    max-plus longest-path loop is capped at v, like the dense kernel's
+    bounded fori and the host Kahn wave) instead of wedging the dispatch."""
+    v = 8
+    src = np.array([0, 2, 3, 4, 2])
+    dst = np.array([2, 3, 4, 2, 1])  # 2 -> 3 -> 4 -> 2 cycle
+    mask = np.ones(5, dtype=bool)
+    is_goal = np.array([True, True, False, False, False, False, False, False])
+    node_mask = np.array([True] * 5 + [False] * 3)
+    label_id = np.array([0, 1, 2, 3, 4, -1, -1, -1])
+    fail_bits = np.zeros((2, 8), dtype=bool)
+    fail_bits[0, 1] = True  # goal 1's label missing from failed run 0
+    nk, ek, fr, mg = diff_masks_sparse_device(
+        src, dst, mask, is_goal, node_mask, label_id, fail_bits, v
+    )
+    assert np.asarray(nk).shape == (2, v)  # terminated, shapes sane
+    assert np.asarray(ek).shape == (2, 5)
+
+
+def test_csr_adj_rows_views():
+    """The lazy densifier serves both backend access patterns — int row
+    and fancy row-array — without building the whole [B,V,V] plane."""
+    src = np.array([[0, 1, 0], [2, 2, 0]])
+    dst = np.array([[1, 2, 0], [3, 1, 0]])
+    mask = np.array([[True, True, False], [True, False, False]])
+    adj = CsrAdjRows(src, dst, mask, v=4)
+    assert adj.shape == (2, 4, 4) and len(adj) == 2
+    row0 = adj[0]
+    assert row0[0, 1] and row0[1, 2] and row0.sum() == 2
+    rows = adj[np.asarray([1, 0])]
+    assert rows.shape == (2, 4, 4)
+    assert rows[0][2, 3] and rows[0].sum() == 1
+
+
+# -------------------------------------------------- routing + e2e parity
+
+
+def _report(res):
+    with open(os.path.join(res.report_dir, "debugging.json")) as f:
+        return json.load(f)
+
+
+@pytest.fixture(scope="module")
+def route_corpus(tmp_path_factory):
+    return write_corpus(
+        SynthSpec(n_runs=8, seed=2, eot=6), str(tmp_path_factory.mktemp("route"))
+    )
+
+
+def test_forced_sparse_device_matches_oracle(route_corpus, tmp_path, monkeypatch):
+    """NEMO_ANALYSIS_IMPL=sparse_device forces fused AND diff through the
+    device CSR engine: the report tree must byte-equal the forced-dense
+    tree, debugging.json must equal the python_ref oracle, and every
+    routed verb must be recorded under the sparse_device route."""
+    from nemo_tpu import obs
+    from nemo_tpu.analysis.pipeline import report_tree_bytes, run_debug
+    from nemo_tpu.backend.jax_backend import JaxBackend
+    from nemo_tpu.backend.python_ref import PythonBackend
+
+    py = run_debug(route_corpus, str(tmp_path / "py"), PythonBackend(), figures="none")
+    monkeypatch.setenv("NEMO_ANALYSIS_IMPL", "dense")
+    dense = run_debug(route_corpus, str(tmp_path / "dense"), JaxBackend(), figures="all")
+    monkeypatch.setenv("NEMO_ANALYSIS_IMPL", "sparse_device")
+    be = JaxBackend()
+    m0 = obs.metrics.snapshot()
+    sd = run_debug(route_corpus, str(tmp_path / "sd"), be, figures="all")
+    mc = obs.Metrics.delta(obs.metrics.snapshot(), m0)["counters"]
+
+    assert _report(sd) == _report(py)
+    td, ts = report_tree_bytes(dense.report_dir), report_tree_bytes(sd.report_dir)
+    assert td.keys() == ts.keys()
+    assert not [k for k in td if td[k] != ts[k]]
+    for verb in ("fused", "diff"):
+        assert mc.get(f"analysis.route.{verb}.sparse_device"), mc
+    assert mc.get("kernel.dispatches.sparse_fused")
+    assert mc.get("kernel.dispatches.sparse_diff")
+    routes = [r for r in be.analysis_routes if r["verb"] == "fused"]
+    assert routes and all(
+        (r["route"], r["reason"]) == ("sparse_device", "forced") for r in routes
+    )
+
+
+def test_giant_route_sparse_device(tmp_path, monkeypatch):
+    """NEMO_GIANT_IMPL=sparse_device keeps giant runs on the device CSR
+    engine, byte-identical to the host giant route."""
+    from nemo_tpu.analysis.pipeline import report_tree_bytes, run_debug
+    from nemo_tpu.backend.jax_backend import JaxBackend
+
+    d = write_corpus(SynthSpec(n_runs=5, seed=4, eot=40), str(tmp_path))
+    monkeypatch.setenv("NEMO_GIANT_V", "64")
+    host = run_debug(d, str(tmp_path / "host"), JaxBackend(), figures="all")
+    monkeypatch.setenv("NEMO_GIANT_IMPL", "sparse_device")
+    be = JaxBackend()
+    sd = run_debug(d, str(tmp_path / "sd"), be, figures="all")
+    assert be.giant_impl_used == "sparse_device"
+    th, ts = report_tree_bytes(host.report_dir), report_tree_bytes(sd.report_dir)
+    assert th.keys() == ts.keys()
+    assert not [k for k in th if th[k] != ts[k]]
+    giant_routes = [r for r in be.analysis_routes if r["verb"] == "giant"]
+    assert giant_routes and all(r["route"] == "sparse_device" for r in giant_routes)
+
+
+# ------------------------------------------------- crossover / env units
+
+
+def test_analysis_impl_env_accepts_sparse_device(monkeypatch):
+    from nemo_tpu.backend.jax_backend import _analysis_impl_env
+
+    monkeypatch.setenv("NEMO_ANALYSIS_IMPL", "sparse_device")
+    assert _analysis_impl_env() == "sparse_device"
+    monkeypatch.setenv("NEMO_ANALYSIS_IMPL", "sparse-device")
+    with pytest.raises(ValueError):
+        _analysis_impl_env()
+
+
+def test_giant_impl_resolution_order(monkeypatch):
+    """Resolution order (ISSUE 10 satellite): umbrella first, then
+    device-sparse on a real device, host on the CPU fallback."""
+    from nemo_tpu.backend import jax_backend as jb
+
+    monkeypatch.delenv("NEMO_GIANT_IMPL", raising=False)
+    monkeypatch.delenv("NEMO_ANALYSIS_IMPL", raising=False)
+    assert jb._giant_impl_default() == "host"  # CPU platform
+    monkeypatch.setattr(jb.jax, "default_backend", lambda: "tpu")
+    assert jb._giant_impl_default() == "sparse_device"  # device-sparse first
+    monkeypatch.setenv("NEMO_ANALYSIS_IMPL", "dense")
+    assert jb._giant_impl_default() == "device"
+    monkeypatch.setenv("NEMO_ANALYSIS_IMPL", "sparse_device")
+    assert jb._giant_impl_default() == "sparse_device"
+    monkeypatch.setenv("NEMO_GIANT_IMPL", "device")
+    assert jb._giant_impl_default() == "device"  # explicit pin wins
+    monkeypatch.setenv("NEMO_GIANT_IMPL", "junk")
+    with pytest.raises(ValueError):
+        jb._giant_impl_default()
+
+
+def _route_backend(monkeypatch, **knobs):
+    from nemo_tpu.backend.jax_backend import JaxBackend
+
+    be = JaxBackend()
+    be._analysis_impl = knobs.pop("impl", "crossover")
+    be._analysis_host_work = knobs.pop("host_work", 1000)
+    be._sparse_device_mem = knobs.pop("mem", 256_000_000)
+    be._sparse_device_density = knobs.pop("density", 1.0 / 256.0)
+    be._sparse_device_min_v = knobs.pop("min_v", 1024)
+    assert not knobs
+    return be
+
+
+def test_density_and_memory_crossover(monkeypatch):
+    """The auto device route's three-step decision: host below the work
+    budget, sparse_device past the dense memory watermark or below the
+    density crossover (with the V floor), dense otherwise."""
+    monkeypatch.delenv("NEMO_ANALYSIS_IMPL", raising=False)
+    be = _route_backend(monkeypatch)
+    assert be._analysis_route(4, 16, 16)[0] == "sparse"  # tiny: host
+    assert be._analysis_route(1024, 64, 256)[:2] == ("dense", "crossover")
+    # density: V past the floor, E far below density*V^2
+    assert be._analysis_route(8, 2048, 2048)[:2] == ("sparse_device", "density")
+    # the V floor keeps tiny-V buckets dense regardless of density
+    assert be._analysis_route(4096, 64, 16)[:2] == ("dense", "crossover")
+    # memory watermark: rows * V^2 * 4 past the budget
+    be2 = _route_backend(monkeypatch, mem=1_000_000, density=0.0)
+    assert be2._analysis_route(64, 1024, 65536)[:2] == ("sparse_device", "mem")
+    # ... priced at the PADDED dispatch width: 1 real row under the budget
+    # but padded 8-wide past it must still route off the dense lane.
+    be_pad = _route_backend(monkeypatch, mem=4 * 1024 * 1024 * 4, density=0.0)
+    assert be_pad._analysis_route(1, 1024, 65536)[:2] == ("dense", "crossover")
+    assert be_pad._analysis_route(1, 1024, 65536, rows_dispatch=8)[:2] == (
+        "sparse_device",
+        "mem",
+    )
+    # knobs off: 0 disables both sparse-device triggers
+    be3 = _route_backend(monkeypatch, mem=0, density=0.0)
+    assert be3._analysis_route(64, 4096, 4096)[:2] == ("dense", "crossover")
+    # forced impl wins regardless
+    monkeypatch.setenv("NEMO_ANALYSIS_IMPL", "sparse_device")
+    be4 = _route_backend(monkeypatch, impl="sparse_device")
+    assert be4._analysis_route(4, 16, 16)[:2] == ("sparse_device", "forced")
+
+
+def test_resolve_wave_impl(monkeypatch):
+    monkeypatch.delenv("NEMO_SPARSE_WAVE_IMPL", raising=False)
+    assert resolve_wave_impl() == "xla"
+    monkeypatch.setenv("NEMO_SPARSE_WAVE_IMPL", "pallas")
+    assert resolve_wave_impl() == "pallas"
+    monkeypatch.setenv("NEMO_SPARSE_WAVE_IMPL", "mosaic")
+    with pytest.raises(ValueError):
+        resolve_wave_impl()
+
+
+# ------------------------------------------------- scheduler third lane
+
+
+def test_scheduler_mixes_three_lanes():
+    """A 3-lane model scheduler plans per the cost model across all lanes
+    a job offers, and jobs that only implement two lanes never plan or
+    steal onto the third."""
+    from nemo_tpu.parallel import sched as sched_mod
+
+    models = {
+        "device": sched_mod.LaneModel(0.1, 5e-8),
+        "sparse_device": sched_mod.LaneModel(0.0, 1e-7),
+        "host": sched_mod.LaneModel(0.0, 1e-6),
+    }
+    s = sched_mod.HeterogeneousScheduler(models)
+    assert s.lanes == ("device", "sparse_device", "host")
+
+    def job(i, work, lanes):
+        return sched_mod.Job(
+            index=i, verb="fused", rows=work // 32, v=16, e=16, work=work,
+            execute=lambda lane, reason, stolen: {"lane": lane}, lanes=lanes,
+        )
+
+    three = job(0, 500_000, ("device", "sparse_device", "host"))
+    # sparse_device: 0 fixed + 1e-7*5e5 = 0.05 < device 0.125 < host 0.5
+    assert s.plan(three)[0] == "sparse_device"
+    two = job(1, 500_000, ("device", "host"))
+    assert s.plan(two)[0] == "device", "a two-lane job must ignore the third lane"
+    # Executed lanes may differ from plans (idle lanes steal), but every
+    # execution must stay within the job's declared lane set.
+    res = s.run([three, two])
+    assert res[0]["lane"] in ("device", "sparse_device", "host")
+    assert res[1]["lane"] in ("device", "host"), "steal violated Job.lanes"
+    # Serial mode executes exactly the planned lanes — the deterministic
+    # check that the 3-lane cost model drives placement.
+    s2 = sched_mod.HeterogeneousScheduler(models)
+    res2 = s2.run(
+        [job(0, 500_000, ("device", "sparse_device", "host")), job(1, 500_000, ("device", "host"))],
+        serial=True,
+    )
+    assert [r["lane"] for r in res2] == ["sparse_device", "device"]
+    assert s2.dispatched["sparse_device"] == 1
+
+
+def test_route_of_lane_vocabulary():
+    from nemo_tpu.parallel import sched as sched_mod
+
+    assert sched_mod.ROUTE_OF_LANE["sparse_device"] == "sparse_device"
+    assert sched_mod.LANE_OF_ROUTE["sparse_device"] == "sparse_device"
+    assert sched_mod.LANE_OF_ROUTE["sparse"] == "host"
+    assert sched_mod.LANE_OF_ROUTE["dense"] == "device"
+    assert "sparse_device" in sched_mod.DEVICE_SIDE_LANES

@@ -181,6 +181,37 @@ def extract_metrics(doc: dict) -> dict[str, tuple[float, str, str]]:
     # never flag the 0 -> N jump this metric exists to catch (any shift
     # past the threshold count flags, in either direction).
     put("serve_tier.rejects", sv.get("rejects"), "split", "ratio")
+    # Sparse-device tier (ISSUE 10): either route's wall creeping up, the
+    # sparse route's watermark growing, or the giant-V watermark ratio
+    # (the memory win the route exists for) collapsing all flag.  Walls
+    # get the "s_fast" floor; the ratio is already normalized.
+    sd = doc.get("sparse_device_tier") or {}
+    for label, row in sorted(sd.items()):
+        if isinstance(row, dict):
+            put(
+                f"sparse_device_tier.{label}.dense_wall_s",
+                row.get("dense_wall_s"),
+                "lower",
+                "s_fast",
+            )
+            put(
+                f"sparse_device_tier.{label}.sparse_device_wall_s",
+                row.get("sparse_device_wall_s"),
+                "lower",
+                "s_fast",
+            )
+            put(
+                f"sparse_device_tier.{label}.sparse_device_peak_mb",
+                row.get("sparse_device_peak_mb"),
+                "lower",
+                "mb",
+            )
+    put(
+        "sparse_device_tier.giant_v.watermark_ratio",
+        (sd.get("giant_v") or {}).get("watermark_ratio"),
+        "higher",
+        "ratio",
+    )
     figures = doc.get("figures") or {}
     put(
         "figures.e2e_warm_all_figures_s",
@@ -200,7 +231,7 @@ def extract_metrics(doc: dict) -> dict[str, tuple[float, str, str]]:
     by_verb: dict[str, dict[str, float]] = {}
     for key, n in routes.items():
         verb, _, route = key.partition(".")
-        if route in ("sparse", "dense"):
+        if route in ("sparse", "dense", "sparse_device"):
             by_verb.setdefault(verb, {})[route] = float(n)
     for verb, counts in by_verb.items():
         total = sum(counts.values())
@@ -211,6 +242,16 @@ def extract_metrics(doc: dict) -> dict[str, tuple[float, str, str]]:
                 "split",
                 "ratio",
             )
+            # The ISSUE-10 third route gets its own split signal, but only
+            # once it has ever been taken — an all-dense history must not
+            # grow a constant-zero metric per verb.
+            if counts.get("sparse_device"):
+                put(
+                    f"route.{verb}.sparse_device_fraction",
+                    counts["sparse_device"] / total,
+                    "split",
+                    "ratio",
+                )
     return out
 
 
