@@ -5,7 +5,7 @@ NATIVE_LIB := native/build/libnemo_native.so
 REPORT_SRC := native/nemo_report.cpp
 REPORT_LIB := native/build/libnemo_report.so
 
-.PHONY: all native test bench bench-watch bench-trend prewarm validate trace-smoke obs-smoke store-smoke delta-smoke shard-smoke sparse-device-smoke serve-smoke chaos-smoke lint-print clean reset proto neo4j-up neo4j-validate neo4j-down
+.PHONY: all native test bench bench-watch bench-trend prewarm validate trace-smoke obs-smoke store-smoke delta-smoke shard-smoke sparse-device-smoke serve-smoke chaos-smoke stream-smoke lint-print clean reset proto neo4j-up neo4j-validate neo4j-down
 
 all: native
 
@@ -101,6 +101,15 @@ serve-smoke:
 # to its healthy twin.
 chaos-smoke:
 	python -m nemo_tpu.utils.validate_smoke --chaos-smoke
+
+# Out-of-core streaming smoke (also the tail of `make validate`;
+# ISSUE 12): a tiny-budget segment-streamed run must be byte-identical —
+# figures included — to the in-memory oracle, its anonymous-RSS watermark
+# must sit strictly below the in-memory run's (the bounded-working-set
+# contract), and a SIGKILL mid-stream must resume via the checkpoint path
+# byte-identical to from-scratch (analysis/stream.py).
+stream-smoke:
+	python -m nemo_tpu.utils.validate_smoke --stream-smoke
 
 # Structured-logging contract: no bare print() in nemo_tpu/ outside the
 # CLI/harness allowlist (tools/lint_no_print.py).

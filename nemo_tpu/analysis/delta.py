@@ -286,11 +286,23 @@ class _CorpusRowView:
         self.post_tid = base.post_tid
         self.iteration = np.asarray(base.iteration)[idx]
         self.success = np.asarray(base.success)[idx]
+
+        def gather(cb, f):
+            # A lazily consolidating multi-segment store batch exposes
+            # take(): gather the view's rows straight from the per-segment
+            # mmaps — same values as consolidated[idx] without ever
+            # materializing the corpus-wide plane (the streamed path's
+            # bounded working set, store/reader.py:LazyCondBatch).
+            take = getattr(cb, "take", None)
+            if take is not None:
+                return take(f, idx)
+            return np.asarray(getattr(cb, f))[idx]
+
         self.pre = NativeCondBatch(
-            **{f: np.asarray(getattr(base.pre, f))[idx] for f in _COND_FIELDS}
+            **{f: gather(base.pre, f) for f in _COND_FIELDS}
         )
         self.post = NativeCondBatch(
-            **{f: np.asarray(getattr(base.post, f))[idx] for f in _COND_FIELDS}
+            **{f: gather(base.post, f) for f in _COND_FIELDS}
         )
 
     def cond(self, name: str):
@@ -441,6 +453,25 @@ class MapOutput:
         self.extensions = list(other.extensions)
         if other.legacy is not None:
             self.legacy = other.legacy
+
+    def merge_figures(self, other: "MapOutput") -> None:
+        """Streamed map (ISSUE 12): fold in only what the REPORT phase
+        reads — the figure DOT dicts and the mapped-run bookkeeping.  The
+        per-run reduce artifacts travel exclusively in the segment
+        partials (pushed into the TreeReducer as each segment completes),
+        so the corpus-wide MapOutput stays O(figure-selected runs) instead
+        of duplicating every per-run artifact a second time."""
+        self.own_iters.extend(other.own_iters)
+        for name in (
+            "hazard",
+            "pre",
+            "post",
+            "pre_clean",
+            "post_clean",
+            "diff",
+            "diff_failed",
+        ):
+            getattr(self, name).update(getattr(other, name))
 
     def as_partial(self, seg: Segment, molly) -> SegmentPartial:
         """Slice this map's artifacts down to one segment's runs."""
@@ -627,6 +658,101 @@ def map_runs(
 # ---------------------------------------------------------------------------
 
 
+def reduce_arity() -> int:
+    """Merge arity of the tree reduce (``NEMO_REDUCE_ARITY``, default 8,
+    floor 2): reduce state stays bounded at O(arity * log_arity(S)) live
+    partials instead of accumulating all S."""
+    from nemo_tpu.utils.env import env_int
+
+    return max(2, env_int("NEMO_REDUCE_ARITY", 8))
+
+
+def merge_partials(parts: "list[SegmentPartial]", arity: int | None = None) -> "SegmentPartial":
+    """Associatively merge segment partials into ONE partial, as a k-ary
+    TREE (pairwise at arity=2) rather than a flat fold — the shape that
+    keeps the reduce state O(log S) deep and lets the run axis shard.
+
+    Per-run dicts are iteration-keyed and disjoint across segments (dict
+    union); the anchor content (corrections/extensions) is a function of
+    the good/baseline runs, which ride in every publishing map's view, so
+    every carrier holds the SAME values — the merge keeps the later
+    carrier's copy, exactly the flat left-fold's last-wins, making tree
+    and flat byte-equal for any arity and segment count (the property
+    test in tests/test_delta.py pins this)."""
+    if not parts:
+        return SegmentPartial()
+    k = arity or reduce_arity()
+    items = list(parts)
+    while len(items) > 1:
+        items = [_merge_group(items[i : i + k]) for i in range(0, len(items), k)]
+        obs.metrics.inc("delta.tree_merge_levels")
+    return items[0]
+
+
+def _merge_group(group: "list[SegmentPartial]") -> "SegmentPartial":
+    """One merge node: fold a <=arity group of partials left to right."""
+    if len(group) == 1:
+        return group[0]
+    out = SegmentPartial()
+    for p in group:
+        out.iters.extend(p.iters)
+        out.success_iters.extend(p.success_iters)
+        out.failed_iters.extend(p.failed_iters)
+        out.proto_ordered.update(p.proto_ordered)
+        out.present.update(p.present)
+        out.missing.update(p.missing)
+        out.achieved.update(p.achieved)
+        out.fig_files.extend(p.fig_files)
+        if p.corrections is not None:
+            # Coupled move: the flat fold takes extensions from the SAME
+            # partial that supplied corrections.
+            out.corrections = list(p.corrections)
+            out.extensions = list(p.extensions or [])
+    obs.metrics.inc("delta.tree_merges")
+    return out
+
+
+class TreeReducer:
+    """Incremental tree merge for the STREAMED reduce: partials are pushed
+    as their segments finish mapping and fold binary-counter style — level
+    0 buffers up to ``arity`` partials, a full buffer merges into one
+    level-1 partial, and so on — so at any moment at most
+    ``arity * ceil(log_arity(S))`` partials are live regardless of how many
+    segments streamed through.  ``partials()`` returns the live frontier in
+    push order (deepest level first), which :func:`reduce_partials`
+    finishes — byte-equal to reducing the full flat list."""
+
+    def __init__(self, arity: int | None = None) -> None:
+        self.arity = arity or reduce_arity()
+        self._levels: list[list[SegmentPartial]] = []
+        self.pushed = 0
+
+    def push(self, p: "SegmentPartial") -> None:
+        self.pushed += 1
+        lvl = 0
+        while True:
+            if len(self._levels) <= lvl:
+                self._levels.append([])
+            buf = self._levels[lvl]
+            buf.append(p)
+            if len(buf) < self.arity:
+                return
+            p = _merge_group(buf)
+            self._levels[lvl] = []
+            lvl += 1
+
+    def live(self) -> int:
+        return sum(len(b) for b in self._levels)
+
+    def partials(self) -> "list[SegmentPartial]":
+        """The live frontier, chronological (a level-N item was always
+        pushed before any surviving lower-level item)."""
+        out: list[SegmentPartial] = []
+        for lvl in reversed(range(len(self._levels))):
+            out.extend(self._levels[lvl])
+        return out
+
+
 class _JsonEvent:
     """MissingEvent stand-in rehydrated from a cached partial: only its
     ``to_json`` is ever consumed downstream (debugging.json splicing), so
@@ -708,26 +834,20 @@ def reduce_partials(
         )
 
     with obs.span("analysis:reduce", segments=len(partials)):
-        ordered: dict[int, list[str]] = {}
-        present: dict[int, list[str]] = {}
-        missing: dict[int, list] = {}
-        achieved_total = 0
-        corrections: list[str] = []
-        extensions: list[str] = []
-        anchor_seen = False
-        for p in partials:
-            ordered.update(p.proto_ordered)
-            present.update(p.present)
-            for f, docs in p.missing.items():
-                missing[f] = [
-                    d if isinstance(d, MissingEvent) else _JsonEvent(d)
-                    for d in docs
-                ]
-            achieved_total += sum(p.achieved.values())
-            if p.corrections is not None:
-                corrections = list(p.corrections)
-                extensions = list(p.extensions or [])
-                anchor_seen = True
+        # Sharded TREE merge (ISSUE 12): pairwise/k-ary instead of a flat
+        # left-fold, so the merge state stays O(arity * log S) and the same
+        # associative node serves the streamed reducer (TreeReducer).
+        merged = merge_partials(partials)
+        ordered = merged.proto_ordered
+        present = merged.present
+        missing: dict[int, list] = {
+            f: [d if isinstance(d, MissingEvent) else _JsonEvent(d) for d in docs]
+            for f, docs in merged.missing.items()
+        }
+        achieved_total = sum(merged.achieved.values())
+        corrections = list(merged.corrections or [])
+        extensions = list(merged.extensions or [])
+        anchor_seen = merged.corrections is not None
         if not anchor_seen and molly.runs:
             raise RuntimeError(
                 "no partial carried the anchor (good/baseline) results; "

@@ -709,13 +709,25 @@ def run_debug(
 
     mo = delta.MapOutput()
     checkpointed: dict[str, object] = {}  # seg name -> already-published partial
+    streamed = False
+    stream_reducer = None
+    stream_fresh: dict[str, object] = {}  # failed/unattempted checkpoint residue
     if to_map:
+        from nemo_tpu.analysis import stream as stream_mod
         from nemo_tpu.utils import chaos
         from nemo_tpu.utils.env import env_flag
 
         pos_by_iter = {}
         for pos, r in enumerate(molly.runs):
             pos_by_iter.setdefault(r.iteration, pos)
+        # Out-of-core streaming (ISSUE 12): a store-served corpus with
+        # several segments to map streams them through the mesh one at a
+        # time behind the double-buffered prefetch (analysis/stream.py) —
+        # peak memory O(segment + reduce state) instead of O(corpus),
+        # byte-identical reports (per-run artifacts are batch-independent,
+        # the reduce order-insensitive).  NEMO_STREAM=off restores the
+        # in-memory sweep.
+        streamed = stream_mod.use_streaming(molly, backend, to_map, legacy=legacy)
         # Crash-safe resume (ISSUE 9): when several segments need mapping
         # and their partials will be cached anyway, map them ONE AT A TIME
         # and publish each segment's partial (figures included) to the
@@ -724,51 +736,120 @@ def run_debug(
         # serves the finished ones (delta.segments_cached) and maps only
         # the rest, producing a byte-identical report.  NEMO_CHECKPOINT=0
         # restores the single-map sweep (marginally fewer dispatches: the
-        # anchor verbs re-run per segment on this path).
+        # anchor verbs re-run per segment on this path).  Streamed runs
+        # ride this same path, so they are crash-resumable for free.
         incremental = (
             len(to_map) > 1
             and bool(partial_keys)
             and rcache is not None
             and env_flag("NEMO_CHECKPOINT", True)
         )
-        map_groups = [[s] for s in to_map] if incremental else [to_map]
+        map_groups = (
+            [[s] for s in to_map] if (incremental or streamed) else [to_map]
+        )
+
+        def build_view(group):
+            own_rows = sorted(r for s in group for r in range(s.start, s.stop))
+            own_row_set = set(own_rows)
+            own_set = {molly.runs[r].iteration for r in own_rows}
+            # Anchor runs ride along as CONTEXT when they live in a
+            # cached (or another group's) segment: the differential
+            # verbs diff against the good run's graph and extensions
+            # read the baseline run's antecedent, so the map's view
+            # must contain them even though their per-run artifacts
+            # come from elsewhere.
+            anchor_rows = {
+                pos_by_iter[it]
+                for it in (good_iter, baseline_iter)
+                if it is not None and pos_by_iter[it] not in own_row_set
+            }
+            view_rows = sorted(own_row_set | anchor_rows)
+            molly_view = (
+                molly
+                if len(view_rows) == len(molly.runs)
+                else delta.subset_molly(molly, view_rows)
+            )
+            return molly_view, own_set
+
+        if streamed:
+            # The anchor verbs run UNGATED per segment (publish semantics)
+            # even when nothing will be cached: every partial then carries
+            # identical anchor content, which is what makes the tree merge
+            # order-insensitive.
+            publish = True
+            stream_reducer = delta.TreeReducer()
+            for _seg, p in cached:
+                stream_reducer.push(p)
+            group_iter = stream_mod.stream_groups(
+                map_groups, build_view, backend, conn, timer=timer
+            )
+        else:
+            publish = bool(partial_keys)
+
+            def _serial_groups():
+                for group in map_groups:
+                    molly_view, own_set = build_view(group)
+                    with timer.phase("init"):
+                        backend.init_graph_db(conn, molly_view)
+                    yield stream_mod.StagedGroup(
+                        group=group,
+                        view=molly_view,
+                        own_set=own_set,
+                        backend=backend,
+                        shared_backend=True,
+                    )
+
+            group_iter = _serial_groups()
+
         with trace_ctx:
-            for group in map_groups:
-                own_rows = sorted(r for s in group for r in range(s.start, s.stop))
-                own_row_set = set(own_rows)
-                own_set = {molly.runs[r].iteration for r in own_rows}
-                # Anchor runs ride along as CONTEXT when they live in a
-                # cached (or another group's) segment: the differential
-                # verbs diff against the good run's graph and extensions
-                # read the baseline run's antecedent, so the map's view
-                # must contain them even though their per-run artifacts
-                # come from elsewhere.
-                anchor_rows = {
-                    pos_by_iter[it]
-                    for it in (good_iter, baseline_iter)
-                    if it is not None and pos_by_iter[it] not in own_row_set
-                }
-                view_rows = sorted(own_row_set | anchor_rows)
-                molly_view = (
-                    molly
-                    if len(view_rows) == len(molly.runs)
-                    else delta.subset_molly(molly, view_rows)
-                )
-                with timer.phase("init"):
-                    backend.init_graph_db(conn, molly_view)
+            for staged in group_iter:
+                group = staged.group
                 try:
                     group_mo = delta.map_runs(
-                        backend,
-                        molly_view,
+                        staged.backend,
+                        staged.view,
                         fault_inj_out,
                         good_iter,
                         fig_set,
-                        own_set,
+                        staged.own_set,
                         timer,
-                        publish=bool(partial_keys),
+                        publish=publish,
                     )
                 finally:
-                    backend.close_db()
+                    staged.backend.close_db()
+                    staged.release()
+                if streamed:
+                    # Bounded reduce state: the report phase keeps only the
+                    # figure dots; the per-run artifacts travel in the
+                    # segment partial, pushed into the k-ary tree reducer
+                    # and — where cacheable — dropped to the rcache NOW, so
+                    # the segment's working set frees before the next one
+                    # stages in.
+                    mo.merge_figures(group_mo)
+                    seg = group[0]
+                    partial = group_mo.as_partial(seg, molly)
+                    key = partial_keys.get(seg.name)
+                    published = False
+                    if incremental and key is not None:
+                        published = _publish_segment_checkpoint(
+                            rcache, key, partial, group_mo
+                        )
+                        if published:
+                            checkpointed[seg.name] = True
+                            obs.metrics.inc("delta.partial_checkpoints")
+                            _log.info(
+                                "delta.checkpoint",
+                                corpus=fault_inj_out,
+                                segment=seg.name,
+                                published=len(checkpointed),
+                                remaining=len(to_map) - len(checkpointed),
+                            )
+                            chaos.on_segment_published(len(checkpointed))
+                    if not published and key is not None:
+                        stream_fresh[seg.name] = partial
+                    stream_reducer.push(partial)
+                    stream_mod.note_segment_done()
+                    continue
                 mo.merge(group_mo)
                 if incremental:
                     seg = group[0]
@@ -808,6 +889,15 @@ def run_debug(
                 )
             ]
             fresh: dict[str, object] = {}
+        elif streamed:
+            # Streamed reduce (ISSUE 12): every partial — cached and fresh
+            # — was already pushed into the k-ary tree reducer as its
+            # segment completed; finish from its live frontier (O(arity *
+            # log S) partials, byte-equal to the flat list).  Only
+            # failed/unattempted checkpoint publishes remain for the
+            # end-of-run flush.
+            fresh = stream_fresh
+            partials = stream_reducer.partials()
         elif not partial_keys and not cached:
             # Nothing cacheable (anonymous corpus or cache off): skip the
             # per-segment JSON slicing and feed the map output straight

@@ -1,0 +1,276 @@
+"""Out-of-core segment-streamed analysis (ISSUE 12).
+
+The PR-5 append-only segment store and the PR-6 per-segment map/reduce are
+exactly the out-of-core shape; this module makes streaming them the
+engine's default scaling mode.  Instead of mapping a corpus in one sweep
+over consolidated arrays, the store's segments flow through the mesh one
+at a time behind a **double-buffered host->device prefetch pipeline**:
+
+  * a background thread STAGES segment k+1 — builds its row-subset view
+    straight from the per-segment mmaps (store/reader.py:LazyCondBatch.take,
+    so the corpus-wide planes never materialize), initializes a per-segment
+    backend clone, bucketizes the fused inputs, and ``jax.device_put``s the
+    narrowed planes where a real accelerator backs the platform
+    (JaxBackend.stage_fused_inputs) —
+  * while segment k's dispatches drain on the consuming thread.
+
+A bounded in-flight budget (``NEMO_STREAM_SEGMENTS``, default 2) keeps at
+most that many segments resident, so ingest never starves the accelerators
+and never outruns memory: peak RSS is O(segment + reduce state),
+independent of corpus size.  Each segment's partial drops to the result
+cache as soon as it reduces (the PR-9 checkpoint path — streamed runs are
+crash-resumable for free) and its arrays are released; the reduce itself
+is the k-ary TREE merge (analysis/delta.py:TreeReducer), bounded at
+O(arity * log S) live partials.
+
+Byte parity: per-run artifacts are independent of batch composition (the
+sparse/dense parity suites pin this) and the reduce is order-insensitive
+(PR 6), so a streamed report is byte-identical to the in-memory one —
+``make stream-smoke`` asserts exactly that, plus a strictly lower RSS
+watermark and SIGKILL-resume.
+
+Knobs:
+
+  NEMO_STREAM           auto (default) | on/1 | off/0.  auto streams any
+                        store-served corpus with >=2 segments to map on a
+                        stream-capable backend; on forces (warns and falls
+                        back when the corpus/backend cannot stream); off
+                        restores the in-memory sweep.
+  NEMO_STREAM_SEGMENTS  in-flight segment budget (default 2 = classic
+                        double buffering: one analyzing + one staging).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+
+from nemo_tpu import obs
+
+_log = obs.log.get_logger("nemo.stream")
+
+_SENTINEL = object()
+
+
+def stream_env() -> str:
+    """``NEMO_STREAM``: auto | on | off (1/0 accepted)."""
+    from nemo_tpu.utils.env import env_choice
+
+    got = env_choice("NEMO_STREAM", "auto", ("auto", "on", "1", "off", "0"))
+    return {"1": "on", "0": "off"}.get(got, got)
+
+
+def stream_budget() -> int:
+    """``NEMO_STREAM_SEGMENTS``: how many segments may be resident at once
+    (the one analyzing + those staged ahead).  Default 2 — classic double
+    buffering; 1 degenerates to stage-then-analyze with no overlap but
+    still the bounded per-segment working set."""
+    from nemo_tpu.utils.env import env_int
+
+    return max(1, env_int("NEMO_STREAM_SEGMENTS", 2))
+
+
+def use_streaming(molly, backend, to_map, legacy: bool = False) -> bool:
+    """Whether this run's map streams segment-by-segment.
+
+    Capability needs: a per-run-decomposing backend that can clone itself
+    for background staging (GraphBackend.stream_clone), a packed corpus
+    (the row-subset views are array gathers), and >=2 segments left to map
+    (a single segment IS the bounded working set already).  ``on`` without
+    capability warns and falls back — never silently wrong bytes, never a
+    hard failure for a knob that only changes the execution shape."""
+    mode = stream_env()
+    if mode == "off":
+        return False
+    capable = (
+        not legacy
+        and len(to_map) >= 2
+        and getattr(molly, "native_corpus", None) is not None
+        and backend.stream_clone() is not None
+    )
+    if mode == "on" and not capable:
+        obs.metrics.inc("stream.unstreamable")
+        _log.warning(
+            "stream.unstreamable",
+            detail="NEMO_STREAM=on but this run cannot stream "
+            "(object-loader corpus, non-cloning backend, or <2 segments "
+            "to map); running the in-memory sweep",
+            segments_to_map=len(to_map),
+        )
+        return False
+    return capable
+
+
+def stream_peak_rss_bytes() -> int:
+    """Current process peak RSS in bytes (ru_maxrss is KB on Linux)."""
+    import resource
+    import sys
+
+    peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    return int(peak * (1 if sys.platform == "darwin" else 1024))
+
+
+def note_segment_done() -> None:
+    """Per-segment RSS watermark gauge (``mem.stream_peak_rss``): the
+    stream-smoke and the bench stream tier read this to prove the working
+    set stays bounded as segments flow through."""
+    obs.metrics.gauge("mem.stream_peak_rss", stream_peak_rss_bytes())
+
+
+@dataclass
+class StagedGroup:
+    """One staged map group: the row-subset view, its own-run set, and the
+    per-segment backend (already init_graph_db'd, fused inputs staged)."""
+
+    group: list
+    view: object
+    own_set: set
+    backend: object
+    stage_s: float = 0.0
+    staged_bytes: int = 0
+    #: serial-path marker: the shared caller-owned backend rides here, and
+    #: release() must not drop state the next group needs.
+    shared_backend: bool = field(default=False)
+    #: residency-slot release (the stream budget's semaphore); None on the
+    #: serial/inline paths.
+    _slot: object = field(default=None, repr=False)
+
+    def release(self) -> None:
+        """Drop the segment's array references so its working set frees as
+        soon as the map completes (the backend was close_db'd by the
+        caller), and return the residency slot to the prefetcher — the
+        budget counts a segment as resident until exactly here."""
+        self.view = None
+        self.own_set = None
+        if not self.shared_backend:
+            self.backend = None
+        slot, self._slot = self._slot, None
+        if slot is not None:
+            slot.release()
+
+
+def stream_groups(
+    map_groups,
+    build_view,
+    backend,
+    conn: str,
+    timer=None,
+    budget: int | None = None,
+    threaded: bool | None = None,
+):
+    """Generator over :class:`StagedGroup`s with double-buffered prefetch.
+
+    ``build_view(group) -> (molly_view, own_set)`` runs on the staging
+    side; a background thread stages ahead of the consumer under a
+    residency budget of ``budget`` segments — a slot is held from before a
+    segment's staging starts until ``StagedGroup.release()`` — so segment
+    k+1's store load + bucketize + device staging overlaps segment k's
+    dispatch drain without ever exceeding the bound.  On an effectively 1-core host the thread is
+    skipped (a producer cannot overlap the consumer on one core — the
+    run_debug_dirs precedent) and staging runs inline, preserving the
+    bounded working set without the handoff overhead.
+
+    Consumer-side stalls (the accelerator waiting on ingest) are recorded
+    as ``stream.prefetch_stall_s`` and — when ``timer`` is passed — as the
+    ``stream_wait`` pipeline phase, so the overlap fraction is measurable.
+    """
+    budget = budget or stream_budget()
+    if threaded is None:
+        from nemo_tpu.utils import effective_cpu_count
+
+        threaded = effective_cpu_count() > 1
+
+    def stage(group) -> StagedGroup:
+        t0 = time.perf_counter()
+        with obs.span(
+            "analysis:stream_prefetch",
+            segments=len(group),
+            runs=sum(s.n_runs for s in group),
+        ):
+            view, own_set = build_view(group)
+            seg_backend = backend.stream_clone()
+            seg_backend.init_graph_db(conn, view)
+            staged_bytes = 0
+            stage_inputs = getattr(seg_backend, "stage_fused_inputs", None)
+            if stage_inputs is not None:
+                plan = stage_inputs()
+                staged_bytes = int(plan.get("staged_bytes") or 0)
+        dt = time.perf_counter() - t0
+        obs.metrics.observe("stream.stage_s", dt)
+        obs.metrics.inc("stream.segments_staged")
+        if staged_bytes:
+            obs.metrics.inc("stream.staged_bytes", staged_bytes)
+        return StagedGroup(
+            group=group,
+            view=view,
+            own_set=own_set,
+            backend=seg_backend,
+            stage_s=dt,
+            staged_bytes=staged_bytes,
+        )
+
+    # Whether the prefetch actually ran on a background thread — the bench
+    # reads this to report a 0 overlap fraction on 1-core hosts instead of
+    # a vacuous "no stalls" 1.0 (inline staging serializes with compute).
+    obs.metrics.gauge("stream.threaded", int(bool(threaded)))
+    if not threaded:
+        obs.metrics.gauge("stream.segments_inflight", 1)
+        for group in map_groups:
+            yield stage(group)
+        obs.metrics.gauge("stream.segments_inflight", 0)
+        return
+
+    q: "queue.Queue" = queue.Queue()
+    stop = threading.Event()
+    # The residency budget: a segment holds a slot from BEFORE its staging
+    # starts until StagedGroup.release() — so at most `budget` segments'
+    # arrays exist at any moment (the one analyzing + those staged ahead),
+    # not budget+1 (a producer that staged first and only then blocked on a
+    # bounded queue would be holding an extra resident segment while
+    # parked).
+    slots = threading.Semaphore(budget)
+
+    def put(item) -> None:
+        # The queue itself is unbounded — the semaphore is the bound — so
+        # puts never park; only slot acquisition waits, and it stays
+        # responsive to consumer abandonment via `stop`.
+        q.put(item)
+
+    def producer() -> None:
+        try:
+            for group in map_groups:
+                while not slots.acquire(timeout=0.1):
+                    if stop.is_set():
+                        return
+                if stop.is_set():
+                    return
+                staged = stage(group)
+                staged._slot = slots
+                put(staged)
+            put(_SENTINEL)
+        except BaseException as ex:  # re-raised on the consuming thread
+            put(ex)
+
+    th = threading.Thread(target=producer, daemon=True, name="nemo-stream-prefetch")
+    th.start()
+    try:
+        while True:
+            t0 = time.perf_counter()
+            if timer is not None:
+                with timer.phase("stream_wait"):
+                    item = q.get()
+            else:
+                item = q.get()
+            obs.metrics.inc("stream.prefetch_stall_s", time.perf_counter() - t0)
+            if item is _SENTINEL:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            # Staged-ahead + the one just handed over.
+            obs.metrics.gauge("stream.segments_inflight", q.qsize() + 1)
+            yield item
+        obs.metrics.gauge("stream.segments_inflight", 0)
+    finally:
+        stop.set()

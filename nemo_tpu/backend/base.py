@@ -47,6 +47,17 @@ class GraphBackend(abc.ABC):
     #: monolithic map with partial caching disabled.
     supports_delta = False
 
+    def stream_clone(self):
+        """A fresh backend instance suitable for the segment-streamed map
+        (analysis/stream.py): the double-buffered prefetch initializes
+        segment k+1's instance on a background thread while segment k's
+        dispatches drain, so one shared mutable instance cannot serve both.
+        None (the default) disables streaming for this backend; overriders
+        should share whatever cross-corpus state is expensive (compiled
+        program caches, executors) and return an instance whose
+        init_graph_db is safe to call on a non-main thread."""
+        return None
+
     def good_run_iter(self) -> int:
         """Iteration of the baseline successful run used for differential
         provenance and the trigger queries.  The first successful run that

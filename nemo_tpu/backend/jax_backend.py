@@ -1262,6 +1262,76 @@ class _LazyGraphs:
         self._cache[key] = value
 
 
+class _LazyCondHolds(dict):
+    """(run iteration, cond) -> per-node condition_holds row, materialized
+    on first access from the fused bucket outputs (ISSUE 12): the eager
+    corpus-wide fill was a 2B-iteration host loop slicing a row per run,
+    while the consumers — figure-selected property-graph builds and the
+    good run's diff backdrop — touch a policy-bounded handful.  Behaves as
+    the dict it replaces (``get``/``[]``/``in``); a miss on a key the fused
+    step never produced raises KeyError exactly like the old dict."""
+
+    def __init__(self, fused) -> None:
+        super().__init__()
+        self._fused = fused
+        index: dict[tuple[int, str], tuple[int, int]] = {}
+        for bi, (pre_b, post_b, _res) in enumerate(fused):
+            for row, rid in enumerate(pre_b.run_ids):
+                index[(rid, "pre")] = (bi, row)
+            for row, rid in enumerate(post_b.run_ids):
+                index[(rid, "post")] = (bi, row)
+        self._index = index
+
+    def __missing__(self, key):
+        bi, row = self._index[key]  # KeyError propagates like a dict miss
+        pre_b, post_b, res = self._fused[bi]
+        cond = key[1]
+        b = pre_b if cond == "pre" else post_b
+        val = self[key] = np.asarray(res[f"{cond}_holds"][row])[
+            : int(b.n_nodes[row])
+        ]
+        return val
+
+    def get(self, key, default=None):
+        # dict.get never consults __missing__ — route through __getitem__.
+        try:
+            return self[key]
+        except KeyError:
+            return default
+
+    def __contains__(self, key) -> bool:
+        return dict.__contains__(self, key) or key in self._index
+
+
+class _LazyAchievedPre(dict):
+    """iteration -> achieved_pre flag, lazily sliced from the fused bucket
+    outputs (same contract as :class:`_LazyCondHolds`)."""
+
+    def __init__(self, fused) -> None:
+        super().__init__()
+        self._fused = fused
+        index: dict[int, tuple[int, int]] = {}
+        for bi, (pre_b, _post_b, _res) in enumerate(fused):
+            for row, rid in enumerate(pre_b.run_ids):
+                index[rid] = (bi, row)
+        self._index = index
+
+    def __missing__(self, key):
+        bi, row = self._index[key]
+        res = self._fused[bi][2]
+        val = self[key] = bool(np.asarray(res["achieved_pre"][row]))
+        return val
+
+    def get(self, key, default=None):
+        try:
+            return self[key]
+        except KeyError:
+            return default
+
+    def __contains__(self, key) -> bool:
+        return dict.__contains__(self, key) or key in self._index
+
+
 class _CorpusPacked:
     """Lazy (run iteration, cond) -> PackedGraph mapping over a NativeCorpus
     (packed-first ingest): graphs materialize as array views on first access
@@ -1308,6 +1378,9 @@ class JaxBackend(GraphBackend):
         self._simplified_row: dict[tuple[int, str], tuple[int, int]] = {}
         # Joint-bucket fused outputs: [(pre_batch, post_batch, out_dict)].
         self._fused_out: list[tuple[PackedBatch, PackedBatch, dict[str, np.ndarray]]] | None = None
+        # Prefetch-staged fused inputs (stage_fused_inputs), adopted by the
+        # next _fused on this instance; None outside the streamed pipeline.
+        self._staged_inputs: dict | None = None
         # (run, cond) -> host-materialized (alive, adj, type) rows.
         self._clean_rows: dict[tuple[int, str], tuple] = {}
         self._run_by_iter: dict[int, object] = {}
@@ -1468,6 +1541,7 @@ class JaxBackend(GraphBackend):
         self.simplified = {}
         self._simplified_row = {}
         self._fused_out = None
+        self._staged_inputs = None
         self._clean_rows = {}
         self._run_by_iter = {r.iteration: r for r in molly.runs}
         nc = getattr(molly, "native_corpus", None)
@@ -1518,6 +1592,7 @@ class JaxBackend(GraphBackend):
         self.simplified = {}
         self._simplified_row = {}
         self._fused_out = None
+        self._staged_inputs = None
         self._clean_rows = {}
         self._run_by_iter = {}
         self._corpus = None
@@ -1632,6 +1707,140 @@ class JaxBackend(GraphBackend):
 
     # ------------------------------------------------------------- fused step
 
+    def stream_clone(self) -> "JaxBackend":
+        """Fresh instance for the segment-streamed map (analysis/stream.py),
+        sharing the executor — and with it the jit/compile caches, the
+        remote channel of a ServiceBackend-style executor, and the cost
+        table — so per-segment backends pay no per-segment warmup.  Only
+        instance state (vocab, packed views, fused outputs) is per-clone;
+        init_graph_db/stage_fused_inputs are pure host work, safe on the
+        prefetch thread, while dispatches stay on the consuming thread."""
+        return type(self)(max_batch=self.max_batch, executor=self.executor)
+
+    def _plan_fused_inputs(self) -> dict:
+        """The ``analysis:pack`` section of :meth:`_fused` as a pure
+        function of the initialized corpus: the giant split, the stress
+        floors, and the bucketized batch pairs.  Factored out so the
+        streamed prefetch (stage_fused_inputs) can run it for segment k+1
+        on a background thread while segment k's dispatches drain —
+        byte-identical inputs either way."""
+        assert self.molly is not None
+        # Giant-run auto-dispatch: a run whose node count exceeds
+        # NEMO_GIANT_V leaves the dense buckets (its [B,V,V] adjacency
+        # would dominate or OOM them) and analyzes alone on the
+        # node-sharded closure-free path (parallel/giant.py).
+        giant_v = self._giant_v
+        if self._corpus is not None:
+            # Packed-first: node counts come from the corpus arrays —
+            # never materialize 2N lazy graph views just to size-split.
+            nc = self._corpus
+            nmax = np.maximum(nc.pre.n_nodes, nc.post.n_nodes)
+            rows = np.nonzero(nmax <= giant_v)[0].tolist()
+            giant_ids = [int(nc.iteration[i]) for i in np.nonzero(nmax > giant_v)[0]]
+            n_dense = len(rows)
+            run_ids = None
+        else:
+            run_ids, giant_ids = [], []
+            for r in self.molly.runs:
+                n = max(
+                    self.packed[(r.iteration, "pre")].n_nodes,
+                    self.packed[(r.iteration, "post")].n_nodes,
+                )
+                (giant_ids if n > giant_v else run_ids).append(r.iteration)
+            n_dense = len(run_ids)
+        # Static dims round to powers of two (see graphs_to_step) so
+        # corpora with nearby vocab sizes share compiled programs; at
+        # stress scale, size FLOORS collapse the per-family bucket
+        # variance entirely — padding [B,64,64] instead of [B,32,32]
+        # costs milliseconds of extra MXU work, while each extra
+        # compiled program costs ~10s of TPU compile.  The diff tail is
+        # excluded (with_diff=0): the backend diffs against the chosen
+        # good run in its own dispatch, and dropping it removes the
+        # label vocab (the most corpus-varying dim) from the signature.
+        big = n_dense >= 512
+        # min_d floors the depth-bucket: per-family corpus depths (15-19
+        # across the case studies) otherwise bucket to 16 vs 32 and split
+        # an identical shape into two compiled programs; with the floor
+        # (and the pinned pre/post table ids) every big corpus shares
+        # ONE fused program — each extra program costs tens of seconds
+        # of fresh TPU compile, the extra trip counts cost microseconds.
+        floors = (64, 256, 32, 32) if big else (16, 16, 8, 4)
+        min_v, min_e, _min_t, _min_d = floors
+        # The pack span splits load_raw_provenance's wall into bucket
+        # construction vs routed analysis (the ISSUE 3 profiling ask):
+        # at 1x the phase was 5-7 s of the 9.2 s e2e wall, and the
+        # span shows the analysis dispatch — not this packing — is the
+        # dominant term, which is what the sparse route removes.
+        # The shard multiple folds into the bucketizer's run-axis pad
+        # (ROADMAP 3b / ISSUE 10 satellite): batches leave here already
+        # a multiple of the run-mesh width, so pad_place_named_arrays
+        # places without copying on the hot path.  Resolved by the
+        # process that owns the device; RemoteExecutor deployments pad
+        # again sidecar-side if the meshes disagree (rare, harmless).
+        from nemo_tpu.parallel.mesh import shard_device_count
+
+        shard_mult = shard_device_count()
+        with obs.span("analysis:pack", runs=n_dense):
+            if self._corpus is not None:
+                batches = bucketize_pairs_corpus(
+                    self._corpus_graphs,
+                    rows,
+                    self._corpus.iteration,
+                    self._max_batch,
+                    min_v=min_v,
+                    min_e=min_e,
+                    shard_multiple=shard_mult,
+                )
+            else:
+                pre = [self.packed[(i, "pre")] for i in run_ids]
+                post = [self.packed[(i, "post")] for i in run_ids]
+                batches = bucketize_pairs(
+                    run_ids, pre, post, self._max_batch, min_v=min_v,
+                    min_e=min_e, shard_multiple=shard_mult,
+                )
+        return {
+            "batches": batches,
+            "giant_ids": giant_ids,
+            "n_dense": n_dense,
+            "floors": floors,
+        }
+
+    def stage_fused_inputs(self) -> dict:
+        """Pre-compute (and, where a real accelerator backs the default
+        platform, device-stage) the fused dispatch inputs — the host half
+        of the double-buffered stream pipeline (ISSUE 12).  Called on the
+        prefetch thread after init_graph_db; the next :meth:`_fused` on
+        this instance adopts the plan instead of re-bucketizing.  Device
+        staging narrows exactly as the dispatch would and ``jax.device_put``s
+        the planes so the dispatch-time H2D copy is already in flight; it
+        is skipped on CPU (host "transfers" are free) and under an active
+        run mesh (pad_place_named_arrays owns placement there).  Returns
+        the plan (exposing ``staged_bytes`` for the stream metrics)."""
+        plan = self._plan_fused_inputs()
+        staged_bytes = 0
+        from nemo_tpu.parallel.mesh import shard_plan
+
+        if jax.default_backend() != "cpu" and not shard_plan()[0]:
+            _, _, min_t, _ = plan["floors"]
+            num_tables = bucket_size(len(self.vocab.tables), min_t)
+            staged: dict[int, dict] = {}
+            for bi, (pre_b, post_b) in enumerate(plan["batches"]):
+                arrays = _narrow_fused_arrays(
+                    _verb_arrays(pre_b, post_b),
+                    v=pre_b.v,
+                    num_tables=num_tables,
+                    with_diff=False,
+                    narrow=self._narrow_xfer,
+                )
+                staged[bi] = {k: jax.device_put(a) for k, a in arrays.items()}
+                staged_bytes += sum(
+                    getattr(a, "nbytes", 0) for a in arrays.values()
+                )
+            plan["staged_arrays"] = staged
+        plan["staged_bytes"] = staged_bytes
+        self._staged_inputs = plan
+        return plan
+
     def _fused(self) -> list[tuple[PackedBatch, PackedBatch, dict[str, np.ndarray]]]:
         """Run the fused analysis step once per joint size bucket; cached.
 
@@ -1642,45 +1851,19 @@ class JaxBackend(GraphBackend):
         per-run, per-phase Cypher round-trips (main.go:106-180)."""
         if self._fused_out is None:
             assert self.molly is not None
-            # Giant-run auto-dispatch: a run whose node count exceeds
-            # NEMO_GIANT_V leaves the dense buckets (its [B,V,V] adjacency
-            # would dominate or OOM them) and analyzes alone on the
-            # node-sharded closure-free path (parallel/giant.py).
-            giant_v = self._giant_v
-            if self._corpus is not None:
-                # Packed-first: node counts come from the corpus arrays —
-                # never materialize 2N lazy graph views just to size-split.
-                nc = self._corpus
-                nmax = np.maximum(nc.pre.n_nodes, nc.post.n_nodes)
-                rows = np.nonzero(nmax <= giant_v)[0].tolist()
-                giant_ids = [int(nc.iteration[i]) for i in np.nonzero(nmax > giant_v)[0]]
-                n_dense = len(rows)
-            else:
-                run_ids, giant_ids = [], []
-                for r in self.molly.runs:
-                    n = max(
-                        self.packed[(r.iteration, "pre")].n_nodes,
-                        self.packed[(r.iteration, "post")].n_nodes,
-                    )
-                    (giant_ids if n > giant_v else run_ids).append(r.iteration)
-                n_dense = len(run_ids)
-            # Static dims round to powers of two (see graphs_to_step) so
-            # corpora with nearby vocab sizes share compiled programs; at
-            # stress scale, size FLOORS collapse the per-family bucket
-            # variance entirely — padding [B,64,64] instead of [B,32,32]
-            # costs milliseconds of extra MXU work, while each extra
-            # compiled program costs ~10s of TPU compile.  The diff tail is
-            # excluded (with_diff=0): the backend diffs against the chosen
-            # good run in its own dispatch, and dropping it removes the
-            # label vocab (the most corpus-varying dim) from the signature.
-            big = n_dense >= 512
-            # min_d floors the depth-bucket: per-family corpus depths (15-19
-            # across the case studies) otherwise bucket to 16 vs 32 and split
-            # an identical shape into two compiled programs; with the floor
-            # (and the pinned pre/post table ids) every big corpus shares
-            # ONE fused program — each extra program costs tens of seconds
-            # of fresh TPU compile, the extra trip counts cost microseconds.
-            min_v, min_e, min_t, min_d = (64, 256, 32, 32) if big else (16, 16, 8, 4)
+            # Bucketize — or adopt the plan a streamed prefetch already
+            # staged on the background thread (stage_fused_inputs): the
+            # host-side pack work then overlaps the PREVIOUS segment's
+            # dispatches instead of serializing ahead of this one's.
+            plan = self._staged_inputs
+            self._staged_inputs = None
+            if plan is None:
+                plan = self._plan_fused_inputs()
+            batches = plan["batches"]
+            giant_ids = plan["giant_ids"]
+            n_dense = plan["n_dense"]
+            min_v, min_e, min_t, min_d = plan["floors"]
+            staged_arrays = plan.get("staged_arrays") or {}
             params_common = dict(
                 pre_tid=self.vocab.tables.lookup("pre"),
                 post_tid=self.vocab.tables.lookup("post"),
@@ -1688,38 +1871,6 @@ class JaxBackend(GraphBackend):
                 num_labels=8,  # unused without the diff tail
                 with_diff=0,
             )
-            # The pack span splits load_raw_provenance's wall into bucket
-            # construction vs routed analysis (the ISSUE 3 profiling ask):
-            # at 1x the phase was 5-7 s of the 9.2 s e2e wall, and the
-            # span shows the analysis dispatch — not this packing — is the
-            # dominant term, which is what the sparse route removes.
-            # The shard multiple folds into the bucketizer's run-axis pad
-            # (ROADMAP 3b / ISSUE 10 satellite): batches leave here already
-            # a multiple of the run-mesh width, so pad_place_named_arrays
-            # places without copying on the hot path.  Resolved by the
-            # process that owns the device; RemoteExecutor deployments pad
-            # again sidecar-side if the meshes disagree (rare, harmless).
-            from nemo_tpu.parallel.mesh import shard_device_count
-
-            shard_mult = shard_device_count()
-            with obs.span("analysis:pack", runs=n_dense):
-                if self._corpus is not None:
-                    batches = bucketize_pairs_corpus(
-                        self._corpus_graphs,
-                        rows,
-                        self._corpus.iteration,
-                        self._max_batch,
-                        min_v=min_v,
-                        min_e=min_e,
-                        shard_multiple=shard_mult,
-                    )
-                else:
-                    pre = [self.packed[(i, "pre")] for i in run_ids]
-                    post = [self.packed[(i, "post")] for i in run_ids]
-                    batches = bucketize_pairs(
-                        run_ids, pre, post, self._max_batch, min_v=min_v,
-                        min_e=min_e, shard_multiple=shard_mult,
-                    )
             from nemo_tpu.ops.simplify import pair_chains_linear
             from nemo_tpu.parallel import sched as sched_mod
 
@@ -1748,8 +1899,24 @@ class JaxBackend(GraphBackend):
                 and jax.default_backend() != "cpu"
             )
 
-            def _add_fused_job(pre_b, post_b, linear):
+            def _add_fused_job(pre_b, post_b, linear, bi):
                 n_rows = len(pre_b.run_ids)
+
+                def dispatch_arrays():
+                    # A streamed prefetch may have already narrowed (and,
+                    # on a real accelerator, device_put) this bucket's verb
+                    # planes — dispatch those instead of rebuilding them.
+                    staged = staged_arrays.get(bi)
+                    if staged is not None:
+                        return staged
+                    return _narrow_fused_arrays(
+                        _verb_arrays(pre_b, post_b),
+                        v=pre_b.v,
+                        num_tables=params_common["num_tables"],
+                        with_diff=False,
+                        narrow=self._narrow_xfer,
+                    )
+
                 route, reason, work = self._analysis_route(
                     n_rows, pre_b.v, pre_b.e,
                     rows_dispatch=int(pre_b.is_goal.shape[0]),
@@ -1824,13 +1991,7 @@ class JaxBackend(GraphBackend):
                         with obs.span("analysis:route", **rec):
                             res = self.executor.run(
                                 "sparse_fused",
-                                _narrow_fused_arrays(
-                                    _verb_arrays(pre_b, post_b),
-                                    v=pre_b.v,
-                                    num_tables=params_common["num_tables"],
-                                    with_diff=False,
-                                    narrow=self._narrow_xfer,
-                                ),
+                                dispatch_arrays(),
                                 dict(
                                     v=pre_b.v,
                                     pre_tid=params_common["pre_tid"],
@@ -1847,13 +2008,7 @@ class JaxBackend(GraphBackend):
                     with obs.span("analysis:route", **rec):
                         res = self.executor.run(
                             "fused",
-                            _narrow_fused_arrays(
-                                _verb_arrays(pre_b, post_b),
-                                v=pre_b.v,
-                                num_tables=params_common["num_tables"],
-                                with_diff=False,
-                                narrow=self._narrow_xfer,
-                            ),
+                            dispatch_arrays(),
                             dict(
                                 v=pre_b.v,
                                 max_depth=bucket_size(
@@ -1876,7 +2031,7 @@ class JaxBackend(GraphBackend):
                 jobs.append(job)
                 serial_plan.append((lane, reason))
 
-            for pre_b, post_b in batches:
+            for bi, (pre_b, post_b) in enumerate(batches):
                 # Linear-chain fast path: when every run's @next member
                 # subgraph is a verified linear chain, the device step
                 # labels components by O(V log V) pointer doubling instead
@@ -1888,7 +2043,7 @@ class JaxBackend(GraphBackend):
                     linear = all(self._lin_by_iter[i] for i in pre_b.run_ids)
                 else:
                     linear = pair_chains_linear(pre_b, post_b)
-                _add_fused_job(pre_b, post_b, linear)
+                _add_fused_job(pre_b, post_b, linear, bi)
             if giant_ids:
                 from nemo_tpu.parallel.giant import giant_plan, pad_comp_labels
 
@@ -2057,17 +2212,17 @@ class JaxBackend(GraphBackend):
 
     def load_raw_provenance(self) -> None:
         assert self.molly is not None
-        for pre_b, post_b, res in self._fused():
-            # Bulk row slicing only — host property-graphs mirror these
-            # lazily on first access (_build_raw), so 10k-run corpora pay
-            # no per-node Python cost here (VERDICT r1).
-            for cond, b, holds in (("pre", pre_b, res["pre_holds"]), ("post", post_b, res["post_holds"])):
-                ns = b.n_nodes.tolist()
-                for row, rid in enumerate(b.run_ids):
-                    self.cond_holds[(rid, cond)] = holds[row, : ns[row]]
-            ach = np.asarray(res["achieved_pre"]).tolist()
-            for row, rid in enumerate(pre_b.run_ids):
-                self.achieved_pre[rid] = bool(ach[row])
+        # Lazy per-run views (ISSUE 12): the fused bucket outputs are
+        # indexed once and a run's holds/achieved rows materialize only
+        # when a consumer touches them — figure-selected property-graph
+        # builds and the good run's diff backdrop, a policy-bounded
+        # handful — instead of the old corpus-wide per-run slicing loop.
+        # Host property-graphs already mirror these lazily on first access
+        # (_build_raw), so this phase's wall is now the fused dispatch
+        # alone (VERDICT r1).
+        fused = self._fused()
+        self.cond_holds = _LazyCondHolds(fused)
+        self.achieved_pre = _LazyAchievedPre(fused)
         # Any raw property-graph built BEFORE this point lacks cond_holds
         # styling; drop the lazy cache so those rebuild with holds mirrored
         # (ADVICE r2: the cache must not pin an order-dependent invariant).

@@ -27,6 +27,9 @@ class ServiceBackend(JaxBackend):
         # opens (graphing/helpers.go:38-49) — so the backend is reusable
         # across corpora after close_db.
         super().__init__(max_batch=max_batch, executor=_Unconnected())
+        #: True on a stream_clone sharing the parent's live channel: close_db
+        #: then detaches instead of closing (the parent owns the lifetime).
+        self._shared_executor = False
 
     def init_graph_db(self, conn: str, molly: MollyOutput) -> None:
         from nemo_tpu.service.client import RemoteExecutor
@@ -43,6 +46,20 @@ class ServiceBackend(JaxBackend):
             self.executor = _Unconnected()
             self.executor = RemoteExecutor(target=self.target)
         super().init_graph_db(conn, molly)
+
+    def stream_clone(self) -> "ServiceBackend":
+        """Per-segment clone for the streamed map.  A connected parent's
+        executor is SHARED (one gRPC channel + compile-cache affinity
+        across all segments) and flagged so the clone's close_db detaches
+        without closing it — the parent owns the channel lifetime; closing
+        it after segment 1 would kill every later segment's RPCs.  An
+        unconnected parent's clone connects lazily in its own
+        init_graph_db and owns (and closes) that channel itself."""
+        clone = type(self)(target=self.target, max_batch=self.max_batch)
+        if not isinstance(self.executor, _Unconnected):
+            clone.executor = self.executor
+            clone._shared_executor = True
+        return clone
 
     def _resolve_max_batch(self):
         """The sidecar owns the accelerator, so the client's platform says
@@ -107,9 +124,15 @@ class ServiceBackend(JaxBackend):
 
     def close_db(self) -> None:
         super().close_db()
-        if not isinstance(self.executor, _Unconnected):
-            self.executor.close()
+        if isinstance(self.executor, _Unconnected):
+            return
+        if getattr(self, "_shared_executor", False):
+            # Segment clone over the parent's channel (stream_clone):
+            # detach without closing — the parent owns the lifetime.
             self.executor = _Unconnected()
+            return
+        self.executor.close()
+        self.executor = _Unconnected()
 
 
 class _Unconnected:

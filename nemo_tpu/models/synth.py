@@ -227,6 +227,121 @@ class SynthSpec:
     first_run_kind: str = "success"
 
 
+def _gen_run(spec: SynthSpec, rng: random.Random, i: int) -> tuple[dict, dict[str, Any]]:
+    """Generate ONE run: (its runs.json entry, its three files).  Consumes
+    the rng in a fixed order, so the streaming writer and the in-memory
+    generator produce identical corpora for identical (seed, index)
+    sequences."""
+    client, primary = "C", "a"
+    replicas = ["b", "c"]
+    nodes = [client, primary] + replicas
+    payload = "foo"
+
+    if i == 0:
+        kind = spec.first_run_kind
+    else:
+        u = rng.random()
+        if u < spec.fail_fraction:
+            kind = "fail"
+        elif u < spec.fail_fraction + spec.vacuous_fraction:
+            kind = "vacuous"
+        elif u < spec.fail_fraction + spec.vacuous_fraction + spec.fail_all_fraction:
+            kind = "fail_all"
+        else:
+            kind = "success"
+
+    eot = spec.eot
+    ack_time = rng.randint(3, max(3, eot - 2))
+    log_time = rng.randint(3, max(3, eot - 1))
+
+    omissions: list[dict[str, Any]] = []
+    crashes: list[dict[str, Any]] = []
+
+    if kind == "fail":
+        # Lose the replicate message to one replica.
+        lost = rng.choice(replicas)
+        logged = [r for r in replicas if r != lost]
+        omissions.append({"from": primary, "to": lost, "time": log_time - 1})
+        pre_achieved, post_achieved = True, False
+        status = "fail"
+    elif kind == "fail_all":
+        # Lose every replicate message: the ack still happens (async
+        # primary/backup acks before replicating) but the consequent
+        # provenance is empty and whole rule tables go missing.
+        logged = []
+        for rep in replicas:
+            omissions.append({"from": primary, "to": rep, "time": log_time - 1})
+        pre_achieved, post_achieved = True, False
+        status = "fail"
+    elif kind == "vacuous":
+        # Lose the initial request: antecedent never achieved.
+        logged = []
+        omissions.append({"from": client, "to": primary, "time": 1})
+        pre_achieved, post_achieved = False, False
+        status = "success"
+    else:
+        logged = list(replicas)
+        pre_achieved, post_achieved = True, True
+        status = "success"
+
+    messages = [
+        {"table": "request", "from": client, "to": primary, "sendTime": 1, "receiveTime": 2},
+    ]
+    if pre_achieved:
+        messages.append(
+            {
+                "table": "ack",
+                "from": primary,
+                "to": client,
+                "sendTime": ack_time - 1,
+                "receiveTime": ack_time,
+            }
+        )
+        for rep in logged:
+            messages.append(
+                {
+                    "table": "replicate",
+                    "from": primary,
+                    "to": rep,
+                    "sendTime": log_time - 1,
+                    "receiveTime": log_time,
+                }
+            )
+
+    # Model tables: last column of each 'pre'/'post' row is the timestep at
+    # which the condition held (faultinjectors/molly.go:38-48).
+    tables: dict[str, list[list[str]]] = {"pre": [], "post": []}
+    if pre_achieved:
+        tables["pre"] = [[payload, str(t)] for t in range(ack_time, eot + 1)]
+    if post_achieved:
+        tables["post"] = [[payload, str(t)] for t in range(log_time, eot + 1)]
+
+    entry = {
+        "iteration": i,
+        "status": status,
+        "failureSpec": {
+            "eot": eot,
+            "eff": spec.eff,
+            "maxCrashes": 1,
+            "nodes": nodes,
+            "crashes": crashes,
+            "omissions": omissions,
+        },
+        "model": {"tables": tables},
+        "messages": messages,
+    }
+    files = {
+        f"run_{i}_pre_provenance.json": _build_pre_prov(
+            pre_achieved, eot, ack_time, client, primary, payload
+        ),
+        f"run_{i}_post_provenance.json": _build_post_prov(
+            logged, eot, log_time, post_achieved, primary, client, payload
+        ),
+        f"run_{i}_spacetime.dot": _build_spacetime_dot(nodes, eot, messages),
+    }
+    return entry, files
+
+
 def generate_corpus(spec: SynthSpec) -> dict[str, Any]:
     """Generate an in-memory corpus: file name -> JSON-serializable content.
 
@@ -236,119 +351,12 @@ def generate_corpus(spec: SynthSpec) -> dict[str, Any]:
     Override with spec.first_run_kind to test that assumption's guard.
     """
     rng = random.Random(spec.seed)
-    client, primary = "C", "a"
-    replicas = ["b", "c"]
-    nodes = [client, primary] + replicas
-    payload = "foo"
-
     files: dict[str, Any] = {}
     runs_json = []
-
     for i in range(spec.n_runs):
-        if i == 0:
-            kind = spec.first_run_kind
-        else:
-            u = rng.random()
-            if u < spec.fail_fraction:
-                kind = "fail"
-            elif u < spec.fail_fraction + spec.vacuous_fraction:
-                kind = "vacuous"
-            elif u < spec.fail_fraction + spec.vacuous_fraction + spec.fail_all_fraction:
-                kind = "fail_all"
-            else:
-                kind = "success"
-
-        eot = spec.eot
-        ack_time = rng.randint(3, max(3, eot - 2))
-        log_time = rng.randint(3, max(3, eot - 1))
-
-        omissions: list[dict[str, Any]] = []
-        crashes: list[dict[str, Any]] = []
-
-        if kind == "fail":
-            # Lose the replicate message to one replica.
-            lost = rng.choice(replicas)
-            logged = [r for r in replicas if r != lost]
-            omissions.append({"from": primary, "to": lost, "time": log_time - 1})
-            pre_achieved, post_achieved = True, False
-            status = "fail"
-        elif kind == "fail_all":
-            # Lose every replicate message: the ack still happens (async
-            # primary/backup acks before replicating) but the consequent
-            # provenance is empty and whole rule tables go missing.
-            logged = []
-            for rep in replicas:
-                omissions.append({"from": primary, "to": rep, "time": log_time - 1})
-            pre_achieved, post_achieved = True, False
-            status = "fail"
-        elif kind == "vacuous":
-            # Lose the initial request: antecedent never achieved.
-            logged = []
-            omissions.append({"from": client, "to": primary, "time": 1})
-            pre_achieved, post_achieved = False, False
-            status = "success"
-        else:
-            logged = list(replicas)
-            pre_achieved, post_achieved = True, True
-            status = "success"
-
-        messages = [
-            {"table": "request", "from": client, "to": primary, "sendTime": 1, "receiveTime": 2},
-        ]
-        if pre_achieved:
-            messages.append(
-                {
-                    "table": "ack",
-                    "from": primary,
-                    "to": client,
-                    "sendTime": ack_time - 1,
-                    "receiveTime": ack_time,
-                }
-            )
-            for rep in logged:
-                messages.append(
-                    {
-                        "table": "replicate",
-                        "from": primary,
-                        "to": rep,
-                        "sendTime": log_time - 1,
-                        "receiveTime": log_time,
-                    }
-                )
-
-        # Model tables: last column of each 'pre'/'post' row is the timestep at
-        # which the condition held (faultinjectors/molly.go:38-48).
-        tables: dict[str, list[list[str]]] = {"pre": [], "post": []}
-        if pre_achieved:
-            tables["pre"] = [[payload, str(t)] for t in range(ack_time, eot + 1)]
-        if post_achieved:
-            tables["post"] = [[payload, str(t)] for t in range(log_time, eot + 1)]
-
-        runs_json.append(
-            {
-                "iteration": i,
-                "status": status,
-                "failureSpec": {
-                    "eot": eot,
-                    "eff": spec.eff,
-                    "maxCrashes": 1,
-                    "nodes": nodes,
-                    "crashes": crashes,
-                    "omissions": omissions,
-                },
-                "model": {"tables": tables},
-                "messages": messages,
-            }
-        )
-
-        files[f"run_{i}_pre_provenance.json"] = _build_pre_prov(
-            pre_achieved, eot, ack_time, client, primary, payload
-        )
-        files[f"run_{i}_post_provenance.json"] = _build_post_prov(
-            logged, eot, log_time, post_achieved, primary, client, payload
-        )
-        files[f"run_{i}_spacetime.dot"] = _build_spacetime_dot(nodes, eot, messages)
-
+        entry, run_files = _gen_run(spec, rng, i)
+        runs_json.append(entry)
+        files.update(run_files)
     files["runs.json"] = runs_json
     return files
 
@@ -394,6 +402,100 @@ def grow_corpus_dir(full_dir: str, dst_dir: str, n_runs: int) -> None:
             shutil.copy2(src, dst)
     with open(os.path.join(dst_dir, "runs.json"), "w", encoding="utf-8") as fh:
         json.dump(raw[:n_runs], fh, indent=1)
+
+
+def _append_entries(path: str, new_entries: list[str], first: bool) -> None:
+    """Flush one segment's pre-serialized runs.json entries, byte-identical
+    to rewriting ``json.dump(all_entries, fh, indent=1)`` — the serializer
+    grow_corpus_dir and the store's strong runs.json prefix check
+    (npack._runs_prefix_sha) pin.  Because each flush keeps the previous
+    one as an exact byte prefix (sans the closing ``\\n]``), later segments
+    APPEND IN PLACE — seek back over the two tail bytes and write only the
+    new entries — so flushing the whole corpus costs O(total) bytes once,
+    not O(segments * total), and no entry outlives its segment in memory."""
+    if first:
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write("[")
+            for j, e in enumerate(new_entries):
+                fh.write(",\n " if j else "\n ")
+                fh.write(e)
+            fh.write("\n]")
+        return
+    with open(path, "r+b") as fh:
+        fh.seek(0, os.SEEK_END)
+        size = fh.tell()
+        fh.seek(max(0, size - 2))
+        tail = fh.read(2)
+        if tail != b"\n]":
+            raise RuntimeError(
+                f"{path}: unexpected tail {tail!r} (not a prior segment flush)"
+            )
+        fh.seek(size - 2)
+        buf = "".join(",\n " + e for e in new_entries) + "\n]"
+        fh.write(buf.encode("utf-8"))
+        fh.truncate()
+
+
+def write_corpus_stream(
+    spec: SynthSpec,
+    out_dir: str,
+    segment_runs: int,
+    store=None,
+    log=None,
+) -> str:
+    """Write a ``spec.n_runs`` corpus SEGMENT BY SEGMENT — the million-run
+    generator (ISSUE 12, extending :func:`grow_corpus_dir`'s incremental-
+    sweep simulation to generation itself).  Each segment's run files are
+    written and runs.json re-flushed (the previous content stays a byte
+    prefix), then — when ``store`` (a CorpusStore) is passed — the corpus
+    store is populated/appended immediately, producing a genuinely
+    multi-segment ``.npack`` whose segment boundaries are exactly these
+    generation batches.  Generation memory and per-segment flush cost are
+    O(segment) — later segments append to runs.json in place
+    (:func:`_append_entries`) — and the per-run provenance content is
+    identical to :func:`generate_corpus` at the same seed.
+
+    Returns the corpus directory."""
+    corpus_dir = os.path.join(out_dir, spec.name)
+    os.makedirs(corpus_dir, exist_ok=True)
+    rng = random.Random(spec.seed)
+    runs_path = os.path.join(corpus_dir, "runs.json")
+    if spec.n_runs == 0:
+        with open(runs_path, "w", encoding="utf-8") as fh:
+            fh.write("[]")
+    i = 0
+    while i < spec.n_runs:
+        seg_end = min(spec.n_runs, i + segment_runs)
+        seg_entries: list[str] = []  # this segment's entries only
+        for j in range(i, seg_end):
+            entry, files = _gen_run(spec, rng, j)
+            # Continuation lines gain the list level's one-space indent;
+            # safe textually because json.dumps escapes newlines inside
+            # strings, so raw "\n" is always formatting.
+            seg_entries.append(json.dumps(entry, indent=1).replace("\n", "\n "))
+            for name, content in files.items():
+                path = os.path.join(corpus_dir, name)
+                with open(path, "w", encoding="utf-8") as f:
+                    if name.endswith(".json"):
+                        json.dump(content, f, indent=1)
+                    else:
+                        f.write(content)
+        _append_entries(runs_path, seg_entries, first=(i == 0))
+        if store is not None:
+            # First segment: parse + populate.  Later segments: the grown
+            # directory classifies GROWN and appends ONLY the new runs
+            # (store/__init__._append_locked); load_corpus skips the
+            # per-run MollyOutput construction, so the per-segment store
+            # maintenance is array-and-parse work over the segment alone.
+            got = store.load_corpus(corpus_dir)
+            if got is None:
+                from nemo_tpu.ingest.molly import load_molly_output
+
+                store.put(corpus_dir, load_molly_output(corpus_dir))
+        if log is not None:
+            log(f"  synth stream: {seg_end}/{spec.n_runs} runs written")
+        i = seg_end
+    return corpus_dir
 
 
 # The shared 10k-node giant-path stress scenario (VERDICT r3 task 7): a

@@ -259,6 +259,37 @@ async function telemetry() {
     body.append(telemetryTable("Result cache / delta analysis", rcacheRows));
   }
 
+  // Streamed analysis (analysis/stream.py, ISSUE 12): whether this run
+  // streamed its segments through the double-buffered prefetch pipeline,
+  // how often the accelerators stalled on ingest, and the bounded
+  // working-set watermark the stream maintained.
+  const allGauges = (data.metrics || {}).gauges || {};
+  const streamRows = [];
+  if (allCounters["stream.segments_staged"]) {
+    streamRows.push(["segments streamed", allCounters["stream.segments_staged"]]);
+    if (allCounters["stream.prefetch_stall_s"] != null) {
+      streamRows.push([
+        "prefetch stall",
+        `${(allCounters["stream.prefetch_stall_s"] * 1e3).toFixed(1)} ms`,
+      ]);
+    }
+    if (allCounters["stream.staged_bytes"]) {
+      streamRows.push([
+        "device-staged",
+        `${(allCounters["stream.staged_bytes"] / 1e6).toFixed(1)} MB`,
+      ]);
+    }
+    if (allGauges["mem.stream_peak_rss"]) {
+      streamRows.push([
+        "stream peak RSS",
+        `${(allGauges["mem.stream_peak_rss"] / 1e6).toFixed(1)} MB`,
+      ]);
+    }
+  }
+  if (streamRows.length) {
+    body.append(telemetryTable("Streamed analysis", streamRows));
+  }
+
   // Kernel cost accounting (backend/jax_backend.py:kernel_cost_snapshot):
   // one row per dispatch signature — FLOPs / bytes-accessed estimates,
   // the first-dispatch (compile) wall, and how often it dispatched.

@@ -35,6 +35,90 @@ _PAD = {
 }
 
 
+class LazyCondBatch:
+    """Multi-segment NativeCondBatch stand-in whose big ``[B,V]``/``[B,E]``
+    planes consolidate LAZILY (ISSUE 12): the ``b``-kind per-run vectors
+    (n_nodes/n_goals/chain_linear — what sizing, the giant split, and the
+    linear fast-path gate read) concatenate eagerly at mmap cost, but a
+    corpus-wide pad+concat of the node/edge planes only happens on the
+    first attribute access — so a report-only touch (string splicing, run
+    metadata) of a multi-segment store never materializes them at all, and
+    the streamed analysis path reads row subsets through :meth:`take`
+    against the per-segment mmaps, keeping peak memory O(segment) instead
+    of O(corpus).  An attribute access materializes the full plane exactly
+    once (cached on the instance), byte-identical to the eager
+    consolidation it replaces."""
+
+    def __init__(self, cond: str, seg_readers: list[dict], segs: list[dict]) -> None:
+        from nemo_tpu.store.npack import _COND_ARRAYS
+
+        self._cond = cond
+        self._readers = seg_readers
+        self._segs = segs
+        self._v = max(int(s["v"]) for s in segs)
+        self._e = max(int(s["e"]) for s in segs)
+        self._kind = dict(_COND_ARRAYS)
+        seg_runs = [int(s["n_runs"]) for s in segs]
+        self._b = sum(seg_runs)
+        self._starts = np.cumsum([0] + seg_runs)
+        for name, kind in _COND_ARRAYS:
+            if kind == "b":
+                setattr(
+                    self,
+                    name,
+                    np.concatenate(
+                        [self._region(k, name) for k in range(len(segs))]
+                    ),
+                )
+
+    def _region(self, k: int, name: str) -> np.ndarray:
+        return self._readers[k][f"arrays_{self._cond}.bin"].region(name)
+
+    def _width(self, kind: str) -> int:
+        return self._v if kind == "bv" else self._e
+
+    def __getattr__(self, name: str) -> np.ndarray:
+        # Only reached when the attribute is NOT yet set: the full lazy
+        # consolidation, cached via setattr so later reads are plain.
+        kind = self.__dict__.get("_kind", {}).get(name)
+        if kind is None or kind == "b":
+            raise AttributeError(name)
+        parts = [self._region(k, name) for k in range(len(self._segs))]
+        out = np.full(
+            (self._b, self._width(kind)), _PAD[name], dtype=parts[0].dtype
+        )
+        row = 0
+        for p in parts:
+            out[row : row + p.shape[0], : p.shape[1]] = p
+            row += p.shape[0]
+        setattr(self, name, out)
+        return out
+
+    def take(self, name: str, rows) -> np.ndarray:
+        """Gather ``rows`` (global positions, any order) of one plane from
+        the per-segment mmaps, padded to the consolidated width — the values
+        the eager path's ``consolidated[rows]`` would produce, without ever
+        materializing the corpus-wide plane.  Reads only the touched
+        segments' pages."""
+        kind = self._kind[name]
+        idx = np.asarray(rows, dtype=np.int64)
+        if kind == "b":
+            return getattr(self, name)[idx]
+        if name in self.__dict__:  # already consolidated — use it
+            return self.__dict__[name][idx]
+        seg_of = np.searchsorted(self._starts, idx, side="right") - 1
+        out = np.full(
+            (len(idx), self._width(kind)),
+            _PAD[name],
+            dtype=self._region(0, name).dtype,
+        )
+        for k in np.unique(seg_of):
+            sel = np.nonzero(seg_of == k)[0]
+            src = self._region(int(k), name)
+            out[sel, : src.shape[1]] = src[idx[sel] - int(self._starts[k])]
+        return out
+
+
 class _SegmentStrings:
     """String access for one segment: the meta shard's status/holds/head
     blobs plus the row-chunked prov/node-id shards per condition."""
@@ -193,8 +277,11 @@ def _decode_vocab(vocab_rd: ShardReader, part: str) -> list[str]:
 
 def build_corpus(store_dir: str, header: dict, seg_readers: list[dict], vocab_rd):
     """Assemble the StoreCorpus from mmapped shards.  Single segment: every
-    array is a zero-copy memmap view.  Multiple segments: consolidated into
-    the joint bucket (pad + concat — still array-speed, no JSON)."""
+    array is a zero-copy memmap view.  Multiple segments: a
+    :class:`LazyCondBatch` — per-run vectors consolidated eagerly, the big
+    node/edge planes consolidated only on first touch (byte-identical to
+    the old eager pad+concat) and row-gatherable per segment via
+    ``take()`` (the streamed path's bounded-working-set read)."""
     _, NativeCondBatch, _, _ = _import_native()
     from nemo_tpu.store.npack import _COND_ARRAYS
 
@@ -205,23 +292,7 @@ def build_corpus(store_dir: str, header: dict, seg_readers: list[dict], vocab_rd
         if len(segs) == 1:
             rd = seg_readers[0][f"arrays_{cond}.bin"]
             return NativeCondBatch(**{n: rd.region(n) for n, _ in _COND_ARRAYS})
-        v = max(int(s["v"]) for s in segs)
-        e = max(int(s["e"]) for s in segs)
-        b = sum(seg_runs)
-        arrs = {}
-        for name, kind in _COND_ARRAYS:
-            parts = [sr[f"arrays_{cond}.bin"].region(name) for sr in seg_readers]
-            if kind == "b":
-                arrs[name] = np.concatenate(parts)
-                continue
-            width = v if kind == "bv" else e
-            out = np.full((b, width), _PAD[name], dtype=parts[0].dtype)
-            row = 0
-            for p in parts:
-                out[row : row + p.shape[0], : p.shape[1]] = p
-                row += p.shape[0]
-            arrs[name] = out
-        return NativeCondBatch(**arrs)
+        return LazyCondBatch(cond, seg_readers, segs)
 
     iteration = (
         seg_readers[0]["runs.bin"].region("iteration")

@@ -1453,6 +1453,242 @@ def _chaos_smoke_inner() -> int:
     return 0
 
 
+#: Child harness for the stream smoke / bench stream tier: run one
+#: pipeline pass and report wall + RSS watermarks.  Anonymous RSS is
+#: sampled from /proc/self/status (RssAnon) on a daemon thread: ru_maxrss
+#: counts file-backed mmap pages too — the warm store's shards, touched by
+#: BOTH modes' report splice and reclaimable under pressure — which would
+#: drown the anonymous working set the streaming bound is actually about.
+STREAM_CHILD_CODE = """
+import json, os, resource, sys, threading, time
+
+peak = [0]
+
+def _sample():
+    while True:
+        try:
+            with open("/proc/self/status") as fh:
+                for line in fh:
+                    if line.startswith("RssAnon:"):
+                        peak[0] = max(peak[0], int(line.split()[1]))
+                        break
+        except OSError:
+            pass
+        time.sleep(0.02)
+
+threading.Thread(target=_sample, daemon=True).start()
+from nemo_tpu import obs
+from nemo_tpu.analysis.pipeline import run_debug
+from nemo_tpu.backend.jax_backend import JaxBackend
+
+t0 = time.perf_counter()
+res = run_debug(sys.argv[1], sys.argv[2], JaxBackend(), figures=sys.argv[3])
+wall = time.perf_counter() - t0
+time.sleep(0.1)  # let the sampler catch the tail
+snap = obs.metrics.snapshot()
+print("STREAM_CHILD " + json.dumps({
+    "wall_s": wall,
+    "runs": len(res.molly.runs),
+    "peak_rss_mb": resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0,
+    "anon_peak_mb": peak[0] / 1024.0,
+    "stall_s": snap["counters"].get("stream.prefetch_stall_s", 0.0),
+    "staged": snap["counters"].get("stream.segments_staged", 0),
+    "threaded": int(snap["gauges"].get("stream.threaded", 0)),
+    "stage_wall_s": (snap["histograms"].get("stream.stage_s") or {}).get("sum", 0.0),
+    "timings": {k: round(v, 4) for k, v in res.timings.items()},
+}))
+"""
+
+
+def run_stream_child(
+    corpus: str, out_dir: str, figures: str, env: dict, timeout: float = 900.0
+) -> dict:
+    """Run one STREAM_CHILD_CODE subprocess; returns its report dict."""
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, "-c", STREAM_CHILD_CODE, corpus, out_dir, figures],
+        env=env, capture_output=True, text=True, timeout=timeout,
+    )
+    for line in proc.stdout.splitlines():
+        if line.startswith("STREAM_CHILD "):
+            return json.loads(line[len("STREAM_CHILD "):])
+    raise RuntimeError(
+        f"stream child produced no report (rc={proc.returncode}); "
+        f"stderr tail: {proc.stderr[-800:]}"
+    )
+
+
+def stream_smoke() -> int:
+    """Out-of-core streaming smoke (`make stream-smoke`, also the tail of
+    `make validate`; ISSUE 12): through real pipeline runs over a
+    multi-segment store,
+
+      * a streamed run (NEMO_STREAM=on, budget 2) must be byte-identical —
+        figures included — to the in-memory oracle (NEMO_STREAM=off), with
+        the stream actually staging every segment;
+      * over a larger corpus, the streamed run's anonymous-RSS watermark
+        (subprocess children identical but for the knob) must sit strictly
+        below the in-memory run's — the bounded-working-set contract;
+      * a SIGKILL mid-stream must resume via the PR-9 checkpoint path:
+        the rerun serves the published segment partials from cache, maps
+        only the rest, and reports byte-identical to from-scratch.
+    """
+    from nemo_tpu.utils.jax_config import pin_platform
+
+    pin_platform("cpu")
+    prior_knobs = {
+        k: os.environ.pop(k, None)
+        for k in (
+            "NEMO_STREAM",
+            "NEMO_STREAM_SEGMENTS",
+            "NEMO_STORE_VERIFY",
+            "NEMO_STORE_FINGERPRINT",
+            "NEMO_STORE_WORKERS",
+            "NEMO_RESULT_CACHE",
+            "NEMO_RESULT_CACHE_MAX_GB",
+            "NEMO_CHECKPOINT",
+            "NEMO_CHAOS",
+        )
+    }
+    try:
+        return _stream_smoke_inner()
+    finally:
+        for k, v in prior_knobs.items():
+            if v is not None:
+                os.environ[k] = v
+            else:
+                os.environ.pop(k, None)
+
+
+def _stream_smoke_inner() -> int:
+    import subprocess
+
+    from nemo_tpu import obs
+    from nemo_tpu.analysis.pipeline import run_debug
+    from nemo_tpu.backend.jax_backend import JaxBackend
+    from nemo_tpu.models.synth import SynthSpec, write_corpus_stream
+    from nemo_tpu.store import resolve_store
+
+    problems: list[str] = []
+    with tempfile.TemporaryDirectory(prefix="nemo_stream_smoke_") as tmp:
+        os.environ["NEMO_SVG_CACHE"] = os.path.join(tmp, "svg_cache")
+        cc = os.path.join(tmp, "corpus_cache")
+        os.environ["NEMO_CORPUS_CACHE"] = cc
+        os.environ["NEMO_RESULT_CACHE"] = "off"
+
+        # ------------------------- (a) byte parity, figures included
+        small = write_corpus_stream(
+            SynthSpec(n_runs=24, seed=3, eot=6, name="stream_small"),
+            os.path.join(tmp, "small"),
+            segment_runs=8,
+            store=resolve_store(cc),
+        )
+
+        def run(label: str, stream: str, **kw):
+            os.environ["NEMO_STREAM"] = stream
+            os.environ["NEMO_STREAM_SEGMENTS"] = "2"
+            m0 = obs.metrics.snapshot()
+            r = run_debug(
+                small, os.path.join(tmp, label), JaxBackend(), figures="all", **kw
+            )
+            return _tree(r.report_dir), obs.Metrics.delta(
+                obs.metrics.snapshot(), m0
+            )["counters"]
+
+        t_mem, _ = run("a_mem", "off")
+        t_str, m_str = run("a_stream", "on")
+        if m_str.get("stream.segments_staged", 0) < 3:
+            problems.append(
+                f"(a) streamed run staged {m_str.get('stream.segments_staged')} "
+                "segments (want 3: the run did not actually stream)"
+            )
+        if t_str != t_mem:
+            bad = sorted(k for k in t_mem if t_mem.get(k) != t_str.get(k))
+            problems.append(
+                f"(a) streamed report diverges from in-memory in {len(bad)} "
+                f"file(s), e.g. {bad[:5]}"
+            )
+
+        # --------------------- (b) bounded working set (RSS watermark)
+        big = write_corpus_stream(
+            SynthSpec(n_runs=1600, seed=7, eot=120, name="stream_big"),
+            os.path.join(tmp, "big"),
+            segment_runs=200,
+            store=resolve_store(cc),
+        )
+        child_env = dict(
+            os.environ, JAX_PLATFORMS="cpu", NEMO_STREAM_SEGMENTS="2",
+            NEMO_RENDER_WORKERS="1",
+        )
+        mem = run_stream_child(
+            big, os.path.join(tmp, "b_mem"), "sample:4",
+            dict(child_env, NEMO_STREAM="off"),
+        )
+        strm = run_stream_child(
+            big, os.path.join(tmp, "b_stream"), "sample:4",
+            dict(child_env, NEMO_STREAM="on"),
+        )
+        if strm["staged"] < 8:
+            problems.append(f"(b) streamed child staged {strm['staged']} segments, want 8")
+        if not (0 < strm["anon_peak_mb"] < mem["anon_peak_mb"]):
+            problems.append(
+                f"(b) streamed anon-RSS watermark {strm['anon_peak_mb']:.0f} MB "
+                f"not below in-memory {mem['anon_peak_mb']:.0f} MB"
+            )
+
+        # ------------------------------ (c) SIGKILL mid-stream resume
+        rc_root = os.path.join(tmp, "rcache")
+        os.environ["NEMO_RESULT_CACHE"] = rc_root
+        os.environ["NEMO_STREAM"] = "on"
+        kill_env = dict(
+            os.environ, JAX_PLATFORMS="cpu",
+            NEMO_CHAOS="kill_after_segments:1", NEMO_RENDER_WORKERS="1",
+        )
+        code = (
+            "from nemo_tpu.analysis.pipeline import run_debug\n"
+            "from nemo_tpu.backend.jax_backend import JaxBackend\n"
+            f"run_debug({small!r}, {os.path.join(tmp, 'c_res')!r}, JaxBackend())\n"
+            "print('COMPLETED')\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code], env=kill_env,
+            capture_output=True, text=True, timeout=600,
+        )
+        if proc.returncode != -9 or "COMPLETED" in proc.stdout:
+            problems.append(
+                f"(c) chaos kill did not SIGKILL the stream (rc={proc.returncode}); "
+                f"stderr tail: {proc.stderr[-500:]}"
+            )
+        m0 = obs.metrics.snapshot()
+        r_res = run_debug(small, os.path.join(tmp, "c_res"), JaxBackend())
+        mr = obs.Metrics.delta(obs.metrics.snapshot(), m0)["counters"]
+        if not mr.get("delta.segments_cached"):
+            problems.append(f"(c) resume served no checkpointed segment: {mr}")
+        if mr.get("delta.segments_mapped", 0) >= 3:
+            problems.append(f"(c) resume re-mapped every segment: {mr}")
+        t_res = _tree(r_res.report_dir)
+        if t_res != t_mem:
+            bad = sorted(k for k in t_mem if t_mem.get(k) != t_res.get(k))
+            problems.append(
+                f"(c) resumed streamed report diverges in {len(bad)} file(s), "
+                f"e.g. {bad[:5]}"
+            )
+        os.environ["NEMO_RESULT_CACHE"] = "off"
+
+    if problems:
+        print("stream-smoke: " + "; ".join(problems), file=sys.stderr)
+        return 1
+    print(
+        "stream-smoke: ok — streamed run byte-identical to the in-memory "
+        "oracle (figures included); anonymous-RSS watermark "
+        f"{strm['anon_peak_mb']:.0f} MB streamed vs {mem['anon_peak_mb']:.0f} MB "
+        "in-memory over 8 segments; SIGKILL mid-stream resumed from the "
+        "checkpointed partials byte-identical to from-scratch"
+    )
+    return 0
+
+
 def main() -> int:
     from nemo_tpu.analysis.pipeline import run_debug
     from nemo_tpu.backend.jax_backend import JaxBackend
@@ -1641,7 +1877,14 @@ def main() -> int:
     # quarantined corrupt runs, host-lane failover + breaker under injected
     # device faults, crash-safe resume after SIGKILL — all byte-identical
     # to healthy runs.
-    return chaos_smoke()
+    rc = chaos_smoke()
+    if rc:
+        return rc
+    # Out-of-core streaming contract (also standalone: make stream-smoke;
+    # ISSUE 12): a tiny-budget streamed run byte-identical to the in-memory
+    # oracle (figures included), a strictly lower anonymous-RSS watermark,
+    # and SIGKILL-mid-stream resume via the checkpoint path.
+    return stream_smoke()
 
 
 if __name__ == "__main__":
@@ -1661,4 +1904,6 @@ if __name__ == "__main__":
         sys.exit(serve_smoke())
     if "--chaos-smoke" in sys.argv:
         sys.exit(chaos_smoke())
+    if "--stream-smoke" in sys.argv:
+        sys.exit(stream_smoke())
     sys.exit(main())

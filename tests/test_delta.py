@@ -289,6 +289,102 @@ def test_reduce_is_order_insensitive():
     assert norm(delta.reduce_partials([r0, r1], molly, good_iter=0)) == fwd
 
 
+def test_tree_merge_property_matches_flat_fold():
+    """ISSUE 12 property test (hypothesis-style seeded loop): for random
+    segment counts, merge arities, and input permutations, the k-ary TREE
+    merge byte-equals the flat left-fold (`_merge_group` over the whole
+    list IS the flat fold), and the reduce built on it is invariant under
+    both the tree shape and input permutation."""
+    import random
+
+    from nemo_tpu.ingest.datatypes import RunData
+    from nemo_tpu.ingest.molly import MollyOutput
+
+    rng = random.Random(1234)
+    tables = ["t_a", "t_b", "t_c", "t_d", "t_e"]
+
+    for trial in range(40):
+        n_segs = rng.randint(1, 12)
+        arity = rng.randint(2, 9)
+        molly = MollyOutput(run_name="m", output_dir="")
+        partials = []
+        it = 0
+        for s in range(n_segs):
+            seg_iters, seg_succ, seg_failed = [], [], []
+            ordered, present, missing, achieved = {}, {}, {}, {}
+            for _ in range(rng.randint(1, 4)):
+                ok = rng.random() < 0.5 or it == 0
+                r = RunData(iteration=it, status="success" if ok else "fail")
+                molly.runs.append(r)
+                molly.runs_iters.append(it)
+                seg_iters.append(it)
+                if ok:
+                    molly.success_runs_iters.append(it)
+                    seg_succ.append(it)
+                    ordered[it] = rng.sample(tables, rng.randint(0, 4))
+                    achieved[it] = rng.randint(0, 2)
+                else:
+                    molly.failed_runs_iters.append(it)
+                    seg_failed.append(it)
+                    present[it] = sorted(rng.sample(tables, rng.randint(0, 3)))
+                    missing[it] = [{"rule": {"id": f"r{it}"}, "goals": []}]
+                    achieved[it] = 0
+                it += 1
+            partials.append(
+                delta.SegmentPartial(
+                    iters=seg_iters,
+                    success_iters=seg_succ,
+                    failed_iters=seg_failed,
+                    proto_ordered=ordered,
+                    present=present,
+                    missing=missing,
+                    achieved=achieved,
+                    # Anchor content is identical on every carrier (the
+                    # anchors ride in every publishing map's view) — the
+                    # invariant that makes last-wins permutation-safe.
+                    corrections=["fix-x"],
+                    extensions=["ext-y"],
+                    fig_files=[f"run_{i}_spacetime.svg" for i in seg_iters],
+                )
+            )
+
+        # (1) merged content: k-ary tree == flat left-fold, byte for byte.
+        tree = delta.merge_partials(list(partials), arity=arity)
+        flat = delta._merge_group(list(partials))
+        assert json.dumps(tree.to_json(), sort_keys=True) == json.dumps(
+            flat.to_json(), sort_keys=True
+        ), f"trial {trial}: tree(arity={arity}) != flat fold over {n_segs} segments"
+
+        # (2) the incremental TreeReducer's frontier reduces identically.
+        reducer = delta.TreeReducer(arity=arity)
+        for p in partials:
+            reducer.push(p)
+        assert reducer.pushed == n_segs
+
+        def norm(red):
+            return (
+                red.inter,
+                red.union,
+                red.inter_miss,
+                red.union_miss,
+                {k: [m.to_json() for m in v] for k, v in red.missing.items()},
+                red.corrections,
+                red.extensions,
+                red.all_achieved,
+            )
+
+        good = molly.success_runs_iters[0] if molly.success_runs_iters else None
+        want = norm(delta.reduce_partials(list(partials), molly, good_iter=good))
+        got = norm(delta.reduce_partials(reducer.partials(), molly, good_iter=good))
+        assert got == want, f"trial {trial}: TreeReducer frontier reduce diverged"
+
+        # (3) permutation invariance of the reduce, any arity.
+        perm = list(partials)
+        rng.shuffle(perm)
+        got_p = norm(delta.reduce_partials(perm, molly, good_iter=good))
+        assert got_p == want, f"trial {trial}: permuted reduce diverged"
+
+
 def test_kernel_dispatch_count_sums_prefix():
     counters = {
         "kernel.dispatches.fused": 2,

@@ -1,0 +1,288 @@
+"""Out-of-core segment-streamed analysis (ISSUE 12, nemo_tpu/analysis/stream.py)
+plus the lazy store views that back it (store/reader.py:LazyCondBatch,
+npack blob-view memoization)."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from nemo_tpu import obs
+from nemo_tpu.analysis import delta
+from nemo_tpu.analysis import stream as stream_mod
+from nemo_tpu.analysis.pipeline import report_tree_bytes as _tree
+from nemo_tpu.analysis.pipeline import run_debug
+from nemo_tpu.backend.jax_backend import JaxBackend
+from nemo_tpu.models.synth import SynthSpec, write_corpus, write_corpus_stream
+from nemo_tpu.store import resolve_store
+
+
+@pytest.fixture()
+def seg_corpus(tmp_path, monkeypatch):
+    """A 3-segment .npack-backed corpus (18 runs, 6 per segment) plus its
+    hermetic cache roots; returns (corpus_dir, store)."""
+    cc = str(tmp_path / "corpus_cache")
+    monkeypatch.setenv("NEMO_CORPUS_CACHE", cc)
+    monkeypatch.setenv("NEMO_RESULT_CACHE", "off")
+    monkeypatch.setenv("NEMO_SVG_CACHE", str(tmp_path / "svg_cache"))
+    store = resolve_store(cc)
+    d = write_corpus_stream(
+        SynthSpec(n_runs=18, seed=3, eot=6, name="seg18"),
+        str(tmp_path),
+        segment_runs=6,
+        store=store,
+    )
+    header = json.load(open(os.path.join(store.store_dir(d), "header.json")))
+    assert len(header["segments"]) == 3
+    return d, store
+
+
+# ----------------------------------------------------------- lazy store views
+
+
+def test_lazy_cond_batch_take_matches_consolidation(seg_corpus):
+    d, store = seg_corpus
+    lazy = store.load_corpus(d)
+    eager = store.load_corpus(d)
+    from nemo_tpu.store.npack import _COND_ARRAYS
+    from nemo_tpu.store.reader import LazyCondBatch
+
+    assert isinstance(lazy.pre, LazyCondBatch)
+    rows = [0, 5, 6, 11, 17, 2]  # crosses all three segments, unsorted
+    for cond in ("pre", "post"):
+        lcb = lazy.cond(cond)
+        ecb = eager.cond(cond)
+        for name, kind in _COND_ARRAYS:
+            got = lcb.take(name, rows)
+            # The big planes must still be unconsolidated after take().
+            if kind != "b":
+                assert name not in lcb.__dict__
+            want = np.asarray(getattr(ecb, name))[np.asarray(rows)]
+            np.testing.assert_array_equal(got, want)
+        # Full attribute access consolidates lazily, once, byte-identical.
+        full = lcb.edge_src
+        assert "edge_src" in lcb.__dict__
+        np.testing.assert_array_equal(full, np.asarray(ecb.edge_src))
+        # take() after consolidation serves from the cached plane.
+        np.testing.assert_array_equal(
+            lcb.take("edge_src", rows), full[np.asarray(rows)]
+        )
+
+
+def test_report_only_touch_never_consolidates(seg_corpus):
+    """The lazy-view win (ISSUE 12 satellite): splicing every run's
+    provenance + head strings — the report path — must not materialize a
+    single corpus-wide node/edge plane of a multi-segment store."""
+    d, store = seg_corpus
+    molly = store.load_packed(d)
+    nc = molly.native_corpus
+    for row, run in enumerate(molly.runs):
+        assert run.pre_prov.json_str()
+        assert nc.run_head_json(row)
+    from nemo_tpu.store.npack import _COND_ARRAYS
+
+    for cond in ("pre", "post"):
+        cb = nc.cond(cond)
+        for name, kind in _COND_ARRAYS:
+            if kind != "b":
+                assert name not in cb.__dict__, f"{cond}.{name} consolidated"
+
+
+def test_blob_views_are_memoized(seg_corpus):
+    d, store = seg_corpus
+    from nemo_tpu.store.reader import open_segments
+
+    header = store._read_header(store.store_dir(d))
+    seg_readers, _, _ = open_segments(store.store_dir(d), header, verify=False)
+    rd = seg_readers[0]["meta.bin"]
+    b1 = rd.blob("head")
+    b2 = rd.blob("head")
+    assert b1 is b2
+    assert b1.row(0) == b1.row(0) != b""
+
+
+# ------------------------------------------------------------ streamed map
+
+
+def test_streamed_report_byte_identical(seg_corpus, tmp_path, monkeypatch):
+    d, _ = seg_corpus
+    monkeypatch.setenv("NEMO_STREAM", "off")
+    r_mem = run_debug(d, str(tmp_path / "mem"), JaxBackend(), figures="failed")
+    monkeypatch.setenv("NEMO_STREAM", "on")
+    monkeypatch.setenv("NEMO_STREAM_SEGMENTS", "2")
+    m0 = obs.metrics.snapshot()
+    r_str = run_debug(d, str(tmp_path / "str"), JaxBackend(), figures="failed")
+    md = obs.Metrics.delta(obs.metrics.snapshot(), m0)["counters"]
+    assert md.get("stream.segments_staged") == 3
+    assert _tree(r_mem.report_dir) == _tree(r_str.report_dir)
+
+
+def test_streamed_default_auto_engages(seg_corpus, tmp_path, monkeypatch):
+    """NEMO_STREAM unset (auto): a multi-segment store-served corpus
+    streams by default — the engine's default scaling mode."""
+    d, _ = seg_corpus
+    monkeypatch.delenv("NEMO_STREAM", raising=False)
+    m0 = obs.metrics.snapshot()
+    run_debug(d, str(tmp_path / "auto"), JaxBackend(), figures="none")
+    md = obs.Metrics.delta(obs.metrics.snapshot(), m0)["counters"]
+    assert md.get("stream.segments_staged") == 3
+
+
+def test_single_segment_does_not_stream(tmp_path, monkeypatch):
+    cc = str(tmp_path / "cc")
+    monkeypatch.setenv("NEMO_CORPUS_CACHE", cc)
+    monkeypatch.setenv("NEMO_RESULT_CACHE", "off")
+    d = write_corpus(SynthSpec(n_runs=6, seed=2, eot=6, name="one"), str(tmp_path))
+    m0 = obs.metrics.snapshot()
+    run_debug(d, str(tmp_path / "res"), JaxBackend(), figures="none")
+    md = obs.Metrics.delta(obs.metrics.snapshot(), m0)["counters"]
+    assert not md.get("stream.segments_staged")
+
+
+def test_stream_on_without_capability_falls_back(tmp_path, monkeypatch):
+    """NEMO_STREAM=on over an unstreamable run (object-loader corpus, one
+    segment) warns + counts stream.unstreamable and still completes."""
+    monkeypatch.setenv("NEMO_STREAM", "on")
+    monkeypatch.setenv("NEMO_CORPUS_CACHE", "off")
+    monkeypatch.setenv("NEMO_RESULT_CACHE", "off")
+    d = write_corpus(SynthSpec(n_runs=5, seed=2, eot=6, name="nostream"), str(tmp_path))
+    m0 = obs.metrics.snapshot()
+    r = run_debug(d, str(tmp_path / "res"), JaxBackend(), figures="none")
+    md = obs.Metrics.delta(obs.metrics.snapshot(), m0)["counters"]
+    assert os.path.exists(os.path.join(r.report_dir, "debugging.json"))
+    assert not md.get("stream.segments_staged")
+
+
+# ----------------------------------------------------------- stream plumbing
+
+
+class _FakeSeg:
+    def __init__(self, n):
+        self.n_runs = n
+
+
+def test_stream_groups_order_and_budget():
+    """Groups come back in order, and the residency budget holds: at most
+    `budget` segments are staged-and-unreleased at any moment (the slot is
+    acquired BEFORE staging starts and returned by StagedGroup.release)."""
+    staged_count = [0]
+    released = [0]
+    max_resident = [0]
+
+    class _B:
+        def stream_clone(self):
+            return self
+
+        def init_graph_db(self, conn, view):
+            pass
+
+    groups = [[_FakeSeg(1)] for _ in range(6)]
+
+    def build_view(group):
+        staged_count[0] += 1
+        max_resident[0] = max(max_resident[0], staged_count[0] - released[0])
+        return ("view", staged_count[0] - 1), {1}
+
+    out = []
+    for staged in stream_mod.stream_groups(
+        groups, build_view, _B(), "", budget=2, threaded=True
+    ):
+        out.append(staged.view[1])
+        # Count the release BEFORE freeing the slot so the producer's next
+        # acquire can never observe an understated release count.
+        released[0] += 1
+        staged.release()
+    assert out == list(range(6))
+    assert max_resident[0] <= 2
+
+
+def test_stream_groups_propagates_producer_errors():
+    class _B:
+        def stream_clone(self):
+            return self
+
+        def init_graph_db(self, conn, view):
+            pass
+
+    def build_view(group):
+        raise RuntimeError("boom in staging")
+
+    with pytest.raises(RuntimeError, match="boom in staging"):
+        list(
+            stream_mod.stream_groups(
+                [[_FakeSeg(1)]], build_view, _B(), "", budget=2, threaded=True
+            )
+        )
+
+
+def test_stream_groups_inline_mode():
+    class _B:
+        def stream_clone(self):
+            return self
+
+        def init_graph_db(self, conn, view):
+            pass
+
+    groups = [[_FakeSeg(1)], [_FakeSeg(2)]]
+    got = list(
+        stream_mod.stream_groups(
+            groups, lambda g: (g, set()), _B(), "", budget=2, threaded=False
+        )
+    )
+    assert [s.group for s in got] == groups
+
+
+def test_stream_env_knobs(monkeypatch):
+    monkeypatch.setenv("NEMO_STREAM", "1")
+    assert stream_mod.stream_env() == "on"
+    monkeypatch.setenv("NEMO_STREAM", "0")
+    assert stream_mod.stream_env() == "off"
+    monkeypatch.delenv("NEMO_STREAM")
+    assert stream_mod.stream_env() == "auto"
+    monkeypatch.setenv("NEMO_STREAM_SEGMENTS", "5")
+    assert stream_mod.stream_budget() == 5
+    monkeypatch.setenv("NEMO_STREAM_SEGMENTS", "0")
+    assert stream_mod.stream_budget() == 1  # floor
+
+
+def test_stream_clone_shares_executor():
+    b = JaxBackend()
+    c = b.stream_clone()
+    assert c is not b
+    assert c.executor is b.executor
+
+
+def test_write_corpus_stream_matches_write_corpus(tmp_path):
+    """The segment-streamed generator's corpus — runs.json appended in
+    place per segment — is byte-identical to the one-shot writer's at the
+    same seed (the store's strong prefix check depends on it)."""
+    spec_a = SynthSpec(n_runs=23, seed=5, eot=6, name="s")
+    spec_b = SynthSpec(n_runs=23, seed=5, eot=6, name="s")
+    d1 = write_corpus(spec_a, str(tmp_path / "a"))
+    d2 = write_corpus_stream(spec_b, str(tmp_path / "b"), segment_runs=7)
+    names = sorted(os.listdir(d1))
+    assert names == sorted(os.listdir(d2))
+    for n in names:
+        a = open(os.path.join(d1, n), "rb").read()
+        b = open(os.path.join(d2, n), "rb").read()
+        assert a == b, f"{n} diverges between one-shot and streamed writers"
+
+
+def test_merge_figures_keeps_only_report_inputs():
+    a = delta.MapOutput()
+    b = delta.MapOutput(
+        own_iters=[1],
+        proto_ordered={1: ["t"]},
+        achieved={1: 1},
+        hazard={1: "dot"},
+        diff={1: "dd"},
+    )
+    a.merge_figures(b)
+    assert a.hazard == {1: "dot"} and a.diff == {1: "dd"}
+    assert a.own_iters == [1]
+    # The per-run reduce artifacts stay in the partials, not in the
+    # corpus-wide MapOutput.
+    assert a.proto_ordered == {} and a.achieved == {}

@@ -212,6 +212,29 @@ def extract_metrics(doc: dict) -> dict[str, tuple[float, str, str]]:
         "higher",
         "ratio",
     )
+    # Stream tier (ISSUE 12): out-of-core throughput collapsing toward (or
+    # past) the in-memory rate, the prefetch overlap disappearing, or the
+    # streamed RSS watermark growing all flag.  peak_rss_mb additionally
+    # carries a history-INDEPENDENT absolute ceiling (STREAM_RSS_CEILING_MB,
+    # checked in main): the bounded-working-set contract is "under 4 GB at
+    # any corpus size", not "no worse than last week".
+    st = doc.get("stream_tier") or {}
+    put("stream_tier.runs_per_s", st.get("runs_per_s"), "higher", "ratio")
+    put(
+        "stream_tier.vs_inmemory_ratio",
+        st.get("vs_inmemory_ratio"),
+        "lower",
+        "ratio",
+    )
+    put(
+        "stream_tier.overlap_fraction", st.get("overlap_fraction"), "higher", "ratio"
+    )
+    put("stream_tier.peak_rss_mb", st.get("peak_rss_mb"), "lower", "mb")
+    put("stream_tier.anon_peak_mb", st.get("anon_peak_mb"), "lower", "mb")
+    put("stream_tier.rss_growth_10x", st.get("rss_growth_10x"), "lower", "ratio")
+    large = st.get("large") or {}
+    put("stream_tier.large.runs_per_s", large.get("runs_per_s"), "higher", "ratio")
+    put("stream_tier.large.peak_rss_mb", large.get("peak_rss_mb"), "lower", "mb")
     figures = doc.get("figures") or {}
     put(
         "figures.e2e_warm_all_figures_s",
@@ -252,6 +275,33 @@ def extract_metrics(doc: dict) -> dict[str, tuple[float, str, str]]:
                     "split",
                     "ratio",
                 )
+    return out
+
+
+#: Absolute ceiling on the stream tier's streamed-child peak RSS (MB): the
+#: ISSUE-12 single-host target is "1M runs under 4 GB", and that bound is
+#: meaningful against ZERO history — a first capture over the ceiling must
+#: flag even though no median exists yet.
+STREAM_RSS_CEILING_MB = 4096.0
+
+
+def ceiling_violations(candidate: dict) -> list[dict]:
+    """History-independent absolute bounds (currently the stream tier's
+    RSS ceiling, default and `large` variants)."""
+    out: list[dict] = []
+    st = candidate.get("stream_tier") or {}
+    for name, row in (("stream_tier", st), ("stream_tier.large", st.get("large") or {})):
+        v = row.get("peak_rss_mb")
+        if isinstance(v, (int, float)) and v > STREAM_RSS_CEILING_MB:
+            out.append(
+                {
+                    "metric": f"{name}.peak_rss_mb",
+                    "candidate": round(float(v), 1),
+                    "ceiling_mb": STREAM_RSS_CEILING_MB,
+                    "direction": "ceiling",
+                    "regressed": True,
+                }
+            )
     return out
 
 
@@ -386,6 +436,13 @@ def main(argv: list[str] | None = None) -> int:
     usable = usable[-args.window:]
 
     rc = 0
+    # Absolute ceilings apply regardless of history (stream-tier RSS bound).
+    ceilings = ceiling_violations(candidate)
+    for c in ceilings:
+        _log(
+            f"bench-trend: {c['metric']}: {c['candidate']} MB exceeds the "
+            f"absolute ceiling {c['ceiling_mb']} MB [REGRESSED]"
+        )
     if len(usable) < args.min_history:
         _log(
             f"bench-trend: only {len(usable)} usable same-platform history "
@@ -393,8 +450,16 @@ def main(argv: list[str] | None = None) -> int:
             "recording without a verdict"
         )
         verdict_doc = {"verdict": "no-history", "platform": platform}
+        if ceilings:
+            verdict_doc = {
+                "verdict": "regression",
+                "platform": platform,
+                "regressions": ceilings,
+            }
+            rc = 1
     else:
         regressions, verdicts = compare(candidate, usable, args.threshold)
+        regressions = ceilings + regressions
         for v in verdicts:
             arrow = "REGRESSED" if v["regressed"] else "ok"
             _log(
