@@ -5,7 +5,7 @@ NATIVE_LIB := native/build/libnemo_native.so
 REPORT_SRC := native/nemo_report.cpp
 REPORT_LIB := native/build/libnemo_report.so
 
-.PHONY: all native test bench bench-watch bench-trend prewarm validate trace-smoke obs-smoke store-smoke delta-smoke shard-smoke sparse-device-smoke serve-smoke chaos-smoke stream-smoke lint-print clean reset proto neo4j-up neo4j-validate neo4j-down
+.PHONY: all native test bench bench-watch bench-trend prewarm validate trace-smoke obs-smoke store-smoke delta-smoke shard-smoke sparse-device-smoke serve-smoke chaos-smoke stream-smoke synth-smoke lint-print clean reset proto neo4j-up neo4j-validate neo4j-down
 
 all: native
 
@@ -25,8 +25,9 @@ test:
 # lint, the full suite, the driver's multi-chip dry run (8 virtual CPU
 # devices), and a CLI smoke whose jax report is byte-compared against the
 # Python oracle backend (whose tail runs the trace,
-# operational-observability, corpus-store, result-cache/delta, serving-tier
-# and chaos/fault-tolerance smokes).
+# operational-observability, corpus-store, result-cache/delta, serving-tier,
+# chaos/fault-tolerance, out-of-core-streaming and batched-synthesis
+# smokes).
 validate: lint-print test
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 		python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
@@ -110,6 +111,16 @@ chaos-smoke:
 # byte-identical to from-scratch (analysis/stream.py).
 stream-smoke:
 	python -m nemo_tpu.utils.validate_smoke --stream-smoke
+
+# Batched-synthesis smoke (also the tail of `make validate`; ISSUE 13):
+# forced NEMO_SYNTH_IMPL=python/sparse/sparse_device pipeline runs must
+# produce byte-identical repair trees (repairs.json + the whole report)
+# with analysis.route.synth.* recorded, the corpus-wide ranking must be
+# stable under segment permutation and identical streamed vs in-memory,
+# and the batched synthesis phase must be >=5x faster than the per-run
+# Python oracle (analysis/synth.py, ops/sparse_{device,host}.py).
+synth-smoke:
+	python -m nemo_tpu.utils.validate_smoke --synth-smoke
 
 # Structured-logging contract: no bare print() in nemo_tpu/ outside the
 # CLI/harness allowlist (tools/lint_no_print.py).

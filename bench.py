@@ -462,6 +462,78 @@ def child_main() -> None:
     except Exception as ex:  # the delta tier must never sink the bench
         log(f"delta tier skipped: {type(ex).__name__}: {ex}")
 
+    # Synthesis tier (ISSUE 13): the batched correction/extension synthesis
+    # kernels (analysis/synth.py + the synth_ext verb family) against the
+    # per-run Python oracle they demoted — at 1x (the base corpora) and the
+    # full 10.2k-run corpus (every family's big dir).  Reports walls,
+    # candidates/s, per-route dispatch splits, and whether two batched
+    # passes rank the same top-10 (the determinism the cached/streamed
+    # reduce relies on).
+    synth_tier = None
+    try:
+        from collections import Counter
+
+        from nemo_tpu.analysis.pipeline import _ingest as _synth_ingest
+        from nemo_tpu.backend.jax_backend import JaxBackend as _SynthJB
+        from nemo_tpu.store import resolve_store as _synth_resolve_store
+
+        def _synth_topk(cands: dict) -> list:
+            support = Counter(t for ts in cands.values() for t in ts)
+            return sorted(support.items(), key=lambda kv: (-kv[1], kv[0]))[:10]
+
+        def _synth_pass(dirs):
+            oracle_s = batched_s = 0.0
+            cand_total = runs_total = 0
+            routes: dict[str, int] = {}
+            stable = True
+            for _name, d in dirs:
+                molly = _synth_ingest(d, True, _synth_resolve_store(None))
+                be = _SynthJB()
+                be.init_graph_db("", molly)
+                be.load_raw_provenance()
+                iters = molly.get_runs_iters()
+                runs_total += len(iters)
+                be._synth_impl = "python"
+                t0 = time.perf_counter()
+                be.synth_candidates(iters)
+                oracle_s += time.perf_counter() - t0
+                be._synth_impl = be._resolve_synth_impl()  # production route
+                m0 = obs.metrics.snapshot()
+                t0 = time.perf_counter()
+                cands = be.synth_candidates(iters)
+                batched_s += time.perf_counter() - t0
+                md = obs.Metrics.delta(obs.metrics.snapshot(), m0)["counters"]
+                for k, v in md.items():
+                    if k.startswith("analysis.route.synth."):
+                        r = k.rsplit(".", 1)[1]
+                        routes[r] = routes.get(r, 0) + int(v)
+                stable = stable and _synth_topk(cands) == _synth_topk(
+                    be.synth_candidates(iters)
+                )
+                cand_total += sum(len(v) for v in cands.values())
+                be.close_db()
+            return oracle_s, batched_s, cand_total, runs_total, routes, stable
+
+        o1, b1, _c1, r1, _rt1, st1 = _synth_pass(list(zip(families, base_dirs)))
+        of, bf, cf, rf, rtf, stf = _synth_pass(big_dirs)
+        synth_tier = {
+            "runs_1x": r1,
+            "oracle_1x_s": round(o1, 4),
+            "batched_1x_s": round(b1, 4),
+            "speedup_1x": round(o1 / b1, 1) if b1 else None,
+            "runs_full": rf,
+            "oracle_full_s": round(of, 3),
+            "batched_full_s": round(bf, 3),
+            "speedup_full": round(of / bf, 1) if bf else None,
+            "candidates": cf,
+            "candidates_per_s": round(cf / bf, 1) if bf else None,
+            "routes": rtf,
+            "topk_stable": bool(st1 and stf),
+        }
+        log(f"synth tier (per-run oracle vs batched): {json.dumps(synth_tier)}")
+    except Exception as ex:  # the synth tier must never sink the bench
+        log(f"synth tier skipped: {type(ex).__name__}: {ex}")
+
     # Chaos tier (ISSUE 9): the fault-tolerance layer's COST, measured.
     # Three walls over one corpus with both scheduler lanes live
     # (NEMO_ANALYSIS_IMPL=crossover + NEMO_SCHED=on): healthy, FAULTED
@@ -1704,6 +1776,7 @@ def child_main() -> None:
         "analysis_tier": analysis_tier,
         "ingest_tier": ingest_tier,
         "delta_tier": delta_tier,
+        "synth_tier": synth_tier,
         "chaos_tier": chaos_tier,
         "shard_tier": shard_tier,
         "sparse_device_tier": sparse_device_tier,

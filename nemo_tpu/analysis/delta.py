@@ -53,7 +53,11 @@ from nemo_tpu.ingest.datatypes import MissingEvent
 #: OUTPUT (not its speed): every cached result is keyed on this, so a bump
 #: invalidates the whole result cache at once — the cheap, always-correct
 #: fleet-wide invalidation (the corpus store's NPACK_ABI_VERSION precedent).
-ANALYSIS_ABI_VERSION = 1
+#: v2: corpus-ranked correction/extension synthesis (ISSUE 13) — partials
+#: carry per-run extension candidates + the good-run prototype anchor, and
+#: report trees gain repairs.json; pre-synthesis cache entries must
+#: recompute loudly, never serve a report missing its ranked repair list.
+ANALYSIS_ABI_VERSION = 2
 
 _log = obs.log.get_logger("nemo.delta")
 
@@ -370,6 +374,16 @@ class SegmentPartial:
     #: owns the good (or baseline) run
     corrections: list[str] | None = None
     extensions: list[str] | None = None
+    #: per owned run: sorted distinct extension-candidate rule tables (the
+    #: batched synth kernels' per-run output, analysis/synth.py); None =
+    #: the map's backend had no synthesis hooks (supports_synth False), so
+    #: the reduce skips ranked repairs entirely
+    ext_candidates: dict[int, list[str]] | None = None
+    #: anchor content: the GOOD run's qualifying prototype rule tables —
+    #: the left side of the correction anti-join, carried (like
+    #: corrections) on every publishing partial; None when no good run
+    #: exists or synthesis did not run
+    good_proto: list[str] | None = None
     #: figure files (basenames under figures/) owned by this segment's runs
     fig_files: list[str] = field(default_factory=list)
 
@@ -384,11 +398,16 @@ class SegmentPartial:
             "achieved": {str(k): v for k, v in self.achieved.items()},
             "corrections": self.corrections,
             "extensions": self.extensions,
+            "ext_candidates": None
+            if self.ext_candidates is None
+            else {str(k): v for k, v in self.ext_candidates.items()},
+            "good_proto": self.good_proto,
             "fig_files": self.fig_files,
         }
 
     @classmethod
     def from_json(cls, d: dict) -> "SegmentPartial":
+        ext = d.get("ext_candidates")
         return cls(
             iters=[int(i) for i in d["iters"]],
             success_iters=[int(i) for i in d["success_iters"]],
@@ -399,6 +418,10 @@ class SegmentPartial:
             achieved={int(k): int(v) for k, v in d["achieved"].items()},
             corrections=d.get("corrections"),
             extensions=d.get("extensions"),
+            ext_candidates=None
+            if ext is None
+            else {int(k): list(v) for k, v in ext.items()},
+            good_proto=d.get("good_proto"),
             fig_files=list(d.get("fig_files") or []),
         )
 
@@ -416,6 +439,12 @@ class MapOutput:
     achieved: dict[int, int] = field(default_factory=dict)
     corrections: list[str] = field(default_factory=list)
     extensions: list[str] = field(default_factory=list)
+    #: per-run synthesis candidates (analysis/synth.py); None = the backend
+    #: has no synthesis hooks, so no repairs.json will be produced
+    ext_candidates: dict[int, list[str]] | None = None
+    #: anchor content: the good run's qualifying prototype tables (the
+    #: correction anti-join's left side); rides every map like corrections
+    good_proto: list[str] | None = None
     # figure dots per family, keyed by iteration (own figure-selected runs)
     hazard: dict = field(default_factory=dict)
     pre: dict = field(default_factory=dict)
@@ -451,6 +480,11 @@ class MapOutput:
             getattr(self, name).update(getattr(other, name))
         self.corrections = list(other.corrections)
         self.extensions = list(other.extensions)
+        self.good_proto = other.good_proto
+        if other.ext_candidates is not None:
+            if self.ext_candidates is None:
+                self.ext_candidates = {}
+            self.ext_candidates.update(other.ext_candidates)
         if other.legacy is not None:
             self.legacy = other.legacy
 
@@ -493,6 +527,10 @@ class MapOutput:
             achieved={i: self.achieved[i] for i in iters if i in self.achieved},
             corrections=list(self.corrections),
             extensions=list(self.extensions),
+            ext_candidates=None
+            if self.ext_candidates is None
+            else {i: self.ext_candidates[i] for i in iters if i in self.ext_candidates},
+            good_proto=None if self.good_proto is None else list(self.good_proto),
             fig_files=[
                 f
                 for i in iters
@@ -650,6 +688,21 @@ def map_runs(
             # discard.
             if publish or sum(out.achieved.values()) < len(view_iters):
                 out.extensions = backend.extension_suggestions()
+
+    # Corpus-ranked repair synthesis (ISSUE 13): per-run extension
+    # candidates via the batched synth kernels, plus the good run's
+    # prototype table set (the correction anti-join's left side) as ANCHOR
+    # content — the good run rides in every view, so every publishing
+    # partial carries the same copy (the corrections convention, which is
+    # what keeps the tree merge order-insensitive).  Ungated: repairs.json
+    # is part of every report this backend family produces.
+    if not legacy and getattr(backend, "supports_synth", False):
+        with timer.phase("synthesis"):
+            ext = backend.synth_candidates(out.own_iters)
+            out.ext_candidates = {i: list(ext.get(i, [])) for i in out.own_iters}
+            if good_iter is not None:
+                g_ordered, _g_present = backend.proto_tables_by_run([good_iter], [])
+                out.good_proto = list(g_ordered.get(good_iter, []))
     return out
 
 
@@ -703,11 +756,17 @@ def _merge_group(group: "list[SegmentPartial]") -> "SegmentPartial":
         out.missing.update(p.missing)
         out.achieved.update(p.achieved)
         out.fig_files.extend(p.fig_files)
+        if p.ext_candidates is not None:
+            if out.ext_candidates is None:
+                out.ext_candidates = {}
+            out.ext_candidates.update(p.ext_candidates)
         if p.corrections is not None:
-            # Coupled move: the flat fold takes extensions from the SAME
-            # partial that supplied corrections.
+            # Coupled move: the flat fold takes extensions (and the
+            # good-run prototype anchor, ISSUE 13) from the SAME partial
+            # that supplied corrections.
             out.corrections = list(p.corrections)
             out.extensions = list(p.extensions or [])
+            out.good_proto = None if p.good_proto is None else list(p.good_proto)
     obs.metrics.inc("delta.tree_merges")
     return out
 
@@ -779,6 +838,9 @@ class Reduced:
     corrections: list[str]
     extensions: list[str]
     all_achieved: bool
+    #: corpus-ranked repair document (analysis/synth.py:build_repairs —
+    #: repairs.json); None when the backend has no synthesis hooks
+    repairs: dict | None = None
 
 
 def reduce_partials(
@@ -866,6 +928,17 @@ def reduce_partials(
             missing.setdefault(f, [])
 
         all_achieved = achieved_total >= len(molly.runs)
+        # Corpus-ranked repairs (ISSUE 13): the order-insensitive
+        # support-count reduce over the merged per-run candidate dicts —
+        # global run order imposed by build_repairs from `molly`, so any
+        # partial permutation ranks identically.
+        repairs = None
+        if merged.ext_candidates is not None:
+            from nemo_tpu.analysis.synth import build_repairs
+
+            repairs = build_repairs(
+                merged.good_proto, merged.ext_candidates, present, molly, good_iter
+            )
         return Reduced(
             inter=wrap_code(inter_raw),
             union=wrap_code(union_raw),
@@ -878,6 +951,7 @@ def reduce_partials(
             corrections=corrections if (good_iter is not None and failed_iters) else [],
             extensions=[] if all_achieved else extensions,
             all_achieved=all_achieved,
+            repairs=repairs,
         )
 
 

@@ -912,6 +912,8 @@ def run_debug(
                     achieved=mo.achieved,
                     corrections=mo.corrections,
                     extensions=mo.extensions,
+                    ext_candidates=mo.ext_candidates,
+                    good_proto=mo.good_proto,
                 )
             ]
         else:
@@ -993,6 +995,19 @@ def run_debug(
                     fh.write(", ")
                 fh.write(_run_json_str(r, good_iter))
             fh.write("]")
+
+        # Suggested repairs (ISSUE 13): the corpus-ranked correction/
+        # extension synthesis document (analysis/synth.py), rendered by the
+        # frontend as the "Suggested repairs" section with per-candidate
+        # supporting-run counts and example run links.  Deterministic and
+        # route-independent (the synth parity suites pin all three routes
+        # byte-equal), part of the cached report tree; absent only for
+        # backends without synthesis hooks.
+        if red.repairs is not None:
+            with open(
+                os.path.join(this_results_dir, "repairs.json"), "w", encoding="utf-8"
+            ) as fh:
+                json.dump(red.repairs, fh, indent=1)
 
         # Degraded-runs sidecar (ISSUE 9): the quarantined set, rendered by
         # the frontend as the "Degraded runs" section.  Deterministic (part

@@ -1,0 +1,168 @@
+"""Corpus-ranked correction/extension synthesis (ISSUE 13 tentpole).
+
+The reference's end product is GenerateCorrections / GenerateExtensions —
+the CIDR paper's debugging recommendations — but it only ever computes them
+from ONE run (the good run's triggers, the baseline run's async boundary).
+This module is the corpus-scale generalization: candidates are extracted
+PER RUN by batched kernels (the map side), then scored and ranked ACROSS
+the whole corpus by an order-insensitive support-count reduce (this
+module) — a correction explaining 900 of 1000 failed runs outranks one
+explaining 3, which is the "what should I fix first" signal the per-run
+reference never had.
+
+Candidate families:
+
+  * **corrections**: the anti-join between the good run's prototype rule
+    tables and each failed run's clean consequent graph — a table the
+    healthy execution's causal chain contains but the failed run never
+    produced is a candidate repair site.  Both sides are existing batched
+    kernel outputs (``proto_bits`` for the good row, ``proto_present`` per
+    failed row — CSR frontier waves on every route), so the anti-join adds
+    no graph sweeps; the reduce counts supporting failed runs per table.
+  * **extensions**: async rules adjacent to the antecedent's condition
+    boundary (extensions.go:63-67), extracted for EVERY run by the new
+    batched ``synth_ext`` kernel (ops/sparse_device.py device twin,
+    ops/sparse_host.py bincount-scatter twin, the per-run PGraph walk of
+    analysis/queries.py demoted to the parity oracle) instead of only the
+    baseline run; the reduce counts supporting runs per table.
+
+Associativity contract: every per-run candidate set is keyed by iteration
+and independent of which other runs shared its batch (the synth parity
+suites pin this), the good-run table set is ANCHOR content identical on
+every publishing partial, and :func:`build_repairs` imposes global run
+order itself — so merging segment partials is permutation-safe, ranked
+repairs delta-update when a corpus grows, and the streamed tree reduce
+produces byte-identical rankings (tests/test_synth.py).
+
+Cache-key coverage: per-run candidates travel in ``SegmentPartial``
+(keyed on segment fingerprint + the good/baseline ANCHOR identities —
+analysis/delta.py:partial_cache_key — so a changed good-run anchor
+invalidates every ranked repair) and the ranked document rides the report
+tree (report_cache_key); ``ANALYSIS_ABI_VERSION`` was bumped with these
+keys so cached pre-synthesis reports recompute loudly.
+"""
+
+from __future__ import annotations
+
+#: Supporting-run links shown per ranked candidate (repairs.json
+#: ``example_runs``): the smallest supporting iterations, ascending — a
+#: deterministic, permutation-safe sample regardless of corpus size.
+MAX_EXAMPLE_RUNS = 5
+
+
+def synth_impl_env() -> str:
+    """Parse + validate NEMO_SYNTH_IMPL — the route knob of the synthesis
+    kernel family, following the NEMO_ANALYSIS_IMPL precedent (loud on
+    junk: a typo silently resolving to auto would change which engine
+    extracts candidates in exactly the dimension the operator pinned):
+
+      auto           resolved by the process that owns the device
+                     (JaxBackend._resolve_synth_impl / the ServiceBackend
+                     override)
+      python         the per-run PGraph oracle (analysis/queries.py walks,
+                     one graph at a time) — the pre-batching reference
+                     path, kept as the parity oracle
+      sparse         the batched bincount-scatter host twin
+                     (ops/sparse_host.py:synth_ext_host)
+      sparse_device  the batched gather/scatter device kernel via the
+                     ``synth_ext`` executor verb (ops/sparse_device.py)
+    """
+    from nemo_tpu.utils.env import env_choice
+
+    return env_choice(
+        "NEMO_SYNTH_IMPL", "auto", ("auto", "python", "sparse", "sparse_device")
+    )
+
+
+def synth_host_work_budget() -> int:
+    """Per-bucket crossover for the synthesis route under auto on a DEVICE
+    backend: buckets at or below this B x (V + E) work run the host
+    bincount twin instead of paying a device dispatch (the
+    NEMO_ANALYSIS_HOST_WORK economics one verb over — the synth kernel is
+    a handful of single-step scatters, so the dispatch's fixed RTT
+    dominates even deeper into the work axis).  NEMO_SYNTH_HOST_WORK
+    overrides."""
+    from nemo_tpu.utils.env import env_int
+
+    return env_int("NEMO_SYNTH_HOST_WORK", 100000)
+
+
+def correction_suggestion(table: str) -> str:
+    """Presentation-ready repair line for one correction candidate (the
+    report frontend renders it next to the support count)."""
+    return f"<code>{table}(node, ...)</code>"
+
+
+def extension_suggestion(table: str) -> str:
+    """Presentation-ready hardening line for one extension candidate —
+    the same clause shape as analysis/corrections.py:synthesize_extensions
+    so the ranked list and the reference-format recommendation agree."""
+    return f"<code>{table}(node, ...)@async :- ...;</code>"
+
+
+def _rank(support: "dict[str, list[int]]", total: int, suggest) -> list[dict]:
+    """Support dict (table -> supporting iterations) -> ranked candidate
+    records, most-supported first, table name as the deterministic
+    tiebreak.  Example runs are the smallest supporting iterations —
+    independent of insertion (segment) order."""
+    out = [
+        {
+            "table": t,
+            "support": len(its),
+            "total": total,
+            "example_runs": sorted(its)[:MAX_EXAMPLE_RUNS],
+            "suggestion": suggest(t),
+        }
+        for t, its in support.items()
+    ]
+    out.sort(key=lambda c: (-c["support"], c["table"]))
+    return out
+
+
+def correction_candidates(good_proto, present) -> list[str]:
+    """The anti-join for ONE failed run: good-run prototype tables absent
+    from the run's clean consequent graph, sorted.  ``present`` is the
+    run's distinct clean rule tables (the fused kernels' proto_present
+    row, already in every SegmentPartial)."""
+    return sorted(set(good_proto or ()) - set(present or ()))
+
+
+def build_repairs(
+    good_proto,
+    ext_by_run: "dict[int, list[str]]",
+    present: "dict[int, list[str] | set[str]]",
+    molly,
+    good_iter: "int | None",
+) -> dict:
+    """The order-insensitive support-count reduce: merge per-run candidate
+    sets into the corpus-ranked repair document (repairs.json).
+
+    Pure function of (anchor table set, per-run candidate dicts, the
+    corpus run order) — per-run dicts are iteration-keyed and disjoint
+    across segments, so any merge order of partials feeds identical inputs
+    here, and the ranking (support desc, table asc) plus the ascending
+    example-run sample are order-free.  This is what makes ranked repairs
+    rcache-cacheable per segment, streamable through the tree reduce, and
+    delta-updatable when a grown corpus's new segment shifts the
+    corpus-wide ranking."""
+    failed_iters = molly.get_failed_runs_iters()
+    run_iters = molly.get_runs_iters()
+
+    corr_support: dict[str, list[int]] = {}
+    if good_iter is not None and good_proto:
+        for f in failed_iters:
+            for t in correction_candidates(good_proto, present.get(f)):
+                corr_support.setdefault(t, []).append(f)
+
+    ext_support: dict[str, list[int]] = {}
+    for r in run_iters:
+        for t in ext_by_run.get(r, ()):
+            ext_support.setdefault(t, []).append(r)
+
+    return {
+        "good_run": good_iter,
+        "runs_total": len(run_iters),
+        "failed_total": len(failed_iters),
+        "corrections": _rank(corr_support, len(failed_iters), correction_suggestion),
+        "extensions": _rank(ext_support, len(run_iters), extension_suggestion),
+    }

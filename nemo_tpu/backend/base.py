@@ -47,6 +47,12 @@ class GraphBackend(abc.ABC):
     #: monolithic map with partial caching disabled.
     supports_delta = False
 
+    #: True when the backend implements :meth:`synth_candidates` — the
+    #: per-run extension-candidate extraction the corpus-ranked repair
+    #: synthesis (analysis/synth.py, ISSUE 13) reduces across segments.
+    #: Backends without it produce reports with no repairs.json section.
+    supports_synth = False
+
     def stream_clone(self):
         """A fresh backend instance suitable for the segment-streamed map
         (analysis/stream.py): the double-buffered prefetch initializes
@@ -106,6 +112,17 @@ class GraphBackend(abc.ABC):
         """The extension suggestion list from the baseline run's antecedent
         provenance, UNgated (generate_extensions applies the all-achieved
         gate, which is global — the reduce applies it instead)."""
+        raise NotImplementedError
+
+    def synth_candidates(self, iters: list[int]) -> dict[int, list[str]]:
+        """Per run in ``iters``: the SORTED distinct extension-candidate
+        rule tables of its antecedent provenance — async rules adjacent to
+        the condition boundary (analysis/queries.py:extension_candidates,
+        extensions.go:63-67), generalized from the baseline-run-only
+        reference to every run so the reduce can rank candidates by
+        supporting-run count across the corpus (analysis/synth.py).
+        Array backends batch the extraction (the ``synth_ext`` kernel
+        family); the Python oracle walks one PGraph per run."""
         raise NotImplementedError
 
     def baseline_run_iter(self) -> int:

@@ -200,6 +200,24 @@ def _k_sparse_diff(edge_src, edge_dst, edge_mask, is_goal, node_mask, label_id, 
     )
 
 
+def _k_synth_ext(
+    edge_src, edge_dst, edge_mask, is_goal, node_mask, type_id, table_id, holds,
+    v, num_tables,
+):
+    """Batched correction/extension synthesis kernel (ISSUE 13): per-run
+    extension-candidate table bitsets over the packed antecedent [B,E]
+    edge planes (ops/sparse_device.py:synth_ext_candidates) — the
+    reference's baseline-run-only PGraph walk generalized to every run of
+    a bucket in one dispatch.  Row-independent, so the serving tier's
+    continuous batcher may merge compatible dispatches."""
+    from nemo_tpu.ops.sparse_device import synth_ext_candidates
+
+    return synth_ext_candidates(
+        edge_src, edge_dst, edge_mask, is_goal, node_mask, type_id, table_id,
+        holds, v=v, num_tables=num_tables,
+    )
+
+
 def _device_annotation(name: str):
     """A ``jax.profiler.TraceAnnotation`` bracketing one kernel dispatch, so
     a jax.profiler device capture running alongside (CLI --profile, sidecar
@@ -251,7 +269,7 @@ def _kernel_cost_analysis(verb: str, fn, args, statics) -> dict:
     out = {"flops": None, "bytes_accessed": None}
     try:
         target = fn
-        if verb in ("fused", "giant", "sparse_fused", "sparse_diff"):
+        if verb in ("fused", "giant", "sparse_fused", "sparse_diff", "synth_ext"):
             target = _COST_JITS.get(verb)
             if target is None:
                 n_arr = len(LocalExecutor.VERBS[verb][1])
@@ -388,16 +406,18 @@ def _index_cost_class(verb: str, arrays: dict, params: dict) -> None:
         rec = _KERNEL_COSTS.get(sig)
         if rec is None or "v" not in params:
             return
-        e = (
-            int(np.shape(arrays["pre_edge_src"])[1])
-            if verb in ("fused", "giant", "sparse_fused")
-            else 0
-        )
-        rows = (
-            int(np.shape(arrays["pre_is_goal"])[0])
-            if arrays.get("pre_is_goal") is not None
-            else 1
-        )
+        if verb in ("fused", "giant", "sparse_fused"):
+            e = int(np.shape(arrays["pre_edge_src"])[1])
+        elif verb == "synth_ext":
+            e = int(np.shape(arrays["edge_src"])[1])
+        else:
+            e = 0
+        if arrays.get("pre_is_goal") is not None:
+            rows = int(np.shape(arrays["pre_is_goal"])[0])
+        elif verb == "synth_ext":
+            rows = int(np.shape(arrays["is_goal"])[0])
+        else:
+            rows = 1
         _COST_BY_CLASS[(verb, int(params["v"]), e)] = (rec, max(rows, 1))
     except Exception:  # lint: allow-silent-except — cost indexing is best-effort observability (docstring)
         pass
@@ -485,6 +505,8 @@ def _jit_cache_size(verb: str, fn) -> int:
         from nemo_tpu.ops.sparse_device import _sparse_step_jit as fn
     elif verb == "sparse_diff":
         from nemo_tpu.ops.sparse_device import _sparse_diff_jit as fn
+    elif verb == "synth_ext":
+        from nemo_tpu.ops.sparse_device import _synth_ext_jit as fn
     elif verb == "giant":
         return -1
     cs = getattr(fn, "_cache_size", None)
@@ -571,6 +593,13 @@ class LocalExecutor:
             ("v",),
             ("node_keep", "edge_keep", "frontier_rule", "missing_goal"),
         ),
+        "synth_ext": (
+            _k_synth_ext,
+            ("edge_src", "edge_dst", "edge_mask", "is_goal", "node_mask",
+             "type_id", "table_id", "holds"),
+            ("v", "num_tables"),
+            ("ext_bits",),
+        ),
     }
 
     #: The run-axis-batched dict-returning verbs: batch-width metrics, the
@@ -647,7 +676,7 @@ class LocalExecutor:
             rows_real = min(int(rows), b_in) if rows is not None else b_in
             obs.metrics.observe("kernel.batch_rows", rows_real)
             span_attrs["rows"] = rows_real
-        elif rows is not None and verb in ("condition", "simplify", "proto"):
+        elif rows is not None and verb in ("condition", "simplify", "proto", "synth_ext"):
             # Serve-tier merged dispatches (nemo_tpu/serve/batch.py) pad
             # the run axis to a stable bucket and attest the REAL merged
             # row count here, so the cost accounting scales by rows_frac
@@ -1080,6 +1109,16 @@ def _analysis_host_work_budget() -> int:
     return int(os.environ.get("NEMO_ANALYSIS_HOST_WORK", "100000"))
 
 
+def _synth_host_work_budget() -> int:
+    """Per-bucket crossover for the synthesis kernel family under auto on
+    a DEVICE backend (analysis/synth.py:synth_host_work_budget — the
+    single definition; re-exported here beside its analysis-route sibling
+    so the backend's knob resolution reads one module)."""
+    from nemo_tpu.analysis.synth import synth_host_work_budget
+
+    return synth_host_work_budget()
+
+
 def _sparse_device_mem_bytes() -> int:
     """Dense-route memory watermark (ISSUE 10): buckets whose dense
     footprint estimate — rows x V^2 x ~4 bytes (the bool [B,V,V] adjacency
@@ -1355,6 +1394,9 @@ class JaxBackend(GraphBackend):
     #: segment-incremental map/reduce (analysis/delta.py) can map a store
     #: segment's runs in isolation and merge cached per-segment partials.
     supports_delta = True
+    #: Per-run synthesis candidates implemented as a batched kernel family
+    #: (the synth_ext verb + its sparse-host twin, ISSUE 13).
+    supports_synth = True
 
     def __init__(self, max_batch: int | None = None, executor=None) -> None:
         self.max_batch = max_batch
@@ -1378,6 +1420,8 @@ class JaxBackend(GraphBackend):
         self._simplified_row: dict[tuple[int, str], tuple[int, int]] = {}
         # Joint-bucket fused outputs: [(pre_batch, post_batch, out_dict)].
         self._fused_out: list[tuple[PackedBatch, PackedBatch, dict[str, np.ndarray]]] | None = None
+        # Memoized _proto_tables_by_run extraction (per corpus).
+        self._proto_tables_cache = None
         # Prefetch-staged fused inputs (stage_fused_inputs), adopted by the
         # next _fused on this instance; None outside the streamed pipeline.
         self._staged_inputs: dict | None = None
@@ -1398,6 +1442,10 @@ class JaxBackend(GraphBackend):
         self._sparse_device_mem = _sparse_device_mem_bytes()
         self._sparse_device_density = _sparse_device_density()
         self._sparse_device_min_v = _sparse_device_min_v()
+        # Synthesis route knobs (ISSUE 13); resolved in init_graph_db
+        # ("auto" reads jax.default_backend(), unsafe before the watchdog).
+        self._synth_impl: str | None = None
+        self._synth_host_work = _synth_host_work_budget()
         #: impl the last _fused giant dispatch actually took (None = no
         #: giant runs in the corpus) — surfaced in the bench giant row.
         self.giant_impl_used = None
@@ -1441,6 +1489,40 @@ class JaxBackend(GraphBackend):
         # "crossover" passes through: _analysis_route's per-bucket budget
         # branch handles any impl that is neither sparse nor dense.
         return impl
+
+    def _resolve_synth_impl(self) -> str:
+        """Synthesis-kernel route (ISSUE 13), resolved by the process that
+        OWNS the device (the NEMO_ANALYSIS_IMPL precedent): an explicit
+        NEMO_SYNTH_IMPL wins ("python" keeps the per-run PGraph oracle);
+        "auto" on a CPU backend routes every bucket through the bincount
+        host twin (a host scatter pass always beats XLA:CPU scatter waves
+        plus dispatch overhead), and on a device backend stays per-bucket:
+        the NEMO_SYNTH_HOST_WORK crossover decides in _synth_route.
+        ServiceBackend overrides — its device lives in the sidecar."""
+        from nemo_tpu.analysis.synth import synth_impl_env
+
+        impl = synth_impl_env()
+        if impl == "auto" and jax.default_backend() == "cpu":
+            return "sparse"
+        return impl
+
+    def _synth_route(self, rows: int, v: int, e: int) -> tuple[str, str, int]:
+        """Per-bucket route decision for the synthesis verb: (route,
+        reason, work).  Routes: "sparse" (the bincount host twin),
+        "sparse_device" (the synth_ext device kernel); the "python"
+        oracle route short-circuits before bucketing (synth_candidates).
+        Auto: the synth kernel is a handful of single-step scatters, so
+        the dispatch-cost crossover (NEMO_SYNTH_HOST_WORK) is the whole
+        signal — there is no dense twin to weigh memory against."""
+        work = rows * (v + e)
+        impl = self._synth_impl
+        if impl in ("sparse", "sparse_device"):
+            from nemo_tpu.analysis.synth import synth_impl_env
+
+            return impl, "forced" if synth_impl_env() != "auto" else "platform", work
+        if work <= self._synth_host_work:
+            return "sparse", "crossover", work
+        return "sparse_device", "crossover", work
 
     def _analysis_route(
         self, rows: int, v: int, e: int, rows_dispatch: int | None = None
@@ -1522,6 +1604,8 @@ class JaxBackend(GraphBackend):
         self._sparse_device_mem = _sparse_device_mem_bytes()
         self._sparse_device_density = _sparse_device_density()
         self._sparse_device_min_v = _sparse_device_min_v()
+        self._synth_impl = self._resolve_synth_impl()
+        self._synth_host_work = _synth_host_work_budget()
         self.analysis_routes = []
         self._narrow_xfer = self._resolve_narrow_xfer()
         self._max_batch = (
@@ -1541,6 +1625,7 @@ class JaxBackend(GraphBackend):
         self.simplified = {}
         self._simplified_row = {}
         self._fused_out = None
+        self._proto_tables_cache = None
         self._staged_inputs = None
         self._clean_rows = {}
         self._run_by_iter = {r.iteration: r for r in molly.runs}
@@ -1592,6 +1677,7 @@ class JaxBackend(GraphBackend):
         self.simplified = {}
         self._simplified_row = {}
         self._fused_out = None
+        self._proto_tables_cache = None
         self._staged_inputs = None
         self._clean_rows = {}
         self._run_by_iter = {}
@@ -2252,7 +2338,15 @@ class JaxBackend(GraphBackend):
 
     def _proto_tables_by_run(self) -> tuple[dict[int, list[str]], dict[int, set[str]]]:
         """Slice the fused step's prototype outputs per run; returns
-        (ordered qualifying tables per run, all present rule tables per run)."""
+        (ordered qualifying tables per run, all present rule tables per
+        run).  Memoized per corpus (reset in init_graph_db/close_db):
+        callers treat the dicts as read-only, and the synthesis phase
+        (ISSUE 13) re-reads the good run's tables after the prototypes
+        phase already extracted the whole view — without the memo that
+        second call would repeat the corpus-wide lexsort extraction
+        (~seconds at 102k runs) to fetch one run's list."""
+        if self._proto_tables_cache is not None:
+            return self._proto_tables_cache
         ordered: dict[int, list[str]] = {}
         present: dict[int, set[str]] = {}
         names = np.asarray(self.vocab.tables.strings, dtype=object)
@@ -2278,7 +2372,8 @@ class JaxBackend(GraphBackend):
             for row, rid in enumerate(post_b.run_ids):
                 ordered[rid] = list(names_o[starts[row] : starts[row + 1]])
                 present[rid] = set(p_names[p_starts[row] : p_starts[row + 1]])
-        return ordered, present
+        self._proto_tables_cache = (ordered, present)
+        return self._proto_tables_cache
 
     def create_prototypes(
         self, success_iters: list[int], failed_iters: list[int]
@@ -2597,6 +2692,132 @@ class JaxBackend(GraphBackend):
         return synthesize_extensions(
             extension_candidates(self.raw[(self.baseline_run_iter(), "pre")])
         )
+
+    # -------------------------------------------------------------- synthesis
+
+    def synth_candidates(self, iters: list[int]) -> dict[int, list[str]]:
+        """Per-run extension-candidate tables for the corpus-ranked repair
+        synthesis (ISSUE 13), batched over the SAME fused buckets the
+        analysis verbs ride: one ``synth_ext`` dispatch (or one host
+        bincount pass) per bucket extracts every run's candidates at once,
+        routed per bucket by NEMO_SYNTH_IMPL / the NEMO_SYNTH_HOST_WORK
+        crossover and drained through the heterogeneous scheduler
+        (parallel/sched.py — device/host lanes, cost hints, stealing,
+        breaker failover) exactly like the fused jobs.  Every dispatch
+        records an ``analysis.route.synth.<route>`` decision.  The per-run
+        PGraph walk survives as NEMO_SYNTH_IMPL=python — the parity
+        ORACLE, one graph at a time (the pre-batching reference path)."""
+        assert self.molly is not None
+        want = set(iters)
+        out: dict[int, list[str]] = {i: [] for i in iters}
+        if self._synth_impl == "python":
+            rec = self._record_route("synth", "python", len(iters), 0, 0, 0, "forced")
+            obs.metrics.inc("kernel.dispatches.synth_python")
+            with obs.span("analysis:route", **rec):
+                for i in iters:
+                    out[i] = sorted(set(extension_candidates(self.raw[(i, "pre")])))
+            return out
+
+        from nemo_tpu.parallel import sched as sched_mod
+
+        names = np.asarray(self.vocab.tables.strings, dtype=object)
+        jobs: list = []
+        serial_plan: list[tuple[str, str]] = []
+        for pre_b, _post_b, res in self._fused():
+            if not any(rid in want for rid in pre_b.run_ids):
+                continue
+            n_rows = len(pre_b.run_ids)
+            holds = np.asarray(res["pre_holds"])
+            # The table-bitset width the fused step already used for this
+            # bucket — keeps the synth planes aligned with proto_bits and
+            # the jit signature bucket-stable.
+            num_tables = int(np.asarray(res["proto_bits"]).shape[1])
+            route, reason, work = self._synth_route(n_rows, pre_b.v, pre_b.e)
+            lane = "host" if route == "sparse" else "device"
+            pinned = lane if reason in ("forced", "platform") else None
+            job = sched_mod.Job(
+                index=len(jobs),
+                verb="synth_ext",  # the cost-model/EWMA shape-class key
+                rows=n_rows,
+                v=pre_b.v,
+                e=pre_b.e,
+                work=work,
+                execute=None,  # assigned below (the closure marks `job`)
+                pinned=pinned,
+                reason=reason,
+                lanes=("device", "host"),
+                rows_dispatch=int(pre_b.is_goal.shape[0]),
+            )
+
+            def execute(
+                run_lane, rec_reason, stolen,
+                pre_b=pre_b, holds=holds, num_tables=num_tables,
+                n_rows=n_rows, work=work, job=job,
+            ):
+                route_name = "sparse" if run_lane == "host" else "sparse_device"
+                rec = self._record_route(
+                    "synth", route_name, n_rows, pre_b.v, pre_b.e, work, rec_reason
+                )
+                if run_lane == "host":
+                    from nemo_tpu.ops.sparse_host import synth_ext_host
+
+                    # kernel.dispatches.* prefix: the result cache's
+                    # zero-dispatch assertion must see host-routed
+                    # synthesis recomputes too (the sparse_fused precedent).
+                    obs.metrics.inc("kernel.dispatches.synth_host")
+                    with obs.span("analysis:route", **rec):
+                        bits = synth_ext_host(pre_b, holds, num_tables)
+                    return (pre_b, bits)
+                with obs.span("analysis:route", **rec):
+                    bits = self.executor.run(
+                        "synth_ext",
+                        {
+                            "edge_src": pre_b.edge_src,
+                            "edge_dst": pre_b.edge_dst,
+                            "edge_mask": pre_b.edge_mask,
+                            "is_goal": pre_b.is_goal,
+                            "node_mask": pre_b.node_mask,
+                            "type_id": pre_b.type_id,
+                            "table_id": pre_b.table_id,
+                            "holds": holds,
+                        },
+                        {"v": pre_b.v, "num_tables": num_tables},
+                        rows=n_rows,
+                    )["ext_bits"]
+                if getattr(self.executor, "last_dispatch_compiled", False):
+                    job.wall_tainted = True
+                return (pre_b, bits)
+
+            job.execute = execute
+            jobs.append(job)
+            serial_plan.append((lane, reason))
+
+        mode = sched_mod.sched_env()
+        if mode != "off" and (mode == "on" or len(jobs) > 1):
+            scheduler = sched_mod.HeterogeneousScheduler(
+                sched_mod.session_models(self._analysis_host_work, sched_device_hint)
+            )
+            outs = scheduler.run(jobs)
+        else:
+            outs = [
+                job.execute(lane, reason, False)
+                for job, (lane, reason) in zip(jobs, serial_plan)
+            ]
+
+        for pre_b, bits in outs:
+            bits = np.asarray(bits)
+            # Vectorized per-bucket extraction (_proto_tables_by_run's
+            # idiom): one lexsort orders (row, name) pairs like the
+            # oracle's per-run sorted(set(...)); row boundaries split.
+            nm = names[: bits.shape[1]]
+            rows_i, ts = np.nonzero(bits)
+            order = np.lexsort((nm[ts], rows_i))
+            rows_o, names_o = rows_i[order], nm[ts[order]]
+            starts = np.searchsorted(rows_o, np.arange(bits.shape[0] + 1))
+            for row, rid in enumerate(pre_b.run_ids):
+                if rid in want:
+                    out[rid] = list(names_o[starts[row] : starts[row + 1]])
+        return out
 
     def generate_extensions(self) -> tuple[bool, list[str]]:
         assert self.molly is not None

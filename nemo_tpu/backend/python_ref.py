@@ -47,6 +47,9 @@ class PythonBackend(GraphBackend):
     #: (analysis/delta.py) — the reduce's set algebra is differential-tested
     #: against create_prototypes through the byte-parity suites.
     supports_delta = True
+    #: Per-run synthesis candidates implemented (the per-run PGraph walk —
+    #: THE parity oracle of the batched synth kernels, ISSUE 13).
+    supports_synth = True
 
     def __init__(self) -> None:
         self.molly: MollyOutput | None = None
@@ -476,6 +479,14 @@ class PythonBackend(GraphBackend):
     def extension_suggestions(self) -> list[str]:
         candidates = extension_candidates(self.graphs[(self.baseline_run_iter(), "pre")])
         return synthesize_extensions(candidates)
+
+    def synth_candidates(self, iters: list[int]) -> dict[int, list[str]]:
+        # The per-run oracle (ISSUE 13): one PGraph walk per run — exactly
+        # what the batched synth_ext kernels must reproduce per row.
+        return {
+            i: sorted(set(extension_candidates(self.graphs[(i, "pre")])))
+            for i in iters
+        }
 
     def generate_extensions(self) -> tuple[bool, list[str]]:
         assert self.molly is not None

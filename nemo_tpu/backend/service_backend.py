@@ -122,6 +122,21 @@ class ServiceBackend(JaxBackend):
         impl = _analysis_impl_env()
         return "dense" if impl == "auto" else impl
 
+    def _resolve_synth_impl(self) -> str:
+        """Synthesis route for RemoteExecutor clients: "auto" runs the
+        bincount host twin CLIENT-side — the synth kernel is a handful of
+        single-step scatters whose host cost is far below one Kernel-RPC
+        round trip, and a deployed sidecar one release behind has no
+        ``synth_ext`` verb to serve (the sparse_diff wire-compat
+        precedent).  An explicit NEMO_SYNTH_IMPL=sparse_device still
+        ships the verb over the Kernel RPC (a sidecar of this release
+        serves it through the same LocalExecutor table), and =python
+        keeps the per-run oracle."""
+        from nemo_tpu.analysis.synth import synth_impl_env
+
+        impl = synth_impl_env()
+        return "sparse" if impl == "auto" else impl
+
     def close_db(self) -> None:
         super().close_db()
         if isinstance(self.executor, _Unconnected):

@@ -61,7 +61,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from nemo_tpu.graphs.packed import TYPE_COLLAPSED, TYPE_NEXT
+from nemo_tpu.graphs.packed import TYPE_ASYNC, TYPE_COLLAPSED, TYPE_NEXT
 from nemo_tpu.ops.proto import DEPTH_INF
 
 __all__ = [
@@ -69,6 +69,7 @@ __all__ = [
     "resolve_wave_impl",
     "sparse_device_step",
     "diff_masks_sparse_device",
+    "synth_ext_candidates",
 ]
 
 
@@ -566,6 +567,78 @@ def diff_masks_sparse_device(
         jnp.asarray(label_id),
         jnp.asarray(fail_bits),
         v=v,
+    )
+
+
+# ------------------------------------------------------------- synthesis
+
+
+@partial(jax.jit, static_argnames=("v", "num_tables"))
+def _synth_ext_jit(
+    src, dst, em, is_goal, node_mask, type_id, table_id, holds, v: int, num_tables: int
+):
+    """Batched extension-candidate extraction (ISSUE 13): the async rules
+    adjacent to the antecedent's condition boundary
+    (analysis/queries.py:extension_candidates, extensions.go:63-67), for
+    EVERY run of a packed bucket in one program.  A candidate rule r is
+    non-goal, type async, and satisfies either
+
+      cond_a: some holding goal parent AND some child goal that does not
+              hold and itself has a non-goal child; or
+      cond_b: some non-holding goal parent.
+
+    Each clause is one single-step gather/scatter over the [B,E] edge
+    planes — no fix points, so the whole verb is a handful of
+    segment-sum pushes — and the per-run candidate TABLE bitset [B,T]
+    folds via the shared table scatter.  Exactly the per-run PGraph
+    walk's semantics (the parity battery pins all three routes)."""
+    goal = is_goal & node_mask
+    g_hold = goal & holds
+    g_nohold = goal & ~holds
+    nongoal = ~is_goal & node_mask
+    # c has a non-goal child (the inner qualifier of cond_a).
+    has_nongoal_child = _scat_any(_gather(nongoal, dst) & em, src, v)
+    qual_child = g_nohold & has_nongoal_child
+    holding_parent = _scat_any(_gather(g_hold, src) & em, dst, v)
+    nonhold_parent = _scat_any(_gather(g_nohold, src) & em, dst, v)
+    has_qual_child = _scat_any(_gather(qual_child, dst) & em, src, v)
+    cand = (
+        nongoal
+        & (type_id == TYPE_ASYNC)
+        & ((holding_parent & has_qual_child) | nonhold_parent)
+    )
+    return _table_any(cand, table_id, num_tables)
+
+
+def synth_ext_candidates(
+    edge_src,  # [B,E] int
+    edge_dst,  # [B,E]
+    edge_mask,  # [B,E] bool
+    is_goal,  # [B,V] bool
+    node_mask,  # [B,V] bool
+    type_id,  # [B,V] int
+    table_id,  # [B,V] int
+    holds,  # [B,V] bool (the fused step's {cond}_holds output)
+    v: int,
+    num_tables: int,
+):
+    """Device twin of ops/sparse_host.py:synth_ext_host: per-run
+    extension-candidate table bitsets [B,T] as batched gather/scatter
+    pushes over the packed edge planes.  Served by the ``synth_ext``
+    executor verb (backend/jax_backend.py) so RemoteExecutor/sidecar run
+    it over the Kernel RPC unchanged; row-independent, so the serving
+    tier's continuous batcher may merge compatible dispatches."""
+    return _synth_ext_jit(
+        jnp.asarray(edge_src).astype(jnp.int32),
+        jnp.asarray(edge_dst).astype(jnp.int32),
+        jnp.asarray(edge_mask, dtype=bool),
+        jnp.asarray(is_goal, dtype=bool),
+        jnp.asarray(node_mask, dtype=bool),
+        jnp.asarray(type_id).astype(jnp.int32),
+        jnp.asarray(table_id).astype(jnp.int32),
+        jnp.asarray(holds, dtype=bool),
+        v=v,
+        num_tables=num_tables,
     )
 
 

@@ -51,7 +51,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from nemo_tpu.graphs.packed import TYPE_COLLAPSED, TYPE_NEXT
+from nemo_tpu.graphs.packed import TYPE_ASYNC, TYPE_COLLAPSED, TYPE_NEXT
 from nemo_tpu.ops.proto import DEPTH_INF
 
 __all__ = [
@@ -59,6 +59,7 @@ __all__ = [
     "bfs_any",
     "bfs_depths",
     "sparse_analysis_step",
+    "synth_ext_host",
 ]
 
 
@@ -373,6 +374,52 @@ def _proto(
     qsel = qualify & (table_f >= 0)
     np.minimum.at(min_depth, rows[qsel] * num_tables + tclip[qsel], rule_depth[qsel])
     return bits, min_depth.reshape(b, num_tables).astype(np.int32), present
+
+
+# ------------------------------------------------------------- synthesis
+
+
+def synth_ext_host(batch, holds: np.ndarray, num_tables: int) -> np.ndarray:
+    """Batched bincount-scatter twin of the ``synth_ext`` device kernel
+    (ops/sparse_device.py:synth_ext_candidates; ISSUE 13): per-run
+    extension-candidate table bitsets [B,T] — async rules adjacent to the
+    antecedent's condition boundary (extensions.go:63-67), exactly the
+    per-run PGraph walk of analysis/queries.py:extension_candidates, for
+    every run of a packed bucket in one flat-space pass.
+
+    ``batch`` is anything exposing the 8 packed fields (the _CondCSR
+    contract); ``holds`` is the fused step's [B,V] pre_holds output.  The
+    CPU-routing/lane-failover twin: the scheduler's host lane and the
+    degraded (breaker-open) mode run this bit-identically."""
+    csr = _CondCSR(batch)
+    b, v, n = csr.b, csr.v, csr.n
+    holds_f = np.asarray(holds, dtype=bool).ravel()
+    goal_f = csr.goal.ravel()
+    g_hold = goal_f & holds_f
+    g_nohold = goal_f & ~holds_f
+    nongoal = (~csr.is_goal & csr.node_mask).ravel()
+
+    has_nongoal_child = csr.scat_any(csr.src, nongoal[csr.dst]).ravel()
+    qual_child = g_nohold & has_nongoal_child
+    holding_parent = csr.scat_any(csr.dst, g_hold[csr.src]).ravel()
+    nonhold_parent = csr.scat_any(csr.dst, g_nohold[csr.src]).ravel()
+    has_qual_child = csr.scat_any(csr.src, qual_child[csr.dst]).ravel()
+
+    cand = (
+        nongoal
+        & (csr.type_id.ravel() == TYPE_ASYNC)
+        & ((holding_parent & has_qual_child) | nonhold_parent)
+    )
+    table_f = csr.table.ravel()
+    rows = np.arange(n, dtype=np.int64) // v
+    tclip = np.clip(table_f, 0, num_tables - 1)
+    sel = cand & (table_f >= 0)
+    return (
+        np.bincount(
+            rows[sel] * num_tables + tclip[sel], minlength=b * num_tables
+        ).reshape(b, num_tables)
+        > 0
+    )
 
 
 # ------------------------------------------------------------- fused step

@@ -352,6 +352,99 @@ async function telemetry() {
   document.getElementById("telemetry").hidden = false;
 }
 
+function runLink(iter) {
+  // Example-run link: jumps to (and opens) the run's detail section.
+  const a = el("a", { href: `#run-${iter}` }, String(iter));
+  a.addEventListener("click", (ev) => {
+    const d = document.getElementById(`run-${iter}`);
+    if (d) {
+      ev.preventDefault();
+      d.open = true;
+      d.scrollIntoView({ behavior: "smooth" });
+    }
+  });
+  return a;
+}
+
+function repairsTable(title, entries, supportLabel) {
+  const wrap = el("div", { class: "telemetry-block" });
+  wrap.append(el("h3", {}, title));
+  const table = el("table", { class: "telemetry-table" });
+  table.append(
+    el(
+      "tr",
+      {},
+      el("th", {}, "#"),
+      el("th", {}, "Suggested repair"),
+      el("th", {}, supportLabel),
+      el("th", {}, "Example runs")
+    )
+  );
+  entries.forEach((c, i) => {
+    const examples = el("td", {});
+    (c.example_runs || []).forEach((r, j) => {
+      if (j) examples.append(", ");
+      examples.append(runLink(r));
+    });
+    table.append(
+      el(
+        "tr",
+        {},
+        el("td", {}, String(i + 1)),
+        el("td", { html: c.suggestion || c.table }),
+        el("td", {}, `${c.support} / ${c.total}`),
+        examples
+      )
+    );
+  });
+  wrap.append(table);
+  return wrap;
+}
+
+async function repairs() {
+  // Suggested repairs (ISSUE 13): repairs.json carries the corpus-ranked
+  // correction/extension synthesis — per-candidate supporting-run counts
+  // over the WHOLE corpus, most-supported first.  Reports from backends
+  // without synthesis hooks have no such file: keep the section hidden.
+  let doc;
+  try {
+    const resp = await fetch("repairs.json");
+    if (!resp.ok) return;
+    doc = await resp.json();
+  } catch (e) {
+    return;
+  }
+  const corr = doc.corrections || [];
+  const ext = doc.extensions || [];
+  if (!corr.length && !ext.length) return;
+  const note = document.getElementById("repairs-note");
+  note.textContent =
+    `Candidates ranked by how many of the corpus's runs they explain ` +
+    `(${doc.failed_total} failed of ${doc.runs_total} runs` +
+    (doc.good_run == null ? "" : `; good run ${doc.good_run}`) +
+    `). Fix the most-supported first.`;
+  const body = document.getElementById("repairs-body");
+  if (corr.length) {
+    body.append(
+      repairsTable(
+        "Corrections — rule tables the good run's causal chain has but failed runs never produced",
+        corr,
+        "Failed runs explained"
+      )
+    );
+  }
+  if (ext.length) {
+    body.append(
+      repairsTable(
+        "Extensions — async rules at the antecedent boundary worth hardening",
+        ext,
+        "Supporting runs"
+      )
+    );
+  }
+  document.getElementById("repairs").hidden = false;
+}
+
 async function quarantine() {
   // Degraded runs (ISSUE 9): quarantine.json lists ingest-quarantined runs
   // (position, iteration when known, failing file, parse error).  Healthy
@@ -384,6 +477,7 @@ async function quarantine() {
 async function main() {
   telemetry(); // independent of the run data; never blocks the report
   quarantine(); // likewise — a healthy corpus has no quarantine.json
+  repairs(); // likewise — ranked repair synthesis when repairs.json exists
   const resp = await fetch("debugging.json");
   const runs = await resp.json();
 

@@ -68,6 +68,11 @@ BATCH_RANK = {
     "condition": ("is_goal", 2),
     "simplify": ("is_goal", 2),
     "proto": ("adj", 3),
+    # The synthesis verb (ISSUE 13) is row-independent by construction —
+    # every output row is a function of its own run's planes — and only
+    # ever dispatches run-batched ([B,V] is_goal), so cross-request
+    # merging is exact.
+    "synth_ext": ("is_goal", 2),
 }
 
 #: Verbs whose run-batched outputs are all per-row functions of per-row

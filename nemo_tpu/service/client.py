@@ -553,6 +553,11 @@ class RemoteExecutor:
             v = int(params["v"])
             e = int(np.asarray(arrays["edge_src"]).shape[0])
             est_resp = f * (3 * v + e) // 8
+        elif verb == "synth_ext":
+            # One [B,T] bool bitset back — the synthesis verb's readback
+            # is orders of magnitude below its request.
+            b = int(np.asarray(arrays["is_goal"]).shape[0])
+            est_resp = b * int(params["num_tables"]) // 8
         est = max(est_req, est_resp)
         if est > self.MAX_MESSAGE_BYTES:
             raise SidecarError(

@@ -129,8 +129,15 @@ def test_forced_routes_match_oracle(impl, route_corpus, oracle_report, tmp_path,
     res = run_debug(route_corpus, str(tmp_path / impl), be, figures="none")
     assert _report(res) == oracle_report
     routed = {(r["verb"], r["route"]) for r in be.analysis_routes}
-    assert routed == {("fused", impl), ("diff", impl)}
-    assert all(r["reason"] == "forced" for r in be.analysis_routes)
+    # The synthesis verb (ISSUE 13) has its own knob (NEMO_SYNTH_IMPL,
+    # unset here): on the CPU-pinned suite it resolves to the host twin
+    # with the platform reason, independent of the analysis umbrella.
+    assert routed == {("fused", impl), ("diff", impl), ("synth", "sparse")}
+    assert all(
+        r["reason"] == "forced"
+        for r in be.analysis_routes
+        if r["verb"] != "synth"
+    )
 
 
 def test_auto_on_cpu_routes_sparse(route_corpus, oracle_report, tmp_path, monkeypatch):

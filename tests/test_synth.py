@@ -1,0 +1,488 @@
+"""Batched correction/extension synthesis (ISSUE 13): the batched synth
+kernel family (device AND host routes) must reproduce the per-run Python
+oracle's candidate sets across every case-study family, the generative
+stress shapes, and the non-linear zigzag members; forced-route reports must
+be byte-identical with route records asserted; the support-count reduce
+must rank order-insensitively (segment permutation, streamed vs in-memory,
+grown-corpus delta); and the synthesis cache keys must pin the good-run
+anchor and the analysis ABI."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from nemo_tpu.analysis import delta
+from nemo_tpu.analysis.pipeline import run_debug
+from nemo_tpu.analysis.synth import build_repairs, synth_impl_env
+from nemo_tpu.backend.jax_backend import JaxBackend
+from nemo_tpu.backend.python_ref import PythonBackend
+from nemo_tpu.ingest.molly import load_molly_output
+from nemo_tpu.models.case_studies import CASE_STUDIES, write_case_study
+from nemo_tpu.models.synth import SynthSpec, grow_corpus_dir, write_corpus
+
+
+def _tree(root: str) -> dict[str, bytes]:
+    from nemo_tpu.analysis.pipeline import report_tree_bytes
+
+    return report_tree_bytes(root)
+
+
+def _three_route_candidates(corpus: str) -> dict[str, dict[int, list[str]]]:
+    """synth_candidates on one JaxBackend under all three routes, plus the
+    PythonBackend oracle — same fused state, only the route varies."""
+    molly = load_molly_output(corpus)
+    iters = molly.get_runs_iters()
+    be = JaxBackend()
+    be.init_graph_db("", molly)
+    be.load_raw_provenance()
+    out = {}
+    for impl in ("python", "sparse", "sparse_device"):
+        be._synth_impl = impl
+        out[impl] = be.synth_candidates(iters)
+    be.close_db()
+    py = PythonBackend()
+    py.init_graph_db("", load_molly_output(corpus))
+    py.load_raw_provenance()
+    out["oracle"] = py.synth_candidates(iters)
+    py.close_db()
+    return out
+
+
+def _assert_routes_agree(routes: dict, label: str) -> None:
+    base = routes["oracle"]
+    for impl in ("python", "sparse", "sparse_device"):
+        assert routes[impl] == base, f"{label}: {impl} diverges from the oracle"
+
+
+# ------------------------------------------------------------ kernel parity
+
+
+@pytest.mark.parametrize("name", sorted(CASE_STUDIES))
+def test_synth_matches_oracle_case_studies(name, tmp_path):
+    """Every case-study family: batched host AND device candidate sets ==
+    the per-run PGraph oracle (both the jax backend's python route over
+    kernel-marked graphs and the PythonBackend's own graphs)."""
+    d = write_case_study(name, n_runs=8, seed=11, out_dir=str(tmp_path))
+    _assert_routes_agree(_three_route_candidates(d), name)
+
+
+@pytest.mark.parametrize(
+    "spec",
+    [
+        SynthSpec(n_runs=8, seed=2, eot=6),  # all four run kinds
+        SynthSpec(n_runs=3, seed=5, eot=60, name="deep"),  # deep chains
+        SynthSpec(n_runs=8, seed=9, eot=10, eff=8, name="fanout"),  # wide fan-out
+        SynthSpec(n_runs=6, seed=7, fail_all_fraction=0.9, name="failall"),
+        SynthSpec(n_runs=5, seed=4, first_run_kind="fail", name="badfirst"),
+    ],
+    ids=lambda s: s.name + f"_s{s.seed}",
+)
+def test_synth_matches_oracle_synth_corpora(spec, tmp_path):
+    d = write_corpus(spec, str(tmp_path))
+    _assert_routes_agree(_three_route_candidates(d), spec.name)
+
+
+def test_synth_matches_oracle_zigzag(tmp_path):
+    """Non-linear member structure: the synth kernel reads the RAW planes
+    (no chain contraction), but the zigzag corpus still exercises the
+    bucket shapes the linear fast path rejects."""
+    from tests.test_giant_nonlinear import _zigzag_prov
+
+    d = tmp_path / "zigzag"
+    d.mkdir()
+    with open(d / "runs.json", "w") as f:
+        json.dump([{"iteration": 0, "status": "success"}], f)
+    for cond in ("pre", "post"):
+        with open(d / f"run_0_{cond}_provenance.json", "w") as f:
+            json.dump(_zigzag_prov(cond), f)
+    _assert_routes_agree(_three_route_candidates(str(d)), "zigzag")
+
+
+# --------------------------------------------------- forced-route reports
+
+
+def test_forced_route_reports_byte_identical(corpus_dir, tmp_path, monkeypatch):
+    """Each forced NEMO_SYNTH_IMPL produces the python_ref oracle's
+    byte-identical report tree (repairs.json included), records its
+    analysis.route.synth.<route> decision, and counts its dispatches under
+    the kernel.dispatches.* prefix (the zero-dispatch cache contract)."""
+    from nemo_tpu import obs
+
+    oracle = run_debug(
+        corpus_dir, str(tmp_path / "py"), PythonBackend(), figures="none"
+    )
+    t_oracle = _tree(oracle.report_dir)
+    assert "repairs.json" in t_oracle
+    counted = {
+        "python": "kernel.dispatches.synth_python",
+        "sparse": "kernel.dispatches.synth_host",
+        "sparse_device": "kernel.dispatches.synth_ext",
+    }
+    for impl in ("python", "sparse", "sparse_device"):
+        monkeypatch.setenv("NEMO_SYNTH_IMPL", impl)
+        be = JaxBackend()
+        m0 = obs.metrics.snapshot()
+        res = run_debug(corpus_dir, str(tmp_path / impl), be, figures="none")
+        mc = obs.Metrics.delta(obs.metrics.snapshot(), m0)["counters"]
+        assert mc.get(f"analysis.route.synth.{impl}"), (impl, mc)
+        assert mc.get(counted[impl]), (impl, mc)
+        synth_recs = [r for r in be.analysis_routes if r["verb"] == "synth"]
+        assert synth_recs and all(r["route"] == impl for r in synth_recs)
+        assert all(r["reason"] == "forced" for r in synth_recs)
+        t = _tree(res.report_dir)
+        bad = sorted(k for k in t_oracle if t_oracle[k] != t.get(k))
+        assert not bad, (impl, bad)
+
+
+# ----------------------------------------------------------- ranked reduce
+
+
+def test_build_repairs_ranking_and_examples():
+    """Support counting, (-support, table) order, example-run caps."""
+
+    class _M:
+        def get_failed_runs_iters(self):
+            return [1, 3, 5, 7, 9, 11, 13]
+
+        def get_runs_iters(self):
+            return list(range(14))
+
+    present = {f: ["log"] for f in [1, 3, 5, 7, 9, 11, 13]}
+    present[1] = ["log", "ack"]  # run 1 has ack -> not a candidate there
+    ext = {r: ["bcast"] for r in range(14)}
+    ext[0] = ["bcast", "ack"]
+    doc = build_repairs(["log", "ack", "replicate"], ext, present, _M(), 0)
+    corr = doc["corrections"]
+    assert [c["table"] for c in corr] == ["replicate", "ack"]
+    assert corr[0]["support"] == 7 and corr[1]["support"] == 6
+    # Example runs: smallest supporting iterations, capped at 5.
+    assert corr[0]["example_runs"] == [1, 3, 5, 7, 9]
+    assert corr[1]["example_runs"] == [3, 5, 7, 9, 11]
+    ext_ranked = doc["extensions"]
+    assert [e["table"] for e in ext_ranked] == ["bcast", "ack"]
+    assert ext_ranked[0]["support"] == 14 and ext_ranked[1]["support"] == 1
+    # Ties break by table name.
+    doc2 = build_repairs(["b", "a"], {}, {f: [] for f in [1, 3]}, _M(), 0)
+    assert [c["table"] for c in doc2["corrections"]] == ["a", "b"]
+    assert all(c["support"] == 7 for c in doc2["corrections"])
+
+
+def test_reduce_permutation_invariance(tmp_path):
+    """Reducing the same partials in any order must produce the same
+    ranked repair document (the streamed/grown-corpus contract)."""
+    import itertools
+
+    parts = []
+    for k, iters in enumerate(([0, 1], [2, 3], [4, 5])):
+        failed = [i for i in iters if i % 2]
+        parts.append(
+            delta.SegmentPartial(
+                iters=iters,
+                success_iters=[i for i in iters if not i % 2],
+                failed_iters=failed,
+                proto_ordered={i: ["log", "ack"] for i in iters if not i % 2},
+                present={f: ["log"] if f < 3 else [] for f in failed},
+                achieved={i: 1 for i in iters},
+                corrections=["c"],
+                extensions=["e"],
+                ext_candidates={i: ["bcast"] if i < 4 else [] for i in iters},
+                good_proto=["log", "ack"],
+            )
+        )
+
+    class _M:
+        runs = [type("R", (), {"iteration": i})() for i in range(6)]
+
+        def get_failed_runs_iters(self):
+            return [1, 3, 5]
+
+        def get_success_runs_iters(self):
+            return [0, 2, 4]
+
+        def get_runs_iters(self):
+            return list(range(6))
+
+    docs = set()
+    for perm in itertools.permutations(parts):
+        red = delta.reduce_partials(list(perm), _M(), 0)
+        assert red.repairs is not None
+        docs.add(json.dumps(red.repairs, sort_keys=True))
+    assert len(docs) == 1
+    doc = json.loads(next(iter(docs)))
+    # Run 1 present {log} -> missing {ack}; runs 3,5 present {} -> missing
+    # {log, ack}: ack explains all 3 failures, log only 2.
+    assert [c["table"] for c in doc["corrections"]] == ["ack", "log"]
+    assert [c["support"] for c in doc["corrections"]] == [3, 2]
+    assert doc["extensions"][0]["support"] == 4
+
+
+def test_segment_partial_roundtrip():
+    """ext_candidates / good_proto survive the JSON round trip, including
+    the None (no-synthesis-backend) sentinel."""
+    p = delta.SegmentPartial(
+        iters=[0, 1],
+        ext_candidates={0: ["a"], 1: []},
+        good_proto=["log"],
+    )
+    q = delta.SegmentPartial.from_json(p.to_json())
+    assert q.ext_candidates == {0: ["a"], 1: []}
+    assert q.good_proto == ["log"]
+    r = delta.SegmentPartial.from_json(delta.SegmentPartial(iters=[0]).to_json())
+    assert r.ext_candidates is None and r.good_proto is None
+
+
+# ------------------------------------------------- cache-key invalidation
+
+
+def test_abi_bump_invalidates_cached_repairs(corpus_dir, tmp_path, monkeypatch):
+    """Invalidation matrix: a report cached under the pre-synthesis ABI
+    must recompute loudly under the bumped ABI — never a stale repair
+    list served from cache."""
+    from nemo_tpu import obs
+
+    cc = str(tmp_path / "cc")
+    rc = str(tmp_path / "rc")
+    monkeypatch.setattr(delta, "ANALYSIS_ABI_VERSION", 1)
+    run_debug(
+        corpus_dir, str(tmp_path / "old"), JaxBackend(), figures="none",
+        corpus_cache=cc, result_cache=rc,
+    )
+    monkeypatch.setattr(delta, "ANALYSIS_ABI_VERSION", 2)
+    m0 = obs.metrics.snapshot()
+    res = run_debug(
+        corpus_dir, str(tmp_path / "new"), JaxBackend(), figures="none",
+        corpus_cache=cc, result_cache=rc,
+    )
+    mc = obs.Metrics.delta(obs.metrics.snapshot(), m0)["counters"]
+    assert not mc.get("rcache.report_hit"), mc
+    assert not mc.get("rcache.partial_hit"), mc
+    assert delta.kernel_dispatch_count(mc) > 0
+    assert "repairs.json" in _tree(res.report_dir)
+
+
+def test_partial_key_pins_good_anchor(corpus_dir):
+    """The synthesis cache keys pin the good-run anchor identity exactly
+    like the PR-6 partial keys: a different anchor, different key."""
+    molly = load_molly_output(corpus_dir)
+    segs = delta.attach_positions(delta.corpus_segments(molly), molly)
+    # Anonymous corpus (no store) -> uncacheable; fabricate a fingerprint.
+    segs[0].fingerprint = "f" * 64
+    k_a = delta.partial_cache_key(segs[0], segs, 0, 0, "none")
+    k_b = delta.partial_cache_key(segs[0], segs, 5, 5, "none")
+    assert k_a and k_b and k_a != k_b
+
+
+def test_changed_good_anchor_invalidates_ranked_repairs(tmp_path, monkeypatch):
+    """Regression (ISSUE 13 satellite): the SAME segment content with a
+    CHANGED good-run anchor must miss every cached partial — ranked
+    repairs recompute against the new anchor instead of serving stale
+    anti-joins."""
+    from nemo_tpu import obs
+
+    corpus = write_corpus(SynthSpec(n_runs=8, seed=2, eot=6), str(tmp_path))
+    cc, rc = str(tmp_path / "cc"), str(tmp_path / "rc")
+    r1 = run_debug(
+        corpus, str(tmp_path / "a"), JaxBackend(), figures="none",
+        corpus_cache=cc, result_cache=rc,
+    )
+    good_1 = delta.choose_good_run(r1.molly)
+    # A different ACHIEVING success run exists in this corpus; repoint the
+    # single shared good-run chooser at it (backends delegate to the same
+    # function, so the pipeline and the map guard stay consistent).
+    other = [
+        i
+        for i in r1.molly.get_success_runs_iters()
+        if i != good_1
+        and {r.iteration: r for r in r1.molly.runs}[i].time_post_holds
+    ]
+    assert other, "corpus needs a second achieving success for this test"
+    monkeypatch.setattr(delta, "choose_good_run", lambda m: other[0])
+    # The tier-1 report entry is content-addressed on the CORPUS (the good
+    # run is normally a pure function of it); evict it so the rerun
+    # exercises the partial tier — whose keys pin the anchor identity.
+    import shutil
+
+    shutil.rmtree(os.path.join(rc, "report"))
+    m0 = obs.metrics.snapshot()
+    r2 = run_debug(
+        corpus, str(tmp_path / "b"), JaxBackend(), figures="none",
+        corpus_cache=cc, result_cache=rc,
+    )
+    mc = obs.Metrics.delta(obs.metrics.snapshot(), m0)["counters"]
+    # No cached partial (nor the report) may serve the new-anchor run.
+    assert not mc.get("rcache.report_hit"), mc
+    assert not mc.get("rcache.partial_hit"), mc
+    assert mc.get("delta.segments_mapped", 0) >= 1 and not mc.get(
+        "delta.segments_cached"
+    )
+    # And the recomputed ranked repairs equal a from-scratch run under the
+    # same anchor (no stale content leaked through).
+    scratch = run_debug(
+        corpus, str(tmp_path / "c"), JaxBackend(), figures="none",
+        corpus_cache="off", result_cache="off",
+    )
+    assert _tree(r2.report_dir) == _tree(scratch.report_dir)
+
+
+def test_grown_corpus_shifts_ranking(tmp_path, monkeypatch):
+    """Grown-corpus delta: the new segment's runs shift the corpus-wide
+    support counts — the merged rerun must match from-scratch (updated
+    ranking) and must NOT equal the stale base ranking."""
+    full = write_corpus(
+        SynthSpec(n_runs=12, seed=2, eot=6), str(tmp_path / "full")
+    )
+    corpus = str(tmp_path / "grow" / os.path.basename(full))
+    grow_corpus_dir(full, corpus, 8)
+    cc, rc = str(tmp_path / "cc"), str(tmp_path / "rc")
+
+    def run(label, **kw):
+        kw.setdefault("corpus_cache", cc)
+        kw.setdefault("result_cache", rc)
+        return run_debug(
+            corpus, str(tmp_path / label), JaxBackend(), figures="none", **kw
+        )
+
+    base = run("base")
+    base_repairs = _tree(base.report_dir)["repairs.json"]
+    grow_corpus_dir(full, corpus, 12)
+    from nemo_tpu import obs
+
+    m0 = obs.metrics.snapshot()
+    grown = run("grown")
+    mc = obs.Metrics.delta(obs.metrics.snapshot(), m0)["counters"]
+    assert mc.get("delta.runs_cached") == 8 and mc.get("delta.runs_mapped") == 4
+    grown_repairs = _tree(grown.report_dir)["repairs.json"]
+    scratch = run("scratch", corpus_cache="off", result_cache="off")
+    assert grown_repairs == _tree(scratch.report_dir)["repairs.json"]
+    assert grown_repairs != base_repairs, "ranking did not update on growth"
+    # The supports really shifted: more failed runs, higher top support.
+    top = json.loads(grown_repairs)["extensions"][0]
+    base_top = json.loads(base_repairs)["extensions"][0]
+    assert top["support"] > base_top["support"]
+
+
+# ------------------------------------------------------ streaming / serve
+
+
+def test_streamed_ranking_matches_inmemory(tmp_path, monkeypatch):
+    from nemo_tpu.models.synth import write_corpus_stream
+    from nemo_tpu.store import resolve_store
+
+    cc = str(tmp_path / "cc")
+    corpus = write_corpus_stream(
+        SynthSpec(n_runs=18, seed=5, eot=6, name="synth_stream"),
+        str(tmp_path),
+        segment_runs=6,
+        store=resolve_store(cc),
+    )
+    monkeypatch.setenv("NEMO_STREAM", "off")
+    mem = run_debug(
+        corpus, str(tmp_path / "mem"), JaxBackend(), figures="none",
+        corpus_cache=cc, result_cache="off",
+    )
+    monkeypatch.setenv("NEMO_STREAM", "on")
+    monkeypatch.setenv("NEMO_STREAM_SEGMENTS", "2")
+    strm = run_debug(
+        corpus, str(tmp_path / "strm"), JaxBackend(), figures="none",
+        corpus_cache=cc, result_cache="off",
+    )
+    t_mem, t_strm = _tree(mem.report_dir), _tree(strm.report_dir)
+    assert t_mem["repairs.json"] == t_strm["repairs.json"]
+    assert t_mem == t_strm
+
+
+def test_serve_batcher_merges_synth_ext(corpus_dir):
+    """The synth_ext verb is continuous-batching-eligible: two compatible
+    run-batched dispatches merged by the serving tier's batcher demux
+    bit-identically to solo executions."""
+    import threading
+
+    from nemo_tpu.backend.jax_backend import JaxBackend, LocalExecutor
+    from nemo_tpu.serve.batch import BATCHABLE_VERBS, KernelBatcher
+
+    assert "synth_ext" in BATCHABLE_VERBS
+    molly = load_molly_output(corpus_dir)
+    be = JaxBackend()
+    be.init_graph_db("", molly)
+    pre_b, _post_b, res = be._fused()[0]
+    holds = np.asarray(res["pre_holds"])
+    num_tables = int(np.asarray(res["proto_bits"]).shape[1])
+    arrays = {
+        "edge_src": np.asarray(pre_b.edge_src),
+        "edge_dst": np.asarray(pre_b.edge_dst),
+        "edge_mask": np.asarray(pre_b.edge_mask),
+        "is_goal": np.asarray(pre_b.is_goal),
+        "node_mask": np.asarray(pre_b.node_mask),
+        "type_id": np.asarray(pre_b.type_id),
+        "table_id": np.asarray(pre_b.table_id),
+        "holds": holds,
+    }
+    params = {"v": pre_b.v, "num_tables": num_tables}
+    ex = LocalExecutor()
+    solo = ex.run("synth_ext", arrays, params)["ext_bits"]
+
+    batcher = KernelBatcher()
+    results: dict[int, np.ndarray] = {}
+    errs: list = []
+
+    def worker(idx):
+        try:
+            results[idx] = batcher.run(LocalExecutor(), "synth_ext", arrays, params)[
+                "ext_bits"
+            ]
+        except Exception as ex_:  # surfaced below
+            errs.append(ex_)
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    be.close_db()
+    assert not errs, errs
+    for got in results.values():
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(solo))
+
+
+# ------------------------------------------------------------ knobs/units
+
+
+def test_synth_impl_env_validation(monkeypatch):
+    for v in ("auto", "python", "sparse", " SPARSE_DEVICE "):
+        monkeypatch.setenv("NEMO_SYNTH_IMPL", v)
+        assert synth_impl_env() == v.strip().lower()
+    monkeypatch.setenv("NEMO_SYNTH_IMPL", "fast")
+    with pytest.raises(ValueError, match="NEMO_SYNTH_IMPL"):
+        synth_impl_env()
+
+
+def test_synth_route_crossover(monkeypatch):
+    """The work budget decides under auto-on-device; forced impls pin."""
+    be = JaxBackend()
+    be._synth_impl = "auto"
+    be._synth_host_work = 1000
+    be.analysis_routes = []
+    assert be._synth_route(10, 50, 50)[0] == "sparse"  # 1000 <= 1000
+    assert be._synth_route(11, 50, 50)[0] == "sparse_device"  # 1100 > 1000
+    assert be._synth_route(11, 50, 50)[1] == "crossover"
+    monkeypatch.setenv("NEMO_SYNTH_IMPL", "sparse")
+    be._synth_impl = "sparse"
+    assert be._synth_route(10**9, 50, 50) == ("sparse", "forced", 10**9 * 100)
+
+
+def test_service_backend_synth_resolution(monkeypatch):
+    """RemoteExecutor clients run the host twin on auto (no Kernel RPC for
+    a handful of scatters; wire-compat with older sidecars); explicit
+    knobs still force either engine or the oracle."""
+    from nemo_tpu.backend.service_backend import ServiceBackend
+
+    be = ServiceBackend()
+    monkeypatch.delenv("NEMO_SYNTH_IMPL", raising=False)
+    assert be._resolve_synth_impl() == "sparse"
+    for impl in ("python", "sparse_device"):
+        monkeypatch.setenv("NEMO_SYNTH_IMPL", impl)
+        assert be._resolve_synth_impl() == impl

@@ -218,6 +218,21 @@ def extract_metrics(doc: dict) -> dict[str, tuple[float, str, str]]:
     # carries a history-INDEPENDENT absolute ceiling (STREAM_RSS_CEILING_MB,
     # checked in main): the bounded-working-set contract is "under 4 GB at
     # any corpus size", not "no worse than last week".
+    # Synthesis tier (ISSUE 13): the batched repair-synthesis wall creeping
+    # up, its speedup over the per-run oracle collapsing (the >=5x
+    # acceptance floor lives in synth-smoke; the trend watches drift), or
+    # candidate throughput dropping all flag.  s_fast floors: the batched
+    # walls are sub-second by design.
+    sy = doc.get("synth_tier") or {}
+    put("synth_tier.batched_1x_s", sy.get("batched_1x_s"), "lower", "s_fast")
+    put("synth_tier.batched_full_s", sy.get("batched_full_s"), "lower", "s_fast")
+    put("synth_tier.speedup_full", sy.get("speedup_full"), "higher", "ratio")
+    put(
+        "synth_tier.candidates_per_s",
+        sy.get("candidates_per_s"),
+        "higher",
+        "ratio",
+    )
     st = doc.get("stream_tier") or {}
     put("stream_tier.runs_per_s", st.get("runs_per_s"), "higher", "ratio")
     put(
