@@ -5,7 +5,7 @@ NATIVE_LIB := native/build/libnemo_native.so
 REPORT_SRC := native/nemo_report.cpp
 REPORT_LIB := native/build/libnemo_report.so
 
-.PHONY: all native test bench bench-watch bench-trend prewarm validate trace-smoke obs-smoke store-smoke delta-smoke shard-smoke sparse-device-smoke serve-smoke chaos-smoke stream-smoke synth-smoke lint-print clean reset proto neo4j-up neo4j-validate neo4j-down
+.PHONY: all native test bench bench-watch bench-trend prewarm validate trace-smoke obs-smoke store-smoke delta-smoke shard-smoke sparse-device-smoke serve-smoke fleet-smoke chaos-smoke stream-smoke synth-smoke lint-print clean reset proto neo4j-up neo4j-validate neo4j-down
 
 all: native
 
@@ -93,6 +93,17 @@ delta-smoke:
 # completes, /healthz NOT_SERVING, exit 0) — nemo_tpu/serve.
 serve-smoke:
 	python -m nemo_tpu.utils.validate_smoke --serve-smoke
+
+# Fleet scale-out smoke (also the tail of `make validate`; ISSUE 14):
+# boot 2 sidecar replicas sharing a result-cache tier plus the thin
+# consistent-hash router, drive a cold-corpus herd across BOTH replicas,
+# and assert exactly ONE analysis fleet-wide (cross-replica single-flight
+# via the shared-tier leader lease), byte-identical responses, a
+# zero-dispatch shared-tier warm hit on the replica that never analyzed
+# the corpus, stable router affinity, and a clean drain of the whole
+# fleet (nemo_tpu/serve/router.py, store/rcache.py).
+fleet-smoke:
+	python -m nemo_tpu.utils.validate_smoke --fleet-smoke
 
 # Fault-tolerance smoke (also the tail of `make validate`; ISSUE 9): the
 # chaos harness (nemo_tpu/utils/chaos.py) injects corrupt runs, device-lane

@@ -1062,6 +1062,297 @@ def child_main() -> None:
     except Exception as ex:  # the serve tier must never sink the bench
         log(f"serve tier skipped: {type(ex).__name__}: {ex}")
 
+    # Fleet tier (ISSUE 14): horizontal scale-out — 2 sidecar REPLICAS
+    # joined by the shared rcache tier behind the consistent-hash router,
+    # vs ONE replica behind the SAME router (path symmetry: both arms pay
+    # the hop), on a mixed-tenant WARM herd (6 distinct corpora, balanced
+    # ring affinity, shared-tier blob hits) where scaling is
+    # serving-path-bound rather than coalesce- or compute-bound — the
+    # deployment shape where adding replicas is SUPPOSED to add capacity.
+    # Also reports the scale-out replica's warm boot-to-first-response
+    # wall (hot persistent compile cache + hot shared tier) against the
+    # first replica's cold one, and a cold-herd microleg's cross-replica
+    # single-flight dedup (N concurrent cold requests across both
+    # replicas -> ONE analysis fleet-wide).
+    #
+    # CEILING CLAUSE (the PR-7 virtual-shard / PR-11 overlap precedent):
+    # replica scaling needs SPARE CORES.  On a 1-effective-core container
+    # every process time-slices one CPU, so 2 replicas cannot beat 1 by
+    # construction — the row still measures and reports honestly
+    # (effective_cores, scaling_expected=false) and the per-platform
+    # trend medians gate what this box CAN do; real multi-core scaling
+    # rides the bench-watch device capture like the shard tier's.
+    fleet_tier = None
+    try:
+        import importlib.util as _ilu
+        import signal as _signal
+        import threading as _threading
+
+        if _ilu.find_spec("grpc") is None:
+            raise RuntimeError("grpcio not installed")
+        from nemo_tpu.models.synth import SynthSpec as _SSpec
+        from nemo_tpu.models.synth import write_corpus as _swrite
+        from nemo_tpu.serve.router import HashRing as _HashRing
+        from nemo_tpu.serve.router import route_key as _route_key
+        from nemo_tpu.service.client import RemoteAnalyzer as _RA
+        from nemo_tpu.utils.subproc import PortReservation as _PortRes
+        from nemo_tpu.utils.subproc import wait_listening as _wait_listening
+
+        m_clients = int(os.environ.get("NEMO_BENCH_FLEET_CLIENTS", "8"))
+        rounds = int(os.environ.get("NEMO_BENCH_FLEET_ROUNDS", "4"))
+        fleet_tmp = os.path.join(tmp, "fleet_tier")
+        os.makedirs(fleet_tmp, exist_ok=True)
+        shared_cache = os.path.join(fleet_tmp, "shared_rcache")
+
+        ports = _PortRes(4)  # the ISSUE-14 bind-and-hold boot-race fix
+        try:
+            fleet_targets = [f"127.0.0.1:{p}" for p in ports.ports[:2]]
+            router_single_target = f"127.0.0.1:{ports.ports[2]}"
+            router_fleet_target = f"127.0.0.1:{ports.ports[3]}"
+            # BALANCED mixed-tenant herd: pick 3 corpora homed on each
+            # replica (by the same ring the router uses), so affinity
+            # splits the warm load evenly and the measured speedup is
+            # replica scaling, not a lucky hash.
+            ring = _HashRing(fleet_targets)
+            per_replica: dict = {t: [] for t in fleet_targets}
+            ci = 0
+            while any(len(v) < 3 for v in per_replica.values()) and ci < 64:
+                d = _swrite(
+                    _SSpec(n_runs=6, seed=120 + ci, name=f"fleet_c{ci}"), fleet_tmp
+                )
+                home = ring.route(_route_key(d))
+                if len(per_replica[home]) < 3:
+                    per_replica[home].append(d)
+                ci += 1
+            fleet_corpora = (
+                per_replica[fleet_targets[0]] + per_replica[fleet_targets[1]]
+            )
+            if len(fleet_corpora) < 6:
+                raise RuntimeError("could not balance corpora across the ring")
+        except BaseException:
+            # The setup segment runs before the measurement try/finally
+            # below owns the reservation: close the 4 held sockets here
+            # instead of leaking them for the rest of the bench process.
+            ports.close()
+            raise
+
+        def _replica_env(i: int) -> dict:
+            return dict(
+                os.environ,
+                NEMO_CORPUS_CACHE=os.path.join(fleet_tmp, f"cc{i}"),
+                NEMO_RESULT_CACHE=os.path.join(fleet_tmp, f"rc{i}"),
+                NEMO_RCACHE_SHARED=shared_cache,
+                # ONE persistent compile cache across the fleet: replica
+                # 1's boot loads replica 0's compiles from disk — the
+                # warm-boot tier under measurement.
+                NEMO_JAX_CACHE=os.path.join(fleet_tmp, "jax_cache"),
+            )
+
+        fleet_procs: list = []
+
+        def _boot_replica(i: int):
+            fh = open(os.path.join(fleet_tmp, f"replica{i}.stderr"), "w")
+            p = subprocess.Popen(
+                [sys.executable, "-m", "nemo_tpu.service.server",
+                 "--port", str(ports.release(i)),
+                 "--platform", platform if platform else "cpu"],
+                stdout=fh,
+                stderr=subprocess.STDOUT,
+                env=_replica_env(i),
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            fleet_procs.append((p, fh))
+            return p
+
+        def _herd(target: str, label: str) -> dict:
+            latencies: list = []
+            failures: list = []
+            lock = _threading.Lock()
+
+            def client(idx: int, barrier) -> None:
+                d = fleet_corpora[idx % len(fleet_corpora)]
+                try:
+                    with _RA(target=target, tenant=f"fleet{idx % 4}") as c:
+                        for _ in range(rounds):
+                            barrier.wait(timeout=120)
+                            t0 = time.perf_counter()
+                            c._call(c._analyze_dir, {"dir": d}, name="AnalyzeDir")
+                            dt = time.perf_counter() - t0
+                            with lock:
+                                latencies.append(dt)
+                except Exception as ex:
+                    with lock:
+                        failures.append(
+                            f"{label} client {idx}: {type(ex).__name__}: {ex}"
+                        )
+
+            barrier = _threading.Barrier(m_clients)
+            threads = [
+                _threading.Thread(target=client, args=(k, barrier))
+                for k in range(m_clients)
+            ]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=600)
+            wall = time.perf_counter() - t0
+            if failures:
+                raise RuntimeError("; ".join(failures[:3]))
+            n = m_clients * rounds
+            if len(latencies) != n:
+                raise RuntimeError(f"{label}: only {len(latencies)}/{n} completed")
+            return {
+                "p50_s": round(float(np.percentile(latencies, 50)), 4),
+                "p99_s": round(float(np.percentile(latencies, 99)), 4),
+                "throughput_rps": round(n / wall, 2),
+                "wall_s": round(wall, 2),
+            }
+
+        def _replica_counters(target: str) -> dict:
+            with _RA(target=target) as c:
+                return c.health().get("metrics", {}).get("counters", {})
+
+        def _boot_router(port_idx: int, backends: list, name: str):
+            fh = open(os.path.join(fleet_tmp, f"{name}.stderr"), "w")
+            p = subprocess.Popen(
+                [sys.executable, "-m", "nemo_tpu.service.server", "--router",
+                 "--port", str(ports.release(port_idx)),
+                 "--backends", ",".join(backends)],
+                stdout=fh,
+                stderr=subprocess.STDOUT,
+                env=dict(os.environ),
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            fleet_procs.append((p, fh))
+            _wait_listening(ports.ports[port_idx], deadline_s=60.0, proc=p)
+            with _RA(target=f"127.0.0.1:{ports.ports[port_idx]}") as probe:
+                probe.wait_ready(60.0)
+            return p
+
+        try:
+            r0 = _boot_replica(0)
+            _wait_listening(ports.ports[0], deadline_s=180.0, proc=r0)
+            with _RA(target=fleet_targets[0]) as probe:
+                probe.wait_ready(120.0)
+                # Prepopulate the shared tier + compile/corpus caches: the
+                # herd measures the fleet SERVING path, not first-compile.
+                t0 = time.perf_counter()
+                probe.analyze_dir_remote(fleet_corpora[0])
+                cold_first_response_s = time.perf_counter() - t0
+                for d in fleet_corpora[1:]:
+                    probe.analyze_dir_remote(d)
+            # Baseline arm THROUGH a router over one backend: both arms
+            # pay the identical hop, so the delta is replica capacity.
+            router1 = _boot_router(2, fleet_targets[:1], "router_single")
+            single = _herd(router_single_target, "single")
+            router1.send_signal(_signal.SIGTERM)
+            router1.wait(timeout=30)
+
+            # Scale-out: replica 1 boots against the hot shared tier and
+            # the hot persistent compile cache; spawn -> first served
+            # response is the "capacity added" wall.
+            t_boot = time.perf_counter()
+            r1 = _boot_replica(1)
+            _wait_listening(ports.ports[1], deadline_s=180.0, proc=r1)
+            with _RA(target=fleet_targets[1]) as probe:
+                probe.wait_ready(120.0)
+                probe.analyze_dir_remote(per_replica[fleet_targets[1]][0])
+            warm_boot_s = time.perf_counter() - t_boot
+
+            _boot_router(3, fleet_targets, "router_fleet")
+            fleet = _herd(router_fleet_target, "fleet")
+
+            # Cold-herd microleg: cross-replica single-flight — 4
+            # concurrent clients of ONE fresh corpus split across both
+            # replicas directly; counter deltas prove one analysis.
+            cold_dir = _swrite(
+                _SSpec(n_runs=6, seed=260, name="fleet_cold"), fleet_tmp
+            )
+            before = [_replica_counters(t) for t in fleet_targets]
+            cold_failures: list = []
+
+            def cold_client(k: int) -> None:
+                try:
+                    with _RA(target=fleet_targets[k % 2]) as c:
+                        c._call(c._analyze_dir, {"dir": cold_dir}, name="AnalyzeDir")
+                except Exception as ex:
+                    cold_failures.append(f"{type(ex).__name__}: {ex}")
+
+            cts = [
+                _threading.Thread(target=cold_client, args=(k,)) for k in range(4)
+            ]
+            for t in cts:
+                t.start()
+            for t in cts:
+                t.join(timeout=300)
+            after = [_replica_counters(t) for t in fleet_targets]
+
+            def _delta(key: str) -> int:
+                return sum(
+                    int(a.get(key, 0)) - int(b.get(key, 0))
+                    for a, b in zip(after, before)
+                )
+
+            cold_analyses = _delta("serve.analyze_chunks")
+            cold_followers = _delta("serve.fleet.follower")
+            cold_requests = 4
+
+            from nemo_tpu.utils import effective_cpu_count as _ecc
+
+            cores = _ecc()
+            speedup = fleet["throughput_rps"] / max(single["throughput_rps"], 1e-9)
+            fleet_tier = {
+                "clients": m_clients,
+                "rounds": rounds,
+                "corpora": len(fleet_corpora),
+                "replicas": 2,
+                # The ceiling clause: speedup needs spare cores; on a
+                # 1-effective-core box 2 replicas time-slice one CPU and
+                # the honest expectation is <= 1.0.
+                "effective_cores": cores,
+                "scaling_expected": cores >= 2,
+                "single": single,
+                "fleet": fleet,
+                "speedup": round(speedup, 2),
+                "per_replica_efficiency": round(speedup / 2.0, 2),
+                "p99_ratio": round(fleet["p99_s"] / max(single["p99_s"], 1e-9), 2),
+                "cold_first_response_s": round(cold_first_response_s, 2),
+                "warm_boot_s": round(warm_boot_s, 2),
+                "cold_herd_requests": cold_requests,
+                "cold_herd_analyses": cold_analyses,
+                # 1 - analyses/requests: 0.75 when 4 concurrent cold
+                # requests cost ONE analysis.  (The follower counter is
+                # reported too but is timing-dependent: a fast leader
+                # turns would-be followers into plain rcache hits.)
+                "cold_herd_dedup_ratio": round(
+                    1.0 - cold_analyses / cold_requests, 3
+                ),
+                "cold_herd_followers": cold_followers,
+                "cold_herd_failures": len(cold_failures),
+            }
+            log(f"fleet tier (2 replicas + router vs 1): {json.dumps(fleet_tier)}")
+            if not fleet_tier["scaling_expected"]:
+                log(
+                    "fleet tier ceiling clause: 1 effective core — replica "
+                    "scaling has no spare cycles here; real scaling rides "
+                    "the bench-watch device capture"
+                )
+        finally:
+            ports.close()
+            for p, _ in fleet_procs:
+                if p.poll() is None:
+                    p.send_signal(_signal.SIGTERM)
+            for p, fh in fleet_procs:
+                try:
+                    p.wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait(timeout=15)
+                fh.close()
+    except Exception as ex:  # the fleet tier must never sink the bench
+        log(f"fleet tier skipped: {type(ex).__name__}: {ex}")
+
     # Warm up (one compile per family's shape signature), then time the full
     # sweep end to end.  Every timed dispatch gets DISTINCT input bytes (a
     # poke in a masked padding slot — results unchanged): the device tunnel
@@ -1782,6 +2073,7 @@ def child_main() -> None:
         "sparse_device_tier": sparse_device_tier,
         "stream_tier": stream_tier,
         "serve_tier": serve_tier,
+        "fleet_tier": fleet_tier,
         "stress_10x": stress_10x,
         # Whole-process obs registry at bench end: the scattered per-layer
         # counters (kernel dispatch/compile split, upload bytes, render

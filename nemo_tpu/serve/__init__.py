@@ -24,6 +24,11 @@ Streaming (the ``AnalyzeDirStream`` RPC) and the serving metrics
 (``serve.*`` on the Prometheus surface) live in service/server.py, which
 composes these three.  Import cost is tiny (numpy + obs); jax loads only
 when a merged launch executes.
+
+:mod:`nemo_tpu.serve.router` (ISSUE 14) adds the FLEET layer above all of
+this: a thin consistent-hash router placing AnalyzeDir traffic by corpus
+affinity over N replicas, with spill under load and failover — imported
+lazily (it needs grpc), never from this package's top level.
 """
 
 from __future__ import annotations

@@ -1,0 +1,567 @@
+"""Thin fleet router: content-affinity over N sidecar replicas (ISSUE 14).
+
+One sidecar process tops out around ~19 req/s on this container (PR 8);
+"millions of users" is a FLEET, and everything a fleet needs is already
+content-addressed.  The router is the placement half of that story:
+
+  * **Affinity routing** — ``AnalyzeDir`` / ``AnalyzeDirStream`` requests
+    consistent-hash on the corpus's store identity (:func:`route_key` —
+    the realpath the corpus store keys its store dir by,
+    store/__init__.py:store_dir), so one corpus's coalesce leader,
+    continuous batcher, and jit/compile cache naturally co-locate on one
+    replica.  The ring uses virtual nodes: adding or removing one of N
+    replicas remaps ~K/N keys, not the whole fleet.
+  * **Spill under load** — the existing admission/backpressure signals
+    drive it: a home replica that sheds (RESOURCE_EXHAUSTED with the
+    ``nemo-retry-after-s`` hint) or whose last-polled queue depth crosses
+    ``NEMO_ROUTER_SPILL_DEPTH`` sends the request to the least-loaded
+    live replica instead (``router.spill``).  The shared rcache tier makes
+    this safe: any replica serves any warm corpus.
+  * **Failover** — UNAVAILABLE marks the replica down and retries the
+    next replica on the ring after a jittered pause
+    (utils/backoff.py:FAILOVER_POLICY), counted ``router.failover``; a
+    background Health poll (``NEMO_ROUTER_HEALTH_S``) brings recovered
+    replicas back into rotation.
+  * **Byte transparency** — generic gRPC handlers with identity
+    serializers hand the router raw request bytes, which it forwards
+    verbatim (AnalyzeDir's JSON is peeked at only for the routing key);
+    trailing metadata (rcache/coalesce/fleet statuses, retry-after hints,
+    span payloads) rides back untouched.  A router hop costs network +
+    bytes-plumbing, never a protobuf decode.
+
+RPCs with no content identity (Analyze, AnalyzeStream, Kernel, Health) go
+to the least-loaded live replica.  Run it with the sidecar CLI:
+``python -m nemo_tpu.service.server --router --backends host:p1,host:p2``
+(or ``NEMO_FLEET_REPLICAS``).
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import json
+import os
+import threading
+import time
+
+import grpc
+
+from nemo_tpu import obs
+from nemo_tpu.obs import log as obs_log
+from nemo_tpu.utils.backoff import FAILOVER_POLICY
+from nemo_tpu.utils.env import env_float
+
+_log = obs_log.get_logger("nemo.router")
+
+#: Same service name the replicas register (service/server.py) — the
+#: router is indistinguishable from a replica to every existing client.
+SERVICE = "nemo.NemoAnalysis"
+
+
+def ring_hash(s: str) -> int:
+    """Stable 64-bit ring position (sha256 prefix — never Python's
+    salted hash(), which would reshuffle the fleet every process)."""
+    return int.from_bytes(hashlib.sha256(s.encode("utf-8")).digest()[:8], "big")
+
+
+def route_key(molly_dir: str) -> str:
+    """A corpus's ROUTING identity: the realpath — exactly what the corpus
+    store keys its store dir by (store/__init__.py:store_dir), i.e. the
+    store's identity.  Stable across corpus growth, so a grown corpus
+    keeps its leader/batcher/compile-cache affinity while the segment
+    fingerprints (the rcache content address) handle freshness."""
+    return os.path.realpath(molly_dir)
+
+
+class HashRing:
+    """Consistent-hash ring with virtual nodes.
+
+    Each backend owns ``vnodes`` points; a key routes to the first point
+    at or after its own hash (wrapping).  Adding one backend to N claims
+    ~1/(N+1) of every other backend's keyspace (the classic remap bound);
+    removing one hands its keys to ring successors — nobody else moves.
+    """
+
+    def __init__(self, backends: list[str], vnodes: int = 64) -> None:
+        self.backends = list(dict.fromkeys(backends))
+        self.vnodes = int(vnodes)
+        ring = sorted(
+            (ring_hash(f"{b}#{i}"), b)
+            for b in self.backends
+            for i in range(self.vnodes)
+        )
+        self._ring = ring
+        self._points = [p for p, _ in ring]
+
+    def preference(self, key: str) -> list[str]:
+        """Every backend, ordered by the ring walk from ``key``'s point:
+        [0] is the affinity home, the rest are the failover order (each
+        distinct backend in walk order)."""
+        if not self._ring:
+            return []
+        i = bisect.bisect(self._points, ring_hash(key)) % len(self._ring)
+        seen: set[str] = set()
+        out: list[str] = []
+        for k in range(len(self._ring)):
+            b = self._ring[(i + k) % len(self._ring)][1]
+            if b not in seen:
+                seen.add(b)
+                out.append(b)
+                if len(out) == len(self.backends):
+                    break
+        return out
+
+    def route(self, key: str) -> str:
+        return self.preference(key)[0]
+
+
+def spill_depth_default() -> float:
+    """Queue depth (queued + inflight, from the replica's own gauges) past
+    which the router proactively spills an affinity-routed request to the
+    least-loaded replica (``NEMO_ROUTER_SPILL_DEPTH``, default 8)."""
+    return env_float("NEMO_ROUTER_SPILL_DEPTH", 8.0)
+
+
+class Router:
+    """Routing state + forwarding engine behind the proxy handlers.
+
+    The decision core (:meth:`plan`) is pure state→order and unit-testable
+    without gRPC; the forwarding methods do the wire work.  Load is
+    tracked two ways: the router's own in-flight count per backend
+    (exact, request-scoped) plus the last Health poll's queued+inflight
+    gauges (covers load arriving from OTHER routers/direct clients).
+    """
+
+    def __init__(self, backends: list[str], vnodes: int = 64) -> None:
+        if not backends:
+            raise ValueError("router needs at least one backend replica")
+        self.ring = HashRing(backends, vnodes)
+        self.backends = self.ring.backends
+        self._lock = threading.Lock()
+        self._channels: dict[str, grpc.Channel] = {}
+        self._inflight = {b: 0 for b in self.backends}
+        self._depth = {b: 0.0 for b in self.backends}
+        self._up = {b: True for b in self.backends}
+        self._stop = threading.Event()
+        self._health_thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------- state
+
+    def start(self) -> None:
+        """Begin the background Health poll (idempotent)."""
+        if self._health_thread is not None:
+            return
+        self._health_thread = threading.Thread(
+            target=self._health_loop, daemon=True, name="nemo-router-health"
+        )
+        self._health_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        # Join the poll thread BEFORE closing channels: a pass racing this
+        # stop could otherwise recreate a channel after the map is cleared
+        # and leak it (plus its grpc worker threads) until process exit.
+        t = self._health_thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=10.0)
+        with self._lock:
+            channels = list(self._channels.values())
+            self._channels.clear()
+        for ch in channels:
+            ch.close()
+
+    def _health_loop(self) -> None:
+        period = max(0.2, env_float("NEMO_ROUTER_HEALTH_S", 2.0))
+        while not self._stop.wait(period):
+            self.poll_health()
+
+    def poll_health(self) -> None:
+        """One Health round across the fleet: marks replicas up/down and
+        refreshes their queued+inflight depth from the metrics snapshot
+        that rides every Health response's trailing metadata."""
+        from nemo_tpu.service.proto import nemo_service_pb2 as pb
+
+        req = pb.HealthRequest().SerializeToString()
+        for b in self.backends:
+            depth = 0.0
+            try:
+                method = self._channel(b).unary_unary(f"/{SERVICE}/Health")
+                _, call = method.with_call(req, timeout=5.0)
+                md = dict(call.trailing_metadata() or ())
+                raw = md.get("nemo-metrics-bin")
+                if raw:
+                    snap = json.loads(
+                        raw.decode("utf-8") if isinstance(raw, bytes) else raw
+                    )
+                    gauges = snap.get("gauges", {})
+                    depth = float(gauges.get("serve.queue_depth", 0.0)) + float(
+                        gauges.get("serve.inflight", 0.0)
+                    )
+                up = True
+            except Exception:
+                up = False
+            with self._lock:
+                was_up = self._up[b]
+                self._up[b] = up
+                self._depth[b] = depth if up else 0.0
+            if up != was_up:
+                obs.metrics.inc("router.backend_up" if up else "router.backend_down")
+                _log.warning("router.backend_state", backend=b, up=up)
+            obs.metrics.gauge(
+                f"router.backend.{self.backends.index(b)}.up", 1.0 if up else 0.0
+            )
+
+    def _channel(self, b: str) -> grpc.Channel:
+        with self._lock:
+            ch = self._channels.get(b)
+        if ch is not None:
+            return ch
+        # The environment quirk (utils/subproc.py): a channel created
+        # before its server listens wedges.  ONE connect probe — a closed
+        # port refuses instantly, so a down backend costs microseconds
+        # (failover / the next health round retries), not a 5 s polling
+        # stall per request and per poll_health pass.
+        import socket as _socket
+
+        host, _, port = b.rpartition(":")
+        _socket.create_connection((host or "127.0.0.1", int(port)), 2.0).close()
+        ch = grpc.insecure_channel(
+            b,
+            options=[
+                ("grpc.max_receive_message_length", 1 << 30),
+                ("grpc.max_send_message_length", 1 << 30),
+                ("grpc.max_metadata_size", 2 << 20),
+            ],
+        )
+        with self._lock:
+            if b in self._channels:
+                ch.close()
+                return self._channels[b]
+            self._channels[b] = ch
+        return ch
+
+    def _begin(self, b: str) -> None:
+        with self._lock:
+            self._inflight[b] += 1
+        obs.metrics.gauge("router.inflight", sum(self._inflight.values()))
+
+    def _end(self, b: str) -> None:
+        with self._lock:
+            self._inflight[b] = max(0, self._inflight[b] - 1)
+        obs.metrics.gauge("router.inflight", sum(self._inflight.values()))
+
+    def _mark_down(self, b: str) -> None:
+        with self._lock:
+            was = self._up[b]
+            self._up[b] = False
+        if was:
+            obs.metrics.inc("router.backend_down")
+            _log.warning("router.backend_state", backend=b, up=False)
+
+    def backend_states(self) -> dict:
+        with self._lock:
+            return {
+                b: {
+                    "up": self._up[b],
+                    "inflight": self._inflight[b],
+                    "depth": self._depth[b],
+                }
+                for b in self.backends
+            }
+
+    # ------------------------------------------------------------ routing
+
+    def plan(self, key: str | None) -> list[str]:
+        """The ordered backends to try for one request.
+
+        No key (Analyze/Kernel/Health): least-loaded live replicas first.
+        With a key: the ring's affinity order, except (a) replicas marked
+        down sink to the tail (they are still TRIED last — the Health poll
+        may be stale), and (b) when the live home's load is at/over the
+        spill threshold AND a strictly less-loaded live replica exists,
+        that replica is tried first (``router.spill_planned``)."""
+        with self._lock:
+            up = dict(self._up)
+            # max, not sum: the replica's polled serve.inflight gauge
+            # already INCLUDES requests this router forwarded, so summing
+            # would double-count them and trip the spill threshold at half
+            # its configured depth.  The router's own count is live; the
+            # poll covers load arriving from elsewhere.
+            load = {
+                b: max(self._inflight[b], self._depth[b]) for b in self.backends
+            }
+        if key is None:
+            return sorted(self.backends, key=lambda b: (not up[b], load[b]))
+        pref = self.ring.preference(key)
+        alive = [b for b in pref if up[b]]
+        down = [b for b in pref if not up[b]]
+        order = alive + down
+        if alive:
+            home = alive[0]
+            if load[home] >= spill_depth_default():
+                spill = min(
+                    (b for b in alive if b != home),
+                    key=lambda b: load[b],
+                    default=None,
+                )
+                if spill is not None and load[spill] < load[home]:
+                    obs.metrics.inc("router.spill_planned")
+                    order = [spill] + [b for b in order if b != spill]
+        return order
+
+    # --------------------------------------------------------- forwarding
+
+    @staticmethod
+    def _retry_hint(ex: grpc.RpcError):
+        """The ``nemo-retry-after-s`` trailing value of an admission
+        rejection, or None — the discriminator between "replica is
+        shedding load" (spill) and a deterministic RESOURCE_EXHAUSTED
+        (propagate; the client precedent in service/client.py:_call)."""
+        try:
+            for k, v in ex.trailing_metadata() or ():
+                if k == "nemo-retry-after-s":
+                    return v
+        except Exception:
+            return None
+        return None
+
+    @staticmethod
+    def _fwd_metadata(context) -> tuple:
+        """The client's metadata (tenant, trace id), forwarded verbatim."""
+        return tuple(context.invocation_metadata() or ()) if context is not None else ()
+
+    @staticmethod
+    def _timeout_of(context) -> float | None:
+        """Forwarded deadline: the client's remaining time, or None (no
+        deadline) when the client set none — the router must not impose a
+        bound of its own on a cold first-compile analysis that would
+        succeed direct-to-replica."""
+        if context is not None:
+            t = context.time_remaining()
+            if t is not None and t > 0:
+                return t
+        return None
+
+    def _abort_like(self, context, ex: grpc.RpcError, rpc: str):
+        """Propagate a backend's terminal status verbatim (trailing
+        metadata included — retry-after hints must survive the hop)."""
+        try:
+            tm = ex.trailing_metadata()
+            if tm and context is not None:
+                context.set_trailing_metadata(tuple(tm))
+        except Exception:  # lint: allow-silent-except — best-effort metadata relay
+            pass
+        obs.metrics.inc(f"router.errors.{rpc}")
+        context.abort(ex.code(), ex.details() or f"{rpc} failed on every replica")
+
+    def call_unary(self, rpc: str, request: bytes, context, key: str | None = None) -> bytes:
+        """Forward one unary RPC: affinity plan, reactive spill on a
+        shedding home, jittered failover on UNAVAILABLE."""
+        obs.metrics.inc(f"router.requests.{rpc}")
+        md = self._fwd_metadata(context)
+        timeout = self._timeout_of(context)
+        backoff = FAILOVER_POLICY.session()
+        candidates = self.plan(key)
+        last: grpc.RpcError | None = None
+        for i, b in enumerate(candidates):
+            try:
+                ch = self._channel(b)
+            except Exception:
+                self._mark_down(b)
+                obs.metrics.inc("router.failover")
+                continue
+            method = ch.unary_unary(f"/{SERVICE}/{rpc}")
+            self._begin(b)
+            try:
+                resp, call = method.with_call(
+                    request, metadata=md or None, timeout=timeout
+                )
+                tm = call.trailing_metadata()
+                if tm and context is not None:
+                    context.set_trailing_metadata(tuple(tm))
+                obs.metrics.inc(f"router.routed.{rpc}")
+                if i > 0:
+                    obs.metrics.inc("router.rerouted")
+                return resp
+            except grpc.RpcError as ex:
+                code = ex.code()
+                if code == grpc.StatusCode.UNAVAILABLE and i + 1 < len(candidates):
+                    self._mark_down(b)
+                    obs.metrics.inc("router.failover")
+                    last = ex
+                    wait = backoff.delay()
+                    if wait is None:
+                        break
+                    time.sleep(wait)
+                    continue
+                if (
+                    code == grpc.StatusCode.RESOURCE_EXHAUSTED
+                    and self._retry_hint(ex) is not None
+                    and i + 1 < len(candidates)
+                ):
+                    # The home replica is SHEDDING (admission rejection
+                    # with a retry-after hint): spill to the next
+                    # candidate instead of bouncing the client.
+                    obs.metrics.inc("router.spill")
+                    last = ex
+                    continue
+                self._abort_like(context, ex, rpc)
+            finally:
+                self._end(b)
+        if last is not None:
+            self._abort_like(context, last, rpc)
+        obs.metrics.inc(f"router.errors.{rpc}")
+        context.abort(grpc.StatusCode.UNAVAILABLE, f"no replica reachable for {rpc}")
+
+    def call_server_stream(self, rpc: str, request: bytes, context, key: str | None = None):
+        """Forward a server-streaming RPC.  Failover only while nothing
+        has been yielded (the replay-safe window — the client-side stream
+        retry precedent, service/client.py:analyze_dir_stream)."""
+        obs.metrics.inc(f"router.requests.{rpc}")
+        md = self._fwd_metadata(context)
+        timeout = self._timeout_of(context)
+        backoff = FAILOVER_POLICY.session()
+        candidates = self.plan(key)
+        last: grpc.RpcError | None = None
+        for b in candidates:
+            try:
+                ch = self._channel(b)
+            except Exception:
+                self._mark_down(b)
+                obs.metrics.inc("router.failover")
+                continue
+            method = ch.unary_stream(f"/{SERVICE}/{rpc}")
+            self._begin(b)
+            got_any = False
+            try:
+                stream = method(request, metadata=md or None, timeout=timeout)
+                for item in stream:
+                    got_any = True
+                    yield item
+                try:
+                    tm = stream.trailing_metadata()
+                    if tm and context is not None:
+                        context.set_trailing_metadata(tuple(tm))
+                except Exception:  # lint: allow-silent-except — best-effort metadata relay
+                    pass
+                obs.metrics.inc(f"router.routed.{rpc}")
+                return
+            except grpc.RpcError as ex:
+                if (
+                    not got_any
+                    and ex.code() == grpc.StatusCode.UNAVAILABLE
+                ):
+                    self._mark_down(b)
+                    obs.metrics.inc("router.failover")
+                    last = ex
+                    wait = backoff.delay()
+                    if wait is None:
+                        break
+                    time.sleep(wait)
+                    continue
+                self._abort_like(context, ex, rpc)
+            finally:
+                self._end(b)
+        if last is not None:
+            self._abort_like(context, last, rpc)
+        obs.metrics.inc(f"router.errors.{rpc}")
+        context.abort(grpc.StatusCode.UNAVAILABLE, f"no replica reachable for {rpc}")
+
+    def call_stream_stream(self, rpc: str, request_iterator, context):
+        """Forward a bidi stream to the least-loaded live replica.  No
+        failover: the request iterator is consumed as it forwards, so a
+        mid-stream replay would double-dispatch — errors propagate and the
+        client's own replay-safe retry handles the cold window."""
+        obs.metrics.inc(f"router.requests.{rpc}")
+        md = self._fwd_metadata(context)
+        timeout = self._timeout_of(context)
+        for b in self.plan(None):
+            try:
+                ch = self._channel(b)
+            except Exception:
+                self._mark_down(b)
+                obs.metrics.inc("router.failover")
+                continue
+            method = ch.stream_stream(f"/{SERVICE}/{rpc}")
+            self._begin(b)
+            try:
+                stream = method(request_iterator, metadata=md or None, timeout=timeout)
+                for item in stream:
+                    yield item
+                try:
+                    tm = stream.trailing_metadata()
+                    if tm and context is not None:
+                        context.set_trailing_metadata(tuple(tm))
+                except Exception:  # lint: allow-silent-except — best-effort metadata relay
+                    pass
+                obs.metrics.inc(f"router.routed.{rpc}")
+                return
+            except grpc.RpcError as ex:
+                self._abort_like(context, ex, rpc)
+            finally:
+                self._end(b)
+        obs.metrics.inc(f"router.errors.{rpc}")
+        context.abort(grpc.StatusCode.UNAVAILABLE, f"no replica reachable for {rpc}")
+
+
+def _dir_key_of(request: bytes) -> str | None:
+    """Peek the routing key out of an AnalyzeDir/AnalyzeDirStream JSON
+    request (the ONLY inspection the router does).  Unparseable requests
+    route by load and let the replica return the proper
+    INVALID_ARGUMENT."""
+    try:
+        doc = json.loads(request.decode("utf-8"))
+        d = doc.get("dir") or (doc.get("dirs") or [None])[0]
+        return route_key(d) if isinstance(d, str) and d else None
+    except Exception:
+        return None
+
+
+def make_router_server(
+    port: int, backends: list[str], max_workers: int = 64, vnodes: int = 64
+) -> tuple[grpc.Server, int, Router]:
+    """Build (but don't start) the router server: the same NemoAnalysis
+    surface the replicas expose, registered with IDENTITY serializers so
+    every handler sees raw bytes and forwards them verbatim."""
+    from concurrent import futures
+
+    router = Router(backends, vnodes=vnodes)
+    router.start()
+
+    def unary(rpc: str, keyed: bool = False):
+        def handler(request: bytes, context):
+            key = _dir_key_of(request) if keyed else None
+            return router.call_unary(rpc, request, context, key=key)
+
+        return grpc.unary_unary_rpc_method_handler(handler)
+
+    def server_stream(rpc: str, keyed: bool = False):
+        def handler(request: bytes, context):
+            key = _dir_key_of(request) if keyed else None
+            yield from router.call_server_stream(rpc, request, context, key=key)
+
+        return grpc.unary_stream_rpc_method_handler(handler)
+
+    handlers = {
+        "Health": unary("Health"),
+        "Analyze": unary("Analyze"),
+        "Kernel": unary("Kernel"),
+        "AnalyzeDir": unary("AnalyzeDir", keyed=True),
+        "AnalyzeDirStream": server_stream("AnalyzeDirStream", keyed=True),
+        "AnalyzeStream": grpc.stream_stream_rpc_method_handler(
+            lambda it, ctx: router.call_stream_stream("AnalyzeStream", it, ctx)
+        ),
+    }
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=[
+            ("grpc.max_receive_message_length", 1 << 30),
+            ("grpc.max_send_message_length", 1 << 30),
+            ("grpc.max_metadata_size", 2 << 20),
+        ],
+    )
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE, handlers),)
+    )
+    bound = server.add_insecure_port(f"127.0.0.1:{port}")
+    return server, bound, router

@@ -377,6 +377,12 @@ class RemoteAnalyzer:
             # "hit" = this request rode another client's identical
             # in-flight analysis (ISSUE 8 single-flight).
             obs.metrics.inc(f"rpc.analyze_dir_coalesce.{coalesce}")
+        fleet = trailing.get("nemo-fleet")
+        if fleet:
+            # Cross-REPLICA single-flight status (ISSUE 14): "leader" ran
+            # the fleet's one analysis, "follower" rode another replica's
+            # via the shared cache tier.
+            obs.metrics.inc(f"rpc.analyze_dir_fleet.{fleet}")
         return codec.outputs_from_pb(resp)
 
     def analyze_dir_stream(self, molly_dirs, corpus_cache=None, result_cache=None):

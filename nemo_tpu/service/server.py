@@ -35,6 +35,15 @@ events as each completes.  SIGTERM drains gracefully: new admissions are
 refused (`/healthz` -> NOT_SERVING), in-flight requests finish inside
 `NEMO_SERVE_DRAIN_S`, then the process exits 0.
 
+Fleet (ISSUE 14): `--shared-cache DIR` / `NEMO_RCACHE_SHARED` attaches
+this replica to the fleet's shared result-cache tier — any replica serves
+any warm corpus, publishes replicate, and a cold herd's concurrent
+identical requests across REPLICAS coalesce through a leader lease in the
+shared tier (one analysis fleet-wide, `nemo-fleet` trailing status).
+`--prewarm` warms the bucket-signature programs at boot so scale-out adds
+capacity in seconds.  `--router --backends h:p,...` turns the process
+into the thin consistent-hash router instead (nemo_tpu/serve/router.py).
+
 Run:  python -m nemo_tpu.service.server --port 50051 --metrics-port 9464
 """
 
@@ -60,6 +69,13 @@ SERVICE = "nemo.NemoAnalysis"
 VERSION = "1"
 
 log = obs_log.get_logger("nemo.sidecar")
+
+
+def _replica_id() -> str:
+    """This replica's fleet identity (lease ownership, log attribution)."""
+    import socket as _socket
+
+    return f"{_socket.gethostname()}-{os.getpid()}"
 
 
 def _health_state() -> dict:
@@ -399,13 +415,21 @@ class _Impl:
         col = _SpanCollection(context)
         try:
             payload, meta = self._dir_payload(request, d, col.tid, ticket, context)
-            resp = pb.AnalyzeResponse.FromString(payload)
             md = col.trailing() + (
                 ("nemo-rcache", meta["rcache"]),
                 ("nemo-coalesce", meta["coalesce"]),
             )
+            if "fleet" in meta:
+                md = md + (("nemo-fleet", meta["fleet"]),)
             context.set_trailing_metadata(md)
-            return resp
+            # The SERIALIZED payload goes to the wire verbatim (the
+            # handler's serializer passes bytes through): map-field
+            # serialization order is process-nondeterministic, so a
+            # decode/re-encode here would break the fleet's byte-identical
+            # response contract the moment a follower REPLICA relays a
+            # leader's payload — and skipping it saves a round trip on
+            # every response anyway.
+            return payload
         finally:
             _rpc_observed("AnalyzeDir", t0, col.tid)
             col.release()
@@ -493,27 +517,54 @@ class _Impl:
                 {"static": {k: int(v) for k, v in static.items()}, "wire": VERSION},
             )
 
+            def _serve_cached(cached: bytes) -> bytes:
+                resp = pb.AnalyzeResponse.FromString(cached)
+                # The stored wall is the POPULATING run's; a served hit
+                # dispatched nothing.
+                resp.step_seconds = 0.0
+                obs.metrics.inc("serve.analyze_dir_cached")
+                return resp.SerializeToString()
+
+            def _run_and_publish() -> bytes:
+                resp = self._run_step(pre, post, static, chunk=0, trace_id=trace_id)
+                p = resp.SerializeToString()
+                if rc is not None and content_key is not None:
+                    rc.put_blob("analyze_dir", content_key, p)
+                return p
+
             def _execute() -> tuple[bytes, dict]:
                 rc_status = "off"
+                meta_extra: dict = {}
                 payload = None
                 if rc is not None and content_key is not None:
                     cached = rc.load_blob("analyze_dir", content_key)
                     if cached is not None:
-                        resp = pb.AnalyzeResponse.FromString(cached)
-                        # The stored wall is the POPULATING run's; a
-                        # served hit dispatched nothing.
-                        resp.step_seconds = 0.0
-                        payload = resp.SerializeToString()
+                        payload = _serve_cached(cached)
                         rc_status = "hit"
-                        obs.metrics.inc("serve.analyze_dir_cached")
                     else:
                         rc_status = "miss"
                 if payload is None:
-                    resp = self._run_step(pre, post, static, chunk=0, trace_id=trace_id)
-                    payload = resp.SerializeToString()
-                    if rc is not None and content_key is not None:
-                        rc.put_blob("analyze_dir", content_key, payload)
-                return payload, {"rcache": rc_status}
+                    if (
+                        rc is not None
+                        and content_key is not None
+                        and rc.lease_root is not None
+                    ):
+                        # Fleet single-flight (ISSUE 14): the shared tier
+                        # carries a leader lease on this content address,
+                        # so a herd hitting EVERY replica of a cold corpus
+                        # still costs the fleet one analysis.  A follower
+                        # returns the leader's flight bytes VERBATIM
+                        # (cross-replica coalesce semantics — the herd's
+                        # responses are byte-identical, step wall
+                        # included); only a LATER request is the rcache's
+                        # zero-walled hit.
+                        payload, fleet = self._fleet_single_flight(
+                            rc, content_key, _run_and_publish, context
+                        )
+                        meta_extra["fleet"] = fleet
+                    else:
+                        payload = _run_and_publish()
+                return payload, {"rcache": rc_status, **meta_extra}
 
             if content_key is None:
                 payload, meta = _execute()
@@ -545,7 +596,122 @@ class _Impl:
             payload, meta = flight.wait_result(
                 is_alive=context.is_active if context is not None else None
             )
-            return payload, dict(meta, coalesce="hit")
+            meta = dict(meta, coalesce="hit")
+            # The fleet role is the LEADER handler's relationship to the
+            # shared tier, not this subscriber's: inheriting it would
+            # report N "nemo-fleet: leader" responses (and inflate the
+            # client-side fleet counters N-fold) for one fleet analysis.
+            meta.pop("fleet", None)
+            return payload, meta
+
+    def _fleet_single_flight(
+        self, rc, content_key: str, run, context
+    ) -> tuple[bytes, str]:
+        """Cross-replica single-flight on the shared cache tier (ISSUE 14).
+
+        The PR-8 coalesce leader's lease moves into the shared tier: a
+        lease FILE keyed on the tier-3 content address (store/rcache.py:
+        Lease under ``<shared>/lease/analyze_dir/``).  The replica that
+        wins the ``O_CREAT|O_EXCL`` create leads — it executes ``run()``
+        (which publishes the blob to the shared tier) under a heartbeat
+        thread refreshing the lease every TTL/3.  Every other replica's
+        identical request FOLLOWS: it polls for the leader's published
+        blob (cheap existence probe, one verified read on appearance) and
+        for the lease's death — a leader that crashes stops heartbeating,
+        the lease goes stale past ``NEMO_LEASE_TTL_S``, and the first
+        follower to steal it RE-ELECTS itself leader.  A follower that
+        exhausts its wait bound (the subscriber deadline,
+        serve/coalesce.py:Flight.WAIT_TIMEOUT_S) or whose client died
+        executes locally as the safety valve: the key is a pure content
+        address, so a duplicate analysis is a counted inefficiency
+        (``serve.fleet.wait_timeout``), never a conflict.
+
+        Returns ``(payload, role)`` — role ``leader``/``timeout`` payloads
+        are fresh serialized responses; ``follower`` payloads are the
+        leader's serialized bytes and the caller relays them VERBATIM
+        (the fleet's byte-identical response contract — re-serializing
+        would diverge on process-dependent map-field order; only a LATER
+        request is the rcache's zero-walled hit).  In-process duplicates
+        never reach here concurrently: the local SingleFlight table
+        already coalesced them onto one handler.
+        """
+        import threading as _threading
+
+        from nemo_tpu.store.rcache import Lease
+
+        lease = Lease(rc.lease_root, "analyze_dir", content_key, owner=_replica_id())
+        deadline = time.monotonic() + serve.coalesce.Flight.WAIT_TIMEOUT_S
+        followed = False
+        while True:
+            # Blob BEFORE lease: a finished leader publishes and only then
+            # releases, so a waiter waking between the two must serve the
+            # published bytes, not win the freed lease and re-run.
+            if rc.blob_present("analyze_dir", content_key):
+                cached = rc.load_blob("analyze_dir", content_key)
+                if cached is not None:
+                    if not followed:
+                        obs.metrics.inc("serve.fleet.follower")
+                    return cached, "follower"
+                # Present but unreadable/corrupt (counted stale by the
+                # cache): fall through — the next acquire/poll decides.
+            acquired = lease.try_acquire()
+            if not acquired and lease.broken:
+                # Shared-tier infrastructure failure (unwritable mount):
+                # nobody can lead OR publish there — run locally now
+                # rather than waiting out the follower deadline for a
+                # publish that can never arrive.
+                obs.metrics.inc("serve.fleet.lease_error")
+                log.warning(
+                    "serve.fleet_lease_error", key=content_key[:12],
+                    detail="shared lease tier unusable; executing locally",
+                )
+                return run(), "lease_error"
+            if acquired:
+                obs.metrics.inc("serve.fleet.leader")
+                log.debug(
+                    "serve.fleet_leader", key=content_key[:12], owner=lease.owner
+                )
+                stop = _threading.Event()
+
+                def _beat() -> None:
+                    while not stop.wait(lease.ttl_s / 3.0):
+                        lease.heartbeat()
+
+                hb = _threading.Thread(
+                    target=_beat, daemon=True, name="nemo-lease-heartbeat"
+                )
+                hb.start()
+                try:
+                    return run(), "leader"
+                finally:
+                    stop.set()
+                    lease.release()
+            if not followed:
+                followed = True
+                obs.metrics.inc("serve.fleet.follower")
+                log.debug(
+                    "serve.fleet_follower", key=content_key[:12],
+                    detail="another replica leads this content address; "
+                    "waiting on the shared tier",
+                )
+            if context is not None and not context.is_active():
+                # Dead client: nobody is listening, and the live leader is
+                # computing the identical key anyway — free this handler
+                # thread WITHOUT running a duplicate analysis (the local
+                # coalesce subscriber's is_alive precedent).
+                obs.metrics.inc("serve.fleet.client_gone")
+                raise TimeoutError(
+                    f"client went away waiting on fleet flight {content_key[:12]}"
+                )
+            if time.monotonic() > deadline:
+                obs.metrics.inc("serve.fleet.wait_timeout")
+                log.warning(
+                    "serve.fleet_wait_timeout", key=content_key[:12],
+                    detail="leader neither published nor expired inside the "
+                    "wait bound; executing locally (duplicate, not stale)",
+                )
+                return run(), "timeout"
+            time.sleep(min(0.25, max(0.02, lease.ttl_s / 10.0)))
 
     def analyze_dir_stream(self, request: dict, context):
         """Server-streaming AnalyzeDir (ISSUE 8): the request names one or
@@ -628,16 +794,17 @@ class _Impl:
                         {**request, "dir": d}, d, col.tid, ticket, context
                     )
                     obs.metrics.inc("serve.stream.results")
-                    events.put(
-                        {
-                            "event": "result",
-                            "dir": d,
-                            "ordinal": i,
-                            "rcache": meta.get("rcache", "off"),
-                            "coalesce": meta.get("coalesce", "off"),
-                            "response_b64": base64.b64encode(payload).decode("ascii"),
-                        }
-                    )
+                    ev = {
+                        "event": "result",
+                        "dir": d,
+                        "ordinal": i,
+                        "rcache": meta.get("rcache", "off"),
+                        "coalesce": meta.get("coalesce", "off"),
+                        "response_b64": base64.b64encode(payload).decode("ascii"),
+                    }
+                    if "fleet" in meta:
+                        ev["fleet"] = meta["fleet"]
+                    events.put(ev)
                 except serve.AdmissionRejected as ex:
                     events.put(
                         {
@@ -764,10 +931,16 @@ def make_server(port: int = 0, max_workers: int | None = None) -> tuple[grpc.Ser
         ),
         # JSON-carried request (generic handlers accept any serializer, so
         # no protoc regeneration is needed for the path-only payload).
+        # The response serializer passes ALREADY-SERIALIZED bytes through:
+        # the handler returns cached/coalesced flight payloads verbatim
+        # (cross-replica byte identity — map fields re-serialize in a
+        # process-dependent order, so round-tripping would diverge).
         "AnalyzeDir": grpc.unary_unary_rpc_method_handler(
             impl.analyze_dir,
             request_deserializer=lambda b: json.loads(b.decode("utf-8")),
-            response_serializer=pb.AnalyzeResponse.SerializeToString,
+            response_serializer=lambda m: (
+                m if isinstance(m, bytes) else m.SerializeToString()
+            ),
         ),
         # Server-streaming AnalyzeDir (ISSUE 8): JSON request, a stream of
         # JSON progress/result events back (results carry the serialized
@@ -799,9 +972,135 @@ def make_server(port: int = 0, max_workers: int | None = None) -> tuple[grpc.Ser
     return server, bound
 
 
+def _router_main(args) -> int:
+    """``--router`` mode: serve the thin fleet router instead of an
+    analysis replica (nemo_tpu/serve/router.py).  No jax, no device — the
+    process is bytes-plumbing plus the ring."""
+    import signal
+
+    backends = [
+        b.strip()
+        for b in (args.backends or os.environ.get("NEMO_FLEET_REPLICAS", "")).split(",")
+        if b.strip()
+    ]
+    if not backends:
+        log.error(
+            "router.no_backends",
+            detail="--router needs --backends host:port,... or NEMO_FLEET_REPLICAS",
+        )
+        return 2
+    from nemo_tpu.serve.router import make_router_server
+
+    server, port, router = make_router_server(args.port, backends)
+    server.start()
+    metrics_httpd = None
+    if args.metrics_port:
+        from nemo_tpu.obs import promexp
+
+        def _router_health() -> dict:
+            states = router.backend_states()
+            up = sum(1 for s in states.values() if s["up"])
+            return {
+                "status": "SERVING" if up else "NOT_SERVING",
+                "role": "router",
+                "replicas": len(states),
+                "replicas_up": up,
+                "backends": states,
+            }
+
+        metrics_httpd, mport = promexp.start_http_server(
+            args.metrics_port, health=_router_health
+        )
+        log.info("metrics.listening", port=mport, paths=["/metrics", "/healthz"])
+    log.info("router.listening", port=port, backends=backends)
+    term = threading.Event()
+
+    def _on_term(signum, frame):
+        term.set()
+
+    prev_handler = signal.signal(signal.SIGTERM, _on_term)
+    try:
+        while not term.wait(0.5):
+            pass
+        # The router holds no work of its own; grace covers in-flight
+        # forwards (each bounded by its client's own deadline).
+        stopped = server.stop(grace=serve.admission.drain_seconds())
+        stopped.wait(timeout=serve.admission.drain_seconds() + 5.0)
+        router.stop()
+        log.info("router.drained")
+        return 0
+    finally:
+        signal.signal(signal.SIGTERM, prev_handler)
+        if metrics_httpd is not None:
+            metrics_httpd.shutdown()
+
+
+def _prewarm_async() -> None:
+    """Warm-boot helper (ISSUE 14): compile/load the bucket-signature
+    programs on a background thread at boot, so the replica's first
+    requests find a hot jit cache.  With the persistent compilation cache
+    enabled (always, unless NEMO_JAX_CACHE=off) a fleet scale-out replica
+    pays disk-cache DESERIALIZATION here — seconds — instead of
+    compile-minutes on its first cold request; serving is never blocked
+    (the thread competes only for spare cycles)."""
+    mode = os.environ.get("NEMO_SERVE_PREWARM", "off").strip().lower()
+    if mode in ("", "0", "off", "none", "false"):
+        return
+    if mode not in ("chunk", "full"):
+        # Warn-and-default like every serving knob (utils/env.py policy):
+        # a typo must neither launch surprise background compiles nor
+        # silently skip the stress program the operator asked for.
+        log.warning(
+            "serve.prewarm_bad_mode", value=mode,
+            detail="NEMO_SERVE_PREWARM must be off|chunk|full; prewarm off",
+        )
+        return
+
+    def _run() -> None:
+        t0 = time.perf_counter()
+        try:
+            from nemo_tpu.models.case_studies import CASE_STUDIES
+            from nemo_tpu.utils.prewarm import prewarm_family
+
+            for name in sorted(CASE_STUDIES):
+                # "chunk" warms only the sidecar's streamed-chunk
+                # signature (the shape every pipelined client dispatches);
+                # "full" adds the stress-floor fused program.
+                prewarm_family(
+                    name,
+                    n_probe=16,
+                    b_pad=2048 if mode == "full" else 16,
+                    chunk_runs=512,
+                    include_stress=mode == "full",
+                )
+            dt = time.perf_counter() - t0
+            obs.metrics.gauge("serve.prewarm_s", dt)
+            log.info("serve.prewarm_done", seconds=round(dt, 2), mode=mode)
+        except Exception as ex:
+            obs.metrics.inc("serve.prewarm_failed")
+            log.warning(
+                "serve.prewarm_failed", error=f"{type(ex).__name__}: {ex}"
+            )
+
+    threading.Thread(target=_run, daemon=True, name="nemo-prewarm").start()
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="nemo-tpu-sidecar")
     parser.add_argument("--port", type=int, default=50051)
+    parser.add_argument(
+        "--router",
+        action="store_true",
+        help="serve the thin fleet ROUTER instead of an analysis replica: "
+        "consistent-hash AnalyzeDir affinity over --backends with spill "
+        "under load and failover on UNAVAILABLE (nemo_tpu/serve/router.py)",
+    )
+    parser.add_argument(
+        "--backends",
+        default=None,
+        metavar="HOST:PORT,...",
+        help="router mode's replica list (default $NEMO_FLEET_REPLICAS)",
+    )
     parser.add_argument(
         "--max-workers",
         type=int,
@@ -883,6 +1182,27 @@ def main(argv: list[str] | None = None) -> int:
         "(trailing metadata nemo-rcache: hit)",
     )
     parser.add_argument(
+        "--shared-cache",
+        default=None,
+        metavar="DIR|off",
+        help="SHARED result-cache tier for a fleet (default "
+        "$NEMO_RCACHE_SHARED or off): a directory every replica reaches; "
+        "publishes replicate here, reads fall back here, and the "
+        "cross-replica single-flight leader lease lives here — any replica "
+        "serves any warm corpus, and a cold herd costs the fleet one "
+        "analysis (store/rcache.py)",
+    )
+    parser.add_argument(
+        "--prewarm",
+        default=None,
+        metavar="off|chunk|full",
+        help="warm-boot prewarm on a background thread (default "
+        "$NEMO_SERVE_PREWARM or off): compile/disk-load the bucket-"
+        "signature programs at boot — 'chunk' warms the streamed-chunk "
+        "shape, 'full' adds the stress-floor fused program — so a "
+        "scale-out replica adds capacity in seconds, not compile-minutes",
+    )
+    parser.add_argument(
         "--metrics-port",
         type=int,
         default=_metrics_port_default(),
@@ -892,12 +1212,20 @@ def main(argv: list[str] | None = None) -> int:
         "$NEMO_METRICS_PORT or off)",
     )
     args = parser.parse_args(argv)
+    if args.router:
+        # The router owns no device and runs no analysis: branch before
+        # any platform/jax work.
+        return _router_main(args)
     if args.corpus_cache is not None:
         # Env-carried like the CLI's knob, so the AnalyzeDir handler and the
         # store module resolve identically in every process shape.
         os.environ["NEMO_CORPUS_CACHE"] = args.corpus_cache
     if args.result_cache is not None:
         os.environ["NEMO_RESULT_CACHE"] = args.result_cache
+    if args.shared_cache is not None:
+        os.environ["NEMO_RCACHE_SHARED"] = args.shared_cache
+    if args.prewarm is not None:
+        os.environ["NEMO_SERVE_PREWARM"] = args.prewarm
     # Serving knobs are env-carried too (the admission controller reads the
     # env on first access, which is after these writes).
     if args.max_inflight is not None:
@@ -944,10 +1272,12 @@ def main(argv: list[str] | None = None) -> int:
         log.info("metrics.listening", port=mport, paths=["/metrics", "/healthz"])
     server, port = make_server(args.port, args.max_workers)
     server.start()
+    _prewarm_async()
     ctl = serve.controller()
     log.info(
-        "sidecar.listening", port=port,
+        "sidecar.listening", port=port, replica=_replica_id(),
         max_inflight=ctl.max_inflight, max_queue=ctl.max_queue,
+        shared_cache=os.environ.get("NEMO_RCACHE_SHARED") or None,
     )
     # Graceful drain (ISSUE 8 satellite): SIGTERM refuses new admissions
     # (the admission controller's drain flag, which /healthz mirrors as

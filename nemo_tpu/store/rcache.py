@@ -26,16 +26,38 @@ Files are hardlinked between the cache and report trees where the
 filesystem allows (the report is regenerated output, and a mutated
 hardlinked report file is exactly what the manifest verify catches), with
 a copy fallback across devices.
+
+**Shared fleet tier (ISSUE 14):** ``NEMO_RCACHE_SHARED`` /
+``--shared-cache DIR`` names a SECOND root on a directory every replica
+can reach (an NFS/FUSE mount, a shared volume).  Reads consult the local
+root first, then the shared one (``rcache.<kind>_shared_hit``); every
+local publish replicates to the shared root, so any replica serves any
+warm corpus at all three tiers.  Consistency needs no protocol: keys are
+pure content addresses, so two replicas racing to publish the same key
+produce byte-identical entries — the loser of the fcntl-guarded
+check-then-rename is counted (``rcache.publish_race``), never torn.  LRU
+last-use stamps (entry.json mtime on every hit) work unchanged on the
+shared tier, and both roots share the ``NEMO_RESULT_CACHE_MAX_GB`` cap.
+
+The shared root also hosts the fleet's **leader lease files**
+(:class:`Lease`, under ``<shared>/lease/<ns>/``): a cross-replica
+single-flight ticket keyed on the same tier-3 content address, with a
+heartbeat (mtime refresh) and a TTL (``NEMO_LEASE_TTL_S``) so a dead
+leader's followers re-elect instead of waiting forever.  Lease files are
+excluded from eviction.
 """
 
 from __future__ import annotations
 
+import fcntl
 import hashlib
 import json
 import os
 import shutil
+import socket as _socket
 import time
 import uuid
+from contextlib import contextmanager
 
 from nemo_tpu import obs
 from nemo_tpu.obs import log as obs_log
@@ -57,9 +79,44 @@ def result_cache_dir(arg: str | None = None) -> str | None:
     return os.path.join(os.path.expanduser("~"), ".cache", "nemo_tpu", "results")
 
 
-def resolve_result_cache(arg: str | None = None) -> "ResultCache | None":
+def shared_cache_dir(arg: str | None = None) -> str | None:
+    """Resolve the SHARED (fleet) cache root: explicit argument wins
+    (``off`` etc. disables), else ``NEMO_RCACHE_SHARED``.  No default — a
+    shared tier is an explicit deployment decision (it names a directory
+    every replica can reach), never something to invent locally."""
+    env = arg if arg is not None else os.environ.get("NEMO_RCACHE_SHARED")
+    if env is None:
+        return None
+    env = env.strip()
+    if env.lower() in ("", "0", "off", "none", "false"):
+        return None
+    return os.path.expanduser(env)
+
+
+def lease_ttl_s() -> float:
+    """Leader-lease heartbeat TTL (``NEMO_LEASE_TTL_S``, default 10 s): a
+    lease whose mtime is older than this is a dead leader's — followers
+    may steal it and re-elect."""
+    from nemo_tpu.utils.env import env_float
+
+    return max(0.05, env_float("NEMO_LEASE_TTL_S", 10.0))
+
+
+def resolve_result_cache(
+    arg: str | None = None, shared_arg: str | None = None
+) -> "ResultCache | None":
+    """Resolve the result cache from (argument, env): the local root plus,
+    when ``NEMO_RCACHE_SHARED``/``shared_arg`` names one, the fleet's
+    shared tier.  The shared tier is a BACKING tier of the result cache,
+    not an independent cache: an explicit ``off`` on the result cache
+    disables everything, shared tier and leases included — "off means
+    off" is what every parity harness that pins ``NEMO_RESULT_CACHE=off``
+    relies on.  (A replica that wants ONLY the shared tier points
+    ``NEMO_RESULT_CACHE`` at the shared directory itself.)"""
     root = result_cache_dir(arg)
-    return ResultCache(root) if root else None
+    if root is None:
+        return None
+    return ResultCache(root, shared_root=shared_cache_dir(shared_arg))
 
 
 def _max_cache_bytes() -> int:
@@ -91,38 +148,62 @@ def _link_or_copy(src: str, dst: str) -> None:
 
 
 class ResultCache:
-    """One result-cache root.  All writes are atomic (tmp dir + rename)
-    and best-effort: a cache failure must never sink the pipeline."""
+    """One result-cache root (plus, for a fleet, the shared tier).  All
+    writes are atomic (tmp dir + rename behind a per-kind fcntl publish
+    lock) and best-effort: a cache failure must never sink the pipeline."""
 
-    def __init__(self, root: str) -> None:
+    def __init__(self, root: str, shared_root: str | None = None) -> None:
         self.root = root
+        #: Where cross-replica leader leases live: the shared tier (None =
+        #: no fleet — cross-replica single-flight needs a root every
+        #: replica can reach).
+        self.lease_root = shared_root
+        #: The secondary read/replicate root; None when there is no shared
+        #: tier OR the shared root IS the primary (local cache off).
+        if shared_root is not None and os.path.abspath(shared_root) == os.path.abspath(root):
+            shared_root = None
+        self.shared_root = shared_root
 
     # ------------------------------------------------------------ plumbing
 
     def _entry_dir(self, kind: str, key: str) -> str:
         return os.path.join(self.root, kind, key)
 
-    def _load_entry(self, kind: str, key: str):
-        """(entry dict, entry dir) on a verified read, else None — misses
-        and stale entries counted and logged per kind.  The HIT counter is
-        the caller's to record (:meth:`_hit`) once the payload actually
-        decodes — a manifest-valid entry whose payload is undecodable must
-        count as stale only, never as both a hit and a stale."""
-        d = self._entry_dir(kind, key)
+    @contextmanager
+    def _publish_lock(self, root: str, kind: str):
+        """Cross-process publish guard for one (root, kind): makes the
+        exists-check + rename atomic across replicas racing to publish the
+        same content address (the shared tier's concurrent-writer
+        contract; also guards two local processes sharing one root).  Lock
+        files live under ``<root>/.locks/`` so kind dirs hold only entries
+        (+ tmp wreckage) — every existing listdir walk stays valid."""
+        ldir = os.path.join(root, ".locks")
+        os.makedirs(ldir, exist_ok=True)
+        fd = os.open(os.path.join(ldir, f"{kind}.lock"), os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            yield
+        finally:
+            fcntl.flock(fd, fcntl.LOCK_UN)
+            os.close(fd)
+
+    def _load_entry_at(self, root: str, kind: str, key: str):
+        """One root's verified read: ("hit", entry, dir) | ("miss",) |
+        ("stale",) — no counters (the orchestrating :meth:`_load_entry`
+        owns them, so a local miss backed by a shared hit is not a miss)."""
+        d = os.path.join(root, kind, key)
         path = os.path.join(d, "entry.json")
         try:
             with open(path, "r", encoding="utf-8") as fh:
                 entry = json.load(fh)
         except FileNotFoundError:
-            obs.metrics.inc(f"rcache.{kind}_miss")
-            return None
+            return ("miss", None, None)
         except (OSError, ValueError) as ex:
-            obs.metrics.inc(f"rcache.{kind}_stale")
             _log.warning(
-                "rcache.entry_unreadable", kind=kind, key=key,
+                "rcache.entry_unreadable", kind=kind, key=key, root=root,
                 error=f"{type(ex).__name__}: {ex}",
             )
-            return None
+            return ("stale", None, None)
         if _verify_on_load():
             for rec in entry.get("manifest", ()):
                 p = os.path.join(d, rec["path"])
@@ -134,15 +215,44 @@ class ResultCache:
                 except OSError:
                     ok = False
                 if not ok:
-                    obs.metrics.inc(f"rcache.{kind}_stale")
                     _log.error(
-                        "rcache.entry_corrupt", kind=kind, key=key,
+                        "rcache.entry_corrupt", kind=kind, key=key, root=root,
                         file=rec["path"],
                         detail="failing the verify pass; recomputing instead "
                         "of serving stale bytes",
                     )
-                    return None
-        return entry, d
+                    return ("stale", None, None)
+        return ("hit", entry, d)
+
+    def _load_entry(self, kind: str, key: str):
+        """(entry dict, entry dir) on a verified read — local root first,
+        then the shared tier — else None.  Misses and stale entries
+        counted per kind (a shared-tier hit counts
+        ``rcache.<kind>_shared_hit`` in addition to the caller's hit).
+        The HIT counter is the caller's to record (:meth:`_hit`) once the
+        payload actually decodes — a manifest-valid entry whose payload is
+        undecodable must count as stale only, never as both a hit and a
+        stale."""
+        any_stale = False
+        status, entry, d = self._load_entry_at(self.root, kind, key)
+        if status == "stale":
+            any_stale = True
+            obs.metrics.inc(f"rcache.{kind}_stale")
+        if status == "hit":
+            return entry, d
+        if self.shared_root is not None:
+            status, entry, d = self._load_entry_at(self.shared_root, kind, key)
+            if status == "stale":
+                any_stale = True
+                obs.metrics.inc(f"rcache.{kind}_stale")
+            if status == "hit":
+                obs.metrics.inc(f"rcache.{kind}_shared_hit")
+                return entry, d
+        if not any_stale:
+            # A stale entry is invalidation, not a cold miss (the store's
+            # counting precedent); only a clean double-miss counts here.
+            obs.metrics.inc(f"rcache.{kind}_miss")
+        return None
 
     def _hit(self, kind: str, entry_dir: str) -> None:
         """Record a served hit: counter + LRU last-use stamp."""
@@ -152,10 +262,61 @@ class ResultCache:
         except OSError:
             pass
 
+    def _commit_tmp(self, root: str, kind: str, key: str, tmp: str) -> str:
+        """Publish a fully built tmp entry dir at ``root``: the
+        exists-check + rename runs under the per-kind fcntl lock, so two
+        processes racing to publish the same content address commit
+        exactly one entry (the loser is counted ``rcache.publish_race``
+        and its tmp removed — same key == same bytes, so keeping the
+        winner, LRU stamp included, is always correct).  Returns the final
+        entry dir."""
+        final = os.path.join(root, kind, key)
+        with self._publish_lock(root, kind):
+            if os.path.isdir(final):
+                obs.metrics.inc("rcache.publish_race")
+                shutil.rmtree(tmp, ignore_errors=True)
+            else:
+                try:
+                    os.rename(tmp, final)
+                except OSError:
+                    # A racer on a lockless filesystem beat the rename
+                    # anyway; the entry that exists is byte-identical.
+                    obs.metrics.inc("rcache.publish_race")
+                    shutil.rmtree(tmp, ignore_errors=True)
+        return final
+
+    def _replicate_shared(self, src: str, kind: str, key: str) -> None:
+        """Copy a just-published entry into the shared tier (fleet
+        replication).  Best-effort: a shared-tier outage must not fail the
+        local publish; losing the cross-replica race is counted, never an
+        error (content-addressed ⇒ the winner's bytes are ours)."""
+        root = self.shared_root
+        if root is None:
+            return
+        try:
+            final = os.path.join(root, kind, key)
+            if os.path.isdir(final):
+                obs.metrics.inc("rcache.publish_race")
+                return
+            tmp = f"{final}.tmp-{os.getpid()}-{uuid.uuid4().hex[:8]}"
+            os.makedirs(os.path.join(root, kind), exist_ok=True)
+            shutil.copytree(src, tmp)
+            self._commit_tmp(root, kind, key, tmp)
+            obs.metrics.inc(f"rcache.{kind}_shared_put")
+            self._evict_over_cap(keep=final, root=root)
+        except Exception as ex:
+            obs.metrics.inc("rcache.write_failed")
+            _log.warning(
+                "rcache.shared_replicate_failed", kind=kind, key=key,
+                root=root, error=f"{type(ex).__name__}: {ex}",
+            )
+
     def _put_entry(self, kind: str, key: str, build) -> bool:
         """Atomically publish one entry: ``build(tmp_dir) -> entry dict``
         populates the payload and returns the entry body (the manifest is
-        appended here).  Returns False (logged) on any failure."""
+        appended here).  Publishes to the local root, then replicates to
+        the shared tier when one is configured.  Returns False (logged) on
+        any failure."""
         try:
             os.makedirs(os.path.join(self.root, kind), exist_ok=True)
             final = self._entry_dir(kind, key)
@@ -179,19 +340,12 @@ class ResultCache:
                 entry["created"] = time.time()
                 with open(os.path.join(tmp, "entry.json"), "w", encoding="utf-8") as fh:
                     json.dump(entry, fh, indent=1)
-                if os.path.isdir(final):
-                    # Same key == same content: keep the existing entry (its
-                    # LRU stamp included) rather than replace-racing it.
-                    shutil.rmtree(tmp, ignore_errors=True)
-                else:
-                    try:
-                        os.rename(tmp, final)
-                    except OSError:
-                        shutil.rmtree(tmp, ignore_errors=True)
+                self._commit_tmp(self.root, kind, key, tmp)
             except BaseException:
                 shutil.rmtree(tmp, ignore_errors=True)
                 raise
             obs.metrics.inc(f"rcache.{kind}_put")
+            self._replicate_shared(final, kind, key)
             self._evict_over_cap(keep=final)
             return True
         except Exception as ex:
@@ -355,22 +509,38 @@ class ResultCache:
 
         return self._put_entry(f"blob_{namespace}", key, build)
 
+    def blob_present(self, namespace: str, key: str) -> bool:
+        """Cheap existence probe (no verify, no counters) across both
+        roots — the fleet follower's poll while its leader runs.  Entries
+        appear atomically (tmp + rename), so a present dir is a complete
+        entry; the follower's single :meth:`load_blob` on appearance does
+        the verified, counted read."""
+        for root in (self.root, self.shared_root):
+            if root and os.path.isdir(os.path.join(root, f"blob_{namespace}", key)):
+                return True
+        return False
+
     # ------------------------------------------------------------ eviction
 
     _WRECKAGE_MAX_AGE_S = 3600.0
 
-    def _evict_over_cap(self, keep: str) -> None:
+    def _evict_over_cap(self, keep: str, root: str | None = None) -> None:
         """LRU size-cap eviction mirroring the corpus store's: sweep aged
         crash leftovers, then evict least-recently-used entries
-        (entry.json mtime, stamped on every hit) until under
-        NEMO_RESULT_CACHE_MAX_GB — never the entry just written."""
+        (entry.json mtime, stamped on every hit — the stamp works the same
+        on the shared tier, so fleet-wide hits keep an entry warm) until
+        under NEMO_RESULT_CACHE_MAX_GB — never the entry just written.
+        The ``lease`` kind is never swept: lease files are liveness state,
+        not cached content (an evicted lease would look like a dead
+        leader)."""
         from nemo_tpu.store import store_size_bytes
 
+        root = self.root if root is None else root
         now = time.time()
         try:
-            for kind in os.listdir(self.root):
-                kdir = os.path.join(self.root, kind)
-                if not os.path.isdir(kdir):
+            for kind in os.listdir(root):
+                kdir = os.path.join(root, kind)
+                if kind == "lease" or kind.startswith(".") or not os.path.isdir(kdir):
                     continue
                 for name in os.listdir(kdir):
                     if ".tmp-" not in name:
@@ -390,14 +560,16 @@ class ResultCache:
             return
         try:
             entries = []
-            for kind in os.listdir(self.root):
-                kdir = os.path.join(self.root, kind)
-                if not os.path.isdir(kdir):
+            for kind in os.listdir(root):
+                kdir = os.path.join(root, kind)
+                if kind == "lease" or kind.startswith(".") or not os.path.isdir(kdir):
                     continue
                 for name in os.listdir(kdir):
                     if ".tmp-" in name:
                         continue
                     path = os.path.join(kdir, name)
+                    if not os.path.isdir(path):
+                        continue
                     size = store_size_bytes(path)
                     try:
                         used = os.path.getmtime(os.path.join(path, "entry.json"))
@@ -419,4 +591,137 @@ class ResultCache:
                     "rcache.evicted", entry=path, freed_mb=round(size / 1e6, 1),
                 )
         except OSError as ex:
-            _log.warning("rcache.evict_failed", root=self.root, error=str(ex))
+            _log.warning("rcache.evict_failed", root=root, error=str(ex))
+
+
+# ---------------------------------------------------------------- leases
+
+
+class Lease:
+    """A cross-replica leader lease: one file under the SHARED cache root
+    (``<root>/lease/<namespace>/<key>.lease``), acquired with an
+    ``O_CREAT|O_EXCL`` create, kept alive by mtime heartbeats, and
+    STEALABLE once the holder's heartbeat is older than the TTL
+    (``NEMO_LEASE_TTL_S``) — how a dead leader's followers re-elect.
+
+    The steal runs under a per-namespace fcntl lock with a re-stat, so two
+    stealers cannot unlink each other's fresh lease; a heartbeat that
+    lands between staleness check and unlink is the accepted race (the
+    old leader finds its lease gone at release time, which is harmless:
+    the payload it publishes is content-addressed and byte-identical to
+    the new leader's).
+    """
+
+    def __init__(
+        self,
+        root: str,
+        namespace: str,
+        key: str,
+        owner: str | None = None,
+        ttl_s: float | None = None,
+    ) -> None:
+        self.dir = os.path.join(root, "lease", namespace)
+        self.path = os.path.join(self.dir, f"{key}.lease")
+        self.owner = owner or f"{_socket.gethostname()}-{os.getpid()}"
+        self.ttl_s = lease_ttl_s() if ttl_s is None else float(ttl_s)
+        self._held = False
+        #: True after an infrastructure failure (unwritable shared tier)
+        #: — distinct from "another replica leads", so the caller can
+        #: execute locally NOW instead of waiting out a follower deadline
+        #: for a publish that can never arrive.
+        self.broken = False
+
+    @property
+    def held(self) -> bool:
+        return self._held
+
+    def _create(self) -> bool:
+        try:
+            fd = os.open(self.path, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644)
+        except FileExistsError:
+            return False
+        with os.fdopen(fd, "w", encoding="utf-8") as fh:
+            json.dump({"owner": self.owner, "acquired": time.time()}, fh)
+        self._held = True
+        return True
+
+    def try_acquire(self) -> bool:
+        """True when this process now holds the lease (fresh acquire, or a
+        steal from a stale holder)."""
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+            if self._create():
+                obs.metrics.inc("rcache.lease_acquired")
+                return True
+            if not self.holder_stale():
+                return False
+            # Steal: serialize stealers and re-check staleness under the
+            # lock so a racing stealer's FRESH lease is never unlinked.
+            lock_fd = os.open(
+                os.path.join(self.dir, ".lease.lock"), os.O_CREAT | os.O_RDWR, 0o644
+            )
+            try:
+                fcntl.flock(lock_fd, fcntl.LOCK_EX)
+                if not self.holder_stale():
+                    return False
+                try:
+                    os.unlink(self.path)
+                except FileNotFoundError:
+                    pass
+                if self._create():
+                    obs.metrics.inc("rcache.lease_steal")
+                    _log.warning(
+                        "rcache.lease_stolen", path=self.path, owner=self.owner,
+                        detail="previous leader's heartbeat expired; re-elected",
+                    )
+                    return True
+                return False
+            finally:
+                fcntl.flock(lock_fd, fcntl.LOCK_UN)
+                os.close(lock_fd)
+        except OSError as ex:
+            # A shared-tier outage must not wedge the caller — and must be
+            # DISTINGUISHABLE from "another replica leads": flag it so the
+            # caller executes locally immediately instead of parking on a
+            # follower deadline for a publish that can never arrive.
+            self.broken = True
+            _log.warning("rcache.lease_error", path=self.path, error=str(ex))
+            return False
+
+    def holder_stale(self) -> bool:
+        """True when the current holder's heartbeat (file mtime) is older
+        than the TTL — or the lease vanished between checks."""
+        try:
+            return time.time() - os.path.getmtime(self.path) > self.ttl_s
+        except OSError:
+            return True
+
+    def heartbeat(self) -> None:
+        """Refresh the holder's liveness stamp (no-op unless held)."""
+        if not self._held:
+            return
+        try:
+            os.utime(self.path)
+        except OSError:
+            pass
+
+    def release(self) -> None:
+        """Drop a held lease (idempotent).  Owner-checked: a lease
+        already STOLEN by a re-electing follower belongs to the new
+        leader now — unlinking it here would orphan that leader mid-run
+        and invite a third duplicate execution.  The read-then-unlink
+        window is accepted (content-addressed payloads make any residual
+        duplicate a counted inefficiency, never a conflict)."""
+        if not self._held:
+            return
+        self._held = False
+        try:
+            with open(self.path, "r", encoding="utf-8") as fh:
+                if json.load(fh).get("owner") != self.owner:
+                    return  # stolen while we ran; it is the new leader's
+        except (OSError, ValueError):
+            return
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass

@@ -167,12 +167,25 @@ def chunk_signature(name: str, n_probe: int, chunk_runs: int):
     return pad_rows(pre), pad_rows(post), static
 
 
-def prewarm_family(name: str, n_probe: int, b_pad: int, chunk_runs: int = 0) -> float:
+def prewarm_family(
+    name: str,
+    n_probe: int,
+    b_pad: int,
+    chunk_runs: int = 0,
+    include_stress: bool = True,
+) -> float:
+    """Compile (or disk-cache-load) this family's programs.  A serving
+    replica's warm boot (service/server.py:_prewarm_async, ISSUE 14) sets
+    ``include_stress=False`` to warm only the streamed-chunk signature —
+    the shape every pipelined client dispatches — without paying the
+    stress-floor compile at boot."""
     import jax
 
     from nemo_tpu.models.pipeline_model import analysis_step
 
-    signatures = [stress_signature(name, n_probe, b_pad)]
+    signatures = []
+    if include_stress:
+        signatures.append(stress_signature(name, n_probe, b_pad))
     if chunk_runs:
         signatures.append(chunk_signature(name, n_probe, chunk_runs))
     # Time ONLY compile+execute: operators read a near-zero per-family

@@ -1,26 +1,118 @@
 """Shared subprocess-service plumbing: free-port probe + listen gate.
 
-Every harness that boots a sidecar subprocess (bench serve tier,
-`make serve-smoke`, the obs/trace smokes) needs the same two primitives,
-and one of them encodes an environment quirk worth centralizing: this
-environment's grpc WEDGES channels whose first connect races the server's
-bind, so the listening socket must be observed BEFORE any channel is
-created — polling Health on an eagerly-created channel spins UNAVAILABLE
-forever against a perfectly healthy server.
+Every harness that boots a sidecar subprocess (bench serve/fleet tiers,
+`make serve-smoke` / `make fleet-smoke`, the obs/trace smokes) needs the
+same primitives, and one of them encodes an environment quirk worth
+centralizing: this environment's grpc WEDGES channels whose first connect
+races the server's bind, so the listening socket must be observed BEFORE
+any channel is created — polling Health on an eagerly-created channel
+spins UNAVAILABLE forever against a perfectly healthy server.
+
+Multi-server boots (ISSUE 14 satellite): the classic bind-to-0 probe
+closes its socket before returning, so N concurrent boots probing in a
+row could be handed the SAME port (the OS is free to reuse it the moment
+the probe closes).  Two fixes compose here: :func:`free_port` never
+repeats a port it issued recently in this process, and
+:class:`PortReservation` bind-and-HOLDS a batch of ports, releasing each
+one only at the instant its server boots — shrinking the TOCTOU window
+from "the whole boot" to one exec.
 """
 
 from __future__ import annotations
 
 import socket
+import threading
 import time
+from collections import deque
+
+#: Ports handed out recently by THIS process (free_port and
+#: PortReservation both record here) — bounded, oldest forgotten first.
+_ISSUED_MAX = 256
+_issued: deque[int] = deque()
+_issued_set: set[int] = set()
+_issued_lock = threading.Lock()
+
+
+def _remember_locked(port: int) -> None:
+    _issued.append(port)
+    _issued_set.add(port)
+    while len(_issued) > _ISSUED_MAX:
+        _issued_set.discard(_issued.popleft())
 
 
 def free_port() -> int:
-    """An OS-assigned currently-free TCP port (the usual bind-to-0 probe;
-    the tiny TOCTOU window to the consumer's own bind is accepted)."""
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+    """An OS-assigned currently-free TCP port (the usual bind-to-0 probe),
+    guaranteed distinct from any port this process was handed recently —
+    the multi-sidecar boot race fix: two concurrent boots each probing
+    can no longer receive the same port from this process.  The residual
+    TOCTOU window against OTHER processes' binds is accepted (use
+    :class:`PortReservation` to shrink it for batch boots)."""
+    port = 0
+    for _ in range(128):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        with _issued_lock:
+            if port not in _issued_set:
+                _remember_locked(port)
+                return port
+    # The OS kept re-issuing recently-seen ports (tiny ephemeral range);
+    # hand out the last probe rather than spinning forever.
+    return port
+
+
+class PortReservation:
+    """Bind-and-hold N distinct ports for a fleet boot.
+
+    Every port stays BOUND (so no other bind-to-0 probe — in this process
+    or any other — can be handed it) until :meth:`release` frees it
+    immediately before the server that will own it executes.  Use as a
+    context manager so an aborted boot never leaks the held sockets::
+
+        with PortReservation(3) as ports:
+            for i, port in enumerate(ports.ports):
+                ports.release(i)
+                boot_server(port)
+    """
+
+    def __init__(self, n: int) -> None:
+        self._socks: list[socket.socket | None] = []
+        try:
+            for _ in range(n):
+                s = socket.socket()
+                # TIME_WAIT tolerance for the holder itself; the eventual
+                # server's own bind happens after release() closes this.
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                s.bind(("127.0.0.1", 0))
+                self._socks.append(s)
+        except OSError:
+            self.close()
+            raise
+        self.ports = [s.getsockname()[1] for s in self._socks]
+        with _issued_lock:
+            for p in self.ports:
+                _remember_locked(p)
+
+    def release(self, i: int) -> int:
+        """Free reservation ``i``'s socket and return its port — call this
+        immediately before booting the server that binds it."""
+        s = self._socks[i]
+        if s is not None:
+            self._socks[i] = None
+            s.close()
+        return self.ports[i]
+
+    def close(self) -> None:
+        for i, s in enumerate(self._socks):
+            if s is not None:
+                self._socks[i] = None
+                s.close()
+
+    def __enter__(self) -> "PortReservation":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 def wait_listening(

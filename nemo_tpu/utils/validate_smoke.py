@@ -1208,6 +1208,335 @@ def serve_smoke() -> int:
                 os.environ[k] = v
 
 
+def fleet_smoke() -> int:
+    """Fleet scale-out smoke (`make fleet-smoke`, also the tail of `make
+    validate`; ISSUE 14): boot TWO sidecar replicas joined by a shared
+    result-cache tier, plus the thin consistent-hash router, and assert
+
+      * a cold-corpus herd hitting BOTH replicas concurrently (2 clients
+        -> replica 0, 1 client -> replica 1, same corpus) is served with
+        EXACTLY ONE analysis fleet-wide — the cross-replica single-flight
+        leader lease in the shared tier — and byte-identical responses
+        from both replicas;
+      * the replica that never analyzed the corpus then serves it WARM
+        from the shared tier: trailing `nemo-rcache: hit`, zero kernel
+        dispatches, same bytes;
+      * the router proxies AnalyzeDir with stable affinity (a repeat of
+        the same corpus lands on the same replica, as an rcache hit) and
+        its router.* series are live on /metrics;
+      * SIGTERM drains the whole fleet cleanly (router and both replicas
+        exit 0).
+    """
+    import importlib.util
+    import signal
+    import subprocess
+    import sys as _sys
+    import threading
+    import urllib.request
+
+    from nemo_tpu.utils.jax_config import pin_platform
+    from nemo_tpu.utils.subproc import PortReservation, free_port, wait_listening
+
+    if importlib.util.find_spec("grpc") is None:
+        print(
+            "fleet-smoke: grpcio not installed; skipping (the smoke's whole "
+            "surface is the sidecar fleet)",
+            file=sys.stderr,
+        )
+        return 0
+    pin_platform("cpu")
+    fleet_knobs = (
+        "NEMO_SERVE_INFLIGHT",
+        "NEMO_SERVE_QUEUE",
+        "NEMO_SERVE_DRAIN_S",
+        "NEMO_SERVE_COALESCE_LINGER_S",
+        "NEMO_RESULT_CACHE",
+        "NEMO_RCACHE_SHARED",
+        "NEMO_CORPUS_CACHE",
+        "NEMO_LEASE_TTL_S",
+        "NEMO_FLEET_REPLICAS",
+        "NEMO_SERVE_PREWARM",
+    )
+    prior_knobs = {k: os.environ.pop(k, None) for k in fleet_knobs}
+    try:
+        with tempfile.TemporaryDirectory(prefix="nemo_fleet_smoke_") as tmp:
+            from nemo_tpu.models.synth import SynthSpec, write_corpus
+            from nemo_tpu.service.client import RemoteAnalyzer
+
+            herd_dir = write_corpus(SynthSpec(n_runs=5, seed=61, name="herd"), tmp)
+            solo_dir = write_corpus(SynthSpec(n_runs=5, seed=62, name="solo"), tmp)
+            shared_cache = os.path.join(tmp, "shared_rcache")
+
+            def replica_env(i: int) -> dict:
+                return dict(
+                    os.environ,
+                    NEMO_LOG_FILE=os.path.join(tmp, f"replica{i}_log.jsonl"),
+                    # Per-replica local caches + ONE shared tier: the
+                    # cross-replica dedup below must flow through the
+                    # shared tier, not an accidentally shared local root.
+                    NEMO_CORPUS_CACHE=os.path.join(tmp, f"corpus_cache{i}"),
+                    NEMO_RESULT_CACHE=os.path.join(tmp, f"result_cache{i}"),
+                    NEMO_RCACHE_SHARED=shared_cache,
+                    # One persistent compile cache across the fleet — the
+                    # warm-boot story's disk tier.
+                    NEMO_JAX_CACHE=os.path.join(tmp, "jax_cache"),
+                )
+
+            procs: list = []
+            log_fhs: list = []
+
+            def boot(args: list, env: dict, name: str):
+                fh = open(os.path.join(tmp, f"{name}.stderr"), "w")
+                log_fhs.append(fh)
+                p = subprocess.Popen(
+                    [_sys.executable, "-m", "nemo_tpu.service.server", *args],
+                    stdout=fh,
+                    stderr=subprocess.STDOUT,
+                    env=env,
+                )
+                procs.append(p)
+                return p
+
+            problems: list[str] = []
+            ports = PortReservation(3)  # the satellite fix in action
+            rports = [ports.ports[0], ports.ports[1]]
+            router_port = ports.ports[2]
+            mport = free_port()
+            try:
+                replicas = []
+                for i in range(2):
+                    ports.release(i)
+                    replicas.append(
+                        boot(
+                            ["--port", str(rports[i]), "--platform", "cpu"],
+                            replica_env(i),
+                            f"replica{i}",
+                        )
+                    )
+                for i in range(2):
+                    wait_listening(rports[i], deadline_s=120.0, proc=replicas[i])
+                targets = [f"127.0.0.1:{p}" for p in rports]
+                for t in targets:
+                    with RemoteAnalyzer(target=t) as c:
+                        c.wait_ready(60.0)
+                ports.release(2)
+                router = boot(
+                    [
+                        "--router",
+                        "--port", str(router_port),
+                        "--backends", ",".join(targets),
+                        "--metrics-port", str(mport),
+                    ],
+                    dict(os.environ, NEMO_LOG_FILE=os.path.join(tmp, "router_log.jsonl")),
+                    "router",
+                )
+                wait_listening(router_port, deadline_s=60.0, proc=router)
+                router_target = f"127.0.0.1:{router_port}"
+                with RemoteAnalyzer(target=router_target) as c:
+                    c.wait_ready(60.0)  # Health proxied through the router
+
+                def replica_counters(t: str) -> dict:
+                    with RemoteAnalyzer(target=t) as c:
+                        return c.health().get("metrics", {}).get("counters", {})
+
+                def dispatches(counters: dict) -> int:
+                    from nemo_tpu.analysis.delta import kernel_dispatch_count
+
+                    return kernel_dispatch_count(counters)
+
+                # ---- 1. Cold herd ACROSS replicas: one analysis fleet-wide.
+                payloads: list = [None] * 3
+                trailings: list = [None] * 3
+                failures: list = []
+
+                def herd_client(i: int, target: str) -> None:
+                    try:
+                        with RemoteAnalyzer(target=target) as c:
+                            resp, call = c._call(
+                                c._analyze_dir, {"dir": herd_dir}, name="AnalyzeDir"
+                            )
+                            payloads[i] = resp.SerializeToString()
+                            trailings[i] = dict(call.trailing_metadata() or ())
+                    except Exception as ex:
+                        failures.append(f"herd client {i}: {type(ex).__name__}: {ex}")
+
+                herd_targets = [targets[0], targets[0], targets[1]]
+                threads = [
+                    threading.Thread(target=herd_client, args=(i, t))
+                    for i, t in enumerate(herd_targets)
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=300)
+                if failures:
+                    problems.append("; ".join(failures))
+                elif any(p is None for p in payloads):
+                    problems.append("a herd client never finished")
+                else:
+                    if len(set(payloads)) != 1:
+                        problems.append(
+                            "herd responses are NOT byte-identical across replicas"
+                        )
+                    after = [replica_counters(t) for t in targets]
+                    chunks = [int(c.get("serve.analyze_chunks", 0)) for c in after]
+                    if sum(chunks) != 1:
+                        problems.append(
+                            f"expected exactly ONE analysis fleet-wide for the "
+                            f"herd, replicas report {chunks}"
+                        )
+                    leaders = [int(c.get("serve.fleet.leader", 0)) for c in after]
+                    followers = [int(c.get("serve.fleet.follower", 0)) for c in after]
+                    if sum(leaders) != 1 or sum(followers) < 1:
+                        problems.append(
+                            f"fleet single-flight counters off: leaders={leaders} "
+                            f"followers={followers}"
+                        )
+
+                    # ---- 2. The NON-leader replica serves the corpus warm
+                    # from the shared tier with zero kernel dispatches.
+                    non_leader = chunks.index(0)
+                    before = replica_counters(targets[non_leader])
+                    with RemoteAnalyzer(target=targets[non_leader]) as c:
+                        resp, call = c._call(
+                            c._analyze_dir, {"dir": herd_dir}, name="AnalyzeDir"
+                        )
+                        warm_payload = resp.SerializeToString()
+                        warm_md = dict(call.trailing_metadata() or ())
+                    now = replica_counters(targets[non_leader])
+                    if warm_md.get("nemo-rcache") != "hit":
+                        problems.append(
+                            f"non-leader warm request was not an rcache hit "
+                            f"(nemo-rcache={warm_md.get('nemo-rcache')!r})"
+                        )
+                    if dispatches(now) - dispatches(before) != 0:
+                        problems.append(
+                            "non-leader replica dispatched kernels serving a "
+                            "shared-tier warm corpus"
+                        )
+                    if int(now.get("rcache.blob_analyze_dir_shared_hit", 0)) < 1:
+                        problems.append(
+                            "non-leader served the warm corpus without a "
+                            "shared-tier hit (local alias?)"
+                        )
+                    # Identical modulo the timing field: a warm rcache hit
+                    # reports step_seconds=0 (it dispatched nothing) while
+                    # the herd's bytes carry the leader's real wall — and
+                    # the hit path re-serializes in the serving replica, so
+                    # the comparison must be MESSAGE equality (map-field
+                    # byte order is process-dependent), not byte equality.
+                    # The herd trio above IS compared byte-for-byte: those
+                    # responses relay one serialization verbatim.
+                    from nemo_tpu.service.proto import nemo_service_pb2 as _pb
+
+                    herd_resp = _pb.AnalyzeResponse.FromString(payloads[0])
+                    herd_resp.step_seconds = 0.0
+                    warm_resp = _pb.AnalyzeResponse.FromString(warm_payload)
+                    if warm_resp.step_seconds != 0.0:
+                        problems.append(
+                            "warm rcache hit reported a nonzero step wall"
+                        )
+                    warm_resp.step_seconds = 0.0
+                    if warm_resp != herd_resp:
+                        problems.append(
+                            "shared-tier warm response diverges from the herd's"
+                        )
+
+                # ---- 3. Router: proxy + stable affinity (repeat = rcache
+                # hit on the SAME replica) + live router.* metrics.
+                try:
+                    base = [replica_counters(t) for t in targets]
+                    with RemoteAnalyzer(target=router_target) as c:
+                        r1 = c.analyze_dir_remote(solo_dir)
+                        before = [replica_counters(t) for t in targets]
+                        r2 = c.analyze_dir_remote(solo_dir)
+                        after = [replica_counters(t) for t in targets]
+                    del r1, r2
+                    solo_chunks = [
+                        int(b.get("serve.analyze_chunks", 0))
+                        - int(z.get("serve.analyze_chunks", 0))
+                        for b, z in zip(before, base)
+                    ]
+                    hits = [
+                        int(a.get("rcache.blob_analyze_dir_hit", 0))
+                        - int(b.get("rcache.blob_analyze_dir_hit", 0))
+                        for a, b in zip(after, before)
+                    ]
+                    # STABLE affinity means the repeat lands on the SAME
+                    # replica that analyzed (a shared-tier hit on the
+                    # other replica would also sum to 1 — vacuous).
+                    if solo_chunks.count(1) != 1 or sum(solo_chunks) != 1:
+                        problems.append(
+                            f"router solo corpus not analyzed exactly once: "
+                            f"{solo_chunks}"
+                        )
+                    elif hits[solo_chunks.index(1)] != 1 or sum(hits) != 1:
+                        problems.append(
+                            f"router repeat did not hit the SAME replica that "
+                            f"analyzed (affinity broken): chunks={solo_chunks} "
+                            f"hits={hits}"
+                        )
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{mport}/metrics", timeout=15
+                    ) as resp:
+                        text = resp.read().decode("utf-8")
+                    if "nemo_router_routed_AnalyzeDir" not in text:
+                        problems.append("router /metrics missing router.routed series")
+                except Exception as ex:
+                    problems.append(f"router leg failed: {type(ex).__name__}: {ex}")
+
+                # ---- 4. Clean drain of the whole fleet.
+                for p in procs:
+                    p.send_signal(signal.SIGTERM)
+                for name, p in zip(("replica0", "replica1", "router"), procs):
+                    try:
+                        rc = p.wait(timeout=60)
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+                        p.wait(timeout=15)
+                        problems.append(f"{name} did not drain inside 60s")
+                        continue
+                    if rc != 0:
+                        problems.append(f"{name} exited rc={rc} after SIGTERM")
+            except Exception as ex:
+                for name in ("replica0", "replica1", "router"):
+                    path = os.path.join(tmp, f"{name}.stderr")
+                    if os.path.exists(path):
+                        with open(path, "r", encoding="utf-8") as fh:
+                            tail = fh.read()[-1500:]
+                        if tail.strip():
+                            print(f"fleet-smoke: {name} log tail:\n{tail}", file=sys.stderr)
+                print(f"fleet-smoke: {type(ex).__name__}: {ex}", file=sys.stderr)
+                return 1
+            finally:
+                ports.close()
+                for p in procs:
+                    if p.poll() is None:
+                        p.terminate()
+                        try:
+                            p.wait(timeout=15)
+                        except subprocess.TimeoutExpired:
+                            p.kill()
+                            p.wait(timeout=15)
+                for fh in log_fhs:
+                    fh.close()
+            if problems:
+                print("fleet-smoke: " + "; ".join(problems), file=sys.stderr)
+                return 1
+            print(
+                "fleet-smoke: ok — a cold herd across 2 replicas cost the "
+                "fleet ONE analysis (shared-tier leader lease), responses "
+                "byte-identical, the non-leader replica served the corpus "
+                "warm with zero dispatches, the router proxied with stable "
+                "affinity, and the whole fleet drained clean"
+            )
+            return 0
+    finally:
+        for k, v in prior_knobs.items():
+            if v is not None:
+                os.environ[k] = v
+
+
 def chaos_smoke() -> int:
     """Fault-tolerance smoke (`make chaos-smoke`, also the tail of `make
     validate`; ISSUE 9) — the chaos harness (utils/chaos.py) injecting
@@ -2080,6 +2409,13 @@ def main() -> int:
     rc = serve_smoke()
     if rc:
         return rc
+    # Fleet scale-out contract (also standalone: make fleet-smoke;
+    # ISSUE 14): a 2-replica fleet + router serves a cold cross-replica
+    # herd with ONE analysis fleet-wide, byte-identical responses, a
+    # shared-tier warm hit on the non-leader, and a clean fleet drain.
+    rc = fleet_smoke()
+    if rc:
+        return rc
     # Fault-tolerance contract (also standalone: make chaos-smoke; ISSUE 9):
     # quarantined corrupt runs, host-lane failover + breaker under injected
     # device faults, crash-safe resume after SIGKILL — all byte-identical
@@ -2116,6 +2452,8 @@ if __name__ == "__main__":
         sys.exit(sparse_device_smoke())
     if "--serve-smoke" in sys.argv:
         sys.exit(serve_smoke())
+    if "--fleet-smoke" in sys.argv:
+        sys.exit(fleet_smoke())
     if "--chaos-smoke" in sys.argv:
         sys.exit(chaos_smoke())
     if "--stream-smoke" in sys.argv:

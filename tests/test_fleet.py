@@ -1,0 +1,637 @@
+"""Fleet scale-out (ISSUE 14): the consistent-hash ring, the shared
+rcache tier's concurrent-writer hardening, cross-replica leader leases
+with dead-leader re-election, router spill/failover over real (fake)
+byte-backends, and the multi-boot port-race fix."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+from nemo_tpu import obs  # noqa: E402
+from nemo_tpu.serve.router import (  # noqa: E402
+    HashRing,
+    Router,
+    make_router_server,
+    ring_hash,
+    route_key,
+)
+from nemo_tpu.store.rcache import Lease, ResultCache, resolve_result_cache  # noqa: E402
+from nemo_tpu.utils.subproc import PortReservation, free_port  # noqa: E402
+
+SERVICE = "nemo.NemoAnalysis"
+
+
+def counters_delta(before):
+    return obs.Metrics.delta(obs.metrics.snapshot(), before)["counters"]
+
+
+# ------------------------------------------------------------------- ring
+
+
+def test_ring_route_is_stable_across_instances():
+    backends = ["h:1", "h:2", "h:3"]
+    r1, r2 = HashRing(backends), HashRing(list(reversed(backends)))
+    for i in range(200):
+        key = f"/corpora/family_{i}"
+        assert r1.route(key) == r2.route(key), (
+            "ring placement must be a pure function of (backends, key) — "
+            "construction order or process identity must not move keys"
+        )
+
+
+def test_ring_hash_is_not_python_hash():
+    # Python's salted str hash would reshuffle the fleet every process.
+    assert ring_hash("x") == ring_hash("x")
+    assert ring_hash("x") != hash("x")
+
+
+def test_ring_preference_covers_all_backends_distinct():
+    r = HashRing(["a:1", "b:2", "c:3", "d:4"])
+    pref = r.preference("/some/corpus")
+    assert sorted(pref) == sorted(r.backends)
+    assert len(set(pref)) == len(pref)
+    assert pref[0] == r.route("/some/corpus")
+
+
+def test_ring_distributes_keys_roughly():
+    r = HashRing(["a:1", "b:2", "c:3"])
+    owners = [r.route(f"/k/{i}") for i in range(600)]
+    for b in r.backends:
+        share = owners.count(b) / len(owners)
+        assert 0.15 < share < 0.55, f"{b} owns {share:.0%} of keys"
+
+
+def test_ring_add_backend_remaps_about_k_over_n():
+    """Adding one replica to 3 should claim ~1/4 of the keyspace, not
+    reshuffle everything (the consistent-hash contract)."""
+    old = HashRing(["a:1", "b:2", "c:3"])
+    new = HashRing(["a:1", "b:2", "c:3", "d:4"])
+    keys = [f"/corpora/run_{i}" for i in range(1000)]
+    moved = sum(1 for k in keys if old.route(k) != new.route(k))
+    assert moved / len(keys) < 0.45, f"{moved}/1000 keys moved on +1 replica"
+    # And every moved key moved TO the new replica, not between survivors.
+    for k in keys:
+        if old.route(k) != new.route(k):
+            assert new.route(k) == "d:4"
+
+
+def test_ring_remove_backend_only_moves_its_keys():
+    full = HashRing(["a:1", "b:2", "c:3"])
+    less = HashRing(["a:1", "c:3"])
+    for i in range(500):
+        k = f"/k/{i}"
+        if full.route(k) != "b:2":
+            assert less.route(k) == full.route(k), (
+                "removing a replica must not move keys between survivors"
+            )
+
+
+def test_route_key_is_store_identity(tmp_path):
+    d = tmp_path / "corpus"
+    d.mkdir()
+    alias = tmp_path / "alias"
+    alias.symlink_to(d)
+    # Same store identity (store_dir keys the realpath) => same routing key
+    # => same replica affinity through any path alias.
+    assert route_key(str(alias)) == route_key(str(d))
+
+
+# ------------------------------------------------------------------ leases
+
+
+@pytest.fixture
+def shared_root(tmp_path):
+    root = tmp_path / "shared"
+    root.mkdir()
+    return str(root)
+
+
+def test_lease_acquire_is_exclusive(shared_root):
+    a = Lease(shared_root, "analyze_dir", "k1", owner="A", ttl_s=30.0)
+    b = Lease(shared_root, "analyze_dir", "k1", owner="B", ttl_s=30.0)
+    assert a.try_acquire()
+    assert a.held
+    assert not b.try_acquire()
+    assert not b.held
+    a.release()
+    assert not a.held
+    assert b.try_acquire()
+    b.release()
+
+
+def test_lease_keys_are_independent(shared_root):
+    a = Lease(shared_root, "analyze_dir", "k1", owner="A", ttl_s=30.0)
+    b = Lease(shared_root, "analyze_dir", "k2", owner="B", ttl_s=30.0)
+    assert a.try_acquire() and b.try_acquire()
+    a.release(), b.release()
+
+
+def test_lease_stale_holder_is_stolen(shared_root):
+    """A dead leader (no heartbeat past the TTL) loses its lease to the
+    first re-electing follower; the steal is counted."""
+    a = Lease(shared_root, "analyze_dir", "k1", owner="dead", ttl_s=0.15)
+    assert a.try_acquire()
+    b = Lease(shared_root, "analyze_dir", "k1", owner="B", ttl_s=0.15)
+    assert not b.try_acquire(), "fresh lease must not be stealable"
+    m0 = obs.metrics.snapshot()
+    time.sleep(0.3)
+    assert b.holder_stale()
+    assert b.try_acquire(), "stale lease must be stolen (re-election)"
+    assert counters_delta(m0).get("rcache.lease_steal") == 1
+    b.release()
+
+
+def test_lease_heartbeat_prevents_steal(shared_root):
+    a = Lease(shared_root, "analyze_dir", "k1", owner="A", ttl_s=0.4)
+    assert a.try_acquire()
+    b = Lease(shared_root, "analyze_dir", "k1", owner="B", ttl_s=0.4)
+    for _ in range(4):
+        time.sleep(0.15)
+        a.heartbeat()
+        assert not b.try_acquire(), "heartbeating leader must keep its lease"
+    a.release()
+
+
+def test_lease_concurrent_stealers_elect_exactly_one(shared_root):
+    dead = Lease(shared_root, "analyze_dir", "k1", owner="dead", ttl_s=0.1)
+    assert dead.try_acquire()
+    time.sleep(0.25)
+    leases = [
+        Lease(shared_root, "analyze_dir", "k1", owner=f"s{i}", ttl_s=0.1)
+        for i in range(6)
+    ]
+    won: list[int] = []
+    barrier = threading.Barrier(len(leases))
+
+    def stealer(i: int) -> None:
+        barrier.wait(timeout=5)
+        if leases[i].try_acquire():
+            won.append(i)
+
+    threads = [threading.Thread(target=stealer, args=(i,)) for i in range(len(leases))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert len(won) == 1, f"exactly one stealer may win, got {won}"
+
+
+# ------------------------------------------------------------ shared tier
+
+
+def two_replica_caches(tmp_path, shared):
+    a = ResultCache(str(tmp_path / "rc_a"), shared_root=shared)
+    b = ResultCache(str(tmp_path / "rc_b"), shared_root=shared)
+    return a, b
+
+
+def test_shared_tier_serves_other_replicas_publish(tmp_path, shared_root):
+    a, b = two_replica_caches(tmp_path, shared_root)
+    assert a.put_blob("analyze_dir", "k" * 16, b"payload-bytes")
+    m0 = obs.metrics.snapshot()
+    assert b.load_blob("analyze_dir", "k" * 16) == b"payload-bytes"
+    d = counters_delta(m0)
+    assert d.get("rcache.blob_analyze_dir_shared_hit") == 1
+    assert d.get("rcache.blob_analyze_dir_hit") == 1
+    assert not d.get("rcache.blob_analyze_dir_miss")
+
+
+def test_shared_tier_publish_race_is_counted_and_byte_identical(tmp_path, shared_root):
+    a, b = two_replica_caches(tmp_path, shared_root)
+    m0 = obs.metrics.snapshot()
+    assert a.put_blob("analyze_dir", "race", b"same-content-bytes")
+    assert b.put_blob("analyze_dir", "race", b"same-content-bytes")
+    d = counters_delta(m0)
+    assert d.get("rcache.publish_race", 0) >= 1, (
+        "the second replica's publish of an existing content address must "
+        "be counted as a race"
+    )
+    # No torn entry: whichever publish won, the bytes are the content's.
+    assert a.load_blob("analyze_dir", "race") == b"same-content-bytes"
+    assert b.load_blob("analyze_dir", "race") == b"same-content-bytes"
+    with open(
+        os.path.join(shared_root, "blob_analyze_dir", "race", "payload.bin"), "rb"
+    ) as fh:
+        assert fh.read() == b"same-content-bytes"
+
+
+def test_shared_tier_concurrent_writers_one_entry(tmp_path, shared_root):
+    """Many threads racing to publish one content address end with ONE
+    complete shared entry and byte-identical reads (the fcntl-guarded
+    commit), with no leftover tmp wreckage."""
+    caches = [
+        ResultCache(str(tmp_path / f"rc_{i}"), shared_root=shared_root)
+        for i in range(6)
+    ]
+    barrier = threading.Barrier(len(caches))
+
+    def publish(i: int) -> None:
+        barrier.wait(timeout=5)
+        caches[i].put_blob("analyze_dir", "hotkey", b"identical")
+
+    threads = [threading.Thread(target=publish, args=(i,)) for i in range(len(caches))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=15)
+    kdir = os.path.join(shared_root, "blob_analyze_dir")
+    entries = [n for n in os.listdir(kdir) if ".tmp-" not in n]
+    wreckage = [n for n in os.listdir(kdir) if ".tmp-" in n]
+    assert entries == ["hotkey"]
+    assert not wreckage, f"torn tmp dirs left behind: {wreckage}"
+    for c in caches:
+        assert c.load_blob("analyze_dir", "hotkey") == b"identical"
+
+
+def test_blob_present_probe(tmp_path, shared_root):
+    a, b = two_replica_caches(tmp_path, shared_root)
+    assert not b.blob_present("analyze_dir", "later")
+    a.put_blob("analyze_dir", "later", b"x")
+    assert b.blob_present("analyze_dir", "later")
+
+
+def test_resolve_off_kills_shared_tier_too(monkeypatch, shared_root):
+    """'off means off': an explicit result-cache disable must not be
+    silently overridden by a fleet-wide NEMO_RCACHE_SHARED export — every
+    parity harness pinning NEMO_RESULT_CACHE=off depends on zero caching."""
+    monkeypatch.setenv("NEMO_RESULT_CACHE", "off")
+    monkeypatch.setenv("NEMO_RCACHE_SHARED", shared_root)
+    assert resolve_result_cache() is None
+
+
+def test_resolve_shared_as_primary(monkeypatch, shared_root):
+    """A replica that wants ONLY the shared tier points the result cache
+    at the shared directory itself: one root, no double-publish, leases
+    still on the shared tier."""
+    monkeypatch.setenv("NEMO_RESULT_CACHE", shared_root)
+    monkeypatch.setenv("NEMO_RCACHE_SHARED", shared_root)
+    rc = resolve_result_cache()
+    assert rc.root == shared_root
+    assert rc.shared_root is None, "shared==primary must not double-publish"
+    assert rc.lease_root == shared_root
+
+
+def test_resolve_no_shared_has_no_lease_root(monkeypatch, tmp_path):
+    monkeypatch.setenv("NEMO_RESULT_CACHE", str(tmp_path / "rc"))
+    monkeypatch.delenv("NEMO_RCACHE_SHARED", raising=False)
+    rc = resolve_result_cache()
+    assert rc.shared_root is None and rc.lease_root is None
+
+
+def test_eviction_never_sweeps_leases(monkeypatch, tmp_path, shared_root):
+    """The size-cap evictor must treat lease files as liveness state, not
+    cached content — an evicted lease would read as a dead leader."""
+    monkeypatch.setenv("NEMO_RESULT_CACHE_MAX_GB", "0.000000001")  # ~1 byte
+    rc = ResultCache(shared_root)
+    lease = Lease(shared_root, "analyze_dir", "held", owner="A", ttl_s=60.0)
+    assert lease.try_acquire()
+    for i in range(4):
+        rc.put_blob("analyze_dir", f"k{i}", b"x" * 512)
+    assert os.path.exists(lease.path), "evictor swept a live lease file"
+    lease.release()
+
+
+# ------------------------------------------------- cross-replica single-flight
+
+
+@pytest.fixture
+def impl():
+    from nemo_tpu import serve
+    from nemo_tpu.service.server import _Impl
+
+    serve.reset_controller()
+    serve.reset_flights()
+    serve.reset_batcher()
+    yield _Impl()
+    serve.reset_controller()
+    serve.reset_flights()
+    serve.reset_batcher()
+
+
+def _fleet_rc(tmp_path, shared):
+    return ResultCache(str(tmp_path / "rc_local"), shared_root=shared)
+
+
+def test_fleet_uncontended_leader_runs_once(impl, tmp_path, shared_root, monkeypatch):
+    monkeypatch.setenv("NEMO_LEASE_TTL_S", "5")
+    rc = _fleet_rc(tmp_path, shared_root)
+    calls = []
+
+    def run() -> bytes:
+        calls.append(1)
+        rc.put_blob("analyze_dir", "ckey", b"fresh-bytes")
+        return b"fresh-bytes"
+
+    m0 = obs.metrics.snapshot()
+    payload, role = impl._fleet_single_flight(rc, "ckey", run, None)
+    assert (payload, role) == (b"fresh-bytes", "leader")
+    assert calls == [1]
+    d = counters_delta(m0)
+    assert d.get("serve.fleet.leader") == 1
+    assert not d.get("serve.fleet.follower")
+    # The lease is released after the run: a fresh acquire succeeds.
+    assert Lease(shared_root, "analyze_dir", "ckey", ttl_s=5).try_acquire()
+
+
+def test_fleet_follower_waits_for_leaders_publish(
+    impl, tmp_path, shared_root, monkeypatch
+):
+    """A replica arriving while another replica leads the same content
+    address must NOT run the analysis: it serves the leader's published
+    bytes from the shared tier."""
+    monkeypatch.setenv("NEMO_LEASE_TTL_S", "10")
+    rc_leader = _fleet_rc(tmp_path / "r0", shared_root)
+    rc_follow = _fleet_rc(tmp_path / "r1", shared_root)
+    leader_lease = Lease(shared_root, "analyze_dir", "herd", owner="r0", ttl_s=10)
+    assert leader_lease.try_acquire()
+
+    def publish_later() -> None:
+        time.sleep(0.3)
+        rc_leader.put_blob("analyze_dir", "herd", b"leader-bytes")
+        leader_lease.release()
+
+    t = threading.Thread(target=publish_later)
+    t.start()
+    ran = []
+    m0 = obs.metrics.snapshot()
+    payload, role = impl._fleet_single_flight(
+        rc_follow, "herd", lambda: ran.append(1) or b"local", None
+    )
+    t.join()
+    assert role == "follower"
+    assert payload == b"leader-bytes"
+    assert not ran, "the follower must not execute the analysis"
+    assert counters_delta(m0).get("serve.fleet.follower") == 1
+
+
+def test_fleet_broken_lease_tier_executes_locally(impl, tmp_path, monkeypatch):
+    """An UNUSABLE shared tier (unwritable/invalid mount) is an infra
+    failure, not 'another replica leads': the request must execute
+    locally immediately instead of parking on the follower deadline for
+    a publish that can never arrive."""
+    monkeypatch.setenv("NEMO_LEASE_TTL_S", "5")
+    bad = tmp_path / "notadir"
+    bad.write_text("a file where the shared tier should be")
+    rc = ResultCache(str(tmp_path / "rc_local"), shared_root=str(bad))
+    ran = []
+    m0 = obs.metrics.snapshot()
+    t0 = time.monotonic()
+    payload, role = impl._fleet_single_flight(
+        rc, "brokenkey", lambda: ran.append(1) or b"local-bytes", None
+    )
+    assert (payload, role) == (b"local-bytes", "lease_error")
+    assert ran == [1]
+    assert time.monotonic() - t0 < 5.0, "must not wait out a follower deadline"
+    d = counters_delta(m0)
+    assert d.get("serve.fleet.lease_error") == 1
+    assert not d.get("serve.fleet.follower")
+
+
+def test_fleet_dead_leader_reelects(impl, tmp_path, shared_root, monkeypatch):
+    """A leader that stops heartbeating (crash) expires; the waiting
+    follower steals the lease and runs the analysis itself."""
+    monkeypatch.setenv("NEMO_LEASE_TTL_S", "0.2")
+    rc = _fleet_rc(tmp_path, shared_root)
+    dead = Lease(shared_root, "analyze_dir", "crashed", owner="dead", ttl_s=0.2)
+    assert dead.try_acquire()
+    ran = []
+
+    def run() -> bytes:
+        ran.append(1)
+        rc.put_blob("analyze_dir", "crashed", b"reelected-bytes")
+        return b"reelected-bytes"
+
+    m0 = obs.metrics.snapshot()
+    payload, role = impl._fleet_single_flight(rc, "crashed", run, None)
+    assert (payload, role) == (b"reelected-bytes", "leader")
+    assert ran == [1]
+    d = counters_delta(m0)
+    assert d.get("rcache.lease_steal") == 1, "re-election must be a counted steal"
+    assert d.get("serve.fleet.follower") == 1, "the replica first followed"
+    assert d.get("serve.fleet.leader") == 1
+
+
+# ------------------------------------------------------------------ router
+
+
+class _FakeBackend:
+    """A raw-bytes NemoAnalysis fake: enough surface for routing tests —
+    AnalyzeDir answers with an identifying payload (or a scripted
+    admission rejection), Health answers with gauges trailing metadata."""
+
+    def __init__(self, name: str, depth: float = 0.0) -> None:
+        self.name = name
+        self.depth = depth
+        self.reject_analyze_dir = False
+        self.served: list[bytes] = []
+        from concurrent import futures
+
+        def analyze_dir(request: bytes, context):
+            if self.reject_analyze_dir:
+                context.set_trailing_metadata((("nemo-retry-after-s", "0.5"),))
+                context.abort(
+                    grpc.StatusCode.RESOURCE_EXHAUSTED, "queue full (scripted)"
+                )
+            self.served.append(request)
+            return f"{self.name}:".encode() + request
+
+        def health(request: bytes, context):
+            context.set_trailing_metadata(
+                (
+                    (
+                        "nemo-metrics-bin",
+                        json.dumps(
+                            {"gauges": {"serve.queue_depth": self.depth}}
+                        ).encode(),
+                    ),
+                )
+            )
+            return b"\x12\x03cpu"  # any bytes; the router never decodes
+
+        handlers = {
+            "AnalyzeDir": grpc.unary_unary_rpc_method_handler(analyze_dir),
+            "Health": grpc.unary_unary_rpc_method_handler(health),
+        }
+        self.server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+        self.server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE, handlers),)
+        )
+        self.port = self.server.add_insecure_port("127.0.0.1:0")
+        self.target = f"127.0.0.1:{self.port}"
+        self.server.start()
+
+    def stop(self) -> None:
+        self.server.stop(grace=None).wait(timeout=5)
+
+
+@pytest.fixture
+def fake_fleet():
+    backends = [_FakeBackend("r0"), _FakeBackend("r1")]
+    yield backends
+    for b in backends:
+        b.stop()
+
+
+def _raw_client(target: str):
+    ch = grpc.insecure_channel(target)
+    return ch, ch.unary_unary(f"/{SERVICE}/AnalyzeDir")
+
+
+def _key_for(ring: HashRing, backend_target: str, tmp_path) -> str:
+    """A corpus dir whose ring home is `backend_target`."""
+    for i in range(512):
+        d = tmp_path / f"corpus_{i}"
+        if ring.route(route_key(str(d))) == backend_target:
+            d.mkdir(exist_ok=True)
+            return str(d)
+    raise AssertionError("no key found for backend (vnode imbalance?)")
+
+
+def test_router_affinity_and_proxy(fake_fleet, tmp_path):
+    targets = [b.target for b in fake_fleet]
+    server, port, router = make_router_server(0, targets)
+    server.start()
+    try:
+        ch, call = _raw_client(f"127.0.0.1:{port}")
+        d0 = _key_for(router.ring, targets[0], tmp_path)
+        d1 = _key_for(router.ring, targets[1], tmp_path)
+        for d, owner in ((d0, fake_fleet[0]), (d1, fake_fleet[1])):
+            req = json.dumps({"dir": d}).encode()
+            for _ in range(3):
+                resp = call(req, timeout=10)
+                assert resp == f"{owner.name}:".encode() + req
+        # Affinity: every repeat landed on the SAME replica.
+        assert len(fake_fleet[0].served) == 3
+        assert len(fake_fleet[1].served) == 3
+        ch.close()
+    finally:
+        server.stop(grace=None)
+        router.stop()
+
+
+def test_router_spill_on_admission_rejection(fake_fleet, tmp_path):
+    """A home replica shedding (RESOURCE_EXHAUSTED + retry-after hint)
+    spills the request to the other replica instead of bouncing the
+    client (the shared tier makes any replica able to serve it)."""
+    targets = [b.target for b in fake_fleet]
+    server, port, router = make_router_server(0, targets)
+    server.start()
+    try:
+        d0 = _key_for(router.ring, targets[0], tmp_path)
+        fake_fleet[0].reject_analyze_dir = True
+        m0 = obs.metrics.snapshot()
+        ch, call = _raw_client(f"127.0.0.1:{port}")
+        req = json.dumps({"dir": d0}).encode()
+        resp = call(req, timeout=10)
+        assert resp == b"r1:" + req, "rejected home must spill to the peer"
+        assert counters_delta(m0).get("router.spill") == 1
+        ch.close()
+    finally:
+        server.stop(grace=None)
+        router.stop()
+
+
+def test_router_failover_on_unavailable(fake_fleet, tmp_path):
+    targets = [b.target for b in fake_fleet]
+    server, port, router = make_router_server(0, targets)
+    server.start()
+    try:
+        d0 = _key_for(router.ring, targets[0], tmp_path)
+        ch, call = _raw_client(f"127.0.0.1:{port}")
+        req = json.dumps({"dir": d0}).encode()
+        assert call(req, timeout=10) == b"r0:" + req
+        fake_fleet[0].stop()
+        m0 = obs.metrics.snapshot()
+        resp = call(req, timeout=15)
+        assert resp == b"r1:" + req, "dead home must fail over to the next ring replica"
+        d = counters_delta(m0)
+        assert d.get("router.failover", 0) >= 1
+        assert not router.backend_states()[targets[0]]["up"]
+        ch.close()
+    finally:
+        server.stop(grace=None)
+        router.stop()
+
+
+def test_router_plan_prefers_live_and_spills_on_depth(fake_fleet, monkeypatch):
+    targets = [b.target for b in fake_fleet]
+    router = Router(targets)
+    try:
+        key = "/any/corpus"
+        home = router.ring.route(key)
+        other = next(t for t in targets if t != home)
+        assert router.plan(key)[0] == home
+        # Home marked down -> the peer plans first (but home stays in the
+        # tail: the health poll may be stale).
+        router._mark_down(home)
+        assert router.plan(key) == [other, home]
+        with router._lock:
+            router._up[home] = True
+        # Queue depth past the spill threshold with a strictly idler peer
+        # -> proactive spill.
+        monkeypatch.setenv("NEMO_ROUTER_SPILL_DEPTH", "4")
+        with router._lock:
+            router._depth[home] = 9.0
+            router._depth[other] = 1.0
+        assert router.plan(key)[0] == other
+        # Keyless RPCs: least-loaded first.
+        assert router.plan(None)[0] == other
+    finally:
+        router.stop()
+
+
+def test_router_health_poll_reads_depth(fake_fleet):
+    fake_fleet[0].depth = 7.0
+    targets = [b.target for b in fake_fleet]
+    router = Router(targets)
+    try:
+        router.poll_health()
+        states = router.backend_states()
+        assert states[targets[0]]["up"] and states[targets[1]]["up"]
+        assert states[targets[0]]["depth"] == 7.0
+    finally:
+        router.stop()
+
+
+# ------------------------------------------------------------------- ports
+
+
+def test_free_port_never_repeats_recent():
+    ports = [free_port() for _ in range(64)]
+    assert len(set(ports)) == len(ports), (
+        "free_port handed out a recently-issued port — the multi-boot race"
+    )
+
+
+def test_port_reservation_holds_and_releases():
+    import socket
+
+    with PortReservation(6) as res:
+        assert len(set(res.ports)) == 6
+        # Held: another bind of the same port must fail while reserved.
+        s = socket.socket()
+        with pytest.raises(OSError):
+            s.bind(("127.0.0.1", res.ports[0]))
+        s.close()
+        # Released: the port is bindable the moment its server boots.
+        p = res.release(0)
+        s2 = socket.socket()
+        s2.bind(("127.0.0.1", p))
+        s2.close()
+    # Context exit closes the rest without error; ports become bindable.
+    s3 = socket.socket()
+    s3.bind(("127.0.0.1", res.ports[1]))
+    s3.close()
+
+
+def test_port_reservation_distinct_from_free_port():
+    with PortReservation(4) as res:
+        for _ in range(32):
+            assert free_port() not in set(res.ports)

@@ -181,6 +181,41 @@ def extract_metrics(doc: dict) -> dict[str, tuple[float, str, str]]:
     # never flag the 0 -> N jump this metric exists to catch (any shift
     # past the threshold count flags, in either direction).
     put("serve_tier.rejects", sv.get("rejects"), "split", "ratio")
+    # Fleet tier (ISSUE 14): the scale-out contract — 2-replica aggregate
+    # throughput >= 1.6x one replica on the mixed-tenant warm herd with
+    # p99 no worse — watched as: speedup / per-replica efficiency
+    # collapsing, fleet p50/p99 (absolute, s_fast floors) or the
+    # fleet-vs-single p99 ratio creeping up, the scale-out replica's warm
+    # boot-to-first-response wall growing back toward compile-scale, or
+    # the cold herd's cross-replica single-flight ratio collapsing (a
+    # herd that stops deduping re-runs the analysis per replica).
+    fl = doc.get("fleet_tier") or {}
+    put("fleet_tier.speedup", fl.get("speedup"), "higher", "ratio")
+    put(
+        "fleet_tier.per_replica_efficiency",
+        fl.get("per_replica_efficiency"),
+        "higher",
+        "ratio",
+    )
+    put("fleet_tier.p99_ratio", fl.get("p99_ratio"), "lower", "ratio")
+    put("fleet_tier.fleet_p50_s", (fl.get("fleet") or {}).get("p50_s"), "lower", "s_fast")
+    put("fleet_tier.fleet_p99_s", (fl.get("fleet") or {}).get("p99_s"), "lower", "s_fast")
+    put(
+        "fleet_tier.throughput_rps",
+        (fl.get("fleet") or {}).get("throughput_rps"),
+        "higher",
+        "ratio",
+    )
+    put("fleet_tier.warm_boot_s", fl.get("warm_boot_s"), "lower", "s")
+    put(
+        "fleet_tier.cold_herd_dedup_ratio",
+        fl.get("cold_herd_dedup_ratio"),
+        "higher",
+        "ratio",
+    )
+    # Cold-herd analyses compare as an absolute shift (the healthy value
+    # is exactly 1; a 1 -> 2 jump means the fleet stopped single-flighting).
+    put("fleet_tier.cold_herd_analyses", fl.get("cold_herd_analyses"), "split", "ratio")
     # Sparse-device tier (ISSUE 10): either route's wall creeping up, the
     # sparse route's watermark growing, or the giant-V watermark ratio
     # (the memory win the route exists for) collapsing all flag.  Walls
