@@ -5,7 +5,7 @@ NATIVE_LIB := native/build/libnemo_native.so
 REPORT_SRC := native/nemo_report.cpp
 REPORT_LIB := native/build/libnemo_report.so
 
-.PHONY: all native test bench bench-watch bench-trend prewarm validate trace-smoke obs-smoke store-smoke delta-smoke shard-smoke sparse-device-smoke serve-smoke fleet-smoke chaos-smoke stream-smoke synth-smoke lint-print clean reset proto neo4j-up neo4j-validate neo4j-down
+.PHONY: all native test bench bench-watch bench-trend prewarm validate trace-smoke obs-smoke store-smoke delta-smoke shard-smoke sparse-device-smoke serve-smoke fleet-smoke chaos-smoke stream-smoke synth-smoke watch-smoke lint-print clean reset proto neo4j-up neo4j-validate neo4j-down
 
 all: native
 
@@ -132,6 +132,16 @@ stream-smoke:
 # Python oracle (analysis/synth.py, ops/sparse_{device,host}.py).
 synth-smoke:
 	python -m nemo_tpu.utils.validate_smoke --synth-smoke
+
+# Live-watch smoke (also the tail of `make validate`; ISSUE 15): the
+# replay driver feeds a 3-generation sweep into a live watcher with one
+# AnalyzeDirStream subscriber — >=3 report_update events in generation
+# order, every cycle dispatching only the new runs (cached segments
+# served from the partial tier), the final live report byte-identical to
+# a post-hoc one-shot of the full corpus, and a mid-write truncated file
+# quarantined then re-ingested ALONE on repair (nemo_tpu/watch).
+watch-smoke:
+	python -m nemo_tpu.utils.validate_smoke --watch-smoke
 
 # Structured-logging contract: no bare print() in nemo_tpu/ outside the
 # CLI/harness allowlist (tools/lint_no_print.py).

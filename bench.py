@@ -31,6 +31,9 @@ Env knobs:
   NEMO_BENCH_CHILD_TIMEOUT  seconds for the measurement child (default 3600)
   NEMO_BENCH_10X           =1 adds the gated 10x e2e stress row (minutes)
   NEMO_BENCH_STREAM_RUNS   stream-tier corpus size (default 4000; 10 segments)
+  NEMO_BENCH_ADV_RUNS      adversarial-tier runs per family (default 96)
+  NEMO_BENCH_WATCH_RUNS    watch-tier replayed corpus size (default 240)
+  NEMO_BENCH_WATCH_GENERATIONS  watch-tier replay generations (default 6)
   NEMO_BENCH_1M            =1 adds the gated million-run streamed variant
                            (NEMO_BENCH_STREAM_RUNS_LARGE overrides the count;
                            generation alone is hours of JSON writing)
@@ -533,6 +536,154 @@ def child_main() -> None:
         log(f"synth tier (per-run oracle vs batched): {json.dumps(synth_tier)}")
     except Exception as ex:  # the synth tier must never sink the bench
         log(f"synth tier skipped: {type(ex).__name__}: {ex}")
+
+    # Adversarial tier (ISSUE 15): the named adversarial graph families
+    # (models/synth.py:ADVERSARIAL_FAMILIES) as first-class bench rows —
+    # deep chains, wide fan-out, near-duplicates, pathological vocab
+    # growth, schema-valid cycles.  One full warm-path pipeline wall per
+    # family plus the per-route dispatch split, so the routing constants
+    # items 2/5 tune against have a standing measured target.
+    adversarial_tier = None
+    try:
+        from nemo_tpu.analysis.pipeline import run_debug as _adv_run_debug
+        from nemo_tpu.backend.jax_backend import JaxBackend as _AdvJB
+        from nemo_tpu.models.synth import (
+            ADVERSARIAL_FAMILIES as _ADV_FAMILIES,
+        )
+        from nemo_tpu.models.synth import adversarial_spec as _adv_spec
+        from nemo_tpu.models.synth import write_corpus as _adv_write
+
+        adv_runs = int(os.environ.get("NEMO_BENCH_ADV_RUNS", "96"))
+        adv_tmp = os.path.join(tmp, "adversarial")
+        os.makedirs(adv_tmp, exist_ok=True)
+        adversarial_tier = {}
+        for fam in _ADV_FAMILIES:
+            d = _adv_write(_adv_spec(fam, n_runs=adv_runs, seed=13), adv_tmp)
+            m0 = obs.metrics.snapshot()
+            t0 = time.perf_counter()
+            _adv_run_debug(
+                d,
+                os.path.join(adv_tmp, "results", fam),
+                _AdvJB(),
+                figures="none",
+                corpus_cache="off",
+                result_cache="off",
+            )
+            wall = time.perf_counter() - t0
+            md = obs.Metrics.delta(obs.metrics.snapshot(), m0)["counters"]
+            routes = {
+                k[len("analysis.route."):]: int(v)
+                for k, v in sorted(md.items())
+                if k.startswith("analysis.route.")
+            }
+            adversarial_tier[fam] = {
+                "runs": adv_runs,
+                "wall_s": round(wall, 3),
+                "graphs_per_s": round(2 * adv_runs / wall, 1) if wall else None,
+                "routes": routes,
+            }
+        log(f"adversarial tier (named graph families): {json.dumps(adversarial_tier)}")
+    except Exception as ex:  # the adversarial tier must never sink the bench
+        log(f"adversarial tier skipped: {type(ex).__name__}: {ex}")
+        adversarial_tier = None
+
+    # Watch tier (ISSUE 15): the live watch loop's standing numbers — a
+    # replayed sweep drives one in-process Watcher generation by
+    # generation (each generation materialized only after the previous
+    # update published, so updates map 1:1 to generations), reporting the
+    # update-latency p50/max, the runs/s the loop absorbed, the per-update
+    # kernel-dispatch count (the O(new runs) contract: flat per update at
+    # fixed generation size), and the steady-state RSS the loop holds —
+    # watched by tools/bench_trend.py with the RSS as an absolute ceiling.
+    watch_tier = None
+    try:
+        import threading as _w_threading
+
+        from nemo_tpu.backend.jax_backend import JaxBackend as _WatchJB
+        from nemo_tpu.models.synth import SynthSpec as _WatchSpec
+        from nemo_tpu.models.synth import write_corpus as _watch_write
+        from nemo_tpu.watch import WatchConfig, Watcher
+        from nemo_tpu.watch.replay import replay_plan
+
+        from nemo_tpu.ingest.adapters import MollyInjector as _WatchInj
+
+        def _vm_rss_kb() -> int:
+            with open("/proc/self/status") as fh:
+                return next(
+                    int(line.split()[1])
+                    for line in fh
+                    if line.startswith("VmRSS:")
+                )
+
+        w_runs = int(os.environ.get("NEMO_BENCH_WATCH_RUNS", "240"))
+        w_gens = int(os.environ.get("NEMO_BENCH_WATCH_GENERATIONS", "6"))
+        rss_before_kb = _vm_rss_kb()
+        w_tmp = os.path.join(tmp, "watch_tier")
+        os.makedirs(w_tmp, exist_ok=True)
+        w_src = _watch_write(
+            _WatchSpec(n_runs=w_runs, seed=31, name="watch_src"), w_tmp
+        )
+        w_live = os.path.join(w_tmp, "live", "watch_src")
+        os.makedirs(w_live, exist_ok=True)
+        watcher = Watcher(
+            w_live,
+            os.path.join(w_tmp, "results"),
+            _WatchJB,
+            WatchConfig(
+                poll_s=0.05,
+                debounce_s=0.05,
+                max_updates=w_gens,
+                figures="none",
+                run_debug_kwargs={
+                    "corpus_cache": os.path.join(w_tmp, "cc"),
+                    "result_cache": os.path.join(w_tmp, "rc"),
+                },
+            ),
+        )
+        wq = watcher.subscribe()
+        wth = _w_threading.Thread(target=watcher.run, daemon=True)
+        wth.start()
+        t_watch0 = time.perf_counter()
+        ups = []
+        for n in replay_plan(w_runs, w_gens):
+            _WatchInj.materialize_prefix(w_src, w_live, n)
+            while True:  # skip watch_error noise, wait for the update
+                ev = wq.get(timeout=600)
+                if ev.get("event") == "report_update":
+                    ups.append(ev)
+                    break
+        watch_wall = time.perf_counter() - t_watch0
+        watcher.stop()
+        wth.join(timeout=60)
+        lat = sorted(e["update_latency_s"] for e in ups)
+        # steady_rss_mb is the WHOLE bench child's RSS at tier end — the
+        # absolute number the 4 GB ceiling bounds (honest: a watcher is a
+        # long-lived process, and an over-ceiling value is alarming no
+        # matter which tier grew it).  rss_growth_mb is the
+        # tier-ATTRIBUTABLE delta the trend sentinel compares, so an
+        # earlier tier's residue cannot flag (or mask) the watch loop.
+        rss_kb = _vm_rss_kb()
+        watch_tier = {
+            "runs": w_runs,
+            "generations": w_gens,
+            "updates": len(ups),
+            "update_latency_p50_s": round(lat[len(lat) // 2], 4) if lat else None,
+            "update_latency_max_s": round(lat[-1], 4) if lat else None,
+            "runs_per_s_absorbed": round(w_runs / watch_wall, 1),
+            "dispatches_per_update": round(
+                sum(e["kernel_dispatches"] for e in ups) / max(1, len(ups)), 1
+            ),
+            "runs_mapped_total": sum(e["runs_mapped"] for e in ups),
+            "steady_rss_mb": round(rss_kb / 1e3, 1),
+            "rss_growth_mb": round(max(0, rss_kb - rss_before_kb) / 1e3, 1),
+            "incremental": all(
+                e["runs_mapped"] == e["new_runs"] for e in ups
+            ),
+        }
+        log(f"watch tier (live loop): {json.dumps(watch_tier)}")
+    except Exception as ex:  # the watch tier must never sink the bench
+        log(f"watch tier skipped: {type(ex).__name__}: {ex}")
+        watch_tier = None
 
     # Chaos tier (ISSUE 9): the fault-tolerance layer's COST, measured.
     # Three walls over one corpus with both scheduler lanes live
@@ -2068,6 +2219,8 @@ def child_main() -> None:
         "ingest_tier": ingest_tier,
         "delta_tier": delta_tier,
         "synth_tier": synth_tier,
+        "adversarial_tier": adversarial_tier,
+        "watch_tier": watch_tier,
         "chaos_tier": chaos_tier,
         "shard_tier": shard_tier,
         "sparse_device_tier": sparse_device_tier,

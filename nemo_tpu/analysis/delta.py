@@ -329,7 +329,11 @@ def subset_molly(molly, rows: list[int]):
     operates on the full molly."""
     from nemo_tpu.ingest.molly import MollyOutput
 
-    out = MollyOutput(run_name=molly.run_name, output_dir=molly.output_dir)
+    out = MollyOutput(
+        run_name=molly.run_name,
+        output_dir=molly.output_dir,
+        ships_spacetime_dots=getattr(molly, "ships_spacetime_dots", True),
+    )
     out.runs = [molly.runs[r] for r in rows]
     for run in out.runs:
         out.runs_iters.append(run.iteration)

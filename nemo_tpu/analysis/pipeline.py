@@ -18,7 +18,7 @@ from nemo_tpu import obs
 from nemo_tpu.backend.base import GraphBackend, NoSuccessfulRunError
 
 _log = obs.log.get_logger("nemo.pipeline")
-from nemo_tpu.ingest.molly import MollyOutput, load_molly_output
+from nemo_tpu.ingest.molly import MollyOutput
 from nemo_tpu.report.writer import Reporter
 from nemo_tpu.utils.timing import PhaseTimer
 
@@ -281,7 +281,18 @@ def _ingest(fault_inj_out: str, use_packed: bool, store=None, consult_store=True
     invocation hits.  The object path (oracle backends, --save-corpus)
     never touches the store.  ``consult_store=False`` skips straight to
     parse+populate — for callers that already took (and counted) the miss
-    themselves (the sidecar's AnalyzeDir after a load_corpus miss)."""
+    themselves (the sidecar's AnalyzeDir after a load_corpus miss).
+
+    Parse dispatch goes through the fault-injector adapter seam
+    (ingest/adapters.py, ``--injector``/``NEMO_INJECTOR``): every front
+    end — Molly, trace-JSON, future injectors — lands in the same
+    MollyOutput and the same store-populate path, so nothing below this
+    function is adapter-specific.  The C++ packed-first ETL applies only
+    where the resolved adapter is ``native_capable`` (the Molly layout);
+    other layouts parse through their adapter and reach packed arrays via
+    the store populate, exactly like a lib-less host."""
+    from nemo_tpu.ingest import adapters
+
     if use_packed and store is not None and consult_store:
         molly = store.load_packed(fault_inj_out)
         if molly is not None:
@@ -289,11 +300,12 @@ def _ingest(fault_inj_out: str, use_packed: bool, store=None, consult_store=True
     if use_packed:
         from nemo_tpu.ingest.native import load_molly_output_packed, native_available
 
+        injector = adapters.resolve_injector(fault_inj_out)
         # Snapshot BEFORE parsing: a file mutated while the parse runs must
         # mismatch the fingerprint the populate stores, so the NEXT load
         # re-parses instead of serving a HIT over mixed content.
         snap = store.snapshot(fault_inj_out) if store is not None else None
-        if native_available():
+        if native_available() and injector.native_capable:
             try:
                 molly = load_molly_output_packed(fault_inj_out)
             except Exception as ex:
@@ -314,12 +326,12 @@ def _ingest(fault_inj_out: str, use_packed: bool, store=None, consult_store=True
                     "loader (NEMO_QUARANTINE=off restores fail-fast)",
                 )
                 obs.metrics.inc("ingest.native_fallback")
-                molly = load_molly_output(fault_inj_out)
+                molly = injector.load(fault_inj_out)
         else:
-            # Lib-less host (or a corrupt store that just fell back): the
-            # object loader serves any backend, and the populate below
-            # makes the next run a warm mmap load.
-            molly = load_molly_output(fault_inj_out)
+            # Lib-less host, non-Molly layout, or a corrupt store that just
+            # fell back: the adapter's object loader serves any backend,
+            # and the populate below makes the next run a warm mmap load.
+            molly = injector.load(fault_inj_out)
         if store is not None:
             header = store.put(fault_inj_out, molly, snapshot=snap)
             if isinstance(header, dict):
@@ -335,7 +347,7 @@ def _ingest(fault_inj_out: str, use_packed: bool, store=None, consult_store=True
                 if nc is not None:
                     attach_store_provenance(nc, sd, header)
         return molly
-    return load_molly_output(fault_inj_out)
+    return adapters.load_output(fault_inj_out)
 
 
 def _attach_ingest_dir(ex: BaseException, d: str) -> BaseException:

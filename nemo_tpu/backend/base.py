@@ -205,8 +205,10 @@ class GraphBackend(abc.ABC):
         memo: dict[tuple, object] = {}
         for i in run_ids:
             run = by_iter[i]
-            with open(self.molly.spacetime_dot_path(run.iteration), "r", encoding="utf-8") as f:
-                text = f.read()
+            # Layout-aware read-or-synthesize (ingest/adapters.py seam):
+            # Molly ships per-run DOT files; other injectors get the
+            # deterministic message-history synthesis.
+            text = self.molly.spacetime_dot_text(run.iteration, run=run)
             key = (
                 text,
                 tuple(sorted(run.time_pre_holds.items())),

@@ -204,12 +204,82 @@ def main(argv: list[str] | None = None) -> int:
         "tree, 'auto' (default) picks native when the backend supports "
         "packed ingest and the library builds",
     )
+    parser.add_argument(
+        "--injector",
+        choices=("auto", "molly", "trace-json"),
+        default=None,
+        help="fault-injector front end (ingest/adapters.py): 'molly' "
+        "(runs.json + per-run provenance files), 'trace-json' (one "
+        "trace.json document, Jepsen-style histories), or 'auto' "
+        "(default; sniffs the directory layout).  Equivalent env: "
+        "NEMO_INJECTOR",
+    )
+    parser.add_argument(
+        "--watch",
+        action="store_true",
+        help="live mode (ISSUE 15): tail the (single) -faultInjOut "
+        "directory WHILE the fault injector runs — each batch of new "
+        "runs is store-appended, delta-analyzed (O(new runs) with the "
+        "corpus store + result cache on), and the report under "
+        "--results-dir is atomically republished.  Combine with --serve "
+        "to watch violations appear live in the browser; Ctrl-C stops",
+    )
+    parser.add_argument(
+        "--watch-poll-s",
+        type=float,
+        default=None,
+        metavar="S",
+        help="watch poll interval (default $NEMO_WATCH_POLL_S or 0.5)",
+    )
+    parser.add_argument(
+        "--watch-debounce-s",
+        type=float,
+        default=None,
+        metavar="S",
+        help="watch debounce: the sweep directory must hold still this "
+        "long before a cycle analyzes (default $NEMO_WATCH_DEBOUNCE_S "
+        "or 0.25)",
+    )
+    parser.add_argument(
+        "--watch-max-updates",
+        type=int,
+        default=0,
+        metavar="N",
+        help="stop watching after N published updates (0 = until Ctrl-C)",
+    )
+    parser.add_argument(
+        "--replay",
+        metavar="SRC_DIR",
+        default=None,
+        help="deterministic live-sweep simulator: replay the FINISHED "
+        "corpus at SRC_DIR into the watched -faultInjOut directory in "
+        "--replay-generations monotonic prefixes, one every "
+        "--replay-interval-s — the smoke/bench driver for --watch",
+    )
+    parser.add_argument(
+        "--replay-generations", type=int, default=3, metavar="N",
+        help="replay generation count (default 3)",
+    )
+    parser.add_argument(
+        "--replay-interval-s", type=float, default=1.0, metavar="S",
+        help="pause between replay generations (default 1.0)",
+    )
     args = parser.parse_args(argv)
 
     dirs = args.fault_inj_out
+    if args.watch and len(dirs) != 1:
+        parser.error("--watch takes exactly one -faultInjOut directory")
+    if args.replay and not args.watch:
+        parser.error("--replay only makes sense with --watch")
     for d in dirs:
         if not os.path.isdir(d):
-            parser.error(f"fault injector output directory not found: {d}")
+            if args.watch:
+                # A watcher may legitimately start BEFORE the model
+                # checker's first flush (or before the replay driver's
+                # first generation) creates the sweep directory.
+                os.makedirs(d, exist_ok=True)
+            else:
+                parser.error(f"fault injector output directory not found: {d}")
     if len(dirs) > 1 and args.save_corpus:
         parser.error(
             "--save-corpus is incompatible with multiple -faultInjOut "
@@ -258,6 +328,11 @@ def main(argv: list[str] | None = None) -> int:
         os.environ["NEMO_CORPUS_CACHE"] = args.corpus_cache
     if args.result_cache is not None:
         os.environ["NEMO_RESULT_CACHE"] = args.result_cache
+    if args.injector is not None:
+        os.environ["NEMO_INJECTOR"] = args.injector
+    if args.watch:
+        return _watch_main(args, dirs[0])
+
     # The tracer is finished in the finally: a pipeline failure must still
     # write the partial trace (a trace of a failed run is exactly when you
     # want one) AND disable the global tracer — main() may run again in
@@ -346,6 +421,99 @@ def main(argv: list[str] | None = None) -> int:
                 httpd.serve_forever()
             except KeyboardInterrupt:
                 pass
+    return 0
+
+
+def _watch_main(args, sweep_dir: str) -> int:
+    """The `--watch` live loop (ISSUE 15): a Watcher tails the sweep
+    directory and republishes the report on every batch of new runs; with
+    --serve the report HTTP server runs CONCURRENTLY so the browser shows
+    invariant violations and ranked-repair shifts live mid-sweep.  Exits
+    on Ctrl-C or after --watch-max-updates updates."""
+    import threading
+
+    from nemo_tpu.obs import trace as obs_trace
+    from nemo_tpu.watch import WatchConfig, Watcher, start_replay
+
+    cfg_kw: dict = {}
+    if args.watch_poll_s is not None:
+        cfg_kw["poll_s"] = args.watch_poll_s
+    if args.watch_debounce_s is not None:
+        cfg_kw["debounce_s"] = args.watch_debounce_s
+    cfg = WatchConfig(
+        max_updates=args.watch_max_updates,
+        figures=args.figures,
+        injector=args.injector,
+        **cfg_kw,
+    )
+    watcher = Watcher(
+        sweep_dir,
+        args.results_dir,
+        lambda: make_backend(args.graph_backend),
+        cfg,
+        conn=args.graph_db_conn,
+    )
+    q = watcher.subscribe()
+
+    def printer() -> None:
+        while True:
+            ev = q.get()
+            if ev.get("event") == "report_update":
+                print(
+                    f"watch update {ev['update']}: {ev['runs_total']} runs "
+                    f"(+{ev['new_runs']} new, {ev['runs_mapped']} mapped, "
+                    f"{ev['segments_cached']} segments cached), "
+                    f"{ev['changed_total']} sections changed, "
+                    f"{ev['update_latency_s']:.2f}s"
+                )
+            elif ev.get("event") == "watch_error":
+                print(f"watch cycle failed: {ev['detail']}", file=sys.stderr)
+
+    threading.Thread(target=printer, daemon=True, name="nemo-watch-print").start()
+
+    httpd = None
+    if args.serve:
+        import functools
+        import http.server
+
+        handler = functools.partial(
+            http.server.SimpleHTTPRequestHandler, directory=args.results_dir
+        )
+        httpd = http.server.ThreadingHTTPServer(("127.0.0.1", args.serve), handler)
+        threading.Thread(
+            target=httpd.serve_forever, daemon=True, name="nemo-watch-http"
+        ).start()
+        print(
+            f"Serving live reports at "
+            f"http://127.0.0.1:{httpd.server_address[1]}/ (Ctrl-C to stop)"
+        )
+
+    replay_stop = None
+    if args.replay:
+        _, replay_stop = start_replay(
+            args.replay,
+            sweep_dir,
+            generations=args.replay_generations,
+            interval_s=args.replay_interval_s,
+            injector=args.injector,
+        )
+    try:
+        watcher.run()
+    except KeyboardInterrupt:
+        watcher.stop()
+    finally:
+        if replay_stop is not None:
+            replay_stop.set()
+        if httpd is not None:
+            httpd.shutdown()
+        trace_path = obs_trace.finish()
+        if trace_path:
+            print(f"obs trace written to {trace_path} (open at ui.perfetto.dev)")
+    if watcher.report_dir:
+        print(
+            f"watch finished after {watcher.updates} updates; live report: "
+            f"{os.path.join(watcher.report_dir, 'index.html')}"
+        )
     return 0
 
 

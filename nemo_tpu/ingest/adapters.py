@@ -1,0 +1,519 @@
+"""Fault-injector ingest adapters — the injector-agnostic seam (ISSUE 15).
+
+The reference binary hard-wires one ``FaultInjector`` implementation
+(faultinjectors/molly.go); everything downstream of ``main.go:106`` only
+touches the interface surface (runs, iteration lists, failure spec,
+messages of failed runs).  This module reproduces that seam for the
+rebuild: a :class:`FaultInjector` adapter enumerates a sweep directory's
+runs and loads them into the SAME :class:`~nemo_tpu.ingest.molly.MollyOutput`
+product every downstream layer consumes — corpus store populate, delta
+analysis, result cache, streaming, synthesis, serving, fleet — so a new
+injector front end is ingest-only work, with no adapter-specific branches
+below this seam.
+
+Two implementations ship:
+
+  * :class:`MollyInjector` — the existing Molly loader
+    (ingest/molly.py:load_molly_output), now the seam's first
+    implementation.  ``native_capable``: the C++ packed-first ETL applies.
+  * :class:`TraceJsonInjector` — a generic trace-JSON / Jepsen-style-history
+    front end: ONE ``trace.json`` document per sweep instead of Molly's
+    per-run file fan-out, with message histories and neutral provenance
+    graphs (schema below).  Proves the seam: a non-Molly corpus flows
+    end-to-end (store, analysis, report, sidecar AnalyzeDir) unchanged.
+
+Selection: ``NEMO_INJECTOR`` / CLI ``--injector`` names an adapter
+(``molly``, ``trace-json``) or ``auto`` (default) — auto sniffs the
+directory layout (``runs.json`` -> molly, ``trace.json`` -> trace-json).
+
+The trace-JSON schema (``<dir>/trace.json``)::
+
+    {
+      "format": "nemo-trace-v1",
+      "name": "optional sweep name",
+      "spec": {"eot": 6, "eff": 4, "max_crashes": 1, "nodes": ["C","a","b"]},
+      "runs": [
+        {
+          "id": 0,
+          "outcome": "ok" | "violation",      # or an explicit "status"
+          "faults": {"omissions": [{"from":"a","to":"b","at":3}],
+                      "crashes":   [{"node":"a","at":3}]},
+          "history": [                         # Jepsen-style op log; only
+            {"op": "send", "table": "request", # send ops carry messages
+             "from": "C", "to": "a", "at": 1, "delivered_at": 2}, ...],
+          "holds": {"pre": [4,5,6], "post": [5,6]},  # invariant timesteps
+          "tables": {...},                     # optional raw model tables
+                                               # (verbatim; wins over holds)
+          "provenance": {
+            "pre":  {"nodes": [{"id":"n0","kind":"fact","table":"pre",
+                                "label":"pre(foo)","time":6},
+                               {"id":"n1","kind":"rule","table":"acked",
+                                "rule_type":"async", ...}, ...],
+                     "deps": [["n0","n1"], ...]},
+            "post": {...}
+          }
+        }, ...
+      ]
+    }
+
+Conversion rules: ``outcome: "ok"`` maps to the exact status ``"success"``
+(molly.go:52-57's partition rule); ``holds`` timestep lists become
+single-column model rows whose LAST column is the timestep (the holds-map
+keying contract, molly.go:38-48); provenance node ids are namespaced
+``run_<id>_{pre,post}_<origID>`` exactly like Molly's (molly.go:92-107).
+Trace sweeps carry no spacetime DOT files — the hazard figures render the
+:meth:`~nemo_tpu.ingest.molly.MollyOutput.spacetime_dot_text` fallback,
+synthesized deterministically from each run's message history and failure
+spec.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from nemo_tpu import obs
+from nemo_tpu.obs import log as _obs_log
+
+from .datatypes import (
+    CrashFailure,
+    Edge,
+    FailureSpec,
+    Goal,
+    Message,
+    MessageLoss,
+    Model,
+    ProvData,
+    Rule,
+    RunData,
+)
+from .molly import (
+    MollyOutput,
+    _namespace_prov,
+    attach_run_metadata,
+    load_molly_output,
+    quarantine_record,
+)
+
+_log = _obs_log.get_logger("nemo.ingest")
+
+TRACE_FILE = "trace.json"
+TRACE_FORMAT = "nemo-trace-v1"
+
+
+class FaultInjector:
+    """One fault-injector front end: how a sweep directory's runs are
+    enumerated and parsed into a :class:`MollyOutput`.
+
+    Subclasses define the class attributes and :meth:`load`; the classmethod
+    surface (:meth:`sniff`, :meth:`count_runs`, :meth:`poll_token`,
+    :meth:`materialize_prefix`) is what layout-aware tooling ABOVE the seam
+    — the live watcher's change detection and the replay driver — consults,
+    so those stay injector-agnostic too."""
+
+    #: Registry name (the ``--injector`` / ``NEMO_INJECTOR`` vocabulary).
+    name: str = ""
+    #: The file whose presence identifies the layout and whose stat cheaply
+    #: signals growth (the watcher's poll reads it, molly: runs.json).
+    index_file: str = ""
+    #: Whether the C++ packed-first ETL (ingest/native.py) can parse this
+    #: layout directly.  False routes the packed path through :meth:`load`
+    #: plus the store populate — the lib-less-host precedent.
+    native_capable: bool = False
+
+    @classmethod
+    def sniff(cls, corpus_dir: str) -> bool:
+        return os.path.isfile(os.path.join(corpus_dir, cls.index_file))
+
+    def load(self, corpus_dir: str, quarantine: bool | None = None) -> MollyOutput:
+        raise NotImplementedError
+
+    @classmethod
+    def count_runs(cls, corpus_dir: str) -> int:
+        """Cheap run count (index parse, no provenance) — watcher bookkeeping."""
+        raise NotImplementedError
+
+    @classmethod
+    def poll_token(cls, corpus_dir: str) -> tuple:
+        """Cheap change signature for the watcher's debounced poll: the dir
+        mtime plus the index file's (size, mtime).  Two equal tokens mean
+        "no new runs appeared and the index is settled"; any append — Molly
+        rewriting runs.json, a trace producer re-flushing trace.json —
+        moves it.  Never parses anything."""
+        try:
+            dir_m = os.stat(corpus_dir).st_mtime_ns
+        except OSError:
+            dir_m = -1
+        try:
+            st = os.stat(os.path.join(corpus_dir, cls.index_file))
+            idx = (st.st_size, st.st_mtime_ns)
+        except OSError:
+            idx = (-1, -1)
+        return (dir_m, *idx)
+
+    @classmethod
+    def materialize_prefix(cls, src_dir: str, dst_dir: str, n_runs: int) -> None:
+        """Materialize the first ``n_runs`` runs of a finished sweep at
+        ``src_dir`` into ``dst_dir``, monotonically (existing run content
+        untouched) — the replay driver's per-generation step."""
+        raise NotImplementedError
+
+
+class MollyInjector(FaultInjector):
+    """The Molly front end — the seam's first implementation, delegating to
+    the reference-parity loader (ingest/molly.py:load_molly_output, whose
+    invariants that module documents)."""
+
+    name = "molly"
+    index_file = "runs.json"
+    native_capable = True
+
+    def load(self, corpus_dir: str, quarantine: bool | None = None) -> MollyOutput:
+        return load_molly_output(corpus_dir, quarantine=quarantine)
+
+    @classmethod
+    def count_runs(cls, corpus_dir: str) -> int:
+        with open(os.path.join(corpus_dir, "runs.json"), encoding="utf-8") as fh:
+            return len(json.load(fh))
+
+    @classmethod
+    def materialize_prefix(cls, src_dir: str, dst_dir: str, n_runs: int) -> None:
+        from nemo_tpu.models.synth import grow_corpus_dir
+
+        grow_corpus_dir(src_dir, dst_dir, n_runs)
+
+
+def _trace_prov(graph: dict) -> ProvData:
+    """Neutral ``{"nodes": [...], "deps": [...]}`` graph -> ProvData.  Node
+    ids stay the producer's (namespacing happens afterwards, shared with
+    the Molly path); a dep naming an unknown node id is a schema violation
+    (quarantined per run by the caller)."""
+    nodes = graph.get("nodes") or []
+    deps = graph.get("deps") or []
+    prov = ProvData()
+    known: set[str] = set()
+    for n in nodes:
+        nid = str(n["id"])
+        known.add(nid)
+        kind = n.get("kind", "fact")
+        if kind == "rule":
+            prov.rules.append(
+                Rule(
+                    id=nid,
+                    label=n.get("label", n.get("table", "")),
+                    table=n.get("table", ""),
+                    type=n.get("rule_type", ""),
+                )
+            )
+        elif kind == "fact":
+            prov.goals.append(
+                Goal(
+                    id=nid,
+                    label=n.get("label", ""),
+                    table=n.get("table", ""),
+                    time=str(n.get("time", "")),
+                    sender=n.get("sender", ""),
+                    receiver=n.get("receiver", ""),
+                )
+            )
+        else:
+            raise ValueError(f"trace node {nid!r} has unknown kind {kind!r}")
+    for dep in deps:
+        src, dst = str(dep[0]), str(dep[1])
+        if src not in known or dst not in known:
+            raise ValueError(f"trace dep {dep!r} names an undeclared node")
+        prov.edges.append(Edge(src=src, dst=dst))
+    return prov
+
+
+def _holds_rows(holds) -> list[list[str]]:
+    """Trace ``holds`` entry -> model-table rows.  Timestep ints become
+    single-column rows; list entries pass through verbatim (full-fidelity
+    producers).  Either way the LAST column is the timestep string the
+    holds-map keying reads (molly.go:38-48)."""
+    rows = []
+    for h in holds or []:
+        rows.append([str(c) for c in h] if isinstance(h, (list, tuple)) else [str(h)])
+    return rows
+
+
+def _trace_run(spec: dict, raw: dict) -> RunData:
+    """One trace run entry -> RunData (provenance attached, un-namespaced)."""
+    iteration = int(raw["id"])
+    status = raw.get("status")
+    if status is None:
+        status = "success" if raw.get("outcome", "ok") == "ok" else "fail"
+    faults = raw.get("faults") or {}
+    fs = FailureSpec(
+        eot=int(spec.get("eot", 0)),
+        eff=int(spec.get("eff", 0)),
+        max_crashes=int(spec.get("max_crashes", 0)),
+        nodes=list(spec["nodes"]) if spec.get("nodes") is not None else None,
+        crashes=[
+            CrashFailure(node=c["node"], time=int(c["at"]))
+            for c in faults.get("crashes") or []
+        ],
+        omissions=[
+            MessageLoss(src=o["from"], dst=o["to"], time=int(o["at"]))
+            for o in faults.get("omissions") or []
+        ],
+    )
+    if raw.get("tables") is not None:
+        tables = {k: [list(r) for r in v] for k, v in raw["tables"].items()}
+    else:
+        holds = raw.get("holds") or {}
+        tables = {
+            "pre": _holds_rows(holds.get("pre")),
+            "post": _holds_rows(holds.get("post")),
+        }
+    messages = [
+        Message(
+            content=ev.get("table", ""),
+            send_node=ev.get("from", ""),
+            recv_node=ev.get("to", ""),
+            send_time=int(ev.get("at", 0)),
+            recv_time=int(ev.get("delivered_at", 0)),
+        )
+        for ev in raw.get("history") or []
+        if ev.get("op") == "send"
+    ]
+    run = RunData(
+        iteration=iteration,
+        status=status,
+        failure_spec=fs,
+        model=Model(tables=tables),
+        messages=messages,
+    )
+    prov = raw.get("provenance") or {}
+    for cond, attr in (("pre", "pre_prov"), ("post", "post_prov")):
+        p = _trace_prov(prov.get(cond) or {})
+        _namespace_prov(p, iteration, cond)
+        setattr(run, attr, p)
+    return run
+
+
+class TraceJsonInjector(FaultInjector):
+    """Generic trace-JSON / Jepsen-style-history front end (schema in the
+    module docstring): one ``trace.json`` document carries the whole sweep.
+    Per-run conversion failures quarantine exactly like the Molly loader's
+    per-run parse failures; the document itself failing to parse raises
+    (no per-run boundary to isolate, the runs.json precedent)."""
+
+    name = "trace-json"
+    index_file = TRACE_FILE
+
+    def load(self, corpus_dir: str, quarantine: bool | None = None) -> MollyOutput:
+        from nemo_tpu.utils.env import quarantine_enabled
+
+        if quarantine is None:
+            quarantine = quarantine_enabled()
+        doc = _read_trace(corpus_dir)
+        out = MollyOutput(
+            run_name=os.path.basename(os.path.normpath(corpus_dir)),
+            output_dir=corpus_dir,
+            # The trace layout ships no spacetime DOT files: hazard
+            # diagrams synthesize from each run's message history.
+            ships_spacetime_dots=False,
+        )
+        spec = doc.get("spec") or {}
+        for i, raw in enumerate(doc.get("runs") or []):
+            try:
+                run = _trace_run(spec, raw)
+            except Exception as ex:
+                if not quarantine:
+                    raise
+                rid = raw.get("id") if isinstance(raw, dict) else None
+                rec = quarantine_record(
+                    i, rid if isinstance(rid, int) else None, TRACE_FILE, ex
+                )
+                out.quarantined.append(rec)
+                obs.metrics.inc("ingest.quarantined")
+                _log.warning(
+                    "ingest.quarantined",
+                    corpus=corpus_dir,
+                    position=rec["position"],
+                    file=rec["file"],
+                    error=rec["error"],
+                )
+                continue
+            out.runs.append(run)
+            attach_run_metadata(out, run)
+        if not out.runs:
+            raise RuntimeError(
+                f"trace corpus {corpus_dir} has no loadable runs"
+                + (
+                    f" ({len(out.quarantined)} quarantined; first: "
+                    f"{out.quarantined[0]['error']})"
+                    if out.quarantined
+                    else ""
+                )
+            )
+        return out
+
+    @classmethod
+    def count_runs(cls, corpus_dir: str) -> int:
+        return len(_read_trace(corpus_dir).get("runs") or [])
+
+    @classmethod
+    def materialize_prefix(cls, src_dir: str, dst_dir: str, n_runs: int) -> None:
+        doc = _read_trace(src_dir)
+        doc["runs"] = (doc.get("runs") or [])[:n_runs]
+        os.makedirs(dst_dir, exist_ok=True)
+        with open(os.path.join(dst_dir, TRACE_FILE), "w", encoding="utf-8") as fh:
+            json.dump(doc, fh, indent=1)
+
+
+def _read_trace(corpus_dir: str) -> dict:
+    with open(os.path.join(corpus_dir, TRACE_FILE), encoding="utf-8") as fh:
+        doc = json.load(fh)
+    if not isinstance(doc, dict):
+        raise ValueError(f"{TRACE_FILE} must be a JSON object")
+    return doc
+
+
+#: Sniff order matters only for pathological dirs carrying BOTH index
+#: files; molly wins there (the richer layout).
+INJECTORS: dict[str, type[FaultInjector]] = {
+    MollyInjector.name: MollyInjector,
+    TraceJsonInjector.name: TraceJsonInjector,
+}
+
+
+def injector_arg(arg: str | None = None) -> str:
+    """Resolve the configured injector name: explicit ``arg`` (CLI) wins,
+    then ``NEMO_INJECTOR``, default ``auto``.  Loud on junk — an unknown
+    injector name silently degrading to auto-sniff would mask typos."""
+    val = (arg or os.environ.get("NEMO_INJECTOR") or "auto").strip().lower()
+    if val not in ("auto", *INJECTORS):
+        raise ValueError(
+            f"unknown injector {val!r} (expected auto, "
+            + ", ".join(INJECTORS)
+            + ")"
+        )
+    return val
+
+
+def resolve_injector(corpus_dir: str, arg: str | None = None) -> FaultInjector:
+    """The ingest seam's dispatch: an adapter instance for ``corpus_dir``.
+    ``auto`` sniffs the layout; an explicit name is trusted (its load will
+    fail loudly on a wrong layout).  Counted per resolution so the
+    telemetry shows which front ends fed the system."""
+    name = injector_arg(arg)
+    if name == "auto":
+        for cand in INJECTORS.values():
+            if cand.sniff(corpus_dir):
+                name = cand.name
+                break
+        else:
+            raise ValueError(
+                f"cannot sniff a fault-injector layout in {corpus_dir}: "
+                f"expected one of "
+                + ", ".join(
+                    f"{c.index_file} ({c.name})" for c in INJECTORS.values()
+                )
+                + "; pin one with --injector / NEMO_INJECTOR"
+            )
+    obs.metrics.inc(f"ingest.injector.{name}")
+    return INJECTORS[name]()
+
+
+def load_output(corpus_dir: str, arg: str | None = None) -> MollyOutput:
+    """Object-loader entry through the seam: resolve + load."""
+    return resolve_injector(corpus_dir, arg).load(corpus_dir)
+
+
+# ---------------------------------------------------------------------------
+# Molly -> trace-JSON conversion (test/benchmark fixture producer)
+# ---------------------------------------------------------------------------
+
+
+def _strip_ns(prov: ProvData, iteration: int, cond: str) -> dict:
+    """ProvData (namespaced) -> neutral trace graph dict, inverting the
+    load path's ``run_<iter>_<cond>_`` prefixing."""
+    prefix = f"run_{iteration}_{cond}_"
+
+    def bare(nid: str) -> str:
+        return nid[len(prefix):] if nid.startswith(prefix) else nid
+
+    nodes: list[dict] = []
+    for g in prov.goals:
+        n: dict = {"id": bare(g.id), "kind": "fact", "table": g.table,
+                   "label": g.label, "time": g.time}
+        if g.sender:
+            n["sender"] = g.sender
+        if g.receiver:
+            n["receiver"] = g.receiver
+        nodes.append(n)
+    for r in prov.rules:
+        n = {"id": bare(r.id), "kind": "rule", "table": r.table, "label": r.label}
+        if r.type:
+            n["rule_type"] = r.type
+        nodes.append(n)
+    return {
+        "nodes": nodes,
+        "deps": [[bare(e.src), bare(e.dst)] for e in prov.edges],
+    }
+
+
+def molly_to_trace(src_dir: str, dst_dir: str) -> str:
+    """Convert a Molly sweep directory into the trace-JSON layout — the
+    deterministic fixture producer the adapter round-trip tests and the
+    non-Molly end-to-end proofs feed on.  Lossless for the analysis
+    surface: statuses, failure specs, model tables (verbatim passthrough),
+    message histories, and provenance graphs (namespace-stripped) survive
+    the round trip bit-exactly; spacetime DOTs are dropped (the trace
+    layout has none — the hazard fallback resynthesizes them from the
+    messages, byte-identical for generator-produced corpora)."""
+    molly = load_molly_output(src_dir)
+    spec0 = molly.runs[0].failure_spec
+    runs = []
+    for run in molly.runs:
+        fs = run.failure_spec
+        entry: dict = {
+            "id": run.iteration,
+            "outcome": "ok" if run.succeeded else "violation",
+            "faults": {
+                "omissions": [
+                    {"from": o.src, "to": o.dst, "at": o.time}
+                    for o in (fs.omissions if fs else None) or []
+                ],
+                "crashes": [
+                    {"node": c.node, "at": c.time}
+                    for c in (fs.crashes if fs else None) or []
+                ],
+            },
+            "history": [
+                {
+                    "op": "send",
+                    "table": m.content,
+                    "from": m.send_node,
+                    "to": m.recv_node,
+                    "at": m.send_time,
+                    "delivered_at": m.recv_time,
+                }
+                for m in run.messages
+            ],
+            "tables": run.model.tables if run.model else {},
+            "provenance": {
+                "pre": _strip_ns(run.pre_prov, run.iteration, "pre"),
+                "post": _strip_ns(run.post_prov, run.iteration, "post"),
+            },
+        }
+        if run.status not in ("success", "fail"):
+            entry["status"] = run.status
+        runs.append(entry)
+    doc = {
+        "format": TRACE_FORMAT,
+        "name": molly.run_name,
+        "spec": {
+            "eot": spec0.eot if spec0 else 0,
+            "eff": spec0.eff if spec0 else 0,
+            "max_crashes": spec0.max_crashes if spec0 else 0,
+            "nodes": spec0.nodes if spec0 else [],
+        },
+        "runs": runs,
+    }
+    os.makedirs(dst_dir, exist_ok=True)
+    with open(os.path.join(dst_dir, TRACE_FILE), "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=1)
+    return dst_dir

@@ -87,6 +87,12 @@ class MollyOutput:
     #: the report's "Degraded runs" section (quarantine.json) and carried
     #: through the corpus store so warm loads reproduce the same set.
     quarantined: list[dict] = field(default_factory=list)
+    #: Whether this corpus's LAYOUT ships per-run spacetime DOT files
+    #: (Molly does; the trace-JSON adapter's doesn't — ingest/adapters.py
+    #: sets False).  Gates :meth:`spacetime_dot_text`'s synthesis: for a
+    #: DOT-shipping layout a MISSING file stays a loud error, never a
+    #: silently fabricated diagram.
+    ships_spacetime_dots: bool = True
 
     # -- FaultInjector getters (reference: faultinjectors/molly.go:166-201) --
 
@@ -112,6 +118,35 @@ class MollyOutput:
         """Path of Molly's space-time diagram for one run
         (reference: graphing/hazard-analysis.go:25)."""
         return os.path.join(self.output_dir, f"run_{iteration}_spacetime.dot")
+
+    def spacetime_dot_text(self, iteration: int, run=None) -> str:
+        """One run's space-time DOT text: the injector's on-disk diagram
+        when the layout ships one (Molly), else synthesized
+        deterministically from the run's message history and failure spec
+        (models/synth.py:build_spacetime_dot — the exact builder the
+        synthetic generators use, so generator-produced corpora round-trip
+        byte-identically).  The synthesis keeps non-Molly front ends
+        (ingest/adapters.py) figure-complete with no adapter-specific
+        branch below the ingest seam: every hazard consumer reads THIS.
+        Gated on ``ships_spacetime_dots`` — a Molly corpus with a
+        missing/deleted DOT file still raises FileNotFoundError loudly
+        instead of silently substituting a fabricated diagram.  ``run``
+        skips the by-iteration scan when the caller already holds the
+        RunData (the hazard loop does)."""
+        if getattr(self, "ships_spacetime_dots", True):
+            with open(self.spacetime_dot_path(iteration), "r", encoding="utf-8") as f:
+                return f.read()
+        from nemo_tpu.models.synth import build_spacetime_dot
+
+        if run is None:
+            run = next(r for r in self.runs if r.iteration == iteration)
+        fs = run.failure_spec
+        return build_spacetime_dot(
+            list(fs.nodes or []) if fs else [],
+            fs.eot if fs else 0,
+            [m.to_json() for m in run.messages],
+            crashes={c.node: c.time for c in (fs.crashes if fs else None) or []},
+        )
 
 
 def attach_run_metadata(out: MollyOutput, run, tables: dict | None = None) -> None:

@@ -225,15 +225,34 @@ class SynthSpec:
     # the reference relies on that (differential-provenance.go:22); set to
     # "fail" to exercise the rebuild's good-run selection guard.
     first_run_kind: str = "success"
+    # Adversarial graph family (ISSUE 15): "pb" is the standard
+    # primary/backup protocol above; the ADVERSARIAL_FAMILIES values warp
+    # it into the shapes that stress specific analysis machinery — see
+    # each family's note at adversarial_spec().
+    family: str = "pb"
+    # deep_chain: @next persistence-chain length (eot is raised to fit).
+    depth: int = 64
+    # wide_fanout: replica count (one post <- log branch per replica).
+    fanout: int = 16
+    # vocab_growth: fresh goal/rule tables EVERY run adds to the corpus
+    # vocabulary.
+    vocab_per_run: int = 6
 
 
 def _gen_run(spec: SynthSpec, rng: random.Random, i: int) -> tuple[dict, dict[str, Any]]:
     """Generate ONE run: (its runs.json entry, its three files).  Consumes
     the rng in a fixed order, so the streaming writer and the in-memory
     generator produce identical corpora for identical (seed, index)
-    sequences."""
+    sequences.  Adversarial families (spec.family) warp the protocol shape
+    but keep the exact Molly schema, so every downstream layer analyzes
+    them unchanged."""
     client, primary = "C", "a"
-    replicas = ["b", "c"]
+    if spec.family == "wide_fanout":
+        # One consequent goal fanning out to `fanout` log branches: the
+        # scatter/gather frontier kernels' widest single wave.
+        replicas = [f"r{k}" for k in range(max(2, spec.fanout))]
+    else:
+        replicas = ["b", "c"]
     nodes = [client, primary] + replicas
     payload = "foo"
 
@@ -251,8 +270,25 @@ def _gen_run(spec: SynthSpec, rng: random.Random, i: int) -> tuple[dict, dict[st
             kind = "success"
 
     eot = spec.eot
-    ack_time = rng.randint(3, max(3, eot - 2))
-    log_time = rng.randint(3, max(3, eot - 1))
+    if spec.family == "deep_chain":
+        # The collapseNextChains worst case at corpus scale: every run's
+        # pre/post chains span `depth` timesteps.
+        eot = max(eot, spec.depth + 3)
+    if spec.family == "near_dup":
+        # Near-duplicate runs: times pinned so consecutive runs differ in
+        # nothing but iteration (and one in four by a single timestep) —
+        # the render-dedup / result-cache aliasing stress.  The rng is
+        # still consumed (below) so the corpus prefix stays stable if the
+        # family is toggled.
+        _, _ = rng.randint(3, max(3, eot - 2)), rng.randint(3, max(3, eot - 1))
+        ack_time, log_time = 3, 4 + (1 if i % 4 == 3 else 0)
+    else:
+        ack_time = rng.randint(3, max(3, eot - 2))
+        log_time = rng.randint(3, max(3, eot - 1))
+    if spec.family == "deep_chain":
+        # Pin the chain bottoms low: the chains (eot -> ack/log time) then
+        # span ~depth steps regardless of the rng draw above.
+        ack_time, log_time = 3, 3
 
     omissions: list[dict[str, Any]] = []
     crashes: list[dict[str, Any]] = []
@@ -330,16 +366,71 @@ def _gen_run(spec: SynthSpec, rng: random.Random, i: int) -> tuple[dict, dict[st
         "model": {"tables": tables},
         "messages": messages,
     }
+    pre_prov = _build_pre_prov(pre_achieved, eot, ack_time, client, primary, payload)
+    post_prov = _build_post_prov(
+        logged, eot, log_time, post_achieved, primary, client, payload
+    )
+    if spec.family == "vocab_growth":
+        _grow_vocab(pre_prov, i, spec.vocab_per_run)
+    elif spec.family == "cycles":
+        _add_cycle(post_prov, i)
     files = {
-        f"run_{i}_pre_provenance.json": _build_pre_prov(
-            pre_achieved, eot, ack_time, client, primary, payload
-        ),
-        f"run_{i}_post_provenance.json": _build_post_prov(
-            logged, eot, log_time, post_achieved, primary, client, payload
-        ),
+        f"run_{i}_pre_provenance.json": pre_prov,
+        f"run_{i}_post_provenance.json": post_prov,
         f"run_{i}_spacetime.dot": _build_spacetime_dot(nodes, eot, messages),
     }
     return entry, files
+
+
+def _grow_vocab(prov: dict[str, Any], i: int, n: int) -> None:
+    """Pathological vocabulary growth (adversarial family): hang ``n``
+    goals with RUN-UNIQUE table/label/time strings off the graph's first
+    goal.  Every run then grows the corpus vocabularies linearly — the
+    stress for vocab interning, store vocab generations, and any
+    [T]-shaped kernel plane."""
+    base = prov["goals"][0]["id"] if prov["goals"] else None
+    for j in range(n):
+        g = {
+            "id": f"aux_g_{i}_{j}",
+            "label": f"aux_{i}_{j}(v{j}, t{i})",
+            "table": f"aux_{i}_{j}",
+            "time": str(10 + i),
+        }
+        r = {
+            "id": f"aux_r_{i}_{j}",
+            "label": f"aux_rule_{i}_{j}",
+            "table": f"aux_rule_{i}_{j}",
+            "type": "",
+        }
+        prov["goals"].append(g)
+        prov["rules"].append(r)
+        prov["edges"].append({"from": g["id"], "to": r["id"]})
+        if base is not None:
+            prov["edges"].append({"from": r["id"], "to": base})
+
+
+def _add_cycle(prov: dict[str, Any], i: int) -> None:
+    """Schema-valid provenance CYCLE (adversarial family): goal -> rule ->
+    goal -> rule -> back to the first goal, attached under the graph's
+    first rule when one exists.  Exercises every fix-point loop's
+    termination (the sparse-device diff's capped max-plus sweep, the host
+    relaxation, dense closure) — a depth-bounded wave that assumed a DAG
+    would spin or truncate here."""
+    anchor = prov["rules"][0]["id"] if prov["rules"] else None
+    g0 = {"id": f"cyc_g0_{i}", "label": f"cyc({i}, a)", "table": "cyc", "time": "2"}
+    g1 = {"id": f"cyc_g1_{i}", "label": f"cyc({i}, b)", "table": "cyc", "time": "3"}
+    r0 = {"id": f"cyc_r0_{i}", "label": "cyc_step", "table": "cyc_step", "type": ""}
+    r1 = {"id": f"cyc_r1_{i}", "label": "cyc_step", "table": "cyc_step", "type": ""}
+    prov["goals"] += [g0, g1]
+    prov["rules"] += [r0, r1]
+    prov["edges"] += [
+        {"from": g0["id"], "to": r0["id"]},
+        {"from": r0["id"], "to": g1["id"]},
+        {"from": g1["id"], "to": r1["id"]},
+        {"from": r1["id"], "to": g0["id"]},
+    ]
+    if anchor is not None:
+        prov["edges"].append({"from": anchor, "to": g0["id"]})
 
 
 def generate_corpus(spec: SynthSpec) -> dict[str, Any]:
@@ -496,6 +587,51 @@ def write_corpus_stream(
             log(f"  synth stream: {seg_end}/{spec.n_runs} runs written")
         i = seg_end
     return corpus_dir
+
+
+# ---------------------------------------------------------------------------
+# adversarial graph families (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+#: Named adversarial families — first-class bench tiers (bench.py
+#: `adversarial_tier`) and the workloads items 2/5's tuning targets.  Each
+#: stresses a specific subsystem; all keep the exact Molly schema, so the
+#: whole stack (store, delta, sparse kernels, synthesis, serving, watch)
+#: analyzes them unchanged.
+ADVERSARIAL_FAMILIES: tuple[str, ...] = (
+    "deep_chain",    # ~depth-step @next chains per run: chain collapse,
+                     # frontier-wave depth, giant-path routing
+    "wide_fanout",   # one consequent goal, `fanout` log branches: widest
+                     # single scatter/gather wave, edge-bucket blowup
+    "near_dup",      # isomorphic-run floods: render dedup, rcache
+                     # aliasing, figure-cache correctness under near-misses
+    "vocab_growth",  # run-unique tables/labels/times: vocab interning,
+                     # store vocab generations, [T]-plane growth
+    "cycles",        # schema-valid provenance cycles: every fix-point
+                     # loop's termination (no DAG assumption survives)
+)
+
+
+def adversarial_spec(
+    family: str, n_runs: int = 8, seed: int = 0, **overrides
+) -> SynthSpec:
+    """A ready-to-write SynthSpec for one adversarial family (plus "pb"
+    for the baseline).  Deterministic per (family, n_runs, seed) — the
+    generator-determinism tests pin exactly that."""
+    if family != "pb" and family not in ADVERSARIAL_FAMILIES:
+        raise ValueError(
+            f"unknown adversarial family {family!r} "
+            f"(expected pb, {', '.join(ADVERSARIAL_FAMILIES)})"
+        )
+    kw: dict[str, Any] = dict(
+        n_runs=n_runs, seed=seed, name=f"adv_{family}", family=family
+    )
+    if family == "deep_chain":
+        kw["depth"] = 64
+    elif family == "wide_fanout":
+        kw["fanout"] = 24
+    kw.update(overrides)
+    return SynthSpec(**kw)
 
 
 # The shared 10k-node giant-path stress scenario (VERDICT r3 task 7): a

@@ -259,11 +259,35 @@ async function telemetry() {
     body.append(telemetryTable("Result cache / delta analysis", rcacheRows));
   }
 
+  // Live watch loop (nemo_tpu/watch, ISSUE 15): when this report was
+  // (re)published by a --watch session, how many updates the loop has
+  // pushed, how many new runs it absorbed, and which injector front end
+  // fed the ingest seam (ingest/adapters.py).
+  const allGauges = (data.metrics || {}).gauges || {};
+  const watchRows = [];
+  if (allCounters["watch.updates"]) {
+    watchRows.push(["report updates published", allCounters["watch.updates"]]);
+    watchRows.push(["new runs absorbed", allCounters["watch.new_runs"] || 0]);
+    if (allGauges["watch.runs_total"] != null) {
+      watchRows.push(["runs in sweep", allGauges["watch.runs_total"]]);
+    }
+    if (allCounters["watch.cycle_failed"]) {
+      watchRows.push(["failed cycles (retried)", allCounters["watch.cycle_failed"]]);
+    }
+  }
+  for (const [k, v] of Object.entries(allCounters).sort()) {
+    if (k.startsWith("ingest.injector.")) {
+      watchRows.push([`ingest via ${k.slice("ingest.injector.".length)}`, v]);
+    }
+  }
+  if (watchRows.length) {
+    body.append(telemetryTable("Live watch / ingest adapters", watchRows));
+  }
+
   // Streamed analysis (analysis/stream.py, ISSUE 12): whether this run
   // streamed its segments through the double-buffered prefetch pipeline,
   // how often the accelerators stalled on ingest, and the bounded
   // working-set watermark the stream maintained.
-  const allGauges = (data.metrics || {}).gauges || {};
   const streamRows = [];
   if (allCounters["stream.segments_staged"]) {
     streamRows.push(["segments streamed", allCounters["stream.segments_staged"]]);

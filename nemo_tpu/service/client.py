@@ -385,7 +385,9 @@ class RemoteAnalyzer:
             obs.metrics.inc(f"rpc.analyze_dir_fleet.{fleet}")
         return codec.outputs_from_pb(resp)
 
-    def analyze_dir_stream(self, molly_dirs, corpus_cache=None, result_cache=None):
+    def analyze_dir_stream(
+        self, molly_dirs, corpus_cache=None, result_cache=None, watch=None
+    ):
         """Server-streaming corpus analysis (ISSUE 8): ship the directory
         PATHS; the sidecar analyzes them concurrently under its admission
         controller and pushes progress + per-family results as each
@@ -397,7 +399,16 @@ class RemoteAnalyzer:
         (with the admission queue position), ``admitted``, ``phase``,
         ``result`` (with ``rcache``/``coalesce`` statuses), per-family
         ``error`` (an admission rejection or failure of ONE directory —
-        the stream continues), and a terminal ``done``."""
+        the stream continues), and a terminal ``done``.
+
+        ``watch`` (ISSUE 15) switches the stream to LIVE mode: a dict of
+        watch options ({"results_root": <sidecar path>, "max_updates",
+        "poll_s", "debounce_s", "figures", "injector"}) attaches this
+        stream to a server-side watcher tailing the (single) directory
+        mid-sweep; events become ``watching`` / ``report_update`` /
+        ``watch_error`` / terminal ``done`` (server _watch_stream
+        docstring).  Live-mode streams never restart on UNAVAILABLE once
+        events flowed (same replay-safety rule as one-shot)."""
         import base64
         import os
 
@@ -408,6 +419,8 @@ class RemoteAnalyzer:
             req["corpus_cache"] = corpus_cache
         if result_cache is not None:
             req["result_cache"] = result_cache
+        if watch is not None:
+            req["watch"] = watch
         obs.metrics.inc("rpc.bytes_sent", len(_json.dumps(req).encode("utf-8")))
         md = self._request_metadata()
         # Same shared retry policy as the unary path (ISSUE 9): the JSON

@@ -757,6 +757,9 @@ class _Impl:
                     grpc.StatusCode.INVALID_ARGUMENT,
                     f"not a directory on the sidecar host: {d!r}",
                 )
+        if request.get("watch") is not None:
+            yield from self._watch_stream(request, dirs, context, t0)
+            return
         tenant = _tenant_of(context)
         col = _SpanCollection(context)
         events: _queue.Queue = _queue.Queue()
@@ -856,6 +859,143 @@ class _Impl:
         finally:
             for t in threads:
                 t.join(timeout=5.0)
+            self.admission.end_stream()
+            _rpc_observed("AnalyzeDirStream", t0, col.tid)
+            col.release()
+
+    def _watch_stream(self, request: dict, dirs: list, context, t0: float):
+        """Live watch mode of AnalyzeDirStream (ISSUE 15): instead of a
+        one-shot analysis the request attaches the stream to a live
+        :class:`~nemo_tpu.watch.watcher.Watcher` tailing ONE sweep
+        directory mid-sweep.  Request shape::
+
+            {"dirs": ["/sweep"], "watch": {"results_root": "/reports",
+             "max_updates": 0, "poll_s": 0.5, "debounce_s": 0.25,
+             "figures": "all", "injector": "auto"}}
+
+        Events: one ``{"event": "watching", ...}`` acknowledgement, then a
+        ``report_update`` per published update (ordinal, new/total runs,
+        O(new runs) evidence — runs mapped / segments cached / kernel
+        dispatch delta — and changed-section sha256 digests; the report
+        tree itself lives at ``results_root`` on the sidecar host, the
+        same trust model as the request's corpus paths), ``watch_error``
+        for a failed cycle (the watch continues), and a terminal ``done``
+        when ``max_updates`` is reached or the client goes away.
+
+        The session holds ONE admission slot for its whole lifetime (it
+        is one long-running analysis job occupying backend capacity) plus
+        stream presence, so a drain waits for the terminal event exactly
+        like the one-shot stream."""
+        import queue as _queue
+        import threading
+
+        wopts = request.get("watch")
+        if wopts is True:
+            wopts = {}
+        if not isinstance(wopts, dict):
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "watch must be a JSON object of watch options",
+            )
+        if len(dirs) != 1:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "watch mode takes exactly one directory",
+            )
+        results_root = wopts.get("results_root")
+        if not results_root or not isinstance(results_root, str):
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "watch mode needs a 'results_root' (sidecar-host path the "
+                "live report publishes under)",
+            )
+        d = dirs[0]
+        col = _SpanCollection(context)
+        ticket = self._admit(context, "AnalyzeDirStream")
+        self.admission.begin_stream()
+        watcher = None
+        th = None
+        try:
+            from nemo_tpu.backend.jax_backend import JaxBackend
+            from nemo_tpu.watch import Watcher, WatchConfig
+
+            cfg_kw = {
+                k: wopts[k]
+                for k in (
+                    "poll_s",
+                    "debounce_s",
+                    "figures",
+                    "injector",
+                    "initial_wait_s",
+                )
+                if wopts.get(k) is not None
+            }
+            cfg = WatchConfig(
+                max_updates=int(wopts.get("max_updates", 0) or 0), **cfg_kw
+            )
+            watcher = Watcher(d, results_root, JaxBackend, cfg)
+            q = watcher.subscribe()
+            crash: list[BaseException] = []
+
+            def _run_watcher() -> None:
+                try:
+                    watcher.run()
+                except BaseException as ex:  # surfaced to the client below
+                    crash.append(ex)
+
+            th = threading.Thread(
+                target=_run_watcher, daemon=True, name="nemo-serve-watch"
+            )
+            th.start()
+            obs.metrics.inc("serve.watch.sessions")
+            yield {
+                "event": "watching",
+                "dir": d,
+                "results_root": results_root,
+                "poll_s": cfg.poll_s,
+                "debounce_s": cfg.debounce_s,
+                "max_updates": cfg.max_updates,
+            }
+            updates = 0
+            while context.is_active():
+                try:
+                    ev = q.get(timeout=0.2)
+                except _queue.Empty:
+                    if not th.is_alive() and q.empty():
+                        break  # watcher finished (max_updates reached)
+                    continue
+                if ev.get("event") == "report_update":
+                    updates += 1
+                obs.metrics.inc("serve.stream.events")
+                yield ev
+            if context.is_active():
+                # A crashed watcher thread (setup-level failure — e.g. the
+                # sweep directory never became sniffable) must NOT read as
+                # a cleanly finished session: report it before the
+                # terminal marker.
+                if crash:
+                    ex = crash[0]
+                    obs.metrics.inc("serve.watch.failed")
+                    yield {
+                        "event": "watch_error",
+                        "dir": d,
+                        "detail": f"{type(ex).__name__}: {ex}",
+                        "fatal": True,
+                    }
+                yield {
+                    "event": "done",
+                    "dir": d,
+                    "updates": updates,
+                    "errors": 1 if crash else 0,
+                }
+        finally:
+            if watcher is not None:
+                watcher.stop()
+            if th is not None:
+                # The watcher may be mid-run_debug; it is a daemon thread
+                # and checks the stop flag at the next poll boundary.
+                th.join(timeout=5.0)
+            ticket.release()
             self.admission.end_stream()
             _rpc_observed("AnalyzeDirStream", t0, col.tid)
             col.release()

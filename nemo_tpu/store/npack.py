@@ -730,12 +730,22 @@ def classify_source(header: dict, corpus_dir: str) -> str:
         # enumerating names costs more than the whole mmap load).
         try:
             st = os.stat(corpus_dir)
-            rj = os.stat(os.path.join(corpus_dir, "runs.json"))
+            # Non-Molly layouts (ingest/adapters.py) have no runs.json at
+            # all: the stored snapshot recorded None, and the index file's
+            # freshness rides the `other` class fingerprint + stat sample
+            # like any regular file.  One appearing later bumps the dir
+            # mtime, so tier 0 falls through to the scan below.
+            rj = (
+                os.stat(os.path.join(corpus_dir, "runs.json"))
+                if src.get("runs_json") is not None
+                else None
+            )
         except OSError:
             return STALE
+        cur_rj = [rj.st_size, rj.st_mtime_ns] if rj is not None else None
         if (
             st.st_mtime_ns == src["dir_mtime_ns"]
-            and [rj.st_size, rj.st_mtime_ns] == src.get("runs_json")
+            and cur_rj == src.get("runs_json")
             and _sample_ok(corpus_dir, src.get("sample"))
         ):
             # An in-place repair of a quarantined file bumps neither the

@@ -231,6 +231,26 @@ class _RawRuns:
         return self._rows[i]
 
 
+class _HeadRuns:
+    """Raw-entry source for layouts WITHOUT a runs.json (the non-Molly
+    ingest adapters, ingest/adapters.py): the lazy metadata trio parses the
+    STORED head fragment instead — the same five canonical pairs
+    (iteration/status/failureSpec/model/messages) the populate serialized,
+    so the materialized objects equal the cold parse's.  Indexed by SOURCE
+    position like :class:`_RawRuns` (the proxy's contract); quarantine
+    stores map position -> stored row via ``positions``."""
+
+    def __init__(self, corpus, positions: list[int] | None) -> None:
+        self._corpus = corpus
+        self._row_of = (
+            {int(p): r for r, p in enumerate(positions)} if positions else None
+        )
+
+    def row(self, i: int) -> dict:
+        row = self._row_of[i] if self._row_of is not None else i
+        return json.loads(b"{" + self._corpus.run_head_json(row) + b"}")
+
+
 class _RawProxy:
     """dict-shaped view of one run's runs.json entry, parsed on demand."""
 
@@ -390,12 +410,24 @@ def molly_from_corpus(corpus, corpus_dir: str, positions: list[int] | None = Non
     from nemo_tpu.ingest.molly import MollyOutput
 
     StoreRunData = _store_run_cls()
+    runs_path = os.path.join(corpus_dir, "runs.json")
     out = MollyOutput(
         run_name=os.path.basename(os.path.normpath(corpus_dir)),
         output_dir=corpus_dir,
+        # Molly layouts (runs.json present) ship per-run spacetime DOTs the
+        # hazard loop reads from the source dir; other injector layouts
+        # synthesize them from message histories (ingest/molly.py).
+        ships_spacetime_dots=os.path.exists(runs_path),
     )
     expected_n = (max(positions) + 1) if positions else corpus.n_runs
-    raws = _RawRuns(os.path.join(corpus_dir, "runs.json"), expected_n)
+    # Molly layouts resolve the lazy trio from the source runs.json; other
+    # injector layouts (ingest/adapters.py) have none — theirs parses from
+    # the stored head fragments, which carry the same five fields.
+    raws = (
+        _RawRuns(runs_path, expected_n)
+        if os.path.exists(runs_path)
+        else _HeadRuns(corpus, positions)
+    )
     strings = corpus.strings
     # Every RunData default (future fields included), captured once from the
     # real constructor; mutable containers are copied per run below.

@@ -1224,8 +1224,13 @@ def fleet_smoke() -> int:
       * the router proxies AnalyzeDir with stable affinity (a repeat of
         the same corpus lands on the same replica, as an rcache hit) and
         its router.* series are live on /metrics;
-      * SIGTERM drains the whole fleet cleanly (router and both replicas
-        exit 0).
+      * router HA (ISSUE 15): a SECOND router sharing the same backend
+        list computes IDENTICAL affinity — the ring is a pure function of
+        the backend set, so N routers are stateless peers — proven by the
+        warm corpus served through router 2 hitting the same replica's
+        rcache with zero re-analyses;
+      * SIGTERM drains the whole fleet cleanly (both routers and both
+        replicas exit 0).
     """
     import importlib.util
     import signal
@@ -1482,13 +1487,65 @@ def fleet_smoke() -> int:
                         text = resp.read().decode("utf-8")
                     if "nemo_router_routed_AnalyzeDir" not in text:
                         problems.append("router /metrics missing router.routed series")
+
+                    # ---- 3b. Router HA (ISSUE 15): N routers sharing the
+                    # consistent-hash ring are stateless BY CONSTRUCTION —
+                    # boot a SECOND router over the same backends and
+                    # assert identical affinity: the same corpus through
+                    # router 2 lands on the SAME replica that analyzed it
+                    # via router 1 (an rcache hit there, zero analyses
+                    # anywhere).
+                    r2_port = free_port()
+                    router2 = boot(
+                        [
+                            "--router",
+                            "--port", str(r2_port),
+                            "--backends", ",".join(targets),
+                        ],
+                        dict(
+                            os.environ,
+                            NEMO_LOG_FILE=os.path.join(tmp, "router2_log.jsonl"),
+                        ),
+                        "router2",
+                    )
+                    wait_listening(r2_port, deadline_s=60.0, proc=router2)
+                    before2 = [replica_counters(t) for t in targets]
+                    with RemoteAnalyzer(target=f"127.0.0.1:{r2_port}") as c:
+                        c.wait_ready(60.0)
+                        c.analyze_dir_remote(solo_dir)
+                    after2 = [replica_counters(t) for t in targets]
+                    chunks2 = [
+                        int(a.get("serve.analyze_chunks", 0))
+                        - int(b.get("serve.analyze_chunks", 0))
+                        for a, b in zip(after2, before2)
+                    ]
+                    hits2 = [
+                        int(a.get("rcache.blob_analyze_dir_hit", 0))
+                        - int(b.get("rcache.blob_analyze_dir_hit", 0))
+                        for a, b in zip(after2, before2)
+                    ]
+                    if sum(chunks2) != 0:
+                        problems.append(
+                            f"second router re-analyzed an already-warm "
+                            f"corpus (affinity diverged): {chunks2}"
+                        )
+                    elif (
+                        solo_chunks.count(1) == 1
+                        and hits2[solo_chunks.index(1)] != 1
+                    ):
+                        problems.append(
+                            f"second router's request did not land on the "
+                            f"replica router 1 pinned (affinity not "
+                            f"identical): chunks1={solo_chunks} hits2={hits2}"
+                        )
                 except Exception as ex:
                     problems.append(f"router leg failed: {type(ex).__name__}: {ex}")
 
-                # ---- 4. Clean drain of the whole fleet.
+                # ---- 4. Clean drain of the whole fleet (router 2 included).
+                proc_names = ("replica0", "replica1", "router", "router2")
                 for p in procs:
                     p.send_signal(signal.SIGTERM)
-                for name, p in zip(("replica0", "replica1", "router"), procs):
+                for name, p in zip(proc_names, procs):
                     try:
                         rc = p.wait(timeout=60)
                     except subprocess.TimeoutExpired:
@@ -1499,7 +1556,7 @@ def fleet_smoke() -> int:
                     if rc != 0:
                         problems.append(f"{name} exited rc={rc} after SIGTERM")
             except Exception as ex:
-                for name in ("replica0", "replica1", "router"):
+                for name in ("replica0", "replica1", "router", "router2"):
                     path = os.path.join(tmp, f"{name}.stderr")
                     if os.path.exists(path):
                         with open(path, "r", encoding="utf-8") as fh:
@@ -1528,7 +1585,8 @@ def fleet_smoke() -> int:
                 "fleet ONE analysis (shared-tier leader lease), responses "
                 "byte-identical, the non-leader replica served the corpus "
                 "warm with zero dispatches, the router proxied with stable "
-                "affinity, and the whole fleet drained clean"
+                "affinity, a second router computed identical affinity "
+                "(stateless ring), and the whole fleet drained clean"
             )
             return 0
     finally:
@@ -2225,6 +2283,236 @@ def _synth_smoke_inner() -> int:
     return 0
 
 
+def watch_smoke() -> int:
+    """Live-watch smoke (`make watch-smoke`, also the tail of `make
+    validate`; ISSUE 15): the replay driver feeds a 3-generation sweep
+    into a LIVE watcher with one AnalyzeDirStream subscriber, asserting
+
+      * >= 3 `report_update` events arrive in generation order (run counts
+        strictly increasing);
+      * every update cycle is O(new runs): `runs_mapped` == the cycle's
+        new runs (zero re-dispatch of already-cached segments, whose
+        count grows 0 -> 1 -> 2 across the updates);
+      * the watcher's FINAL published report is byte-identical to a
+        post-hoc one-shot run of the full corpus;
+      * a mid-sweep TRUNCATED provenance file is quarantined (degraded
+        report, sweep continues) and picked up on repair via the store's
+        GROWN re-ingest, mapping ONLY the repaired run — no full
+        re-analysis.
+    """
+    import importlib.util
+
+    from nemo_tpu.utils.jax_config import pin_platform
+
+    pin_platform("cpu")
+    prior_knobs = {
+        k: os.environ.pop(k, None)
+        for k in (
+            "NEMO_STORE_VERIFY",
+            "NEMO_STORE_FINGERPRINT",
+            "NEMO_RESULT_CACHE",
+            "NEMO_RESULT_CACHE_MAX_GB",
+            "NEMO_WATCH_POLL_S",
+            "NEMO_WATCH_DEBOUNCE_S",
+            "NEMO_INJECTOR",
+        )
+    }
+    try:
+        return _watch_smoke_inner(importlib.util.find_spec("grpc") is not None)
+    finally:
+        for k, v in prior_knobs.items():
+            if v is not None:
+                os.environ[k] = v
+
+
+def _watch_smoke_inner(have_grpc: bool) -> int:
+    import shutil
+    import threading
+
+    from nemo_tpu.analysis.pipeline import run_debug
+    from nemo_tpu.backend.jax_backend import JaxBackend
+    from nemo_tpu.models.synth import SynthSpec, grow_corpus_dir, write_corpus
+    from nemo_tpu.watch import WatchConfig, Watcher, start_replay
+
+    problems: list[str] = []
+    with tempfile.TemporaryDirectory(prefix="nemo_watch_smoke_") as tmp:
+        os.environ["NEMO_SVG_CACHE"] = os.path.join(tmp, "svg_cache")
+        os.environ["NEMO_CORPUS_CACHE"] = os.path.join(tmp, "corpus_cache")
+        os.environ["NEMO_RESULT_CACHE"] = os.path.join(tmp, "result_cache")
+        full = write_corpus(
+            SynthSpec(n_runs=9, seed=11, name="sweep"), os.path.join(tmp, "full")
+        )
+        live_dir = os.path.join(tmp, "live", "sweep")
+        os.makedirs(live_dir)
+        wres = os.path.join(tmp, "wres")
+        watch_opts = {
+            "results_root": wres,
+            "max_updates": 3,
+            "poll_s": 0.1,
+            "debounce_s": 0.1,
+            "figures": "failed",
+        }
+
+        # ---- 1. Replay-driven live session with one AnalyzeDirStream
+        # subscriber.  grpc-less environments run the watcher in-process
+        # (the subscriber queue IS the event stream — same event payloads);
+        # with grpc the events flow through a real sidecar stream.
+        events: list[dict] = []
+        if have_grpc:
+            from nemo_tpu.service.client import RemoteAnalyzer
+            from nemo_tpu.service.server import make_server
+
+            server, port = make_server(port=0)
+            server.start()
+            try:
+                th, rstop = start_replay(
+                    full, live_dir, generations=3, interval_s=2.0
+                )
+                with RemoteAnalyzer(target=f"127.0.0.1:{port}") as c:
+                    for ev in c.analyze_dir_stream([live_dir], watch=watch_opts):
+                        events.append(ev)
+                rstop.set()
+            finally:
+                server.stop(None)
+        else:
+            print(
+                "watch-smoke: grpcio not installed; driving the watcher "
+                "in-process (the stream leg is skipped)",
+                file=sys.stderr,
+            )
+            w = Watcher(
+                live_dir,
+                wres,
+                JaxBackend,
+                WatchConfig(poll_s=0.1, debounce_s=0.1, max_updates=3,
+                            figures="failed"),
+            )
+            q = w.subscribe()
+            th, rstop = start_replay(full, live_dir, generations=3, interval_s=2.0)
+            w.run()
+            rstop.set()
+            while not q.empty():
+                events.append(q.get())
+
+        ups = [e for e in events if e.get("event") == "report_update"]
+        if len(ups) < 3:
+            problems.append(
+                f"expected >=3 report_update events, got {len(ups)} "
+                f"(events: {[e.get('event') for e in events]})"
+            )
+        else:
+            totals = [e["runs_total"] for e in ups]
+            if totals != sorted(totals) or len(set(totals)) != len(totals):
+                problems.append(
+                    f"updates not in generation order: runs_total={totals}"
+                )
+            if totals and totals[-1] != 9:
+                problems.append(
+                    f"final update covers {totals[-1]} runs, want 9"
+                )
+            for k, e in enumerate(ups):
+                if e["runs_mapped"] != e["new_runs"]:
+                    problems.append(
+                        f"update {k + 1} mapped {e['runs_mapped']} runs for "
+                        f"{e['new_runs']} new ones — cached segments were "
+                        "re-dispatched"
+                    )
+            cached = [e["segments_cached"] for e in ups[:3]]
+            if cached != [0, 1, 2]:
+                problems.append(
+                    f"cached-segment counts {cached} (want [0, 1, 2]: every "
+                    "already-analyzed segment must serve from the partial tier)"
+                )
+
+        # ---- 2. Final published report byte-identical to a post-hoc
+        # one-shot of the full corpus (fresh caches: full recompute).
+        live_report = os.path.join(wres, "sweep")
+        if not os.path.isdir(live_report):
+            problems.append(f"no live report published at {live_report}")
+        else:
+            one = run_debug(
+                live_dir,
+                os.path.join(tmp, "oneshot"),
+                JaxBackend(),
+                figures="failed",
+                report_name="sweep",
+                corpus_cache=os.path.join(tmp, "cc2"),
+                result_cache="off",
+            )
+            t_live, t_one = _tree(live_report), _tree(one.report_dir)
+            if t_live.keys() != t_one.keys():
+                problems.append(
+                    "live/post-hoc report file sets diverge: "
+                    f"{sorted(t_live.keys() ^ t_one.keys())[:5]}"
+                )
+            else:
+                bad = sorted(k for k in t_one if t_one[k] != t_live[k])
+                if bad:
+                    problems.append(
+                        f"final live report DIVERGES from the post-hoc "
+                        f"one-shot in {len(bad)} file(s), e.g. {bad[:5]}"
+                    )
+
+        # ---- 3. Mid-write quarantine -> repair-GROWN pickup, O(repair).
+        qsweep = os.path.join(tmp, "qsweep", "sweep")
+        grow_corpus_dir(full, qsweep, 4)
+        victim = os.path.join(qsweep, "run_3_post_provenance.json")
+        intact = open(victim, "rb").read()
+        with open(victim, "wb") as fh:
+            fh.write(intact[: len(intact) // 2])  # a half-written flush
+        w2 = Watcher(
+            qsweep,
+            os.path.join(tmp, "qres"),
+            JaxBackend,
+            WatchConfig(poll_s=0.1, debounce_s=0.1, max_updates=2,
+                        figures="none"),
+        )
+        q2 = w2.subscribe()
+        wt = threading.Thread(target=w2.run, daemon=True)
+        wt.start()
+        try:
+            ev1 = q2.get(timeout=60)
+            if ev1.get("quarantined") != 1 or ev1.get("runs_total") != 3:
+                problems.append(
+                    f"truncated run not quarantined: {ev1.get('quarantined')} "
+                    f"quarantined / {ev1.get('runs_total')} analyzed (want 1/3)"
+                )
+            with open(victim, "wb") as fh:  # the injector finishes the file
+                fh.write(intact)
+            ev2 = q2.get(timeout=60)
+            if ev2.get("runs_total") != 4 or ev2.get("quarantined") != 0:
+                problems.append(
+                    f"repaired run not picked up: runs_total="
+                    f"{ev2.get('runs_total')} quarantined={ev2.get('quarantined')}"
+                )
+            if ev2.get("runs_mapped") != 1:
+                problems.append(
+                    f"repair cycle mapped {ev2.get('runs_mapped')} runs "
+                    "(want 1: the repaired run only, not a re-analysis)"
+                )
+        except Exception as ex:
+            problems.append(
+                f"quarantine/repair leg failed: {type(ex).__name__}: {ex}"
+            )
+        finally:
+            w2.stop()
+            wt.join(timeout=30)
+        shutil.rmtree(os.path.join(tmp, "qres"), ignore_errors=True)
+
+    if problems:
+        print("watch-smoke: " + "; ".join(problems), file=sys.stderr)
+        return 1
+    print(
+        "watch-smoke: ok — 3 replay generations produced 3 in-order "
+        "report_update events, each cycle mapped only its new runs "
+        "(cached segments 0/1/2 served from the partial tier), the final "
+        "live report is byte-identical to the post-hoc one-shot, and a "
+        "mid-write truncated file was quarantined then picked up on "
+        "repair by mapping exactly 1 run"
+    )
+    return 0
+
+
 def main() -> int:
     from nemo_tpu.analysis.pipeline import run_debug
     from nemo_tpu.backend.jax_backend import JaxBackend
@@ -2434,7 +2722,15 @@ def main() -> int:
     # ISSUE 13): python/sparse/sparse_device repair trees byte-identical
     # with routes recorded, ranking permutation/stream-stable, batched
     # synthesis >=5x over the per-run oracle.
-    return synth_smoke()
+    rc = synth_smoke()
+    if rc:
+        return rc
+    # Live-watch contract (also standalone: make watch-smoke; ISSUE 15):
+    # a replayed 3-generation sweep produces >=3 in-order report_update
+    # events over AnalyzeDirStream, each cycle O(new runs), the final
+    # live report byte-identical to the post-hoc one-shot, and a
+    # truncated-then-repaired file quarantines and re-ingests alone.
+    return watch_smoke()
 
 
 if __name__ == "__main__":
@@ -2460,4 +2756,6 @@ if __name__ == "__main__":
         sys.exit(stream_smoke())
     if "--synth-smoke" in sys.argv:
         sys.exit(synth_smoke())
+    if "--watch-smoke" in sys.argv:
+        sys.exit(watch_smoke())
     sys.exit(main())

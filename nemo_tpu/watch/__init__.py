@@ -1,0 +1,20 @@
+"""Live sweep watcher (ISSUE 15): tail a fault-injection sweep directory
+WHILE the injector runs, incrementally re-analyzing and republishing the
+debug report on every batch of new runs.
+
+Composition of existing layers, no new analysis code: the corpus store's
+GROWN append (PR 5) absorbs each batch of new runs as a segment, the
+result cache's partial tier (PR 6) makes every update cycle O(new runs)
+— cached segments re-load with zero kernel dispatches — quarantine
+(PR 9) isolates the half-written files a live sweep inevitably produces
+(picked up on repair via the store's GROWN re-ingest), and subscribers
+receive ``report_update`` events over the serving tier's
+``AnalyzeDirStream`` (PR 8).
+
+Public surface: :class:`~nemo_tpu.watch.watcher.Watcher`,
+:class:`~nemo_tpu.watch.watcher.WatchConfig`, and the deterministic
+live-sweep simulator :func:`~nemo_tpu.watch.replay.replay_corpus`.
+"""
+
+from nemo_tpu.watch.watcher import WatchConfig, Watcher  # noqa: F401
+from nemo_tpu.watch.replay import replay_corpus, start_replay  # noqa: F401

@@ -1,0 +1,99 @@
+"""Replay driver: feed a FINISHED corpus into a live watcher at a
+configurable rate — the deterministic live-sweep simulator (ISSUE 15).
+
+A real model checker appends runs over minutes; tests, smokes and benches
+need that arrival pattern reproducibly in seconds.  ``replay_corpus``
+materializes an existing corpus into a destination directory in
+``generations`` monotonic prefixes (via the ingest adapter's
+``materialize_prefix`` — Molly's run-file fan-out and trace-JSON's single
+document both replay), sleeping ``interval_s`` between generations,
+exactly the way ``grow_corpus_dir`` simulates an incremental sweep for
+the delta smoke.  Pair with ``Watcher`` (CLI ``--watch --replay SRC``) or
+drive it standalone.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+
+from nemo_tpu.obs import log as _obs_log
+
+_log = _obs_log.get_logger("nemo.watch")
+
+
+def replay_plan(n_runs: int, generations: int) -> list[int]:
+    """Monotonic prefix sizes for ``generations`` even cuts of ``n_runs``
+    (last cut always the full corpus).  Fewer runs than generations
+    degrades to one-run steps."""
+    generations = max(1, min(generations, n_runs))
+    return [
+        max(1, math.ceil(n_runs * (g + 1) / generations))
+        for g in range(generations)
+    ]
+
+
+def replay_corpus(
+    src_dir: str,
+    dst_dir: str,
+    generations: int = 3,
+    interval_s: float = 1.0,
+    injector: str | None = None,
+    stop: threading.Event | None = None,
+) -> int:
+    """Replay ``src_dir`` into ``dst_dir`` in ``generations`` steps;
+    returns the number of generations written.  The FIRST generation is
+    written immediately (a watcher pointed at ``dst_dir`` starts from it);
+    each later one lands after ``interval_s``.  ``stop`` aborts between
+    generations."""
+    from nemo_tpu.ingest import adapters
+
+    inj = adapters.resolve_injector(src_dir, injector)
+    total = inj.count_runs(src_dir)
+    plan = replay_plan(total, generations)
+    os.makedirs(dst_dir, exist_ok=True)
+    _log.info(
+        "watch.replay_start",
+        src=src_dir,
+        dst=dst_dir,
+        runs=total,
+        generations=len(plan),
+        interval_s=interval_s,
+    )
+    written = 0
+    for g, n in enumerate(plan):
+        if stop is not None and stop.is_set():
+            break
+        if g:
+            if stop is not None:
+                if stop.wait(interval_s):
+                    break
+            else:
+                time.sleep(interval_s)
+        inj.materialize_prefix(src_dir, dst_dir, n)
+        written += 1
+        _log.info(
+            "watch.replay_generation", dst=dst_dir, generation=g + 1, runs=n
+        )
+    return written
+
+
+def start_replay(
+    src_dir: str,
+    dst_dir: str,
+    generations: int = 3,
+    interval_s: float = 1.0,
+    injector: str | None = None,
+) -> tuple[threading.Thread, threading.Event]:
+    """``replay_corpus`` on a daemon thread; returns (thread, stop event)."""
+    stop = threading.Event()
+    th = threading.Thread(
+        target=replay_corpus,
+        args=(src_dir, dst_dir, generations, interval_s, injector, stop),
+        daemon=True,
+        name="nemo-watch-replay",
+    )
+    th.start()
+    return th, stop

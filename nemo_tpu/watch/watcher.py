@@ -1,0 +1,377 @@
+"""The live watch loop: debounced change detection -> incremental
+re-analysis -> atomic report republish -> subscriber push.
+
+One :class:`Watcher` owns one sweep directory.  Each cycle:
+
+  1. **Detect** — the resolved ingest adapter's :meth:`poll_token`
+     (ingest/adapters.py: dir mtime + index-file stat; never parses) is
+     polled every ``poll_s``; a moved token arms the cycle.  Files named
+     by the previous cycle's quarantine records are statted too, so an
+     operator (or the injector finishing a half-written file) repairing a
+     quarantined run re-arms the loop even when the index is untouched.
+  2. **Debounce** — the token must hold still for ``debounce_s`` before
+     analysis starts, so a mid-flush index write settles; whatever is
+     still half-written after that lands in quarantine (PR 9) instead of
+     failing the cycle, and is re-ingested on repair via the store's
+     GROWN path.
+  3. **Analyze** — a standard :func:`~nemo_tpu.analysis.pipeline.run_debug`
+     into a staging generation directory.  With the corpus store and the
+     result cache enabled (both default-on) the store appends ONLY the
+     new runs as a GROWN segment and the partial tier serves every
+     already-mapped segment with zero kernel dispatches — per-update work
+     is O(new runs), asserted by the watch smoke via
+     ``delta.runs_mapped`` / ``kernel_dispatch_count`` deltas.
+  4. **Publish** — the live report name under ``results_root`` is a
+     SYMLINK flipped atomically (``os.replace`` of a fresh link) onto the
+     new generation directory; a reader mid-walk keeps the previous
+     generation, which is swept one flip later.
+  5. **Push** — every subscriber queue receives one ``report_update``
+     event: update ordinal, new/total run counts, the incrementality
+     evidence (runs mapped, segments cached, kernel-dispatch delta), and
+     the changed report sections as ``{relpath: sha256[:12]}`` digests.
+
+A SIGKILL'd watcher resumes for free: the next watcher (or any post-hoc
+run) consults the same content-addressed partials and maps only what the
+dead one never finished — the PR-9 crash-safe-resume contract.
+
+Observability: ``watch.updates`` / ``watch.new_runs`` /
+``watch.update_latency_s`` / ``watch.cycle_failed`` metrics and one
+``watch:cycle`` span per update, surfaced in the report's telemetry
+table.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import queue as _queue
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+
+from nemo_tpu import obs
+from nemo_tpu.obs import log as _obs_log
+
+_log = _obs_log.get_logger("nemo.watch")
+
+#: Cap on per-event changed-section listings: debugging.json plus a few
+#: figures is the common case; a first full-corpus update can touch
+#: thousands of files, and the event is a notification, not the payload.
+_MAX_CHANGED = 256
+
+
+@dataclass
+class WatchConfig:
+    """Watch-loop knobs.  Defaults resolve from env (the CLI/server pass
+    explicit values through): ``NEMO_WATCH_POLL_S`` (default 0.5),
+    ``NEMO_WATCH_DEBOUNCE_S`` (default 0.25), both warn-and-default on
+    junk (the serving-knob policy: a long-lived watcher must not crash-loop
+    on a typo'd env)."""
+
+    poll_s: float = None  # type: ignore[assignment]
+    debounce_s: float = None  # type: ignore[assignment]
+    #: Stop after this many published updates; 0 = run until stopped.
+    max_updates: int = 0
+    figures: str = "all"
+    #: Explicit injector name (``--injector``); None = auto-sniff.
+    injector: str | None = None
+    #: Give up waiting for the FIRST loadable corpus after this long.
+    initial_wait_s: float = 300.0
+    #: Extra kwargs forwarded to run_debug (corpus_cache/result_cache...).
+    run_debug_kwargs: dict = field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        from nemo_tpu.utils.env import env_float
+
+        if self.poll_s is None:
+            self.poll_s = env_float("NEMO_WATCH_POLL_S", 0.5, minimum=0.01)
+        if self.debounce_s is None:
+            self.debounce_s = env_float(
+                "NEMO_WATCH_DEBOUNCE_S", 0.25, minimum=0.0
+            )
+
+
+class Watcher:
+    """Tail one sweep directory; see the module docstring for the loop.
+
+    ``make_backend`` is called once per update cycle (the CLI precedent:
+    one GraphBackend instance per analysis; jit/compile caches are
+    process-global, so cycles stay warm).  Thread-safe subscriber fan-out:
+    any number of queues receive every event dict."""
+
+    def __init__(
+        self,
+        corpus_dir: str,
+        results_root: str,
+        make_backend,
+        config: WatchConfig | None = None,
+        conn: str = "",
+    ) -> None:
+        self.corpus_dir = os.path.abspath(corpus_dir)
+        self.results_root = os.path.abspath(results_root)
+        self.make_backend = make_backend
+        self.config = config or WatchConfig()
+        self.conn = conn
+        self.updates = 0
+        self.report_dir: str | None = None  # the live (symlink) path
+        self._stop = threading.Event()
+        self._subs: list[_queue.SimpleQueue] = []
+        self._subs_lock = threading.Lock()
+        self._digests: dict[str, str] = {}
+        self._runs_total = 0
+        self._gen_dirs: list[str] = []  # generation ROOTS, oldest first
+        self._quarantine_files: list[str] = []
+
+    # ------------------------------------------------------------ subscribe
+
+    def subscribe(self) -> _queue.SimpleQueue:
+        q: _queue.SimpleQueue = _queue.SimpleQueue()
+        with self._subs_lock:
+            self._subs.append(q)
+        return q
+
+    def unsubscribe(self, q) -> None:
+        with self._subs_lock:
+            if q in self._subs:
+                self._subs.remove(q)
+
+    def _push(self, event: dict) -> None:
+        with self._subs_lock:
+            subs = list(self._subs)
+        for q in subs:
+            q.put(event)
+
+    # ----------------------------------------------------------------- loop
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _injector(self):
+        from nemo_tpu.ingest import adapters
+
+        return adapters.resolve_injector(self.corpus_dir, self.config.injector)
+
+    def _qstats(self) -> tuple:
+        """Stats of every file the last cycle quarantined — the repair
+        tripwire component of the poll token."""
+        qstats = []
+        for path in self._quarantine_files:
+            try:
+                st = os.stat(path)
+                qstats.append((path, st.st_size, st.st_mtime_ns))
+            except OSError:
+                qstats.append((path, -1, -1))
+        return tuple(qstats)
+
+    def _token(self, injector) -> tuple:
+        """Change signature: the adapter's poll token plus the stats of
+        every file the last cycle quarantined (a repair must re-arm the
+        loop even though the index is untouched).  The quarantine stats
+        are always the LAST component (the post-cycle refresh in `run`
+        replaces exactly that slot)."""
+        return (*injector.poll_token(self.corpus_dir), self._qstats())
+
+    def run(self) -> int:
+        """Run the watch loop until stopped or ``max_updates`` published;
+        returns the number of updates.  Raises only on setup-level
+        failures (unsniffable directory past ``initial_wait_s``); per-cycle
+        analysis failures are counted (``watch.cycle_failed``), logged,
+        pushed as ``watch_error`` events, and retried on the next change."""
+        from nemo_tpu.ingest import adapters
+
+        cfg = self.config
+        # Config errors fail FAST: an unknown --injector/NEMO_INJECTOR name
+        # raises here, before the retry loop — only "the sweep directory has
+        # no index yet" is worth waiting out below.
+        adapters.injector_arg(cfg.injector)
+        deadline = time.monotonic() + cfg.initial_wait_s
+        injector = None
+        while injector is None and not self._stop.is_set():
+            try:
+                injector = self._injector()
+            except ValueError:
+                # The sweep directory may not have its index yet (a watcher
+                # started BEFORE the model checker's first flush).
+                if time.monotonic() > deadline:
+                    raise
+                self._stop.wait(cfg.poll_s)
+        if injector is None:
+            return self.updates
+        _log.info(
+            "watch.start",
+            corpus=self.corpus_dir,
+            injector=injector.name,
+            poll_s=cfg.poll_s,
+            debounce_s=cfg.debounce_s,
+        )
+        last = None  # token of the last ANALYZED state
+        while not self._stop.is_set():
+            token = self._token(injector)
+            if token == last:
+                if cfg.max_updates and self.updates >= cfg.max_updates:
+                    break
+                self._stop.wait(cfg.poll_s)
+                continue
+            # Debounce: hold still for debounce_s before analyzing.
+            while not self._stop.is_set():
+                self._stop.wait(cfg.debounce_s)
+                settled = self._token(injector)
+                if settled == token:
+                    break
+                token = settled
+            if self._stop.is_set():
+                break
+            try:
+                self._cycle(injector, token)
+            except Exception as ex:
+                obs.metrics.inc("watch.cycle_failed")
+                _log.warning(
+                    "watch.cycle_failed",
+                    corpus=self.corpus_dir,
+                    error=f"{type(ex).__name__}: {ex}",
+                )
+                self._push(
+                    {
+                        "event": "watch_error",
+                        "dir": self.corpus_dir,
+                        "detail": f"{type(ex).__name__}: {ex}",
+                    }
+                )
+                # Do NOT record the token: the next poll retries this state
+                # (typically a mid-write index that settles shortly).
+                self._stop.wait(cfg.poll_s)
+                continue
+            # Record the PRE-cycle adapter token (an index write landing
+            # while the analysis ran must trigger another cycle) but the
+            # POST-cycle quarantine stats — `_cycle` just redefined the
+            # quarantine watch list, and comparing the fresh list against
+            # the pre-cycle snapshot would read as a change and spin a
+            # spurious duplicate cycle.  (A repair landing inside the
+            # analysis window itself is picked up with the sweep's next
+            # index append — the store's pre-parse fingerprints guarantee
+            # it can never be served stale.)
+            last = (*token[:-1], self._qstats())
+            if cfg.max_updates and self.updates >= cfg.max_updates:
+                break
+        _log.info("watch.stop", corpus=self.corpus_dir, updates=self.updates)
+        return self.updates
+
+    # ---------------------------------------------------------------- cycle
+
+    def _cycle(self, injector, token) -> None:
+        from nemo_tpu.analysis.delta import kernel_dispatch_count
+        from nemo_tpu.analysis.pipeline import report_tree_bytes, run_debug
+
+        cfg = self.config
+        name = os.path.basename(os.path.normpath(self.corpus_dir))
+        gen = os.path.join(
+            self.results_root, ".watch", f"{name}-gen-{self.updates:06d}-{uuid.uuid4().hex[:6]}"
+        )
+        t0 = time.perf_counter()
+        before = obs.metrics.snapshot()["counters"]
+        with obs.span(
+            "watch:cycle", dir=name, update=self.updates, injector=injector.name
+        ):
+            result = run_debug(
+                self.corpus_dir,
+                gen,
+                self.make_backend(),
+                conn=self.conn,
+                figures=cfg.figures,
+                report_name=name,
+                **cfg.run_debug_kwargs,
+            )
+        after = obs.metrics.snapshot()["counters"]
+        latency = time.perf_counter() - t0
+
+        molly = result.molly
+        runs_total = len(molly.runs)
+        quarantined = list(getattr(molly, "quarantined", None) or ())
+        self._quarantine_files = [
+            os.path.join(self.corpus_dir, rec["file"])
+            for rec in quarantined
+            if rec.get("file") and rec["file"] != injector.index_file
+        ]
+        new_runs = max(0, runs_total - self._runs_total)
+        self._runs_total = runs_total
+
+        # Incrementality evidence (the smoke's O(new runs) assertion).
+        def delta_of(key: str) -> int:
+            return int(after.get(key, 0)) - int(before.get(key, 0))
+
+        runs_mapped = delta_of("delta.runs_mapped")
+        segments_cached = delta_of("delta.segments_cached")
+        dispatches = kernel_dispatch_count(after) - kernel_dispatch_count(before)
+
+        # Changed-section digests against the previously published tree.
+        tree = report_tree_bytes(result.report_dir)
+        digests = {
+            p: hashlib.sha256(b).hexdigest()[:12] for p, b in tree.items()
+        }
+        changed = sorted(
+            p for p, h in digests.items() if self._digests.get(p) != h
+        )
+        removed = sorted(p for p in self._digests if p not in digests)
+        self._digests = digests
+
+        live = self._publish(result.report_dir, gen, name)
+        self.updates += 1
+        obs.metrics.inc("watch.updates")
+        obs.metrics.inc("watch.new_runs", new_runs)
+        obs.metrics.observe("watch.update_latency_s", latency)
+        obs.metrics.gauge("watch.runs_total", runs_total)
+        event = {
+            "event": "report_update",
+            "dir": self.corpus_dir,
+            "update": self.updates,
+            "runs_total": runs_total,
+            "new_runs": new_runs,
+            "quarantined": len(quarantined),
+            "runs_mapped": runs_mapped,
+            "segments_cached": segments_cached,
+            "kernel_dispatches": dispatches,
+            "update_latency_s": round(latency, 4),
+            "report_dir": live,
+            "changed_total": len(changed),
+            "removed": removed[:_MAX_CHANGED],
+            "sections": {p: digests[p] for p in changed[:_MAX_CHANGED]},
+        }
+        _log.info(
+            "watch.update",
+            corpus=self.corpus_dir,
+            update=self.updates,
+            runs_total=runs_total,
+            new_runs=new_runs,
+            runs_mapped=runs_mapped,
+            dispatches=dispatches,
+            changed=len(changed),
+            seconds=round(latency, 3),
+        )
+        self._push(event)
+
+    def _publish(self, gen_report_dir: str, gen_root: str, name: str) -> str:
+        """Atomically point ``results_root/<name>`` at the new generation:
+        a fresh symlink ``os.replace``d over the live name (atomic on
+        POSIX).  The PREVIOUS generation directory survives one more flip
+        for readers mid-walk; older ones are swept.  A pre-existing REAL
+        directory under the live name (an earlier one-shot run) is rotated
+        aside once, loudly."""
+        import shutil
+
+        live = os.path.join(self.results_root, name)
+        os.makedirs(self.results_root, exist_ok=True)
+        if os.path.lexists(live) and not os.path.islink(live):
+            aside = f"{live}.pre-watch-{uuid.uuid4().hex[:6]}"
+            os.rename(live, aside)
+            _log.warning(
+                "watch.rotated_existing_report", report=live, moved_to=aside
+            )
+        tmp_link = f"{live}.link-{uuid.uuid4().hex[:6]}"
+        os.symlink(gen_report_dir, tmp_link)
+        os.replace(tmp_link, live)
+        self._gen_dirs.append(gen_root)
+        while len(self._gen_dirs) > 2:
+            shutil.rmtree(self._gen_dirs.pop(0), ignore_errors=True)
+        self.report_dir = live
+        return live

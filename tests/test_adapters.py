@@ -1,0 +1,221 @@
+"""Fault-injector ingest-adapter seam tests (ISSUE 15, ingest/adapters.py).
+
+Adapter parity: the Molly loader THROUGH the seam must equal the direct
+loader across every case-study family; the trace-JSON adapter must
+round-trip a converted corpus bit-exactly on the analysis surface and flow
+end-to-end (store populate, analysis, report, sidecar AnalyzeDir) with no
+adapter-specific branches below the seam.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from nemo_tpu.ingest import adapters
+from nemo_tpu.ingest.molly import load_molly_output
+from nemo_tpu.models.case_studies import CASE_STUDIES, write_case_study
+from nemo_tpu.models.synth import SynthSpec, write_corpus
+
+
+def _run_surface(molly) -> list:
+    """The analysis-facing content of every run, JSON-normalized."""
+    return [
+        {
+            **r.to_json(),
+            "preProv": r.pre_prov.to_json() if r.pre_prov else None,
+            "postProv": r.post_prov.to_json() if r.post_prov else None,
+            "timePreHolds": r.time_pre_holds,
+            "timePostHolds": r.time_post_holds,
+        }
+        for r in molly.runs
+    ]
+
+
+# ------------------------------------------------------------ molly adapter
+
+
+@pytest.mark.parametrize("name", sorted(CASE_STUDIES))
+def test_molly_adapter_matches_direct_loader(name, tmp_path):
+    """MollyInjector (the seam's first implementation) is byte-identical
+    to the direct loader across all six case-study families."""
+    d = write_case_study(name, n_runs=6, seed=7, out_dir=str(tmp_path))
+    direct = load_molly_output(d)
+    via = adapters.MollyInjector().load(d)
+    assert _run_surface(via) == _run_surface(direct)
+    assert via.runs_iters == direct.runs_iters
+    assert via.success_runs_iters == direct.success_runs_iters
+    assert via.failed_runs_iters == direct.failed_runs_iters
+    assert via.quarantined == direct.quarantined
+
+
+def test_molly_adapter_sniff_and_count(corpus_dir):
+    assert adapters.MollyInjector.sniff(corpus_dir)
+    assert not adapters.TraceJsonInjector.sniff(corpus_dir)
+    assert adapters.MollyInjector.count_runs(corpus_dir) == 8
+    inj = adapters.resolve_injector(corpus_dir)
+    assert inj.name == "molly"
+
+
+# ------------------------------------------------------- trace-json adapter
+
+
+def test_trace_roundtrip_run_surface(tmp_path):
+    """molly_to_trace -> TraceJsonInjector.load reproduces every run's
+    analysis surface bit-exactly (statuses, specs, tables, messages,
+    namespaced provenance, holds maps)."""
+    src = write_corpus(SynthSpec(n_runs=8, seed=2, eot=6), str(tmp_path))
+    td = adapters.molly_to_trace(src, str(tmp_path / "trace"))
+    direct = load_molly_output(src)
+    via = adapters.load_output(td)
+    assert _run_surface(via) == _run_surface(direct)
+    assert via.failed_runs_iters == direct.failed_runs_iters
+
+
+@pytest.mark.parametrize("name", sorted(CASE_STUDIES))
+def test_trace_roundtrip_case_studies(name, tmp_path):
+    d = write_case_study(name, n_runs=5, seed=3, out_dir=str(tmp_path))
+    td = adapters.molly_to_trace(d, str(tmp_path / "trace"))
+    assert _run_surface(adapters.load_output(td)) == _run_surface(
+        load_molly_output(d)
+    )
+
+
+def test_trace_sniff_resolution_and_env(tmp_path, monkeypatch):
+    src = write_corpus(SynthSpec(n_runs=3, seed=1), str(tmp_path))
+    td = adapters.molly_to_trace(src, str(tmp_path / "trace"))
+    assert adapters.resolve_injector(td).name == "trace-json"
+    # Explicit pin wins over sniffing; junk is loud.
+    assert adapters.resolve_injector(td, "trace-json").name == "trace-json"
+    monkeypatch.setenv("NEMO_INJECTOR", "trace-json")
+    assert adapters.resolve_injector(td).name == "trace-json"
+    monkeypatch.setenv("NEMO_INJECTOR", "jepsen2000")
+    with pytest.raises(ValueError, match="unknown injector"):
+        adapters.resolve_injector(td)
+
+
+def test_unsniffable_directory_is_loud(tmp_path):
+    (tmp_path / "README").write_text("not a sweep")
+    with pytest.raises(ValueError, match="cannot sniff"):
+        adapters.resolve_injector(str(tmp_path))
+
+
+def test_trace_quarantine_isolates_bad_runs(tmp_path):
+    src = write_corpus(SynthSpec(n_runs=4, seed=5), str(tmp_path))
+    td = adapters.molly_to_trace(src, str(tmp_path / "trace"))
+    doc = json.load(open(os.path.join(td, "trace.json")))
+    doc["runs"][2]["provenance"]["pre"]["deps"].append(["nope", "alsono"])
+    json.dump(doc, open(os.path.join(td, "trace.json"), "w"))
+    out = adapters.load_output(td)
+    assert len(out.runs) == 3
+    assert len(out.quarantined) == 1
+    rec = out.quarantined[0]
+    assert rec["position"] == 2 and rec["file"] == "trace.json"
+    # quarantine off -> fail fast
+    with pytest.raises(ValueError):
+        adapters.TraceJsonInjector().load(td, quarantine=False)
+    # every run bad -> still raises
+    for r in doc["runs"]:
+        r.pop("id")
+    json.dump(doc, open(os.path.join(td, "trace.json"), "w"))
+    with pytest.raises(RuntimeError, match="no loadable runs"):
+        adapters.load_output(td)
+
+
+def test_trace_materialize_prefix_monotonic(tmp_path):
+    src = write_corpus(SynthSpec(n_runs=6, seed=9), str(tmp_path))
+    td = adapters.molly_to_trace(src, str(tmp_path / "trace"))
+    dst = str(tmp_path / "replay")
+    adapters.TraceJsonInjector.materialize_prefix(td, dst, 2)
+    assert adapters.TraceJsonInjector.count_runs(dst) == 2
+    tok1 = adapters.TraceJsonInjector.poll_token(dst)
+    adapters.TraceJsonInjector.materialize_prefix(td, dst, 6)
+    assert adapters.TraceJsonInjector.count_runs(dst) == 6
+    assert adapters.TraceJsonInjector.poll_token(dst) != tok1
+    assert _run_surface(adapters.load_output(dst)) == _run_surface(
+        adapters.load_output(td)
+    )
+
+
+def test_spacetime_fallback_matches_generated_dot(tmp_path):
+    """The synthesized spacetime DOT (no on-disk file) is byte-identical
+    to the generator-written one — the trace layout's hazard figures
+    therefore byte-match the Molly original's."""
+    src = write_corpus(SynthSpec(n_runs=4, seed=2), str(tmp_path))
+    td = adapters.molly_to_trace(src, str(tmp_path / "trace"))
+    mm, tm = load_molly_output(src), adapters.load_output(td)
+    for r in mm.runs:
+        assert tm.spacetime_dot_text(r.iteration) == mm.spacetime_dot_text(
+            r.iteration
+        )
+
+
+# --------------------------------------------------- end-to-end (no branches)
+
+
+def test_trace_report_byte_parity_python(tmp_path):
+    """Full report tree (figures included) byte-identical: trace corpus vs
+    the Molly original, same backend — no adapter-specific content below
+    the seam."""
+    from nemo_tpu.analysis.pipeline import report_tree_bytes, run_debug
+    from nemo_tpu.backend.python_ref import PythonBackend
+
+    src = write_corpus(SynthSpec(n_runs=6, seed=7), str(tmp_path / "m"))
+    td = adapters.molly_to_trace(src, str(tmp_path / "t"))
+    rm = run_debug(src, str(tmp_path / "rm"), PythonBackend(), report_name="r")
+    rt = run_debug(td, str(tmp_path / "rt"), PythonBackend(), report_name="r")
+    assert report_tree_bytes(rm.report_dir) == report_tree_bytes(rt.report_dir)
+
+
+def test_trace_store_populate_and_warm_hit(tmp_path):
+    """Trace corpora flow through the SAME store-populate path: cold run
+    populates, warm run serves a store HIT (head-fragment-backed lazy
+    trio, no runs.json anywhere), reports byte-identical."""
+    from nemo_tpu import obs
+    from nemo_tpu.analysis.pipeline import report_tree_bytes, run_debug
+    from nemo_tpu.backend.jax_backend import JaxBackend
+
+    src = write_corpus(SynthSpec(n_runs=5, seed=4), str(tmp_path / "m"))
+    td = adapters.molly_to_trace(src, str(tmp_path / "t"))
+    cc = str(tmp_path / "cc")
+    r1 = run_debug(
+        td, str(tmp_path / "r1"), JaxBackend(), report_name="r",
+        corpus_cache=cc, result_cache="off",
+    )
+    m0 = obs.metrics.snapshot()
+    r2 = run_debug(
+        td, str(tmp_path / "r2"), JaxBackend(), report_name="r",
+        corpus_cache=cc, result_cache="off",
+    )
+    md = obs.Metrics.delta(obs.metrics.snapshot(), m0)["counters"]
+    assert md.get("store.hit") == 1 and not md.get("store.stale")
+    assert report_tree_bytes(r1.report_dir) == report_tree_bytes(r2.report_dir)
+    # The lazy metadata trio materializes from stored head fragments.
+    assert r2.molly.get_failure_spec().eot == 6
+    assert len(r2.molly.get_msgs_failed_runs()) == len(
+        r2.molly.failed_runs_iters
+    )
+
+
+def test_trace_analyze_dir_via_sidecar(tmp_path, sidecar, monkeypatch):
+    """A non-Molly corpus served end-to-end by the sidecar's AnalyzeDir —
+    the handler's ingest rides pipeline._ingest, which resolves the
+    adapter; response equals the Molly original's analysis arrays."""
+    pytest.importorskip("grpc")
+    import numpy as np
+
+    from nemo_tpu.service.client import RemoteAnalyzer
+
+    monkeypatch.setenv("NEMO_CORPUS_CACHE", str(tmp_path / "cc"))
+    src = write_corpus(SynthSpec(n_runs=4, seed=6), str(tmp_path / "m"))
+    td = adapters.molly_to_trace(src, str(tmp_path / "t"))
+    with RemoteAnalyzer(target=sidecar) as c:
+        out_m = c.analyze_dir_remote(src)
+        out_t = c.analyze_dir_remote(td)
+    assert sorted(out_m) == sorted(out_t)
+    for k in out_m:
+        np.testing.assert_array_equal(
+            np.asarray(out_m[k]), np.asarray(out_t[k]), err_msg=k
+        )

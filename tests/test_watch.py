@@ -1,0 +1,434 @@
+"""Live watcher tests (ISSUE 15, nemo_tpu/watch) + adversarial-family
+generator determinism.
+
+Timing-sensitive tests use generous settle margins: the watcher's poll
+and debounce are set to tens of milliseconds and the assertions are about
+COUNTS (updates published, runs mapped), not wall clocks.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from nemo_tpu.backend.jax_backend import JaxBackend
+from nemo_tpu.models.synth import (
+    ADVERSARIAL_FAMILIES,
+    SynthSpec,
+    adversarial_spec,
+    generate_corpus,
+    grow_corpus_dir,
+    write_corpus,
+    write_corpus_stream,
+)
+from nemo_tpu.watch import WatchConfig, Watcher
+from nemo_tpu.watch.replay import replay_corpus, replay_plan
+
+
+def _watch(tmp_path, dst, max_updates, figures="none", **cfg_kw):
+    cfg = WatchConfig(
+        poll_s=0.05, debounce_s=0.05, max_updates=max_updates,
+        figures=figures, **cfg_kw,
+    )
+    cfg.run_debug_kwargs.setdefault("corpus_cache", str(tmp_path / "cc"))
+    cfg.run_debug_kwargs.setdefault("result_cache", str(tmp_path / "rc"))
+    w = Watcher(str(dst), str(tmp_path / "wres"), JaxBackend, cfg)
+    return w, w.subscribe()
+
+
+def _drain(q):
+    out = []
+    while not q.empty():
+        out.append(q.get())
+    return out
+
+
+# ------------------------------------------------------------------ replay
+
+
+def test_replay_plan_even_cuts():
+    assert replay_plan(9, 3) == [3, 6, 9]
+    assert replay_plan(10, 3) == [4, 7, 10]
+    assert replay_plan(2, 5) == [1, 2]
+    assert replay_plan(1, 1) == [1]
+
+
+def test_replay_corpus_materializes_generations(tmp_path):
+    src = write_corpus(SynthSpec(n_runs=6, seed=1, name="s"), str(tmp_path))
+    dst = str(tmp_path / "dst")
+    n = replay_corpus(src, dst, generations=3, interval_s=0.0)
+    assert n == 3
+    with open(os.path.join(dst, "runs.json")) as fh:
+        assert len(json.load(fh)) == 6
+
+
+# ----------------------------------------------------------------- watcher
+
+
+def test_watcher_updates_are_incremental(tmp_path):
+    """Three generations -> three in-order updates; every cycle maps ONLY
+    its new runs (the O(new runs) contract, delta.runs_mapped) and the
+    kernel-dispatch count never re-covers cached segments
+    (kernel_dispatch_count via the event's dispatch delta)."""
+    src = write_corpus(SynthSpec(n_runs=9, seed=11, name="sweep"), str(tmp_path))
+    dst = tmp_path / "live"
+    w, q = _watch(tmp_path, dst, max_updates=3)
+    th = threading.Thread(target=w.run, daemon=True)
+    th.start()
+    for n in replay_plan(9, 3):
+        grow_corpus_dir(src, str(dst), n)
+        ev = q.get(timeout=120)
+        assert ev["event"] == "report_update"
+        assert ev["runs_total"] == n
+        assert ev["runs_mapped"] == ev["new_runs"] == 3
+    th.join(timeout=60)
+    assert w.updates == 3
+    evs = [ev]  # last one
+    # Segment partials accumulate: the third cycle served 2 cached segments.
+    assert evs[-1]["segments_cached"] == 2
+    # Dispatches happened for the new segment only — a full re-analysis of
+    # 9 runs would dispatch strictly more than the 3-run first cycle did.
+    assert evs[-1]["kernel_dispatches"] > 0
+
+
+def test_watcher_debounce_coalesces_rapid_writes(tmp_path):
+    """Several index flushes inside one debounce window produce ONE
+    update covering the final state."""
+    src = write_corpus(SynthSpec(n_runs=8, seed=3, name="s"), str(tmp_path))
+    dst = tmp_path / "live"
+    w, q = _watch(tmp_path, dst, max_updates=1)
+    w.config.debounce_s = 0.4
+    th = threading.Thread(target=w.run, daemon=True)
+    th.start()
+    for n in (2, 4, 6, 8):  # all well inside one 0.4s debounce window
+        grow_corpus_dir(src, str(dst), n)
+        time.sleep(0.05)
+    ev = q.get(timeout=120)
+    th.join(timeout=60)
+    assert ev["runs_total"] == 8 and ev["update"] == 1
+    assert w.updates == 1
+
+
+def test_watcher_publish_is_atomic_symlink_flip(tmp_path):
+    src = write_corpus(SynthSpec(n_runs=4, seed=5, name="s"), str(tmp_path))
+    dst = tmp_path / "live"
+    grow_corpus_dir(src, str(dst), 4)
+    # A pre-existing REAL report dir under the live name rotates aside.
+    stale = tmp_path / "wres" / "live"
+    stale.mkdir(parents=True)
+    (stale / "debugging.json").write_text("[]")
+    w, q = _watch(tmp_path, dst, max_updates=1)
+    w.run()
+    ev = q.get(timeout=5)
+    live = ev["report_dir"]
+    assert os.path.islink(live)
+    assert os.path.isfile(os.path.join(live, "debugging.json"))
+    rotated = [
+        p for p in os.listdir(tmp_path / "wres") if p.startswith("live.pre-watch-")
+    ]
+    assert len(rotated) == 1
+
+
+def test_watcher_survives_failed_cycle_and_retries(tmp_path):
+    """A cycle that fails (unreadable index mid-write) is counted, pushed
+    as watch_error, and retried on the next change — the loop survives."""
+    dst = tmp_path / "live"
+    dst.mkdir()
+    (dst / "runs.json").write_text("[truncated")  # sniffs molly, parse fails
+    w, q = _watch(tmp_path, dst, max_updates=1)
+    th = threading.Thread(target=w.run, daemon=True)
+    th.start()
+    ev = q.get(timeout=60)
+    assert ev["event"] == "watch_error"
+    src = write_corpus(SynthSpec(n_runs=3, seed=7, name="s"), str(tmp_path))
+    grow_corpus_dir(src, str(dst), 3)
+    while True:
+        ev = q.get(timeout=120)
+        if ev["event"] == "report_update":
+            break
+    th.join(timeout=60)
+    assert ev["runs_total"] == 3
+
+
+def test_watcher_initial_wait_times_out_loudly(tmp_path):
+    dst = tmp_path / "empty"
+    dst.mkdir()
+    w, _ = _watch(tmp_path, dst, max_updates=1)
+    w.config.initial_wait_s = 0.2
+    with pytest.raises(ValueError, match="cannot sniff"):
+        w.run()
+
+
+def test_watcher_junk_injector_fails_fast(tmp_path, monkeypatch):
+    """A typo'd NEMO_INJECTOR raises immediately — NOT after spinning out
+    the initial sniff wait."""
+    dst = tmp_path / "empty"
+    dst.mkdir()
+    monkeypatch.setenv("NEMO_INJECTOR", "mollly")
+    w, _ = _watch(tmp_path, dst, max_updates=1)
+    w.config.initial_wait_s = 300.0
+    t0 = time.monotonic()
+    with pytest.raises(ValueError, match="unknown injector"):
+        w.run()
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_watcher_quarantine_cycle_does_not_self_retrigger(tmp_path):
+    """The post-cycle quarantine-watch refresh must not read as a change:
+    a cycle that quarantined a file, with NOTHING moving on disk after it,
+    publishes no spurious duplicate update."""
+    src = write_corpus(SynthSpec(n_runs=4, seed=5, name="s"), str(tmp_path))
+    dst = tmp_path / "live"
+    grow_corpus_dir(src, str(dst), 4)
+    victim = dst / "run_3_post_provenance.json"
+    intact = victim.read_bytes()
+    victim.write_bytes(intact[: len(intact) // 2])
+    w, q = _watch(tmp_path, dst, max_updates=3)
+    th = threading.Thread(target=w.run, daemon=True)
+    th.start()
+    try:
+        ev1 = q.get(timeout=120)
+        assert ev1["quarantined"] == 1
+        time.sleep(1.0)  # many poll periods; disk untouched
+        assert q.empty(), "spurious update after an unchanged quarantine cycle"
+        victim.write_bytes(intact)  # the repair re-arms the loop
+        ev2 = q.get(timeout=120)
+        assert ev2["quarantined"] == 0 and ev2["runs_mapped"] == 1
+    finally:
+        w.stop()
+        th.join(timeout=60)
+
+
+def test_molly_missing_dot_file_is_loud(tmp_path):
+    """A Molly-layout corpus with a deleted spacetime DOT must RAISE, not
+    silently substitute a synthesized diagram (ships_spacetime_dots gate)."""
+    from nemo_tpu.ingest.molly import load_molly_output
+
+    d = write_corpus(SynthSpec(n_runs=2, seed=1, name="s"), str(tmp_path))
+    os.remove(os.path.join(d, "run_1_spacetime.dot"))
+    m = load_molly_output(d)
+    assert m.spacetime_dot_text(0)  # intact file reads fine
+    with pytest.raises(FileNotFoundError):
+        m.spacetime_dot_text(1)
+
+
+def test_watch_config_env_resolution(monkeypatch):
+    monkeypatch.setenv("NEMO_WATCH_POLL_S", "2.5")
+    monkeypatch.setenv("NEMO_WATCH_DEBOUNCE_S", "1.25")
+    cfg = WatchConfig()
+    assert cfg.poll_s == 2.5 and cfg.debounce_s == 1.25
+    monkeypatch.setenv("NEMO_WATCH_POLL_S", "junk")  # warn-and-default
+    assert WatchConfig().poll_s == 0.5
+    assert WatchConfig(poll_s=0.1).poll_s == 0.1  # explicit wins
+
+
+def test_watcher_sigkill_resume(tmp_path):
+    """SIGKILL the watching PROCESS mid-sweep; a post-hoc run over the
+    same caches resumes from the published partials — it maps only the
+    segments the dead watcher never finished, byte-identical to
+    from-scratch (the PR-9 crash-safe-resume contract riding the watch
+    loop)."""
+    import signal
+    import subprocess
+    import sys
+
+    from nemo_tpu import obs
+    from nemo_tpu.analysis.pipeline import report_tree_bytes, run_debug
+
+    src = write_corpus(SynthSpec(n_runs=6, seed=13, name="sweep"), str(tmp_path))
+    dst = str(tmp_path / "live")
+    cc, rc = str(tmp_path / "cc"), str(tmp_path / "rc")
+    grow_corpus_dir(src, dst, 3)  # generation 1 on disk before the watcher
+    env = dict(
+        os.environ,
+        NEMO_CORPUS_CACHE=cc,
+        NEMO_RESULT_CACHE=rc,
+        JAX_PLATFORMS="cpu",
+    )
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "nemo_tpu.cli",
+            "-faultInjOut", dst,
+            "--graph-backend", "jax",
+            "--results-dir", str(tmp_path / "wres"),
+            "--figures", "none",
+            "--watch", "--watch-poll-s", "0.1", "--watch-debounce-s", "0.1",
+            "--watch-max-updates", "99",
+        ],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+    )
+    try:
+        live = os.path.join(tmp_path, "wres", "live")
+        deadline = time.monotonic() + 180
+        while not os.path.islink(live):  # update 1 published
+            assert proc.poll() is None, proc.stdout.read().decode()[-2000:]
+            assert time.monotonic() < deadline, "watcher never published"
+            time.sleep(0.2)
+    finally:
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+    # The sweep finishes while nobody watches...
+    grow_corpus_dir(src, dst, 6)
+    # ... and the resumed analysis maps ONLY the unfinished tail: the dead
+    # watcher's segment partial serves from the cache.
+    m0 = obs.metrics.snapshot()
+    res = run_debug(
+        dst, str(tmp_path / "resume"), JaxBackend(), figures="none",
+        corpus_cache=cc, result_cache=rc,
+    )
+    md = obs.Metrics.delta(obs.metrics.snapshot(), m0)["counters"]
+    assert md.get("delta.runs_cached", 0) == 3
+    assert md.get("delta.runs_mapped", 0) == 3
+    scratch = run_debug(
+        dst, str(tmp_path / "scratch"), JaxBackend(), figures="none",
+        corpus_cache="off", result_cache="off",
+    )
+    assert report_tree_bytes(res.report_dir) == report_tree_bytes(
+        scratch.report_dir
+    )
+
+
+# ------------------------------------------------------ server watch stream
+
+
+def test_server_watch_stream_events(tmp_path, sidecar, monkeypatch):
+    """AnalyzeDirStream watch mode: a subscriber receives watching /
+    report_update / done over the wire while the replay driver grows the
+    sweep server-side."""
+    pytest.importorskip("grpc")
+    from nemo_tpu.service.client import RemoteAnalyzer
+    from nemo_tpu.watch import start_replay
+
+    monkeypatch.setenv("NEMO_CORPUS_CACHE", str(tmp_path / "cc"))
+    monkeypatch.setenv("NEMO_RESULT_CACHE", str(tmp_path / "rc"))
+    src = write_corpus(SynthSpec(n_runs=6, seed=17, name="sweep"), str(tmp_path))
+    dst = str(tmp_path / "live")
+    os.makedirs(dst)
+    th, stop = start_replay(src, dst, generations=2, interval_s=2.0)
+    events = []
+    with RemoteAnalyzer(target=sidecar) as c:
+        for ev in c.analyze_dir_stream(
+            [dst],
+            watch={
+                "results_root": str(tmp_path / "wres"),
+                "max_updates": 2,
+                "poll_s": 0.1,
+                "debounce_s": 0.1,
+                "figures": "none",
+            },
+        ):
+            events.append(ev)
+    stop.set()
+    kinds = [e["event"] for e in events]
+    assert kinds[0] == "watching" and kinds[-1] == "done"
+    ups = [e for e in events if e["event"] == "report_update"]
+    assert len(ups) == 2
+    assert [e["runs_total"] for e in ups] == [3, 6]
+    assert events[-1]["updates"] == 2
+
+
+def test_server_watch_stream_surfaces_watcher_crash(sidecar, tmp_path):
+    """A watcher that dies at setup (never-sniffable dir) must yield a
+    fatal watch_error before done — not a clean done, updates=0."""
+    pytest.importorskip("grpc")
+    from nemo_tpu.service.client import RemoteAnalyzer
+
+    d = str(tmp_path / "never_a_sweep")
+    os.makedirs(d)
+    with RemoteAnalyzer(target=sidecar) as c:
+        events = list(
+            c.analyze_dir_stream(
+                [d],
+                watch={
+                    "results_root": str(tmp_path / "wres"),
+                    "poll_s": 0.05,
+                    "initial_wait_s": 0.3,
+                },
+            )
+        )
+    kinds = [e["event"] for e in events]
+    assert "watch_error" in kinds
+    err = next(e for e in events if e["event"] == "watch_error")
+    assert err.get("fatal") and "cannot sniff" in err["detail"]
+    assert events[-1]["event"] == "done" and events[-1]["errors"] == 1
+
+
+def test_server_watch_stream_validates_request(sidecar, tmp_path):
+    pytest.importorskip("grpc")
+    import grpc
+
+    from nemo_tpu.service.client import RemoteAnalyzer
+
+    d = str(tmp_path / "d")
+    os.makedirs(d)
+    with RemoteAnalyzer(target=sidecar) as c:
+        with pytest.raises(grpc.RpcError) as exc:
+            list(c.analyze_dir_stream([d], watch={}))  # no results_root
+        assert exc.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+# ------------------------------------------------- adversarial determinism
+
+
+@pytest.mark.parametrize("family", ADVERSARIAL_FAMILIES)
+def test_adversarial_generator_deterministic(family):
+    a = generate_corpus(adversarial_spec(family, n_runs=6, seed=9))
+    b = generate_corpus(adversarial_spec(family, n_runs=6, seed=9))
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+    c = generate_corpus(adversarial_spec(family, n_runs=6, seed=10))
+    assert json.dumps(a, sort_keys=True) != json.dumps(c, sort_keys=True)
+
+
+def test_adversarial_families_have_their_shapes():
+    deep = generate_corpus(adversarial_spec("deep_chain", n_runs=2, seed=0))
+    assert len(deep["run_0_pre_provenance.json"]["goals"]) > 60
+    wide = generate_corpus(adversarial_spec("wide_fanout", n_runs=2, seed=0))
+    assert len(wide["runs.json"][0]["failureSpec"]["nodes"]) >= 26
+    vocab = generate_corpus(adversarial_spec("vocab_growth", n_runs=3, seed=0))
+    tables = {
+        g["table"]
+        for i in range(3)
+        for g in vocab[f"run_{i}_pre_provenance.json"]["goals"]
+    }
+    assert {"aux_0_0", "aux_1_0", "aux_2_0"} <= tables
+    cyc = generate_corpus(adversarial_spec("cycles", n_runs=2, seed=0))
+    post = cyc["run_0_post_provenance.json"]
+    ids = {e["from"] for e in post["edges"]} | {e["to"] for e in post["edges"]}
+    assert "cyc_g0_0" in ids and "cyc_r1_0" in ids
+
+
+def test_adversarial_stream_writer_matches_in_memory(tmp_path):
+    """write_corpus_stream == write_corpus for an adversarial family (the
+    rng-consumption-order contract extends to the new families)."""
+    spec = adversarial_spec("near_dup", n_runs=6, seed=21)
+    a = write_corpus(spec, str(tmp_path / "mem"))
+    spec2 = adversarial_spec("near_dup", n_runs=6, seed=21)
+    b = write_corpus_stream(spec2, str(tmp_path / "stream"), segment_runs=2)
+    fa = sorted(os.listdir(a))
+    assert fa == sorted(os.listdir(b))
+    for f in fa:
+        assert (
+            open(os.path.join(a, f), "rb").read()
+            == open(os.path.join(b, f), "rb").read()
+        ), f
+
+
+def test_adversarial_cycles_analyze_and_terminate(tmp_path):
+    """The cyclic family flows through the full pipeline (fix-point loops
+    terminate) with jax-vs-oracle byte parity on debugging.json."""
+    from nemo_tpu.analysis.pipeline import run_debug
+    from nemo_tpu.backend.python_ref import PythonBackend
+
+    d = write_corpus(adversarial_spec("cycles", n_runs=4, seed=2), str(tmp_path))
+    rj = run_debug(d, str(tmp_path / "rj"), JaxBackend(), figures="none")
+    rp = run_debug(d, str(tmp_path / "rp"), PythonBackend(), figures="none")
+    assert (
+        open(os.path.join(rj.report_dir, "debugging.json"), "rb").read()
+        == open(os.path.join(rp.report_dir, "debugging.json"), "rb").read()
+    )

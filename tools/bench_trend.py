@@ -285,6 +285,43 @@ def extract_metrics(doc: dict) -> dict[str, tuple[float, str, str]]:
     large = st.get("large") or {}
     put("stream_tier.large.runs_per_s", large.get("runs_per_s"), "higher", "ratio")
     put("stream_tier.large.peak_rss_mb", large.get("peak_rss_mb"), "lower", "mb")
+    # Watch tier (ISSUE 15): the live loop's update latency p50 (s_fast
+    # floor — warm incremental cycles are sub-second), the runs/s the loop
+    # absorbed, per-update dispatch count (the O(new runs) contract: a
+    # jump means cached segments re-dispatched), and the steady-state RSS
+    # (also bounded by an absolute ceiling in ceiling_violations).
+    wt = doc.get("watch_tier") or {}
+    put(
+        "watch_tier.update_latency_p50_s",
+        wt.get("update_latency_p50_s"),
+        "lower",
+        "s_fast",
+    )
+    put(
+        "watch_tier.runs_per_s_absorbed",
+        wt.get("runs_per_s_absorbed"),
+        "higher",
+        "ratio",
+    )
+    put(
+        "watch_tier.dispatches_per_update",
+        wt.get("dispatches_per_update"),
+        "lower",
+        "ratio",
+    )
+    # Trend on the tier-ATTRIBUTABLE growth (steady_rss_mb is the whole
+    # bench child's RSS — earlier tiers' residue would flag the wrong
+    # tier); the absolute number is bounded by WATCH_RSS_CEILING_MB below.
+    put("watch_tier.rss_growth_mb", wt.get("rss_growth_mb"), "lower", "mb")
+    # Adversarial tier (ISSUE 15): per-family walls (s_fast floors — the
+    # corpora are small; what matters is a family suddenly exploding).
+    for fam, row in sorted((doc.get("adversarial_tier") or {}).items()):
+        put(
+            f"adversarial_tier.{fam}.wall_s",
+            (row or {}).get("wall_s"),
+            "lower",
+            "s_fast",
+        )
     figures = doc.get("figures") or {}
     put(
         "figures.e2e_warm_all_figures_s",
@@ -334,10 +371,17 @@ def extract_metrics(doc: dict) -> dict[str, tuple[float, str, str]]:
 #: flag even though no median exists yet.
 STREAM_RSS_CEILING_MB = 4096.0
 
+#: Absolute ceiling on the watch tier's steady-state RSS (MB): a live
+#: watcher is a LONG-RUNNING process tailing a sweep for hours — its
+#: memory must stay bounded regardless of how many updates it published
+#: (ISSUE 15), and like the stream ceiling this is meaningful on the very
+#: first capture.
+WATCH_RSS_CEILING_MB = 4096.0
+
 
 def ceiling_violations(candidate: dict) -> list[dict]:
-    """History-independent absolute bounds (currently the stream tier's
-    RSS ceiling, default and `large` variants)."""
+    """History-independent absolute bounds (the stream tier's RSS ceiling,
+    default and `large` variants, plus the watch tier's steady-state RSS)."""
     out: list[dict] = []
     st = candidate.get("stream_tier") or {}
     for name, row in (("stream_tier", st), ("stream_tier.large", st.get("large") or {})):
@@ -352,6 +396,18 @@ def ceiling_violations(candidate: dict) -> list[dict]:
                     "regressed": True,
                 }
             )
+    wt = candidate.get("watch_tier") or {}
+    v = wt.get("steady_rss_mb")
+    if isinstance(v, (int, float)) and v > WATCH_RSS_CEILING_MB:
+        out.append(
+            {
+                "metric": "watch_tier.steady_rss_mb",
+                "candidate": round(float(v), 1),
+                "ceiling_mb": WATCH_RSS_CEILING_MB,
+                "direction": "ceiling",
+                "regressed": True,
+            }
+        )
     return out
 
 
