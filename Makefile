@@ -5,7 +5,7 @@ NATIVE_LIB := native/build/libnemo_native.so
 REPORT_SRC := native/nemo_report.cpp
 REPORT_LIB := native/build/libnemo_report.so
 
-.PHONY: all native test bench bench-watch bench-trend prewarm validate trace-smoke obs-smoke store-smoke delta-smoke shard-smoke sparse-device-smoke serve-smoke fleet-smoke chaos-smoke stream-smoke synth-smoke watch-smoke lint-print clean reset proto neo4j-up neo4j-validate neo4j-down
+.PHONY: all native test bench bench-watch bench-trend prewarm validate trace-smoke obs-smoke store-smoke delta-smoke shard-smoke sparse-device-smoke serve-smoke fleet-smoke obs-fleet-smoke chaos-smoke stream-smoke synth-smoke watch-smoke lint-print lint-metrics clean reset proto neo4j-up neo4j-validate neo4j-down
 
 all: native
 
@@ -28,7 +28,7 @@ test:
 # operational-observability, corpus-store, result-cache/delta, serving-tier,
 # chaos/fault-tolerance, out-of-core-streaming and batched-synthesis
 # smokes).
-validate: lint-print test
+validate: lint-print lint-metrics test
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 		python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 	$(MAKE) shard-smoke
@@ -105,6 +105,18 @@ serve-smoke:
 fleet-smoke:
 	python -m nemo_tpu.utils.validate_smoke --fleet-smoke
 
+# Fleet-observability smoke (also the tail of `make validate`; ISSUE 17):
+# boot 2 replicas + the router with --metrics-port, assert the router's
+# federated /metrics carries BOTH replicas' series under
+# {replica="host:port"} labels plus nemo_fleet_* rollups, one warm
+# AnalyzeDir through the router yields ONE stitched trace (router-forward
+# + replica admission/serve spans under one trace id), an injected
+# breaker trip dumps exactly one flight-recorder bundle, and a synthetic
+# queue-depth surge flips /autoscale up then — hysteresis — back down
+# (nemo_tpu/obs/federation.py, obs/flight.py, serve/autoscale.py).
+obs-fleet-smoke:
+	python -m nemo_tpu.utils.validate_smoke --obs-fleet-smoke
+
 # Fault-tolerance smoke (also the tail of `make validate`; ISSUE 9): the
 # chaos harness (nemo_tpu/utils/chaos.py) injects corrupt runs, device-lane
 # dispatch failures, and a mid-sweep SIGKILL into real pipeline runs and
@@ -147,6 +159,13 @@ watch-smoke:
 # CLI/harness allowlist (tools/lint_no_print.py).
 lint-print:
 	python tools/lint_no_print.py
+
+# Metrics-doc contract (ISSUE 17): every metrics series emitted in
+# nemo_tpu/ must be documented in docs/METRICS.md; fails on undocumented,
+# stale, or statically unresolvable series names.  Regenerate with
+# `python tools/metrics_doc.py --write` (descriptions survive).
+lint-metrics:
+	python tools/metrics_doc.py
 
 # Regression sentinel (see bench-watch, which runs this automatically
 # after every capture): compares a BENCH json against the trailing

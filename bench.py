@@ -2122,6 +2122,65 @@ def child_main() -> None:
     except Exception as ex:  # figure costing must never sink the bench
         log(f"figure costing skipped: {type(ex).__name__}: {ex}")
 
+    # Flight-recorder armed-idle overhead (ISSUE 17): the same differential
+    # per-span measurement tests/test_obs_fleet.py pins at <3% of a
+    # conservative 256 KiB-hash work unit, captured here so bench_trend
+    # watches the ring-append hot path drift capture over capture.
+    obs_flight = None
+    try:
+        import hashlib
+
+        from nemo_tpu.obs import flight as _flight
+
+        fl_payload = b"x" * 262144
+        fl_n = 300
+
+        def _fl_min(fn, reps: int) -> float:
+            best = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                fn()
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        def _fl_span_loop() -> None:
+            for _ in range(fl_n):
+                with obs.span("flight_hot", step=1):
+                    pass
+
+        def _fl_bare_loop() -> None:
+            for _ in range(fl_n):
+                pass
+
+        def _fl_work_loop() -> None:
+            for _ in range(fl_n):
+                hashlib.sha256(fl_payload).digest()
+
+        def _fl_per_span_s() -> float:
+            return (
+                max(0.0, _fl_min(_fl_span_loop, 9) - _fl_min(_fl_bare_loop, 9))
+                / fl_n
+            )
+
+        disarmed_span_s = _fl_per_span_s()
+        _flight.arm(os.path.join(tmp, "flightrec"))
+        try:
+            armed_span_s = _fl_per_span_s()
+        finally:
+            _flight.disarm()
+        fl_work_s = _fl_min(_fl_work_loop, 5) / fl_n
+        obs_flight = {
+            "work_unit_us": round(fl_work_s * 1e6, 2),
+            "disarmed_span_us": round(disarmed_span_s * 1e6, 3),
+            "armed_span_us": round(armed_span_s * 1e6, 3),
+            "armed_idle_overhead": (
+                round(armed_span_s / fl_work_s, 4) if fl_work_s else None
+            ),
+        }
+        log(f"flight armed-idle overhead: {json.dumps(obs_flight)}")
+    except Exception as ex:  # a micro-bench must never sink the bench
+        log(f"flight overhead micro-bench skipped: {type(ex).__name__}: {ex}")
+
     # Gated 10x stress row (ISSUE 3): NEMO_BENCH_10X=1 re-runs the e2e
     # pipeline over corpora 10x the configured size — the acceptance
     # surface for the sparse CPU tier (102,000 distinct runs, warm wall
@@ -2227,6 +2286,7 @@ def child_main() -> None:
         "stream_tier": stream_tier,
         "serve_tier": serve_tier,
         "fleet_tier": fleet_tier,
+        "obs_flight": obs_flight,
         "stress_10x": stress_10x,
         # Whole-process obs registry at bench end: the scattered per-layer
         # counters (kernel dispatch/compile split, upload bytes, render

@@ -111,6 +111,16 @@ def _write_telemetry(report_dir: str, timings: dict, figure_stats: dict | None) 
                 doc["sched"] = table
         except Exception:  # lint: allow-silent-except — telemetry must never fail a report (docstring)
             pass
+    # Per-tenant SLO table (ISSUE 17) — same gate: only a process that
+    # actually served traffic has an admission controller to report on.
+    adm = sys.modules.get("nemo_tpu.serve.admission")
+    if adm is not None:
+        try:
+            slo = adm.slo_snapshot()
+            if slo:
+                doc["slo"] = slo
+        except Exception:  # lint: allow-silent-except — telemetry must never fail a report (docstring)
+            pass
     try:
         with open(os.path.join(report_dir, "telemetry.json"), "w", encoding="utf-8") as fh:
             json.dump(doc, fh, indent=1)

@@ -28,6 +28,12 @@ two primitives a sharded / two-process / worker-pool deployment needs:
   the active tracer's trace id, so log lines from any process in a run —
   render-pool workers, the sidecar — correlate with the Perfetto trace.
 
+* **Flight recorder** (`obs.flight`): an always-on bounded ring of recent
+  spans / log records / metric deltas that dumps a Perfetto-loadable
+  postmortem bundle when an anomaly trigger fires (breaker trip, dispatch
+  watchdog, shed burst, failed watch cycle, lease steal) — the first
+  production incident is capturable without `--trace` having been on.
+
 Import cost is deliberately tiny (stdlib only, no jax/numpy) so every layer
 can depend on it unconditionally.  `obs.promexp` is imported lazily by its
 consumers (it pulls in http.server).
@@ -35,7 +41,7 @@ consumers (it pulls in http.server).
 
 from __future__ import annotations
 
-from . import log
+from . import flight, log
 from .metrics import HIST_BUCKETS, Metrics, metrics
 from .trace import (
     Tracer,
@@ -59,6 +65,7 @@ __all__ = [
     "enabled",
     "export",
     "finish",
+    "flight",
     "log",
     "metrics",
     "span",

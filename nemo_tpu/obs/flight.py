@@ -1,0 +1,323 @@
+"""Flight recorder: always-on postmortem capture for the serving fleet.
+
+`--trace` answers "why was THAT request slow" — but only if it was on
+before the request ran.  Production incidents don't schedule themselves:
+the first breaker trip, watchdog escalation, or lease steal of a
+deployment happens with tracing off, and by the time an operator attaches,
+the evidence is gone.  The flight recorder closes that gap the way an
+aircraft FDR does: a bounded ring buffer of recent activity that costs
+(almost) nothing while nothing is wrong, dumped as a self-contained bundle
+the moment an anomaly trigger fires.
+
+What the ring holds:
+
+* **spans** — every ``obs.span(...)`` completion, whether or not a tracer
+  is active (when tracing is off, spans that would have been dropped land
+  here instead; when tracing is on they land in both).  Stored as bare
+  tuples — no dict/string work on the hot path — and rendered to Chrome
+  trace events only at dump time.
+* **log records** — every record `obs.log` emits (post level-filter),
+  tapped at the `_emit` funnel.
+* **metric deltas** — each bundle carries ``Metrics.delta`` between the
+  registry now and the recorder's base snapshot (taken at arm, refreshed
+  per dump): what the fleet's counters did in the window the bundle covers.
+
+Triggers (`trigger(reason, **ctx)`): breaker trip (parallel/sched.py),
+dispatch-watchdog escalation (parallel/sched.py), admission shed burst
+(serve/admission.py via `note_shed`), failed watch cycle
+(watch/watcher.py), lease steal (store/rcache.py).  Each reason has a
+cooldown (``NEMO_FLIGHT_COOLDOWN_S``, default 30 s) so a failure storm
+produces ONE bundle, not a bundle storm.
+
+Bundles are ``flightrec-<reason>-<pid>-<seq>.json`` under
+``NEMO_FLIGHT_DIR`` (default ``~/.cache/nemo_tpu/flightrec``) in Chrome
+trace-event format — load directly in Perfetto; the log records, metric
+delta, and trigger context ride in ``otherData``.
+
+Knobs (all warn-and-default, parsed lazily so this module stays
+stdlib-only with no import cycle into utils/env):
+
+    NEMO_FLIGHT=off            disable (configure_from_env arms otherwise)
+    NEMO_FLIGHT_DIR=PATH       bundle directory
+    NEMO_FLIGHT_SPANS=2048     span ring capacity
+    NEMO_FLIGHT_LOGS=512       log-record ring capacity
+    NEMO_FLIGHT_COOLDOWN_S=30  per-reason dump cooldown
+    NEMO_FLIGHT_SHED_BURST=5   sheds within the window that count as a burst
+    NEMO_FLIGHT_SHED_WINDOW_S=10   the shed burst window
+
+Armed-but-idle cost: one tuple append into a bounded deque per span — the
+<3% kernel-dispatch hot-loop guard (tests/test_obs_fleet.py, watched by
+bench.py / tools/bench_trend.py) pins it.  Disarmed cost: one module
+global read (the PR-2 null-span guard still holds).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+
+from . import metrics as _metrics_mod
+from . import trace as _trace
+from .metrics import metrics as _metrics
+
+__all__ = [
+    "FlightRecorder",
+    "arm",
+    "configure_from_env",
+    "disarm",
+    "note_shed",
+    "recorder",
+    "trigger",
+]
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _default_dir() -> str:
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache"
+    )
+    return os.path.join(base, "nemo_tpu", "flightrec")
+
+
+class FlightRecorder:
+    """Bounded rings + trigger/dump.  `add_span` is Tracer-signature
+    compatible so trace.py's `_Span` can record into it directly when no
+    tracer is active."""
+
+    def __init__(
+        self,
+        out_dir: str | None = None,
+        max_spans: int | None = None,
+        max_logs: int | None = None,
+        cooldown_s: float | None = None,
+        shed_burst: int | None = None,
+        shed_window_s: float | None = None,
+    ) -> None:
+        self.out_dir = out_dir or os.environ.get("NEMO_FLIGHT_DIR", "").strip() or _default_dir()
+        self.pid = os.getpid()
+        cap_s = max_spans if max_spans is not None else _env_int("NEMO_FLIGHT_SPANS", 2048)
+        cap_l = max_logs if max_logs is not None else _env_int("NEMO_FLIGHT_LOGS", 512)
+        self.max_spans = max(1, cap_s)
+        self.max_logs = max(1, cap_l)
+        self.cooldown_s = (
+            cooldown_s if cooldown_s is not None else _env_float("NEMO_FLIGHT_COOLDOWN_S", 30.0)
+        )
+        self.shed_burst = (
+            shed_burst if shed_burst is not None else _env_int("NEMO_FLIGHT_SHED_BURST", 5)
+        )
+        self.shed_window_s = (
+            shed_window_s
+            if shed_window_s is not None
+            else _env_float("NEMO_FLIGHT_SHED_WINDOW_S", 10.0)
+        )
+        # deque.append with maxlen is atomic under the GIL — the span hot
+        # path takes no lock; only dump() locks, to copy consistently.
+        self._spans: collections.deque = collections.deque(maxlen=self.max_spans)
+        self._logs: collections.deque = collections.deque(maxlen=self.max_logs)
+        self._sheds: collections.deque = collections.deque(maxlen=max(1, self.shed_burst))
+        self._lock = threading.Lock()
+        self._last_dump: dict[str, float] = {}
+        self._seq = 0
+        self._base_snap = _metrics.snapshot()
+
+    # ------------------------------------------------------------- recording
+
+    def add_span(
+        self,
+        name: str,
+        start_us: int,
+        dur_us: int,
+        args: dict | None = None,
+        pid: int | None = None,
+        tid: int | None = None,
+        thread_name: str | None = None,
+    ) -> None:
+        if tid is None:
+            tid = threading.get_ident()
+        self._spans.append((name, start_us, dur_us, args, pid or self.pid, tid))
+
+    def record_log(self, rec: dict) -> None:
+        self._logs.append(rec)
+
+    def note_shed(self, reason: str = "", tenant: str = "") -> None:
+        """Admission-shed burst detector: a trigger fires when `shed_burst`
+        sheds land inside `shed_window_s` — one shed is load shedding doing
+        its job; a burst is an incident."""
+        now = time.monotonic()
+        self._sheds.append(now)
+        if (
+            len(self._sheds) >= self.shed_burst
+            and now - self._sheds[0] <= self.shed_window_s
+        ):
+            self.trigger(
+                "shed_burst", shed_reason=reason, tenant=tenant, sheds=len(self._sheds)
+            )
+
+    # -------------------------------------------------------------- dumping
+
+    def trigger(self, reason: str, **ctx) -> str | None:
+        """Dump a bundle for `reason` unless its cooldown is still running.
+        Returns the bundle path, or None when suppressed/failed.  Never
+        raises — a postmortem capture must not become a second incident."""
+        now = time.monotonic()
+        with self._lock:
+            last = self._last_dump.get(reason)
+            if last is not None and now - last < self.cooldown_s:
+                _metrics.inc("flight.suppressed")
+                return None
+            self._last_dump[reason] = now
+            self._seq += 1
+            seq = self._seq
+            spans = list(self._spans)
+            logs = list(self._logs)
+            snap = _metrics.snapshot()
+            base, self._base_snap = self._base_snap, snap
+        try:
+            path = self._write_bundle(reason, ctx, spans, logs, snap, base, seq)
+        except Exception as ex:
+            from . import log as _log  # deferred: dump path only
+
+            _log.get_logger("nemo.flight").warning(
+                "flight.dump_failed", reason=reason, error=repr(ex)
+            )
+            return None
+        _metrics.inc("flight.dumps")
+        _metrics.inc(f"flight.dumps.{reason}")
+        from . import log as _log
+
+        _log.get_logger("nemo.flight").warning(
+            "flight.dumped", reason=reason, path=path, spans=len(spans), logs=len(logs)
+        )
+        return path
+
+    def _write_bundle(
+        self, reason, ctx, spans, logs, snap, base, seq
+    ) -> str:
+        thread_names = {t.ident: t.name for t in threading.enumerate() if t.ident}
+        events: list[dict] = []
+        base_ts = min((s[1] for s in spans), default=0)
+        seen_threads: set[tuple[int, int]] = set()
+        for name, start_us, dur_us, args, pid, tid in spans:
+            ev = {
+                "name": name,
+                "ph": "X",
+                "ts": start_us - base_ts,
+                "dur": dur_us,
+                "pid": pid,
+                "tid": tid,
+            }
+            if args:
+                ev["args"] = args
+            events.append(ev)
+            seen_threads.add((pid, tid))
+        meta: list[dict] = [
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": self.pid,
+                "tid": 0,
+                "args": {"name": f"nemo-flightrec (pid {self.pid})"},
+            }
+        ]
+        for pid, tid in sorted(seen_threads):
+            tn = thread_names.get(tid)
+            if tn:
+                meta.append(
+                    {"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+                     "args": {"name": tn}}
+                )
+        doc = {
+            "traceEvents": meta + events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "tool": "nemo-tpu flight recorder",
+                "reason": reason,
+                "context": {k: v for k, v in ctx.items() if v is not None},
+                "trace_id": _trace.trace_id(),
+                "pid": self.pid,
+                "wall_ts": time.time(),
+                "logs": logs,
+                "metrics_delta": _metrics_mod.Metrics.delta(snap, base),
+            },
+        }
+        safe = "".join(c if c.isalnum() or c in "-_" else "_" for c in reason)
+        os.makedirs(self.out_dir, exist_ok=True)
+        path = os.path.join(self.out_dir, f"flightrec-{safe}-{self.pid}-{seq:03d}.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh, default=str)
+        os.replace(tmp, path)
+        return path
+
+
+# Module-level armed recorder: None = disarmed (no capture, no ring cost).
+_RECORDER: FlightRecorder | None = None
+
+
+def recorder() -> FlightRecorder | None:
+    return _RECORDER
+
+
+def arm(out_dir: str | None = None, **kw) -> FlightRecorder:
+    """Install a recorder and wire the span/log taps.  Re-arming replaces
+    the previous recorder (tests)."""
+    global _RECORDER
+    rec = FlightRecorder(out_dir, **kw)
+    _RECORDER = rec
+    _trace.set_flight_recorder(rec)
+    from . import log as _log
+
+    _log.set_flight_recorder(rec)
+    return rec
+
+
+def disarm() -> None:
+    global _RECORDER
+    _RECORDER = None
+    _trace.set_flight_recorder(None)
+    from . import log as _log
+
+    _log.set_flight_recorder(None)
+
+
+def trigger(reason: str, **ctx) -> str | None:
+    """Fire a trigger on the armed recorder; cheap no-op when disarmed.
+    Call sites (breaker trip, watchdog, watch cycle, lease steal) don't
+    need to know whether a recorder is armed."""
+    rec = _RECORDER
+    if rec is None:
+        return None
+    return rec.trigger(reason, **ctx)
+
+
+def note_shed(reason: str = "", tenant: str = "") -> None:
+    rec = _RECORDER
+    if rec is not None:
+        rec.note_shed(reason, tenant)
+
+
+def configure_from_env() -> FlightRecorder | None:
+    """Arm unless NEMO_FLIGHT=off/0/false.  Long-lived entry points (the
+    sidecar, the router, the watcher) call this at startup — the recorder
+    is meant to be ON in production; short-lived CLI runs don't bother."""
+    if os.environ.get("NEMO_FLIGHT", "").strip().lower() in ("0", "off", "false", "no"):
+        return None
+    if _RECORDER is not None:
+        return _RECORDER
+    return arm()

@@ -42,6 +42,17 @@ LEVELS = {"debug": 10, "info": 20, "warning": 30, "error": 40}
 
 _lock = threading.Lock()
 
+# Armed flight recorder (obs/flight.py): every emitted record also lands in
+# its bounded ring so postmortem bundles carry the recent log tail.  Set via
+# `set_flight_recorder` by flight.arm()/disarm() — log.py never imports
+# flight, keeping the import graph acyclic.
+_FLIGHT = None
+
+
+def set_flight_recorder(rec) -> None:
+    global _FLIGHT
+    _FLIGHT = rec
+
 
 def _threshold() -> int:
     return LEVELS.get(os.environ.get("NEMO_LOG_LEVEL", "").strip().lower(), LEVELS["info"])
@@ -93,6 +104,9 @@ def _emit(level: str, logger: str, event: str, fields: dict) -> None:
     rec.update(fields)
     if rec.get("trace_id") is None:
         rec.pop("trace_id", None)  # an untraced call site passed None explicitly
+    fr = _FLIGHT
+    if fr is not None:
+        fr.record_log(rec)
     line = json.dumps(rec, default=str)
     with _lock:
         print(line, file=sys.stderr, flush=True)  # lint: allow-print (the log sink itself)

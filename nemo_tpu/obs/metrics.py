@@ -26,6 +26,13 @@ counts over a fixed 1-2.5-5 geometric ladder spanning 1e-4..5e9 — wide
 enough for seconds-scale latencies, batch-row counts, and byte volumes
 with one binning policy to version.  The buckets are what the Prometheus
 exposition renders as ``_bucket{le=...}`` series.
+
+A histogram may opt into a custom bucket ladder via ``set_buckets(name,
+bounds)`` BEFORE its first observation (ms-scale SLO latencies need finer
+bins than the default ladder's decade steps; multi-minute analysis walls
+need fewer).  The default ladder, and every histogram that never opts in,
+is unchanged — custom-ladder snapshots carry an extra ``"ladder"`` key so
+the exposition and consumers render the right ``le`` bounds.
 """
 
 from __future__ import annotations
@@ -62,8 +69,10 @@ class Metrics:
         self._dropped = 0
         self._counters: dict[str, float] = {}
         self._gauges: dict[str, float] = {}
-        # name -> [count, sum, min, max, per-bucket counts (len(HIST_BUCKETS))]
+        # name -> [count, sum, min, max, per-bucket counts, ladder tuple]
         self._hists: dict[str, list] = {}
+        # name -> custom ladder, registered via set_buckets() pre-observation
+        self._ladders: dict[str, tuple[float, ...]] = {}
 
     def _admit(self) -> bool:
         """Bounded-registry gate, called under the lock for a name NOT yet
@@ -77,6 +86,21 @@ class Metrics:
             return True
         self._dropped += 1
         return False
+
+    def set_buckets(self, name: str, bounds) -> None:
+        """Register a per-metric histogram bucket ladder (Prometheus ``le``
+        upper bounds).  Must run before `name`'s first observation — once a
+        histogram exists its ladder is frozen (rebinning live cumulative
+        counts is lossy), so a late registration is a silent no-op and the
+        series keeps the ladder it was born with.  Idempotent; bounds are
+        sorted and deduplicated."""
+        ladder = tuple(sorted({float(b) for b in bounds}))
+        if not ladder:
+            return
+        with self._lock:
+            if name in self._hists:
+                return
+            self._ladders[name] = ladder
 
     # ------------------------------------------------------------- mutators
 
@@ -98,28 +122,30 @@ class Metrics:
             if h is None:
                 if not self._admit():
                     return
-                h = self._hists[name] = [0, 0.0, value, value, [0] * len(HIST_BUCKETS)]
+                ladder = self._ladders.get(name, HIST_BUCKETS)
+                h = self._hists[name] = [0, 0.0, value, value, [0] * len(ladder), ladder]
             h[0] += 1
             h[1] += value
             if value < h[2]:
                 h[2] = value
             if value > h[3]:
                 h[3] = value
-            i = bisect.bisect_left(HIST_BUCKETS, value)
-            if i < len(HIST_BUCKETS):
+            ladder = h[5]
+            i = bisect.bisect_left(ladder, value)
+            if i < len(ladder):
                 h[4][i] += 1
 
     # ------------------------------------------------------------ snapshots
 
     @staticmethod
-    def _cumulative(buckets: list[int], count: int) -> list:
+    def _cumulative(buckets: list[int], count: int, ladder=HIST_BUCKETS) -> list:
         """Per-bucket counts -> cumulative [le, count] pairs, trimmed after
         the first bucket that already holds every observation (the tail
         adds no information and would bloat telemetry.json ~40 pairs per
         histogram); the exposition layer re-extends with +Inf."""
         out = []
         cum = 0
-        for le, c in zip(HIST_BUCKETS, buckets):
+        for le, c in zip(ladder, buckets):
             cum += c
             out.append([le, cum])
             if cum >= count:
@@ -137,22 +163,27 @@ class Metrics:
             if self._dropped:
                 counters["metrics.dropped_series"] = self._dropped
             gauges = dict(self._gauges)
-            hists = {k: (v[0], v[1], v[2], v[3], list(v[4])) for k, v in self._hists.items()}
-        return {
-            "counters": counters,
-            "gauges": gauges,
-            "histograms": {
-                k: {
-                    "count": int(c),
-                    "sum": s,
-                    "min": lo,
-                    "max": hi,
-                    "mean": s / c if c else 0.0,
-                    "buckets": self._cumulative(b, c),
-                }
-                for k, (c, s, lo, hi, b) in hists.items()
-            },
-        }
+            hists = {
+                k: (v[0], v[1], v[2], v[3], list(v[4]), v[5])
+                for k, v in self._hists.items()
+            }
+        out_hists = {}
+        for k, (c, s, lo, hi, b, ladder) in hists.items():
+            doc = {
+                "count": int(c),
+                "sum": s,
+                "min": lo,
+                "max": hi,
+                "mean": s / c if c else 0.0,
+                "buckets": self._cumulative(b, c, ladder),
+            }
+            if ladder is not HIST_BUCKETS:
+                # Non-default ladders must travel with the data so the
+                # exposition emits the right full ladder; default-ladder
+                # snapshots keep their pre-existing shape byte-for-byte.
+                doc["ladder"] = list(ladder)
+            out_hists[k] = doc
+        return {"counters": counters, "gauges": gauges, "histograms": out_hists}
 
     @staticmethod
     def delta(after: dict, before: dict) -> dict:

@@ -105,8 +105,9 @@ def render_prometheus(snapshot: dict | None = None) -> str:
         # the appearance mis-reads the jump.  Past the trimmed prefix every
         # bucket holds all observations, ending at +Inf == _count.
         by_le = {le: int(c) for le, c in h.get("buckets", [])}
+        ladder = tuple(h.get("ladder") or HIST_BUCKETS)
         cum = 0
-        for le in HIST_BUCKETS:
+        for le in ladder:
             # The pairs are a ladder prefix, so carrying the last value
             # forward is exact: a trimmed tail means every later bucket
             # already holds all observations.
@@ -170,14 +171,24 @@ def parse_prometheus_text(text: str) -> dict:
     return families
 
 
-def start_http_server(port: int, health: "callable | None" = None):
+def start_http_server(
+    port: int,
+    health: "callable | None" = None,
+    render: "callable | None" = None,
+    routes: "dict | None" = None,
+):
     """Start the metrics HTTP endpoint on a daemon thread; returns
     (ThreadingHTTPServer, bound_port).  Routes:
 
-      /metrics   Prometheus exposition of the process-global registry
+      /metrics   Prometheus exposition of the process-global registry, or
+                 of `render()` when given (the router passes its federated
+                 fleet renderer; must return exposition text)
       /healthz   JSON from `health()` (the sidecar passes a callable
                  mirroring its gRPC Health response), or a bare
                  {"status": "SERVING"} when no callable is wired
+      <extra>    each `routes` entry path -> zero-arg callable returning a
+                 JSON-able dict, served as application/json (the router
+                 mounts /autoscale this way)
 
     port=0 binds an ephemeral port (tests); the caller owns shutdown()."""
     import http.server
@@ -185,12 +196,27 @@ def start_http_server(port: int, health: "callable | None" = None):
     from . import log as obs_log
 
     log = obs_log.get_logger("nemo.metrics")
+    extra = dict(routes or {})
 
     class Handler(http.server.BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 (stdlib handler contract)
-            if self.path.split("?", 1)[0] == "/metrics":
-                body = render_prometheus().encode("utf-8")
+            path = self.path.split("?", 1)[0]
+            if path == "/metrics":
+                try:
+                    text = render_prometheus() if render is None else render()
+                except Exception as ex:
+                    log.warning("metrics.render_failed", error=repr(ex))
+                    self.send_error(500)
+                    return
+                body = text.encode("utf-8")
                 ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif path in extra:
+                try:
+                    doc = extra[path]()
+                except Exception as ex:
+                    doc = {"error": repr(ex)}
+                body = json.dumps(doc).encode("utf-8")
+                ctype = "application/json"
             elif self.path.split("?", 1)[0] == "/healthz":
                 doc = {"status": "SERVING"}
                 if health is not None:

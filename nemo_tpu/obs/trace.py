@@ -272,14 +272,30 @@ class _Span:
         return self
 
     def __exit__(self, *exc):
-        self._tracer.add_span(
-            self._name, self._start, _now_us() - self._start, self._args or None
-        )
+        dur = _now_us() - self._start
+        args = self._args or None
+        self._tracer.add_span(self._name, self._start, dur, args)
+        f = _FLIGHT
+        if f is not None and f is not self._tracer:
+            f.add_span(self._name, self._start, dur, args)
         return False
 
 
 # Module-level tracer state: None = disabled (the common case).
 _TRACER: Tracer | None = None
+
+# Armed flight recorder (obs/flight.py), duck-typed to Tracer.add_span.
+# When no tracer is active, spans record into its bounded ring instead of
+# vanishing; when a tracer IS active it sees them too (a postmortem bundle
+# must not go blind just because someone was tracing).  Set via
+# `set_flight_recorder` by flight.arm()/disarm() — trace.py never imports
+# flight, keeping the import graph acyclic.
+_FLIGHT = None
+
+
+def set_flight_recorder(rec) -> None:
+    global _FLIGHT
+    _FLIGHT = rec
 
 
 def enabled() -> bool:
@@ -302,7 +318,10 @@ def span(name: str, **attrs):
     containment).  Near-free when tracing is disabled."""
     t = _TRACER
     if t is None:
-        return _NULL
+        f = _FLIGHT
+        if f is None:
+            return _NULL
+        return _Span(f, name, attrs)
     return _Span(t, name, attrs)
 
 
@@ -312,6 +331,9 @@ def add_span(name: str, start_us: int, dur_us: int, args: dict | None = None) ->
     t = _TRACER
     if t is not None:
         t.add_span(name, start_us, dur_us, args)
+    f = _FLIGHT
+    if f is not None and f is not t:
+        f.add_span(name, start_us, dur_us, args)
 
 
 def start_trace(path: str | None, trace_id_: str | None = None) -> Tracer:

@@ -388,6 +388,7 @@ class CircuitBreaker:
             return now - self._probe_at >= self.cooldown_s
 
     def record_failure(self) -> None:
+        tripped = 0
         with self._lock:
             self._consecutive += 1
             obs.metrics.inc("sched.breaker.failures")
@@ -405,6 +406,14 @@ class CircuitBreaker:
                     detail="device lane degraded; routing host-only until a "
                     "half-open probe succeeds",
                 )
+                tripped = self._consecutive
+        if tripped:
+            # Flight-recorder dump OUTSIDE the breaker lock (bundle IO must
+            # not serialize against allow()/peek() on the dispatch path).
+            obs.flight.trigger(
+                "breaker_trip", consecutive_failures=tripped,
+                cooldown_s=self.cooldown_s,
+            )
 
     def record_success(self) -> None:
         with self._lock:
@@ -551,40 +560,49 @@ class HeterogeneousScheduler:
         failover path — the escalation ladder's last rung (the
         NEMO_SLOW_DISPATCH_MS watchdog logs, this cancels + fails over)."""
         timeout = dispatch_timeout_s()
-        if lane not in DEVICE_SIDE_LANES or not timeout:
-            return job.execute(lane, reason, stolen)
-        box: dict = {}
-        done = threading.Event()
+        # The lane span: a stitched client trace shows which scheduler lane
+        # ran each job between the admission span and the kernel spans.
+        with obs.span(f"sched:{lane}", verb=job.verb, index=job.index, reason=reason):
+            if lane not in DEVICE_SIDE_LANES or not timeout:
+                return job.execute(lane, reason, stolen)
+            box: dict = {}
+            done = threading.Event()
 
-        def target() -> None:
-            try:
-                box["res"] = job.execute(lane, reason, stolen)
-            except BaseException as ex:
-                box["ex"] = ex
-            finally:
-                done.set()
+            def target() -> None:
+                try:
+                    box["res"] = job.execute(lane, reason, stolen)
+                except BaseException as ex:
+                    box["ex"] = ex
+                finally:
+                    done.set()
 
-        t = threading.Thread(
-            target=target, daemon=True, name=f"nemo-sched-dispatch-{job.index}"
-        )
-        t.start()
-        if not done.wait(timeout):
-            obs.metrics.inc("watchdog.dispatch_timeout")
-            _log.error(
-                "sched.dispatch_timeout",
-                verb=job.verb,
-                index=job.index,
-                timeout_s=timeout,
-                detail="abandoning the wedged dispatch thread (daemon); "
-                "failing the job over to the host lane",
+            t = threading.Thread(
+                target=target, daemon=True, name=f"nemo-sched-dispatch-{job.index}"
             )
-            raise DispatchTimeout(
-                f"device dispatch of job {job.index} ({job.verb}) exceeded "
-                f"NEMO_DISPATCH_TIMEOUT_S={timeout}"
-            )
-        if "ex" in box:
-            raise box["ex"]
-        return box["res"]
+            t.start()
+            if not done.wait(timeout):
+                obs.metrics.inc("watchdog.dispatch_timeout")
+                _log.error(
+                    "sched.dispatch_timeout",
+                    verb=job.verb,
+                    index=job.index,
+                    timeout_s=timeout,
+                    detail="abandoning the wedged dispatch thread (daemon); "
+                    "failing the job over to the host lane",
+                )
+                # The escalation rung IS the incident: capture the ring
+                # (the wedged dispatch's spans are still in it).
+                obs.flight.trigger(
+                    "dispatch_watchdog", verb=job.verb, index=job.index,
+                    timeout_s=timeout,
+                )
+                raise DispatchTimeout(
+                    f"device dispatch of job {job.index} ({job.verb}) exceeded "
+                    f"NEMO_DISPATCH_TIMEOUT_S={timeout}"
+                )
+            if "ex" in box:
+                raise box["ex"]
+            return box["res"]
 
     def run(self, jobs: list[Job], serial: bool = False) -> list[dict]:
         results: list[dict | None] = [None] * len(jobs)

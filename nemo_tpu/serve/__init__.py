@@ -39,6 +39,7 @@ from .admission import (
     Ticket,
     controller,
     reset_controller,
+    slo_snapshot,
 )
 from .batch import BATCHABLE_VERBS, KernelBatcher, batcher, reset_batcher
 from .coalesce import SingleFlight, flights, reset_flights
@@ -56,4 +57,5 @@ __all__ = [
     "reset_batcher",
     "reset_controller",
     "reset_flights",
+    "slo_snapshot",
 ]

@@ -29,6 +29,21 @@ Everything is observable on the PR-4 Prometheus surface:
 cannot mint unbounded series), and the queued-vs-executing latency split as
 two histograms: ``serve.queued_s`` (admission wait) and ``serve.exec_s``
 (slot-held execution).
+
+Per-tenant SLO accounting (ISSUE 17) rides the same tier, because admission
+is the ONE chokepoint every request crosses in both directions: end-to-end
+latency (enqueue -> release, i.e. queued + executed — what the client felt)
+lands in ``serve.slo.<t>.latency_s``, a ms-ladder histogram registered via
+``set_buckets`` so SLO math gets finer bins than the default decade ladder;
+sheds are charged against an error budget — the fraction of a tenant's
+requests that may be rejected (``NEMO_SLO_SHED_BUDGET``, default 1%) —
+surfaced as the ``serve.slo.<t>.budget_remaining`` gauge (1.0 = untouched,
+0.0 = exhausted) with ``serve.slo.<t>.breaches`` counting each exhaustion
+transition.  ``slo_snapshot()`` renders the whole table (per-tenant
+request/shed totals, budget state, latency mean/max and p50/p95/p99 read
+back off the histogram buckets) for telemetry.json and the Health surface.
+Every shed also feeds the flight recorder's burst detector
+(``obs.flight.note_shed``) so a shed *burst* dumps a postmortem bundle.
 """
 
 from __future__ import annotations
@@ -90,6 +105,21 @@ def queue_timeout_seconds() -> float:
     default 120 s): a queue that cannot drain within this is overload the
     client should hear about as a reject, not a hung RPC."""
     return _env_float("NEMO_SERVE_QUEUE_S", 120.0)
+
+
+def slo_shed_budget() -> float:
+    """Fraction of a tenant's requests that may be shed before its error
+    budget reads exhausted (``NEMO_SLO_SHED_BUDGET``, default 0.01 = 1%)."""
+    return _env_float("NEMO_SLO_SHED_BUDGET", 0.01)
+
+
+#: Bucket ladder for ``serve.slo.<t>.latency_s`` — finer than the default
+#: registry ladder at the ms..s range where serving SLOs live, coarser past
+#: a minute (anything up there is already an outage, not a distribution).
+SLO_LATENCY_BUCKETS: tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0,
+)
 
 
 class AdmissionRejected(Exception):
@@ -162,6 +192,14 @@ class AdmissionController:
         #: EWMA of executed-slot seconds — the retry-after estimator's view
         #: of how fast one slot turns over.
         self._exec_ewma = 0.5
+        #: tenant -> [requests, sheds, budget_breached] — the SLO ledger.
+        #: Bounded by the same force that bounds per-tenant metric series:
+        #: tenants are sanitized 32-char strings and the registry cap stops
+        #: minting anyway, so a dict here cannot outgrow the metric space.
+        self._slo: dict[str, list] = {}
+        #: tenants whose latency ladder is already registered (set_buckets
+        #: is idempotent but takes the registry lock; skip after first).
+        self._slo_ladders: set[str] = set()
 
     # ------------------------------------------------------------- state
 
@@ -219,6 +257,7 @@ class AdmissionController:
         obs.metrics.inc("serve.requests")
         obs.metrics.inc(f"serve.tenant.{tenant}.requests")
         with self._lock:
+            self._slo.setdefault(tenant, [0, 0, False])[0] += 1
             if self._draining:
                 reason = "draining"
             elif self._queued >= self.max_queue and self._inflight >= self.max_inflight:
@@ -237,12 +276,52 @@ class AdmissionController:
         obs.metrics.inc("serve.rejected")
         obs.metrics.inc(f"serve.rejected.{reason}")
         obs.metrics.inc(f"serve.tenant.{tenant}.rejected")
+        self.record_shed(tenant, reason)
         retry = self.retry_after_s() if reason == "queue_full" else 1.0
         _log.warning(
             "serve.rejected", tenant=tenant, reason=reason,
             retry_after_s=round(retry, 2),
         )
         raise AdmissionRejected(reason, retry)
+
+    # ----------------------------------------------------- SLO accounting
+
+    def record_shed(self, tenant: str, reason: str) -> None:
+        """Charge one shed against `tenant`'s error budget and feed the
+        flight recorder's burst detector.  Called from the enqueue reject
+        path AND from the server's queue-timeout reject (a timeout is a shed
+        the queue took too long to admit — the client experienced the same
+        refusal), so the budget sees every refused request regardless of
+        which tier refused it."""
+        tenant = sanitize_tenant(tenant)
+        budget = slo_shed_budget()
+        with self._lock:
+            rec = self._slo.setdefault(tenant, [0, 0, False])
+            rec[1] += 1
+            requests, sheds, breached = max(rec[0], 1), rec[1], rec[2]
+            remaining = max(0.0, 1.0 - (sheds / requests) / budget) if budget > 0 else 0.0
+            now_breached = remaining <= 0.0
+            rec[2] = now_breached
+        obs.metrics.gauge(f"serve.slo.{tenant}.budget_remaining", remaining)
+        if now_breached and not breached:
+            obs.metrics.inc(f"serve.slo.{tenant}.breaches")
+            _log.warning(
+                "serve.slo_breach", tenant=tenant, requests=requests,
+                sheds=sheds, shed_budget=budget,
+            )
+        obs.flight.note_shed(reason, tenant)
+
+    def _slo_observe_locked(self, ticket: Ticket, now: float) -> None:
+        """End-to-end latency (enqueue -> release: queued + executed — the
+        wall the client saw) into the tenant's ms-ladder SLO histogram.
+        Caller holds the lock (the registry has its own and never re-enters
+        admission, so the nesting is one-directional and safe)."""
+        tenant = ticket.tenant
+        name = f"serve.slo.{tenant}.latency_s"
+        if tenant not in self._slo_ladders:
+            obs.metrics.set_buckets(name, SLO_LATENCY_BUCKETS)  # metrics-doc: serve.slo.<tenant>.latency_s
+            self._slo_ladders.add(tenant)
+        obs.metrics.observe(name, now - ticket.enqueued_at)  # metrics-doc: serve.slo.<tenant>.latency_s
 
     # ------------------------------------------------------- grant logic
 
@@ -303,9 +382,11 @@ class AdmissionController:
                 return
             self._inflight -= 1
             if ticket.granted_at is not None:
-                held = time.monotonic() - ticket.granted_at
+                now = time.monotonic()
+                held = now - ticket.granted_at
                 obs.metrics.observe("serve.exec_s", held)
                 self._exec_ewma = 0.7 * self._exec_ewma + 0.3 * held
+                self._slo_observe_locked(ticket, now)
             self._grant_locked()
             self._gauges_locked()
 
@@ -372,3 +453,67 @@ def reset_controller() -> None:
     global _controller
     with _controller_lock:
         _controller = None
+
+
+# ------------------------------------------------------------- SLO table
+
+
+def _hist_quantile(hist: dict, q: float) -> float:
+    """Quantile estimate off a snapshot histogram: the smallest bucket
+    upper bound covering q of the observations (standard Prometheus
+    histogram_quantile coarseness — exact would need raw samples).
+    Observations past the ladder's top land in +Inf; report the lifetime
+    max for those rather than infinity."""
+    count = hist.get("count", 0)
+    if not count:
+        return 0.0
+    need = q * count
+    for le, cum in hist.get("buckets", []):
+        if cum >= need:
+            return float(le)
+    return float(hist.get("max", 0.0))
+
+
+def slo_snapshot() -> dict:
+    """The per-tenant SLO table: request/shed totals, error-budget state,
+    and latency stats (mean/max plus p50/p95/p99 read back off the SLO
+    histogram's buckets).  Empty dict when no serving traffic has run —
+    telemetry.json and the report hide the section then.  Reads the live
+    singleton WITHOUT creating it: a CLI run that never served must not
+    boot an admission controller just to report that it didn't."""
+    with _controller_lock:
+        ctl = _controller
+    if ctl is None:
+        return {}
+    with ctl._lock:
+        ledger = {t: list(rec) for t, rec in ctl._slo.items()}
+    if not ledger:
+        return {}
+    budget = slo_shed_budget()
+    hists = obs.metrics.snapshot()["histograms"]
+    table: dict = {}
+    for tenant in sorted(ledger):
+        requests, sheds, breached = ledger[tenant]
+        ratio = sheds / max(requests, 1)
+        row = {
+            "requests": int(requests),
+            "sheds": int(sheds),
+            "shed_ratio": round(ratio, 6),
+            "shed_budget": budget,
+            "budget_remaining": round(
+                max(0.0, 1.0 - ratio / budget) if budget > 0 else 0.0, 6
+            ),
+            "breached": bool(breached),
+        }
+        h = hists.get(f"serve.slo.{tenant}.latency_s")
+        if h:
+            row["latency"] = {
+                "count": h["count"],
+                "mean_s": round(h["mean"], 6),
+                "max_s": round(h["max"], 6),
+                "p50_s": _hist_quantile(h, 0.50),
+                "p95_s": _hist_quantile(h, 0.95),
+                "p99_s": _hist_quantile(h, 0.99),
+            }
+        table[tenant] = row
+    return table

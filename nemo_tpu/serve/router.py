@@ -48,10 +48,15 @@ import grpc
 
 from nemo_tpu import obs
 from nemo_tpu.obs import log as obs_log
+from nemo_tpu.serve.autoscale import Autoscaler
 from nemo_tpu.utils.backoff import FAILOVER_POLICY
 from nemo_tpu.utils.env import env_float
 
 _log = obs_log.get_logger("nemo.router")
+
+#: Same cap as the replica's per-RPC span relay (service/server.py): a
+#: stitched span payload past this rides without the router's additions.
+_SPANS_MAX_BYTES = 1 << 20
 
 #: Same service name the replicas register (service/server.py) — the
 #: router is indistinguishable from a replica to every existing client.
@@ -142,6 +147,10 @@ class Router:
         self._inflight = {b: 0 for b in self.backends}
         self._depth = {b: 0.0 for b in self.backends}
         self._up = {b: True for b in self.backends}
+        # Full per-replica metrics snapshot from the last Health round —
+        # the federation/autoscale source of truth ({} until first reply).
+        self._snaps: dict[str, dict] = {b: {} for b in self.backends}
+        self.autoscaler = Autoscaler()
         self._stop = threading.Event()
         self._health_thread: threading.Thread | None = None
 
@@ -184,6 +193,7 @@ class Router:
         req = pb.HealthRequest().SerializeToString()
         for b in self.backends:
             depth = 0.0
+            snap: dict = {}
             try:
                 method = self._channel(b).unary_unary(f"/{SERVICE}/Health")
                 _, call = method.with_call(req, timeout=5.0)
@@ -204,12 +214,54 @@ class Router:
                 was_up = self._up[b]
                 self._up[b] = up
                 self._depth[b] = depth if up else 0.0
+                if up:
+                    # A down replica keeps its LAST snapshot (the federated
+                    # page marks it down via nemo_fleet_backend_up; stale
+                    # series beat vanishing series mid-incident).
+                    self._snaps[b] = snap
             if up != was_up:
                 obs.metrics.inc("router.backend_up" if up else "router.backend_down")
                 _log.warning("router.backend_state", backend=b, up=up)
             obs.metrics.gauge(
                 f"router.backend.{self.backends.index(b)}.up", 1.0 if up else 0.0
             )
+        snaps, up_map = self.fleet_snapshots()
+        rec = self.autoscaler.update(snaps, up_map)
+        obs.metrics.gauge("fleet.autoscale.recommendation", rec)
+
+    def fleet_snapshots(self) -> tuple[dict, dict]:
+        """(target -> last Health-ride metrics snapshot, target -> up) —
+        what the federated /metrics page and the autoscaler consume."""
+        with self._lock:
+            return (
+                {b: self._snaps.get(b) or {} for b in self.backends},
+                dict(self._up),
+            )
+
+    def fleet_health_trailing(self, tm, backend: str):
+        """Health trailing-metadata hook: replace the ONE forwarded
+        replica's ``nemo-metrics-bin`` snapshot with the whole fleet's —
+        ``{"replicas": {target: snapshot}, "up": {target: bool}}`` — so
+        ``client.health()["metrics"]`` through the router describes every
+        replica instead of whichever replica answered.  The answering
+        replica's snapshot is taken fresh from this very response; the
+        rest come from the last Health poll round."""
+        snaps, up = self.fleet_snapshots()
+        out = []
+        for k, v in tm or ():
+            if k == "nemo-metrics-bin":
+                try:
+                    snaps[backend] = json.loads(
+                        v.decode("utf-8") if isinstance(v, bytes) else v
+                    )
+                    up[backend] = True
+                except Exception:  # lint: allow-silent-except — stale poll snapshot stands in
+                    pass
+                continue
+            out.append((k, v))
+        doc = {"replicas": snaps, "up": up}
+        out.append(("nemo-metrics-bin", json.dumps(doc).encode("utf-8")))
+        return tuple(out)
 
     def _channel(self, b: str) -> grpc.Channel:
         with self._lock:
@@ -354,11 +406,74 @@ class Router:
         obs.metrics.inc(f"router.errors.{rpc}")
         context.abort(ex.code(), ex.details() or f"{rpc} failed on every replica")
 
-    def call_unary(self, rpc: str, request: bytes, context, key: str | None = None) -> bytes:
+    # ----------------------------------------------------- trace stitching
+
+    @staticmethod
+    def _trace_id_of(md: tuple) -> str | None:
+        for k, v in md:
+            if k == "nemo-trace-id":
+                return v if isinstance(v, str) else v.decode("utf-8", "replace")
+        return None
+
+    @staticmethod
+    def _stitch_trailing(tm, spans: list[dict]):
+        """Merge the router's own forward spans into the replica's
+        ``nemo-spans-bin`` trailing payload (wire shape:
+        Tracer.drain_spans dicts) so the tracing client adopts ONE stitched
+        set — replica spans under the replica's pid, router spans under
+        ours.  Oversize payloads ride through without the additions (same
+        cap stance as the replica's _SpanCollection)."""
+        if not spans:
+            return tm
+        out = []
+        payload: list = []
+        for k, v in tm or ():
+            if k == "nemo-spans-bin":
+                try:
+                    payload = json.loads(v.decode("utf-8") if isinstance(v, bytes) else v)
+                except Exception:
+                    payload = []
+                continue
+            out.append((k, v))
+        payload = list(payload) + spans
+        blob = json.dumps(payload).encode("utf-8")
+        if len(blob) <= _SPANS_MAX_BYTES:
+            out.append(("nemo-spans-bin", blob))
+        return tuple(out)
+
+    def _forward_span(
+        self, rpc: str, backend: str, start_us: int, dur_us: int, attempt: int
+    ) -> dict:
+        """One router-hop span in the cross-process wire shape `adopt`
+        consumes.  Also lands in the armed flight recorder's ring (and the
+        router's own tracer, were one active)."""
+        args = {"backend": backend, "attempt": attempt}
+        obs.add_span(f"router:{rpc}", start_us, dur_us, args)
+        return {
+            "name": f"router:{rpc}",
+            "ts": start_us,
+            "dur": dur_us,
+            "pid": os.getpid(),
+            "tid": threading.get_ident(),
+            "thread_name": threading.current_thread().name,
+            "args": args,
+        }
+
+    def call_unary(
+        self,
+        rpc: str,
+        request: bytes,
+        context,
+        key: str | None = None,
+        trailing_hook=None,
+    ) -> bytes:
         """Forward one unary RPC: affinity plan, reactive spill on a
-        shedding home, jittered failover on UNAVAILABLE."""
+        shedding home, jittered failover on UNAVAILABLE.  `trailing_hook`
+        (tm, backend) -> tm lets a handler rewrite the trailing metadata
+        before relay (the Health handler swaps in the fleet snapshot)."""
         obs.metrics.inc(f"router.requests.{rpc}")
         md = self._fwd_metadata(context)
+        client_tid = self._trace_id_of(md)
         timeout = self._timeout_of(context)
         backoff = FAILOVER_POLICY.session()
         candidates = self.plan(key)
@@ -373,10 +488,20 @@ class Router:
             method = ch.unary_unary(f"/{SERVICE}/{rpc}")
             self._begin(b)
             try:
+                start_us = time.perf_counter_ns() // 1000
                 resp, call = method.with_call(
                     request, metadata=md or None, timeout=timeout
                 )
-                tm = call.trailing_metadata()
+                dur_us = time.perf_counter_ns() // 1000 - start_us
+                tm = call.trailing_metadata() or ()
+                if client_tid is not None:
+                    tm = self._stitch_trailing(
+                        tm, [self._forward_span(rpc, b, start_us, dur_us, i)]
+                    )
+                else:
+                    self._forward_span(rpc, b, start_us, dur_us, i)
+                if trailing_hook is not None:
+                    tm = trailing_hook(tm, b)
                 if tm and context is not None:
                     context.set_trailing_metadata(tuple(tm))
                 obs.metrics.inc(f"router.routed.{rpc}")
@@ -419,6 +544,7 @@ class Router:
         retry precedent, service/client.py:analyze_dir_stream)."""
         obs.metrics.inc(f"router.requests.{rpc}")
         md = self._fwd_metadata(context)
+        client_tid = self._trace_id_of(md)
         timeout = self._timeout_of(context)
         backoff = FAILOVER_POLICY.session()
         candidates = self.plan(key)
@@ -434,12 +560,17 @@ class Router:
             self._begin(b)
             got_any = False
             try:
+                start_us = time.perf_counter_ns() // 1000
                 stream = method(request, metadata=md or None, timeout=timeout)
                 for item in stream:
                     got_any = True
                     yield item
+                dur_us = time.perf_counter_ns() // 1000 - start_us
                 try:
-                    tm = stream.trailing_metadata()
+                    tm = stream.trailing_metadata() or ()
+                    span = self._forward_span(rpc, b, start_us, dur_us, 0)
+                    if client_tid is not None:
+                        tm = self._stitch_trailing(tm, [span])
                     if tm and context is not None:
                         context.set_trailing_metadata(tuple(tm))
                 except Exception:  # lint: allow-silent-except — best-effort metadata relay
@@ -528,10 +659,12 @@ def make_router_server(
     router = Router(backends, vnodes=vnodes)
     router.start()
 
-    def unary(rpc: str, keyed: bool = False):
+    def unary(rpc: str, keyed: bool = False, trailing_hook=None):
         def handler(request: bytes, context):
             key = _dir_key_of(request) if keyed else None
-            return router.call_unary(rpc, request, context, key=key)
+            return router.call_unary(
+                rpc, request, context, key=key, trailing_hook=trailing_hook
+            )
 
         return grpc.unary_unary_rpc_method_handler(handler)
 
@@ -543,7 +676,7 @@ def make_router_server(
         return grpc.unary_stream_rpc_method_handler(handler)
 
     handlers = {
-        "Health": unary("Health"),
+        "Health": unary("Health", trailing_hook=router.fleet_health_trailing),
         "Analyze": unary("Analyze"),
         "Kernel": unary("Kernel"),
         "AnalyzeDir": unary("AnalyzeDir", keyed=True),

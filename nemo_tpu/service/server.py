@@ -231,6 +231,7 @@ class _Impl:
         While queued, the wait polls so a dead client's slot request is
         abandoned instead of granted to a hung handler."""
         tenant = _tenant_of(context)
+        t0_us = time.perf_counter_ns() // 1000
         try:
             ticket = self.admission.enqueue(tenant)
         except serve.AdmissionRejected as ex:
@@ -253,6 +254,9 @@ class _Impl:
                 ticket.cancel()
                 obs.metrics.inc("serve.rejected")
                 obs.metrics.inc("serve.rejected.queue_timeout")
+                # A timeout is a shed the queue took too long to admit —
+                # charge the tenant's SLO budget like any other refusal.
+                self.admission.record_shed(tenant, "queue_timeout")
                 context.set_trailing_metadata(
                     (("nemo-retry-after-s", f"{self.admission.retry_after_s():.3f}"),)
                 )
@@ -260,7 +264,28 @@ class _Impl:
                     grpc.StatusCode.RESOURCE_EXHAUSTED,
                     f"{rpc} queued past the admission timeout",
                 )
+        # The queued interval as a span: a stitched client trace shows
+        # admission wait next to exec instead of an unexplained gap.
+        obs.add_span(
+            "serve:admission",
+            t0_us,
+            time.perf_counter_ns() // 1000 - t0_us,
+            {"tenant": tenant, "rpc": rpc},
+        )
         return ticket
+
+    def _admit_traced(self, context, rpc: str) -> tuple:
+        """(ticket, span collection) — the collection FIRST, so the
+        admission-wait span lands in the traced client's stitched set
+        rather than only the flight ring; released on an admission abort
+        (context.abort raises) so a rejected request can't leak the
+        pathless collector."""
+        col = _SpanCollection(context)
+        try:
+            return self._admit(context, rpc), col
+        except BaseException:
+            col.release()
+            raise
 
     def health(self, request: pb.HealthRequest, context) -> pb.HealthResponse:
         col = _SpanCollection(context)
@@ -340,8 +365,7 @@ class _Impl:
 
     def analyze(self, request: pb.AnalyzeRequest, context) -> pb.AnalyzeResponse:
         t0 = time.perf_counter()
-        ticket = self._admit(context, "Analyze")
-        col = _SpanCollection(context)
+        ticket, col = self._admit_traced(context, "Analyze")
         try:
             resp = self._analyze_one(request, trace_id=col.tid)
             md = col.trailing()
@@ -359,8 +383,7 @@ class _Impl:
         # One admission ticket covers the whole stream: a streaming session
         # is one continuous occupancy of the device, not per-chunk work.
         t0 = time.perf_counter()
-        ticket = self._admit(context, "AnalyzeStream")
-        col = _SpanCollection(context)
+        ticket, col = self._admit_traced(context, "AnalyzeStream")
         try:
             for request in request_iterator:
                 yield self._analyze_one(request, trace_id=col.tid)
@@ -411,8 +434,7 @@ class _Impl:
                 grpc.StatusCode.INVALID_ARGUMENT,
                 f"not a directory on the sidecar host: {d!r}",
             )
-        ticket = self._admit(context, "AnalyzeDir")
-        col = _SpanCollection(context)
+        ticket, col = self._admit_traced(context, "AnalyzeDir")
         try:
             payload, meta = self._dir_payload(request, d, col.tid, ticket, context)
             md = col.trailing() + (
@@ -642,6 +664,12 @@ class _Impl:
         lease = Lease(rc.lease_root, "analyze_dir", content_key, owner=_replica_id())
         deadline = time.monotonic() + serve.coalesce.Flight.WAIT_TIMEOUT_S
         followed = False
+        flight_id = content_key[:16]
+        t0_us = time.perf_counter_ns() // 1000
+        # Read the leader's identity BEFORE serving its bytes: the lease is
+        # released right after publish, so a post-return read would usually
+        # find nothing to link the follower's trace to.
+        leader_owner: str | None = None
         while True:
             # Blob BEFORE lease: a finished leader publishes and only then
             # releases, so a waiter waking between the two must serve the
@@ -651,6 +679,20 @@ class _Impl:
                 if cached is not None:
                     if not followed:
                         obs.metrics.inc("serve.fleet.follower")
+                    # Span-link to the leader's flight: the follower's
+                    # trace names the flight id (shared with the leader's
+                    # serve:fleet_leader span args) and the leader replica
+                    # that computed the bytes — not a dead end.
+                    obs.add_span(
+                        "serve:fleet_follower",
+                        t0_us,
+                        time.perf_counter_ns() // 1000 - t0_us,
+                        {
+                            "flight": flight_id,
+                            "span_link": f"flight:{flight_id}",
+                            "leader": leader_owner or lease.read_owner(),
+                        },
+                    )
                     return cached, "follower"
                 # Present but unreadable/corrupt (counted stale by the
                 # cache): fall through — the next acquire/poll decides.
@@ -682,13 +724,17 @@ class _Impl:
                 )
                 hb.start()
                 try:
-                    return run(), "leader"
+                    with obs.span(
+                        "serve:fleet_leader", flight=flight_id, owner=lease.owner
+                    ):
+                        return run(), "leader"
                 finally:
                     stop.set()
                     lease.release()
             if not followed:
                 followed = True
                 obs.metrics.inc("serve.fleet.follower")
+                leader_owner = lease.read_owner()
                 log.debug(
                     "serve.fleet_follower", key=content_key[:12],
                     detail="another replica leads this content address; "
@@ -910,8 +956,7 @@ class _Impl:
                 "live report publishes under)",
             )
         d = dirs[0]
-        col = _SpanCollection(context)
-        ticket = self._admit(context, "AnalyzeDirStream")
+        ticket, col = self._admit_traced(context, "AnalyzeDirStream")
         self.admission.begin_stream()
         watcher = None
         th = None
@@ -1011,8 +1056,7 @@ class _Impl:
         from nemo_tpu.backend.jax_backend import LocalExecutor
 
         t_rpc = time.perf_counter()
-        ticket = self._admit(context, "Kernel")
-        col = _SpanCollection(context)
+        ticket, col = self._admit_traced(context, "Kernel")
         try:
             verb, arrays, params = codec.kernel_request_from_pb(request)
             if verb not in LocalExecutor.VERBS:
@@ -1131,11 +1175,16 @@ def _router_main(args) -> int:
         return 2
     from nemo_tpu.serve.router import make_router_server
 
+    # The flight recorder is on for the router too: a breaker-style
+    # incident seen from the routing tier (failover storms, spill loops)
+    # deserves the same postmortem capture as a replica-side one.
+    if obs.flight.configure_from_env() is not None:
+        log.info("flight.armed", dir=obs.flight.recorder().out_dir)
     server, port, router = make_router_server(args.port, backends)
     server.start()
     metrics_httpd = None
     if args.metrics_port:
-        from nemo_tpu.obs import promexp
+        from nemo_tpu.obs import federation, promexp
 
         def _router_health() -> dict:
             states = router.backend_states()
@@ -1148,10 +1197,23 @@ def _router_main(args) -> int:
                 "backends": states,
             }
 
+        def _fleet_metrics() -> str:
+            # The federated page: router's own registry unlabeled, every
+            # replica's last Health-ride snapshot {replica=...}-labeled,
+            # fleet rollups + liveness gauges (obs/federation.py).
+            snaps, up = router.fleet_snapshots()
+            return federation.federate(snaps, up)
+
         metrics_httpd, mport = promexp.start_http_server(
-            args.metrics_port, health=_router_health
+            args.metrics_port,
+            health=_router_health,
+            render=_fleet_metrics,
+            routes={"/autoscale": router.autoscaler.doc},
         )
-        log.info("metrics.listening", port=mport, paths=["/metrics", "/healthz"])
+        log.info(
+            "metrics.listening", port=mport,
+            paths=["/metrics", "/healthz", "/autoscale"],
+        )
     log.info("router.listening", port=port, backends=backends)
     term = threading.Event()
 
@@ -1397,6 +1459,12 @@ def main(argv: list[str] | None = None) -> int:
     # either way (obs/trace.py).
     if obs_trace.configure_from_env() is not None:
         log.info("trace.enabled", path=obs.tracer().path)
+    # Always-on flight recorder (NEMO_FLIGHT=off to disable): the ring
+    # costs a tuple append per span; the first breaker trip / watchdog
+    # escalation / shed burst dumps a Perfetto-loadable postmortem bundle
+    # even though nobody had --trace on (obs/flight.py).
+    if obs.flight.configure_from_env() is not None:
+        log.info("flight.armed", dir=obs.flight.recorder().out_dir)
     if args.profiler_port:
         import jax
 
@@ -1414,6 +1482,10 @@ def main(argv: list[str] | None = None) -> int:
     server.start()
     _prewarm_async()
     ctl = serve.controller()
+    # The admission capacity as a gauge: the router's autoscaler divides
+    # fleet queue depth by summed capacity to get a utilization it can
+    # threshold (serve/autoscale.py).
+    obs.metrics.gauge("serve.capacity", float(ctl.max_inflight))
     log.info(
         "sidecar.listening", port=port, replica=_replica_id(),
         max_inflight=ctl.max_inflight, max_queue=ctl.max_queue,

@@ -674,6 +674,11 @@ class Lease:
                         "rcache.lease_stolen", path=self.path, owner=self.owner,
                         detail="previous leader's heartbeat expired; re-elected",
                     )
+                    # A steal means a leader died mid-flight — exactly the
+                    # moment a postmortem bundle is worth its disk.
+                    obs.flight.trigger(
+                        "lease_steal", path=self.path, new_owner=self.owner
+                    )
                     return True
                 return False
             finally:
@@ -695,6 +700,16 @@ class Lease:
             return time.time() - os.path.getmtime(self.path) > self.ttl_s
         except OSError:
             return True
+
+    def read_owner(self) -> str | None:
+        """The lease file's CURRENT owner id, whoever holds it (a follower
+        reads this to span-link its trace to the leader's flight), or None
+        when the lease is gone/unreadable."""
+        try:
+            with open(self.path, "r", encoding="utf-8") as fh:
+                return json.load(fh).get("owner")
+        except (OSError, ValueError):
+            return None
 
     def heartbeat(self) -> None:
         """Refresh the holder's liveness stamp (no-op unless held)."""

@@ -1595,6 +1595,474 @@ def fleet_smoke() -> int:
                 os.environ[k] = v
 
 
+def obs_fleet_smoke() -> int:
+    """Fleet-observability smoke (`make obs-fleet-smoke`, also the tail of
+    `make validate`; ISSUE 17): boot TWO replicas plus the router with
+    --metrics-port and assert the four legs of the observability plane:
+
+      * **federation** — the router's /metrics page parses as conformant
+        Prometheus text and carries BOTH replicas' series under
+        ``{replica="host:port"}`` labels, ``nemo_fleet_*`` rollups
+        (counters summed, the ``serve.capacity`` gauge envelope), and the
+        ``nemo_fleet_backend_up`` / ``nemo_fleet_backends_up`` liveness
+        gauges (obs/federation.py);
+      * **trace stitching** — ONE traced warm AnalyzeDir through the
+        router yields ONE trace file holding the client's rpc span, the
+        router's forward span, and the replica's admission + serve spans,
+        from >=3 distinct pids (serve/router.py, service/server.py);
+      * **flight recorder** — replica 0 boots with an injected chaos fault
+        (``NEMO_CHAOS=fail_dispatch:2`` + ``NEMO_BREAKER_FAILURES=2``);
+        its first two batched Kernel dispatches fail on the device lane
+        (serve/batch.py -> parallel/sched.py), tripping the breaker, which
+        dumps exactly ONE ``flightrec-breaker_trip-*.json`` bundle — and
+        the SAME kernel call then succeeds, closing the breaker
+        (obs/flight.py);
+      * **autoscale** — a shed surge against a 1-slot/0-queue replica
+        flips the router's /autoscale recommendation to +1, and going idle
+        flips it back down through the hold-count hysteresis
+        (serve/autoscale.py);
+
+    then SIGTERM drains the whole fleet cleanly (every process exits 0).
+    """
+    import glob
+    import importlib.util
+    import signal
+    import subprocess
+    import sys as _sys
+    import threading
+    import time as _time
+    import urllib.request
+
+    from nemo_tpu.utils.jax_config import pin_platform
+    from nemo_tpu.utils.subproc import PortReservation, free_port, wait_listening
+
+    if importlib.util.find_spec("grpc") is None:
+        print(
+            "obs-fleet-smoke: grpcio not installed; skipping (the smoke's "
+            "whole surface is the sidecar fleet)",
+            file=sys.stderr,
+        )
+        return 0
+    pin_platform("cpu")
+    knobs = (
+        "NEMO_SERVE_INFLIGHT",
+        "NEMO_SERVE_QUEUE",
+        "NEMO_SERVE_DRAIN_S",
+        "NEMO_SERVE_COALESCE_LINGER_S",
+        "NEMO_SERVE_PREWARM",
+        "NEMO_RESULT_CACHE",
+        "NEMO_RCACHE_SHARED",
+        "NEMO_CORPUS_CACHE",
+        "NEMO_FLEET_REPLICAS",
+        "NEMO_CHAOS",
+        "NEMO_BREAKER_FAILURES",
+        "NEMO_FLIGHT",
+        "NEMO_FLIGHT_DIR",
+        "NEMO_FLIGHT_COOLDOWN_S",
+        "NEMO_ROUTER_HEALTH_S",
+        "NEMO_AUTOSCALE_UP",
+        "NEMO_AUTOSCALE_DOWN",
+        "NEMO_AUTOSCALE_HOLD_UP",
+        "NEMO_AUTOSCALE_HOLD_DOWN",
+        "NEMO_AUTOSCALE_COOLDOWN_S",
+        "NEMO_TRACE",
+        "NEMO_SLO_SHED_BUDGET",
+    )
+    prior_knobs = {k: os.environ.pop(k, None) for k in knobs}
+    try:
+        with tempfile.TemporaryDirectory(prefix="nemo_obs_fleet_smoke_") as tmp:
+            from nemo_tpu.models.synth import SynthSpec, write_corpus
+            from nemo_tpu.obs import trace as obs_trace
+            from nemo_tpu.obs.promexp import parse_prometheus_text
+            from nemo_tpu.service.client import RemoteAnalyzer
+
+            chaos_dir = write_corpus(SynthSpec(n_runs=5, seed=71, name="chaos"), tmp)
+            stitch_dir = write_corpus(SynthSpec(n_runs=5, seed=72, name="stitch"), tmp)
+            flight_dirs = [os.path.join(tmp, f"flight{i}") for i in range(2)]
+
+            def replica_env(i: int) -> dict:
+                env = dict(
+                    os.environ,
+                    NEMO_LOG_FILE=os.path.join(tmp, f"replica{i}_log.jsonl"),
+                    NEMO_CORPUS_CACHE=os.path.join(tmp, f"corpus_cache{i}"),
+                    NEMO_RESULT_CACHE=os.path.join(tmp, f"result_cache{i}"),
+                    NEMO_JAX_CACHE=os.path.join(tmp, "jax_cache"),
+                    # 1 slot, no queue: the shed surge below must reject
+                    # instantly (serve.rejected is the autoscaler's up
+                    # signal), and capacity=1 keeps the utilization math
+                    # legible on the federated page.
+                    NEMO_SERVE_INFLIGHT="1",
+                    NEMO_SERVE_QUEUE="0",
+                    NEMO_FLIGHT_DIR=flight_dirs[i],
+                    # One bundle per reason for the whole smoke.
+                    NEMO_FLIGHT_COOLDOWN_S="600",
+                )
+                if i == 0:
+                    # First 2 device-lane dispatches fail -> host-lane
+                    # failover keeps the request green while the breaker
+                    # (threshold 2) trips and fires the flight trigger.
+                    env["NEMO_CHAOS"] = "fail_dispatch:2"
+                    env["NEMO_BREAKER_FAILURES"] = "2"
+                return env
+
+            procs: list = []
+            log_fhs: list = []
+
+            def boot(args: list, env: dict, name: str):
+                fh = open(os.path.join(tmp, f"{name}.stderr"), "w")
+                log_fhs.append(fh)
+                p = subprocess.Popen(
+                    [_sys.executable, "-m", "nemo_tpu.service.server", *args],
+                    stdout=fh,
+                    stderr=subprocess.STDOUT,
+                    env=env,
+                )
+                procs.append(p)
+                return p
+
+            problems: list[str] = []
+            ports = PortReservation(3)
+            rports = [ports.ports[0], ports.ports[1]]
+            router_port = ports.ports[2]
+            mport = free_port()
+            try:
+                replicas = []
+                for i in range(2):
+                    ports.release(i)
+                    replicas.append(
+                        boot(
+                            ["--port", str(rports[i]), "--platform", "cpu"],
+                            replica_env(i),
+                            f"replica{i}",
+                        )
+                    )
+                for i in range(2):
+                    wait_listening(rports[i], deadline_s=120.0, proc=replicas[i])
+                targets = [f"127.0.0.1:{p}" for p in rports]
+                for t in targets:
+                    with RemoteAnalyzer(target=t) as c:
+                        c.wait_ready(60.0)
+                ports.release(2)
+                router = boot(
+                    [
+                        "--router",
+                        "--port", str(router_port),
+                        "--backends", ",".join(targets),
+                        "--metrics-port", str(mport),
+                    ],
+                    dict(
+                        os.environ,
+                        NEMO_LOG_FILE=os.path.join(tmp, "router_log.jsonl"),
+                        NEMO_FLIGHT_DIR=os.path.join(tmp, "flight_router"),
+                        # Fast polls + short holds so the hysteresis
+                        # round-trips inside a smoke budget: up after 1
+                        # shed-delta poll, down after 3 calm polls + 1 s
+                        # cooldown.
+                        NEMO_ROUTER_HEALTH_S="0.2",
+                        NEMO_AUTOSCALE_HOLD_UP="1",
+                        NEMO_AUTOSCALE_HOLD_DOWN="3",
+                        NEMO_AUTOSCALE_COOLDOWN_S="1",
+                    ),
+                    "router",
+                )
+                wait_listening(router_port, deadline_s=60.0, proc=router)
+                router_target = f"127.0.0.1:{router_port}"
+                with RemoteAnalyzer(target=router_target) as c:
+                    c.wait_ready(60.0)
+
+                def replica_counters(t: str) -> dict:
+                    with RemoteAnalyzer(target=t) as c:
+                        return c.health().get("metrics", {}).get("counters", {})
+
+                def http_json(path: str) -> dict:
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{mport}{path}", timeout=15
+                    ) as resp:
+                        return json.loads(resp.read().decode("utf-8"))
+
+                # ---- 1. Flight recorder: replica 0's first two batched
+                # Kernel dispatches hit the injected device-lane faults
+                # inside the continuous batcher's scheduler job; failure 2
+                # trips the breaker (NEMO_BREAKER_FAILURES=2) and dumps
+                # exactly one bundle, then the SAME call succeeds and
+                # closes it.
+                import numpy as _np
+
+                from nemo_tpu.ingest.molly import load_molly_output
+                from nemo_tpu.models.pipeline_model import pack_molly_for_step
+
+                _, kpost, kstatic = pack_molly_for_step(load_molly_output(chaos_dir))
+                karrays = {
+                    "edge_src": _np.asarray(kpost.edge_src),
+                    "edge_dst": _np.asarray(kpost.edge_dst),
+                    "edge_mask": _np.asarray(kpost.edge_mask),
+                    "is_goal": _np.asarray(kpost.is_goal),
+                    "table_id": _np.asarray(kpost.table_id),
+                    "node_mask": _np.asarray(kpost.node_mask),
+                }
+                kparams = {
+                    "v": kstatic["v"],
+                    "cond_tid": kstatic["post_tid"],
+                    "num_tables": kstatic["num_tables"],
+                }
+                recovered = False
+                with RemoteAnalyzer(target=targets[0]) as c:
+                    for _ in range(5):
+                        try:
+                            c.kernel("condition", karrays, kparams)
+                        except Exception:
+                            continue  # an injected fault surfacing — expected
+                        recovered = True
+                        break
+                if not recovered:
+                    problems.append(
+                        "replica 0's Kernel RPC never recovered after the "
+                        "injected chaos faults were spent"
+                    )
+                c0 = replica_counters(targets[0])
+                if int(c0.get("sched.breaker.trip", 0)) < 1:
+                    problems.append(
+                        "replica 0 never tripped its breaker under "
+                        f"fail_dispatch chaos (counters: "
+                        f"{ {k: v for k, v in c0.items() if 'breaker' in k} })"
+                    )
+                bundles: list = []
+                deadline = _time.monotonic() + 30.0
+                while _time.monotonic() < deadline:
+                    bundles = glob.glob(
+                        os.path.join(flight_dirs[0], "flightrec-breaker_trip-*.json")
+                    )
+                    if bundles:
+                        break
+                    _time.sleep(0.2)
+                if len(bundles) != 1:
+                    problems.append(
+                        f"expected exactly ONE breaker_trip flight bundle, "
+                        f"found {len(bundles)}: {sorted(map(os.path.basename, bundles))}"
+                    )
+                else:
+                    with open(bundles[0], "r", encoding="utf-8") as fh:
+                        bundle = json.load(fh)
+                    other = bundle.get("otherData", {})
+                    if other.get("reason") != "breaker_trip":
+                        problems.append(
+                            f"flight bundle reason={other.get('reason')!r}, "
+                            "want 'breaker_trip'"
+                        )
+                    if not other.get("context", {}).get("consecutive_failures"):
+                        problems.append(
+                            "flight bundle context lost the breaker's "
+                            "consecutive_failures count"
+                        )
+                    events = _validate_trace_events(bundle)
+                    if not any(ev["ph"] == "X" for ev in events):
+                        problems.append(
+                            "flight bundle ring captured no spans around the trip"
+                        )
+                    delta = other.get("metrics_delta", {}).get("counters", {})
+                    if int(delta.get("sched.breaker.trip", 0)) < 1:
+                        problems.append(
+                            "flight bundle metrics_delta does not show the trip"
+                        )
+
+                # ---- 2. Trace stitching: warm the corpus through the
+                # router, then repeat TRACED — one trace file must hold the
+                # client rpc span, the router forward span, and the
+                # replica's admission + serve spans, from >=3 processes.
+                with RemoteAnalyzer(target=router_target) as c:
+                    c.analyze_dir_remote(stitch_dir)  # cold: pins affinity
+                trace_path = os.path.join(tmp, "stitched.json")
+                obs_trace.start_trace(trace_path)
+                try:
+                    with RemoteAnalyzer(target=router_target) as c:
+                        c.analyze_dir_remote(stitch_dir)  # warm rcache hit
+                finally:
+                    obs_trace.finish()
+                with open(trace_path, "r", encoding="utf-8") as fh:
+                    events = _validate_trace_events(json.load(fh))
+                names = {ev["name"] for ev in events}
+                for want in (
+                    "rpc:AnalyzeDir",
+                    "router:AnalyzeDir",
+                    "serve:admission",
+                    "serve:AnalyzeDir",
+                ):
+                    if want not in names:
+                        problems.append(f"stitched trace is missing a {want!r} span")
+                pids = {ev["pid"] for ev in events if ev["ph"] == "X"}
+                if len(pids) < 3:
+                    problems.append(
+                        f"stitched trace spans come from {len(pids)} pid(s), "
+                        "want >=3 (client + router + replica)"
+                    )
+
+                # ---- 3. Federation: the router's /metrics carries both
+                # replicas' labeled series, fleet rollups, and liveness.
+                text = ""
+                deadline = _time.monotonic() + 30.0
+                while _time.monotonic() < deadline:
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{mport}/metrics", timeout=15
+                    ) as resp:
+                        text = resp.read().decode("utf-8")
+                    # Both replica labels appear almost at boot (the first
+                    # Health-poll snapshot); the chunks rollup needs a poll
+                    # taken AFTER leg 2's analysis, so wait for it too.
+                    if (
+                        all(f'replica="{t}"' in text for t in targets)
+                        and "nemo_fleet_serve_analyze_chunks_total" in text
+                    ):
+                        break
+                    _time.sleep(0.3)
+                fams = parse_prometheus_text(text)  # raises on a malformed page
+                for t in targets:
+                    if f'replica="{t}"' not in text:
+                        problems.append(f"/metrics has no series labeled replica={t!r}")
+                up_fam = fams.get("nemo_fleet_backend_up", {"samples": []})
+                up_vals = {
+                    labels.get("replica"): v
+                    for _, labels, v in up_fam["samples"]
+                }
+                if not all(up_vals.get(t) == 1 for t in targets):
+                    problems.append(
+                        f"nemo_fleet_backend_up does not show both replicas "
+                        f"up: {up_vals}"
+                    )
+                n_up = fams.get("nemo_fleet_backends_up", {"samples": []})["samples"]
+                if not n_up or n_up[0][2] != 2:
+                    problems.append(f"nemo_fleet_backends_up != 2: {n_up}")
+                if "nemo_fleet_serve_analyze_chunks_total" not in fams:
+                    problems.append(
+                        "/metrics has no summed nemo_fleet_serve_analyze_chunks_total "
+                        "counter rollup"
+                    )
+                cap = {
+                    labels.get("agg"): v
+                    for _, labels, v in fams.get(
+                        "nemo_fleet_serve_capacity", {"samples": []}
+                    )["samples"]
+                }
+                if cap.get("max") != 1 or cap.get("min") != 1:
+                    problems.append(
+                        f"nemo_fleet_serve_capacity envelope is not the "
+                        f"replicas' 1-slot admission capacity: {cap}"
+                    )
+
+                # ---- 4. Autoscale: a shed surge (concurrent requests at a
+                # full 1-slot/0-queue replica) flips the recommendation up;
+                # going idle flips it back down through the hold-count
+                # hysteresis.  Warm the surge corpus first (which also
+                # proves replica 0 serves normally after the breaker
+                # episode) so surge rounds are instant rcache hits.
+                with RemoteAnalyzer(target=targets[0]) as c:
+                    c.analyze_dir_remote(chaos_dir)
+                def surge_round() -> None:
+                    def one() -> None:
+                        try:
+                            with RemoteAnalyzer(target=targets[0]) as c:
+                                c.analyze_dir_remote(chaos_dir)  # warm hit
+                        except Exception:
+                            pass  # the rejections ARE the signal
+                    ts = [threading.Thread(target=one) for _ in range(4)]
+                    for th in ts:
+                        th.start()
+                    for th in ts:
+                        th.join(timeout=120)
+
+                rec_up = None
+                deadline = _time.monotonic() + 90.0
+                while _time.monotonic() < deadline:
+                    surge_round()
+                    _time.sleep(0.3)
+                    doc = http_json("/autoscale")
+                    if doc.get("recommendation", 0) >= 1:
+                        rec_up = doc
+                        break
+                if rec_up is None:
+                    problems.append(
+                        "shed surge never flipped /autoscale to a scale-up "
+                        f"recommendation (last: {http_json('/autoscale')})"
+                    )
+                elif rec_up.get("desired_replicas") != 3:
+                    problems.append(
+                        f"scale-up desired_replicas != live+1: {rec_up}"
+                    )
+                rec_down = None
+                deadline = _time.monotonic() + 90.0
+                while _time.monotonic() < deadline:
+                    doc = http_json("/autoscale")
+                    if doc.get("recommendation", 0) <= -1:
+                        rec_down = doc
+                        break
+                    _time.sleep(0.3)
+                if rec_down is None:
+                    problems.append(
+                        "idle fleet never flipped /autoscale back down "
+                        f"(last: {http_json('/autoscale')})"
+                    )
+                elif rec_down.get("desired_replicas") != 1:
+                    problems.append(
+                        f"scale-down desired_replicas != max(1, live-1): {rec_down}"
+                    )
+
+                # ---- 5. Clean drain of the whole fleet.
+                proc_names = ("replica0", "replica1", "router")
+                for p in procs:
+                    p.send_signal(signal.SIGTERM)
+                for name, p in zip(proc_names, procs):
+                    try:
+                        rc = p.wait(timeout=60)
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+                        p.wait(timeout=15)
+                        problems.append(f"{name} did not drain inside 60s")
+                        continue
+                    if rc != 0:
+                        problems.append(f"{name} exited rc={rc} after SIGTERM")
+            except Exception as ex:
+                for name in ("replica0", "replica1", "router"):
+                    path = os.path.join(tmp, f"{name}.stderr")
+                    if os.path.exists(path):
+                        with open(path, "r", encoding="utf-8") as fh:
+                            tail = fh.read()[-1500:]
+                        if tail.strip():
+                            print(
+                                f"obs-fleet-smoke: {name} log tail:\n{tail}",
+                                file=sys.stderr,
+                            )
+                print(f"obs-fleet-smoke: {type(ex).__name__}: {ex}", file=sys.stderr)
+                return 1
+            finally:
+                ports.close()
+                for p in procs:
+                    if p.poll() is None:
+                        p.terminate()
+                        try:
+                            p.wait(timeout=15)
+                        except subprocess.TimeoutExpired:
+                            p.kill()
+                            p.wait(timeout=15)
+                for fh in log_fhs:
+                    fh.close()
+            if problems:
+                print("obs-fleet-smoke: " + "; ".join(problems), file=sys.stderr)
+                return 1
+            print(
+                "obs-fleet-smoke: ok — federated /metrics carried both "
+                "replicas' labeled series + fleet rollups, one traced "
+                "AnalyzeDir stitched client/router/replica spans into one "
+                "trace, an injected breaker trip dumped exactly one flight "
+                "bundle (the verb succeeded once the fault budget drained), "
+                "a shed surge flipped /autoscale up and idleness "
+                "flipped it back down, and the fleet drained clean"
+            )
+            return 0
+    finally:
+        for k, v in prior_knobs.items():
+            if v is not None:
+                os.environ[k] = v
+
+
 def chaos_smoke() -> int:
     """Fault-tolerance smoke (`make chaos-smoke`, also the tail of `make
     validate`; ISSUE 9) — the chaos harness (utils/chaos.py) injecting
@@ -2704,6 +3172,14 @@ def main() -> int:
     rc = fleet_smoke()
     if rc:
         return rc
+    # Fleet-observability contract (also standalone: make obs-fleet-smoke;
+    # ISSUE 17): federated /metrics with per-replica labels + rollups, one
+    # stitched cross-process trace through the router, an injected breaker
+    # trip dumping exactly one flight bundle, and /autoscale flipping up
+    # under a shed surge then back down through hysteresis.
+    rc = obs_fleet_smoke()
+    if rc:
+        return rc
     # Fault-tolerance contract (also standalone: make chaos-smoke; ISSUE 9):
     # quarantined corrupt runs, host-lane failover + breaker under injected
     # device faults, crash-safe resume after SIGKILL — all byte-identical
@@ -2750,6 +3226,8 @@ if __name__ == "__main__":
         sys.exit(serve_smoke())
     if "--fleet-smoke" in sys.argv:
         sys.exit(fleet_smoke())
+    if "--obs-fleet-smoke" in sys.argv:
+        sys.exit(obs_fleet_smoke())
     if "--chaos-smoke" in sys.argv:
         sys.exit(chaos_smoke())
     if "--stream-smoke" in sys.argv:

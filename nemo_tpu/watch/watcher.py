@@ -231,6 +231,10 @@ class Watcher:
                     corpus=self.corpus_dir,
                     error=f"{type(ex).__name__}: {ex}",
                 )
+                obs.flight.trigger(
+                    "watch_cycle_failed", corpus=self.corpus_dir,
+                    error=f"{type(ex).__name__}: {ex}",
+                )
                 self._push(
                     {
                         "event": "watch_error",

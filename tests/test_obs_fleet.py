@@ -1,0 +1,526 @@
+"""Fleet observability plane (ISSUE 17): metrics federation conformance,
+autoscale hysteresis, the flight recorder's trigger matrix / ring bounds /
+armed-idle overhead, per-metric histogram ladders, and per-tenant SLO
+accounting."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+
+import pytest
+
+from nemo_tpu import obs
+from nemo_tpu.obs import federation, flight
+from nemo_tpu.obs import trace as obs_trace
+from nemo_tpu.obs.metrics import HIST_BUCKETS
+from nemo_tpu.obs.promexp import parse_prometheus_text, render_prometheus
+from nemo_tpu.serve import admission
+from nemo_tpu.serve.autoscale import Autoscaler
+
+
+@pytest.fixture
+def armed(tmp_path):
+    """Arm a flight recorder into a tmp dir for one test; always disarmed
+    after so the span/log taps never leak into the rest of the suite."""
+    rec = flight.arm(str(tmp_path / "flightrec"), cooldown_s=0.0)
+    try:
+        yield rec
+    finally:
+        flight.disarm()
+
+
+def _bundles(rec: flight.FlightRecorder) -> list[str]:
+    if not os.path.isdir(rec.out_dir):
+        return []
+    return sorted(
+        os.path.join(rec.out_dir, f)
+        for f in os.listdir(rec.out_dir)
+        if f.startswith("flightrec-") and f.endswith(".json")
+    )
+
+
+def _load(path: str) -> dict:
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+# -------------------------------------------------------------- federation
+
+
+def _replica_snap(requests: float, depth: float, step_s: list[float]) -> dict:
+    m = obs.Metrics()
+    m.inc("serve.requests", requests)
+    m.gauge("serve.queue_depth", depth)
+    for v in step_s:
+        m.observe("serve.step_s", v)
+    return m.snapshot()
+
+
+def test_federate_replica_labels_and_rollups():
+    snaps = {
+        "h:1": _replica_snap(5, 3.0, [0.2]),
+        "h:2": _replica_snap(8, 7.0, [0.4, 2.0]),
+    }
+    own = obs.Metrics()
+    own.gauge("fleet.autoscale.recommendation", 1.0)
+    page = federation.federate(snaps, up={"h:1": True, "h:2": True},
+                               own_snapshot=own.snapshot())
+    fams = parse_prometheus_text(page)  # conformance: parses clean
+
+    req = fams["nemo_serve_requests_total"]
+    by_replica = {l.get("replica"): v for _, l, v in req["samples"]}
+    assert by_replica == {"h:1": 5.0, "h:2": 8.0}
+    # fleet counter rollup = sum
+    fleet_req = fams["nemo_fleet_serve_requests_total"]["samples"]
+    assert [(l, v) for _, l, v in fleet_req] == [({}, 13.0)]
+    # gauges roll up as the max/min envelope, never a sum
+    fleet_depth = fams["nemo_fleet_serve_queue_depth"]["samples"]
+    agg = {l["agg"]: v for _, l, v in fleet_depth}
+    assert agg == {"max": 7.0, "min": 3.0}
+    # the router's own registry rides unlabeled
+    rec_samples = fams["nemo_fleet_autoscale_recommendation"]["samples"]
+    assert rec_samples == [("nemo_fleet_autoscale_recommendation", {}, 1.0)]
+    # liveness
+    ups = {l["replica"]: v for _, l, v in fams["nemo_fleet_backend_up"]["samples"]}
+    assert ups == {"h:1": 1.0, "h:2": 1.0}
+    assert fams["nemo_fleet_backends_up"]["samples"][0][2] == 2.0
+    assert fams["nemo_fleet_backends_total"]["samples"][0][2] == 2.0
+
+
+def test_federate_down_backend_and_empty_snapshot():
+    snaps = {"h:1": _replica_snap(2, 0.0, []), "h:2": {}}
+    page = federation.federate(snaps, up={"h:1": True, "h:2": False},
+                               own_snapshot=obs.Metrics().snapshot())
+    fams = parse_prometheus_text(page)
+    ups = {l["replica"]: v for _, l, v in fams["nemo_fleet_backend_up"]["samples"]}
+    assert ups == {"h:1": 1.0, "h:2": 0.0}
+    assert fams["nemo_fleet_backends_up"]["samples"][0][2] == 1.0
+    # the dead replica contributes no labeled series, and rollups only
+    # cover what answered
+    assert fams["nemo_fleet_serve_requests_total"]["samples"][0][2] == 2.0
+
+
+def test_federate_histogram_merge_mixed_ladders_is_le_monotone():
+    """Replica A on the default ladder, replica B on a custom per-metric
+    ladder for the SAME series: the fleet rollup merges over the union le
+    set with per-replica carry-forward, so the merged bucket series must
+    be non-decreasing and end at +Inf == total count."""
+    a = obs.Metrics()
+    for v in (0.0003, 0.02, 1.7):
+        a.observe("serve.step_s", v)
+    b = obs.Metrics()
+    b.set_buckets("serve.step_s", (0.015, 0.15, 1.5))
+    for v in (0.01, 0.1, 1.0, 9.0):
+        b.observe("serve.step_s", v)
+    page = federation.federate(
+        {"h:1": a.snapshot(), "h:2": b.snapshot()},
+        own_snapshot=obs.Metrics().snapshot(),
+    )
+    fams = parse_prometheus_text(page)
+    fleet = fams["nemo_fleet_serve_step_s"]
+    buckets = [
+        (l["le"], v) for n, l, v in fleet["samples"] if n.endswith("_bucket")
+    ]
+    les = [le for le, _ in buckets]
+    assert les == sorted(les, key=lambda s: float(s.replace("+Inf", "inf")))
+    counts = [v for _, v in buckets]
+    assert counts == sorted(counts), f"non-monotone merged buckets: {buckets}"
+    assert buckets[-1] == ("+Inf", 7.0)
+    count = [v for n, _, v in fleet["samples"] if n.endswith("_count")][0]
+    assert count == 7.0
+
+
+def test_federate_sanitize_collision_keeps_first_and_stays_conformant():
+    """Two registry names that sanitize to one exposition family must not
+    produce a double-TYPE'd page: the first sample wins, the page parses."""
+    m = obs.Metrics()
+    m.inc("serve.x", 1)
+    m.inc("serve_x", 9)  # sanitizes to the same nemo_serve_x_total
+    page = federation.federate({"h:1": m.snapshot()},
+                               own_snapshot=obs.Metrics().snapshot())
+    fams = parse_prometheus_text(page)
+    samples = fams["nemo_serve_x_total"]["samples"]
+    assert len([s for s in samples if s[1].get("replica") == "h:1"]) == 1
+
+
+# --------------------------------------------------------------- autoscale
+
+
+def _mk(depth: float, inflight: float, cap: float = 4.0, shed: float = 0.0) -> dict:
+    return {
+        "counters": {"serve.rejected": shed},
+        "gauges": {
+            "serve.queue_depth": depth,
+            "serve.inflight": inflight,
+            "serve.capacity": cap,
+        },
+        "histograms": {},
+    }
+
+
+def test_autoscale_up_needs_hold_up_polls():
+    a = Autoscaler(up_util=0.8, down_util=0.2, hold_up=2, hold_down=5,
+                   cooldown_s=60.0)
+    up = {"h:1": True}
+    assert a.update({"h:1": _mk(6, 4)}, up, now=0.0) == 0  # 1/2 held
+    assert a.update({"h:1": _mk(6, 4)}, up, now=1.0) == 1  # 2/2 -> flip
+    doc = a.doc()
+    assert doc["recommendation"] == 1
+    assert doc["desired_replicas"] == 2
+    assert doc["utilization"] == 2.5
+    assert doc["thresholds"]["up_util"] == 0.8
+
+
+def test_autoscale_shed_delta_forces_up():
+    a = Autoscaler(up_util=0.8, down_util=0.2, hold_up=1, hold_down=5,
+                   cooldown_s=60.0)
+    up = {"h:1": True}
+    # first sight of a counter only records the baseline
+    assert a.update({"h:1": _mk(0, 0, shed=10)}, up, now=0.0) in (0, -1)
+    a2 = a.update({"h:1": _mk(0, 0, shed=12)}, up, now=1.0)
+    assert a2 == 1
+    assert "shed" in a.doc()["reason"]
+
+
+def test_autoscale_down_hysteresis_and_cooldown():
+    a = Autoscaler(up_util=0.8, down_util=0.2, hold_up=1, hold_down=2,
+                   cooldown_s=30.0)
+    up = {"h:1": True}
+    assert a.update({"h:1": _mk(6, 4)}, up, now=0.0) == 1  # up immediately
+    # idle now — but down must hold 2 polls AND sit out the cooldown
+    assert a.update({"h:1": _mk(0, 0)}, up, now=1.0) == 1
+    assert a.update({"h:1": _mk(0, 0)}, up, now=2.0) == 1  # held, cooling
+    assert "cooling" in a.doc()["reason"]
+    # sustained low util through the cooldown flips as soon as it expires
+    assert a.update({"h:1": _mk(0, 0)}, up, now=31.0) == -1
+    assert a.doc()["desired_replicas"] == 1  # never below 1
+
+
+def test_autoscale_no_live_replicas_scales_up():
+    a = Autoscaler(hold_up=1, hold_down=5, cooldown_s=60.0)
+    assert a.update({"h:1": {}}, {"h:1": False}, now=0.0) == 1
+    doc = a.doc()
+    assert doc["replicas_live"] == 0 and doc["reason"] == "no live replicas"
+    assert doc["desired_replicas"] == 1
+
+
+# --------------------------------------------------------- flight recorder
+
+
+def test_flight_trigger_matrix(armed):
+    """Every production trigger reason dumps exactly one Perfetto-loadable
+    bundle carrying the ring contents and its context."""
+    with obs.span("sched:device", verb="fused", index=3):
+        time.sleep(0.001)
+    obs.log.get_logger("nemo.test").warning("obs_fleet.trigger_matrix", k=1)
+    before = obs.metrics.snapshot()
+    reasons = {
+        "breaker_trip": {"consecutive_failures": 3},
+        "dispatch_watchdog": {"verb": "fused", "timeout_s": 10.0},
+        "shed_burst": {"sheds": 5},
+        "watch_cycle_failed": {"corpus": "/tmp/x"},
+        "lease_steal": {"path": "/tmp/l", "new_owner": "h:2"},
+    }
+    paths = {r: flight.trigger(r, **ctx) for r, ctx in reasons.items()}
+    assert all(paths.values()), paths
+    assert len(_bundles(armed)) == len(reasons)
+    for reason, path in paths.items():
+        doc = _load(path)
+        assert doc["otherData"]["reason"] == reason
+        assert doc["otherData"]["context"] == {
+            k: v for k, v in reasons[reason].items()
+        }
+        names = [e["name"] for e in doc["traceEvents"] if e.get("ph") == "X"]
+        assert "sched:device" in names
+        events = [e for e in doc["otherData"]["logs"]
+                  if e.get("event") == "obs_fleet.trigger_matrix"]
+        assert events and events[0]["k"] == 1
+    delta = obs.Metrics.delta(obs.metrics.snapshot(), before)["counters"]
+    assert delta["flight.dumps"] >= len(reasons)
+    for r in reasons:
+        assert delta[f"flight.dumps.{r}"] == 1
+
+
+def test_flight_ring_is_bounded(tmp_path):
+    rec = flight.arm(str(tmp_path / "fr"), max_spans=8, max_logs=4,
+                     cooldown_s=0.0)
+    try:
+        for i in range(50):
+            rec.add_span(f"s{i}", i * 10, 5)
+            rec.record_log({"event": f"e{i}"})
+        path = rec.trigger("breaker_trip")
+        doc = _load(path)
+        xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        assert len(xs) == 8
+        assert [e["name"] for e in xs] == [f"s{i}" for i in range(42, 50)]
+        assert [l["event"] for l in doc["otherData"]["logs"]] == [
+            f"e{i}" for i in range(46, 50)
+        ]
+    finally:
+        flight.disarm()
+
+
+def test_flight_cooldown_suppresses_repeat_triggers(tmp_path):
+    rec = flight.arm(str(tmp_path / "fr"), cooldown_s=60.0)
+    try:
+        before = obs.metrics.snapshot()
+        assert rec.trigger("breaker_trip") is not None
+        assert rec.trigger("breaker_trip") is None  # cooldown
+        assert rec.trigger("lease_steal") is not None  # per-reason clocks
+        assert len(_bundles(rec)) == 2
+        delta = obs.Metrics.delta(obs.metrics.snapshot(), before)["counters"]
+        assert delta["flight.suppressed"] == 1
+    finally:
+        flight.disarm()
+
+
+def test_flight_shed_burst_detector(tmp_path):
+    rec = flight.arm(str(tmp_path / "fr"), shed_burst=3, shed_window_s=60.0,
+                     cooldown_s=0.0)
+    try:
+        rec.note_shed("queue_full", "t1")
+        rec.note_shed("queue_full", "t1")
+        assert not _bundles(rec)  # two sheds: load shedding working as designed
+        rec.note_shed("queue_full", "t1")
+        bundles = _bundles(rec)
+        assert len(bundles) == 1
+        doc = _load(bundles[0])
+        assert doc["otherData"]["reason"] == "shed_burst"
+        assert doc["otherData"]["context"]["tenant"] == "t1"
+    finally:
+        flight.disarm()
+
+
+def test_flight_bundle_carries_metric_delta(armed):
+    obs.metrics.inc("obs_fleet.test_window_counter", 7)
+    doc = _load(armed.trigger("watch_cycle_failed"))
+    delta = doc["otherData"]["metrics_delta"]["counters"]
+    assert delta["obs_fleet.test_window_counter"] == 7
+    # base snapshot refreshes per dump: a second bundle sees only its window
+    obs.metrics.inc("obs_fleet.test_window_counter", 2)
+    doc2 = _load(armed.trigger("watch_cycle_failed"))
+    assert doc2["otherData"]["metrics_delta"]["counters"][
+        "obs_fleet.test_window_counter"] == 2
+
+
+def test_flight_spans_land_without_tracer_and_alongside_one(armed, tmp_path):
+    assert not obs.enabled()
+    with obs.span("flightonly:a", k=1):
+        pass
+    assert any(s[0] == "flightonly:a" for s in armed._spans)
+    # with a tracer active, spans land in BOTH (a postmortem bundle must
+    # not go blind just because someone was tracing)
+    tracer = obs_trace.start_trace(str(tmp_path / "t.json"))
+    try:
+        with obs.span("both:b"):
+            pass
+    finally:
+        obs_trace.finish()
+    assert any(s[0] == "both:b" for s in armed._spans)
+    assert any(d["name"] == "both:b" for d in tracer.drain_spans())
+
+
+def test_flight_armed_idle_overhead_under_3_percent(armed):
+    """The tentpole's acceptance guard: an ARMED-but-idle flight recorder
+    must cost <3% wall on the kernel-dispatch hot loop.  Work unit: a
+    256 KiB hash (~200us) — conservative for a dispatch (bench's smallest
+    real dispatches are ms-scale).  Same differential measurement as
+    test_obs.py's disabled-mode guard: per-span cost (span loop minus bare
+    loop) against the work's per-iteration cost, min-of-repeats, because
+    racing full loops jitters more than the margin being asserted."""
+    assert not obs.enabled()
+    payload = b"x" * 262144
+    n = 300
+
+    def work() -> None:
+        for _ in range(n):
+            hashlib.sha256(payload).digest()
+
+    def span_loop() -> None:
+        for _ in range(n):
+            with obs.span("hot", step=1):
+                pass
+
+    def bare_loop() -> None:
+        for _ in range(n):
+            pass
+
+    t_work = min(_timed(work) for _ in range(5))
+    t_span = min(_timed(span_loop) for _ in range(9))
+    t_bare = min(_timed(bare_loop) for _ in range(9))
+    per_span_s = max(0.0, t_span - t_bare) / n
+    ratio = per_span_s / (t_work / n)
+    assert ratio <= 0.03, (
+        f"armed-idle span overhead {ratio:.2%} "
+        f"({per_span_s * 1e6:.2f} us/span vs {t_work / n * 1e6:.1f} us work unit)"
+    )
+    # Absolute backstop: one live-span bracket + one ring append.
+    assert per_span_s < 5e-6, f"armed span costs {per_span_s * 1e6:.2f} us"
+    # they actually landed in the (bounded) ring
+    assert len(armed._spans) == min(n * 9, armed.max_spans)
+
+
+def _timed(fn) -> float:
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
+# ------------------------------------------------------- histogram ladders
+
+
+def test_set_buckets_custom_ladder_rides_snapshot_only_when_custom():
+    m = obs.Metrics()
+    m.set_buckets("custom_h", (5.0, 0.5, 0.5, 0.05))  # dedup + sort
+    m.observe("custom_h", 0.3)
+    m.observe("custom_h", 99.0)  # beyond the ladder -> +Inf only
+    m.observe("default_h", 0.3)
+    snap = m.snapshot()
+    assert snap["histograms"]["custom_h"]["ladder"] == [0.05, 0.5, 5.0]
+    assert snap["histograms"]["custom_h"]["buckets"] == [[0.05, 0], [0.5, 1], [5.0, 1]]
+    assert snap["histograms"]["custom_h"]["count"] == 2
+    # the default ladder keeps the pre-existing snapshot shape exactly
+    assert "ladder" not in snap["histograms"]["default_h"]
+
+
+def test_set_buckets_after_first_observation_is_frozen():
+    m = obs.Metrics()
+    m.observe("h", 1.0)
+    m.set_buckets("h", (0.1, 0.2))  # too late — silent no-op
+    m.observe("h", 1.0)
+    snap = m.snapshot()
+    assert "ladder" not in snap["histograms"]["h"]
+    assert snap["histograms"]["h"]["count"] == 2
+
+
+def test_promexp_renders_custom_ladder_conformantly():
+    m = obs.Metrics()
+    m.set_buckets("slo_h", (0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.5, 5.0):
+        m.observe("slo_h", v)
+    fams = parse_prometheus_text(render_prometheus(m.snapshot()))
+    buckets = [(l["le"], v) for n, l, v in fams["nemo_slo_h"]["samples"]
+               if n.endswith("_bucket")]
+    assert buckets == [("0.01", 1.0), ("0.1", 2.0), ("1", 3.0), ("+Inf", 4.0)]
+    # default-ladder histograms still render the full fixed ladder
+    m2 = obs.Metrics()
+    m2.observe("h", 0.3)
+    fams2 = parse_prometheus_text(render_prometheus(m2.snapshot()))
+    n_buckets = sum(1 for n, _, _ in fams2["nemo_h"]["samples"]
+                    if n.endswith("_bucket"))
+    assert n_buckets == len(HIST_BUCKETS) + 1
+
+
+# ------------------------------------------------------------ SLO accounting
+
+
+@pytest.fixture
+def slo_ctl():
+    """A fresh singleton admission controller (slo_snapshot reads the
+    singleton); always reset after."""
+    admission.reset_controller()
+    ctl = admission.AdmissionController(max_inflight=1, max_queue=0)
+    admission._controller = ctl
+    try:
+        yield ctl
+    finally:
+        admission.reset_controller()
+
+
+def test_slo_latency_histogram_ms_ladder_and_table(slo_ctl):
+    for _ in range(2):
+        t = slo_ctl.enqueue("alpha")
+        assert t.wait(1.0)
+        time.sleep(0.002)
+        t.release()
+    snap = obs.metrics.snapshot()
+    h = snap["histograms"]["serve.slo.alpha.latency_s"]
+    assert h["count"] == 2
+    assert h["ladder"] == list(admission.SLO_LATENCY_BUCKETS)
+    table = admission.slo_snapshot()
+    row = table["alpha"]
+    assert row["requests"] == 2 and row["sheds"] == 0
+    assert row["budget_remaining"] == 1.0 and not row["breached"]
+    lat = row["latency"]
+    assert lat["count"] == 2
+    assert 0.002 <= lat["mean_s"] < 1.0
+    assert lat["p50_s"] in admission.SLO_LATENCY_BUCKETS
+    assert lat["p50_s"] <= lat["p95_s"] <= lat["p99_s"]
+
+
+def test_slo_shed_budget_breach_counted_once(slo_ctl):
+    before = obs.metrics.snapshot()
+    hold = slo_ctl.enqueue("beta")
+    assert hold.wait(1.0)
+    for _ in range(3):
+        with pytest.raises(admission.AdmissionRejected):
+            slo_ctl.enqueue("beta")
+    hold.release()
+    delta = obs.Metrics.delta(obs.metrics.snapshot(), before)["counters"]
+    assert delta["serve.slo.beta.breaches"] == 1  # one transition, 3 sheds
+    row = admission.slo_snapshot()["beta"]
+    assert row["sheds"] == 3 and row["breached"]
+    assert row["budget_remaining"] == 0.0
+    assert row["shed_ratio"] == 0.75
+
+
+def test_slo_sheds_feed_flight_burst_detector(slo_ctl, tmp_path):
+    rec = flight.arm(str(tmp_path / "fr"), shed_burst=3, shed_window_s=60.0,
+                     cooldown_s=0.0)
+    try:
+        hold = slo_ctl.enqueue("gamma")
+        assert hold.wait(1.0)
+        for _ in range(3):
+            with pytest.raises(admission.AdmissionRejected):
+                slo_ctl.enqueue("gamma")
+        hold.release()
+        bundles = _bundles(rec)
+        assert len(bundles) == 1
+        doc = _load(bundles[0])
+        assert doc["otherData"]["reason"] == "shed_burst"
+        assert doc["otherData"]["context"]["shed_reason"] == "queue_full"
+        assert doc["otherData"]["context"]["tenant"] == "gamma"
+    finally:
+        flight.disarm()
+
+
+def test_hist_quantile_reads_bucket_upper_bounds():
+    h = {"count": 10, "max": 7.5,
+         "buckets": [[0.1, 2], [0.5, 5], [1.0, 9], [5.0, 10]]}
+    assert admission._hist_quantile(h, 0.5) == 0.5
+    assert admission._hist_quantile(h, 0.95) == 5.0
+    assert admission._hist_quantile({"count": 0, "buckets": []}, 0.5) == 0.0
+    # past-the-ladder mass reports the lifetime max, not +Inf
+    h2 = {"count": 4, "max": 42.0, "buckets": [[1.0, 2]]}
+    assert admission._hist_quantile(h2, 0.99) == 42.0
+
+
+def test_slo_snapshot_empty_without_controller_or_traffic():
+    admission.reset_controller()
+    assert admission.slo_snapshot() == {}
+
+
+# ----------------------------------------------------------- trace stitching
+
+
+def test_router_stitch_trailing_merges_spans_under_cap():
+    pytest.importorskip("grpc")
+    from nemo_tpu.serve.router import Router, _SPANS_MAX_BYTES
+
+    replica_spans = [{"name": "serve:Analyze", "ts": 10, "dur": 5, "pid": 1,
+                      "tid": 1}]
+    tm = (("nemo-spans-bin", json.dumps(replica_spans).encode("utf-8")),
+          ("other", b"x"))
+    router_span = {"name": "router:Analyze", "ts": 8, "dur": 9, "pid": 2,
+                   "tid": 1, "args": {"backend": "h:1", "attempt": 0}}
+    out = dict(Router._stitch_trailing(tm, [router_span]))
+    assert out["other"] == b"x"
+    merged = json.loads(out["nemo-spans-bin"])
+    assert [s["name"] for s in merged] == ["serve:Analyze", "router:Analyze"]
+    # oversize payloads ride through without the additions
+    fat = [{"name": "x" * _SPANS_MAX_BYTES, "ts": 0, "dur": 0}]
+    out2 = dict(Router._stitch_trailing(tm, fat))
+    assert "nemo-spans-bin" not in out2

@@ -216,6 +216,15 @@ def extract_metrics(doc: dict) -> dict[str, tuple[float, str, str]]:
     # Cold-herd analyses compare as an absolute shift (the healthy value
     # is exactly 1; a 1 -> 2 jump means the fleet stopped single-flighting).
     put("fleet_tier.cold_herd_analyses", fl.get("cold_herd_analyses"), "split", "ratio")
+    # Flight recorder (ISSUE 17): the armed-but-idle span cost creeping up
+    # — the always-on postmortem ring buffer is only viable while its
+    # hot-path tax stays a rounding error (<3% of a conservative work
+    # unit, pinned by tests/test_obs_fleet.py); both the normalized
+    # overhead ratio and the absolute per-span wall are watched.
+    ofl = doc.get("obs_flight") or {}
+    put("obs_flight.armed_idle_overhead", ofl.get("armed_idle_overhead"), "lower", "ratio")
+    if isinstance(ofl.get("armed_span_us"), (int, float)):
+        put("obs_flight.armed_span_ms", ofl["armed_span_us"] / 1e3, "lower", "ms")
     # Sparse-device tier (ISSUE 10): either route's wall creeping up, the
     # sparse route's watermark growing, or the giant-V watermark ratio
     # (the memory win the route exists for) collapsing all flag.  Walls
