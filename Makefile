@@ -5,7 +5,7 @@ NATIVE_LIB := native/build/libnemo_native.so
 REPORT_SRC := native/nemo_report.cpp
 REPORT_LIB := native/build/libnemo_report.so
 
-.PHONY: all native test bench bench-watch bench-trend prewarm validate trace-smoke obs-smoke store-smoke delta-smoke shard-smoke sparse-device-smoke serve-smoke fleet-smoke obs-fleet-smoke chaos-smoke stream-smoke synth-smoke watch-smoke lint-print lint-metrics clean reset proto neo4j-up neo4j-validate neo4j-down
+.PHONY: all native test bench bench-watch bench-trend prewarm validate trace-smoke obs-smoke store-smoke delta-smoke shard-smoke sparse-device-smoke serve-smoke fleet-smoke obs-fleet-smoke chaos-smoke stream-smoke synth-smoke watch-smoke profile-smoke lint-print lint-metrics clean reset proto neo4j-up neo4j-validate neo4j-down
 
 all: native
 
@@ -154,6 +154,17 @@ synth-smoke:
 # quarantined then re-ingested ALONE on repair (nemo_tpu/watch).
 watch-smoke:
 	python -m nemo_tpu.utils.validate_smoke --watch-smoke
+
+# Platform-profile smoke (also the tail of `make validate`; ISSUE 19):
+# four fresh processes against one hermetic profile dir — a cold cache
+# root runs exactly ONE bounded (<10s) microprobe calibration and
+# persists a fingerprint-keyed profile, a second process boots measured
+# with zero probe dispatches, NEMO_PROFILE=off reproduces the seeded
+# resolution, env overrides beat the measurement (with the measured
+# record preserved), and all four report trees are byte-identical
+# (nemo_tpu/platform).
+profile-smoke:
+	python -m nemo_tpu.utils.validate_smoke --profile-smoke
 
 # Structured-logging contract: no bare print() in nemo_tpu/ outside the
 # CLI/harness allowlist (tools/lint_no_print.py).

@@ -111,6 +111,16 @@ def _write_telemetry(report_dir: str, timings: dict, figure_stats: dict | None) 
                 doc["sched"] = table
         except Exception:  # lint: allow-silent-except — telemetry must never fail a report (docstring)
             pass
+    # Platform profile (ISSUE 19): which routing constants were live for
+    # this run and where each came from (env > measured > seeded), plus
+    # the calibration fingerprint/wall — same sys.modules gate (an oracle
+    # run with the profile subsystem never imported has nothing to say).
+    pp = sys.modules.get("nemo_tpu.platform.profile")
+    if pp is not None:
+        try:
+            doc["platform_profile"] = pp.telemetry_section()
+        except Exception:  # lint: allow-silent-except — telemetry must never fail a report (docstring)
+            pass
     # Per-tenant SLO table (ISSUE 17) — same gate: only a process that
     # actually served traffic has an admission controller to report on.
     adm = sys.modules.get("nemo_tpu.serve.admission")

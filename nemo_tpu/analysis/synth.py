@@ -81,10 +81,17 @@ def synth_host_work_budget() -> int:
     NEMO_ANALYSIS_HOST_WORK economics one verb over — the synth kernel is
     a handful of single-step scatters, so the dispatch's fixed RTT
     dominates even deeper into the work axis).  NEMO_SYNTH_HOST_WORK
-    overrides."""
+    overrides; a measured platform profile supplies its fitted crossover
+    when the env is unset (ISSUE 19 — env > profile > seeded)."""
     from nemo_tpu.utils.env import env_int
 
-    return env_int("NEMO_SYNTH_HOST_WORK", 100000)
+    try:
+        from nemo_tpu.platform import profile as _pp
+
+        measured = _pp.profile_value("synth_host_work")
+    except Exception:  # lint: allow-silent-except — a broken profile store must degrade to the seeded crossover, not sink routing (docstring)
+        measured = None
+    return env_int("NEMO_SYNTH_HOST_WORK", 100000 if measured is None else int(measured))
 
 
 def correction_suggestion(table: str) -> str:

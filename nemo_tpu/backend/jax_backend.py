@@ -423,6 +423,22 @@ def _index_cost_class(verb: str, arrays: dict, params: dict) -> None:
         pass
 
 
+def _profile_value(name: str, seeded: float) -> float:
+    """Measured platform-profile value for one routing constant, or the
+    seeded default — the middle rung of the env > profile > seeded
+    precedence (ISSUE 19; nemo_tpu/platform/profile.py).  Every budget
+    helper below checks its env var FIRST with its own legacy parser, so
+    NEMO_PROFILE=off (or a broken profile store) reproduces today's
+    resolution bit for bit."""
+    try:
+        from nemo_tpu.platform import profile as _pp
+
+        v = _pp.profile_value(name)
+    except Exception:  # lint: allow-silent-except — a broken profile store must degrade to seeded constants, not sink routing (docstring)
+        return seeded
+    return seeded if v is None else float(v)
+
+
 def sched_device_hint(job) -> float | None:
     """Device-lane cost hint for the heterogeneous scheduler
     (parallel/sched.py): the PR-4 cost table's FLOPs estimate for the job's
@@ -442,10 +458,14 @@ def sched_device_hint(job) -> float | None:
     rec, rec_rows = entry
     if rec.get("flops") is None:
         return None
-    try:
-        rate = float(os.environ.get("NEMO_SCHED_FLOPS_PER_S", "5e9"))
-    except ValueError:
-        rate = 5e9
+    env = os.environ.get("NEMO_SCHED_FLOPS_PER_S")
+    if env is not None:
+        try:
+            rate = float(env)
+        except ValueError:
+            rate = 5e9
+    else:
+        rate = _profile_value("sched_flops_per_s", 5e9)
     per_row = float(rec["flops"]) / rec_rows
     rows = int(getattr(job, "rows_dispatch", 0)) or int(getattr(job, "rows", 1))
     return per_row * max(rows, 1) / max(rate, 1.0)
@@ -1105,8 +1125,13 @@ def _analysis_host_work_budget() -> int:
     fixed cost still dominates.  The fused dispatch carries ~8x more
     device work per unit than the diff verb but also ~8x more host sweeps,
     so the same order of magnitude holds; NEMO_ANALYSIS_HOST_WORK
-    overrides for directly-attached devices (no RTT tax: lower it)."""
-    return int(os.environ.get("NEMO_ANALYSIS_HOST_WORK", "100000"))
+    overrides for directly-attached devices (no RTT tax: lower it), and a
+    measured platform profile supplies its fitted crossover when the env
+    is unset (ISSUE 19)."""
+    env = os.environ.get("NEMO_ANALYSIS_HOST_WORK")
+    if env is not None:
+        return int(env)
+    return int(_profile_value("analysis_host_work", 100000))
 
 
 def _synth_host_work_budget() -> int:
@@ -1127,8 +1152,13 @@ def _sparse_device_mem_bytes() -> int:
     (256 MB) keeps every case-study bucket dense (V <= a few hundred:
     megabytes) while giant-V buckets (V in the thousands: gigabytes) stay
     on the device sparsely instead of OOMing or escaping to the host.
-    NEMO_SPARSE_DEVICE_MEM_MB overrides (0 disables the watermark)."""
-    return int(float(os.environ.get("NEMO_SPARSE_DEVICE_MEM_MB", "256")) * 1e6)
+    NEMO_SPARSE_DEVICE_MEM_MB overrides (0 disables the watermark); a
+    measured platform profile supplies the real device's headroom when
+    the env is unset (ISSUE 19)."""
+    env = os.environ.get("NEMO_SPARSE_DEVICE_MEM_MB")
+    if env is not None:
+        return int(float(env) * 1e6)
+    return int(_profile_value("sparse_device_mem_mb", 256.0) * 1e6)
 
 
 def _sparse_device_density() -> float:
@@ -1141,8 +1171,14 @@ def _sparse_device_density() -> float:
     E-bucket 256 -> density ~0.06) the dense MXU path is the measured
     winner and keeps the route; the sparse win is the large-V, E ~ V
     regime Molly's chain-heavy graphs produce.
-    NEMO_SPARSE_DEVICE_DENSITY overrides (0 disables the crossover)."""
-    return float(os.environ.get("NEMO_SPARSE_DEVICE_DENSITY", str(1.0 / 256.0)))
+    NEMO_SPARSE_DEVICE_DENSITY overrides (0 disables the crossover); the
+    platform profile may supply a measured value when the env is unset
+    (ISSUE 19 — today's calibrator records it seeded: no giant-V probe
+    fits the budget)."""
+    env = os.environ.get("NEMO_SPARSE_DEVICE_DENSITY")
+    if env is not None:
+        return float(env)
+    return float(_profile_value("sparse_device_density", 1.0 / 256.0))
 
 
 def _sparse_device_min_v() -> int:
@@ -1167,8 +1203,13 @@ def _diff_host_work_budget() -> int:
     >2x at every corpus this repo generates.  The 2M default (~3 s of host
     work) is where tunnel-deployment device costs finally amortize; on
     directly-attached TPU (no tunnel RTT/bandwidth tax) lower it via
-    NEMO_DIFF_HOST_WORK."""
-    return int(os.environ.get("NEMO_DIFF_HOST_WORK", "2000000"))
+    NEMO_DIFF_HOST_WORK; a measured platform profile anchors the same
+    20x ratio to its fitted analysis crossover when the env is unset
+    (ISSUE 19)."""
+    env = os.environ.get("NEMO_DIFF_HOST_WORK")
+    if env is not None:
+        return int(env)
+    return int(_profile_value("diff_host_work", 2000000))
 
 
 def _narrow_xfer_env() -> int | None:
@@ -1594,6 +1635,15 @@ class JaxBackend(GraphBackend):
     # ------------------------------------------------------------------ setup
 
     def init_graph_db(self, conn: str, molly: MollyOutput) -> None:
+        # Platform self-calibration trigger (ISSUE 19): first contact on a
+        # cold cache root runs ONE bounded microprobe suite and persists
+        # the fingerprint-keyed profile; every later process (and every
+        # later corpus in this one) loads it with zero probe dispatches.
+        # Must run BEFORE the budget re-reads below — they resolve
+        # env > profile > seeded.  ensure_calibrated never raises.
+        from nemo_tpu.platform import profile as _platform_profile
+
+        _platform_profile.ensure_calibrated()
         # Full state reset: a backend instance may be reused across corpora.
         # The giant threshold is re-read here and ONLY here, so _fused and
         # build_figures can never disagree within one corpus.

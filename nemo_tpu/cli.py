@@ -58,7 +58,6 @@ def main(argv: list[str] | None = None) -> int:
         "-faultInjOut",
         "--fault-inj-out",
         dest="fault_inj_out",
-        required=True,
         action="append",
         help="file system path to output directory of fault injector.  "
         "Repeatable: several corpus directories analyze in ONE run through "
@@ -215,6 +214,26 @@ def main(argv: list[str] | None = None) -> int:
         "NEMO_INJECTOR",
     )
     parser.add_argument(
+        "--calibrate",
+        action="store_true",
+        help="run the platform microprobe calibration now (bounded by "
+        "$NEMO_PROFILE_BUDGET_S, default 8s), persist the "
+        "fingerprint-keyed profile under ~/.cache/nemo_tpu/platform, and "
+        "print the resolved routing-constant table.  Recalibrates even "
+        "over an existing profile.  Standalone (no -faultInjOut) exits "
+        "after calibrating",
+    )
+    parser.add_argument(
+        "--profile-mode",
+        choices=("auto", "off", "force"),
+        default=None,
+        help="platform-profile policy (nemo_tpu/platform): 'auto' loads "
+        "the measured profile and calibrates once per fingerprint, 'off' "
+        "resolves every routing constant env/seeded (pre-profile "
+        "behavior, bit-for-bit), 'force' recalibrates once per process.  "
+        "Equivalent env: NEMO_PROFILE",
+    )
+    parser.add_argument(
         "--watch",
         action="store_true",
         help="live mode (ISSUE 15): tail the (single) -faultInjOut "
@@ -266,7 +285,9 @@ def main(argv: list[str] | None = None) -> int:
     )
     args = parser.parse_args(argv)
 
-    dirs = args.fault_inj_out
+    dirs = args.fault_inj_out or []
+    if not dirs and not args.calibrate:
+        parser.error("-faultInjOut is required (unless --calibrate runs standalone)")
     if args.watch and len(dirs) != 1:
         parser.error("--watch takes exactly one -faultInjOut directory")
     if args.replay and not args.watch:
@@ -330,6 +351,12 @@ def main(argv: list[str] | None = None) -> int:
         os.environ["NEMO_RESULT_CACHE"] = args.result_cache
     if args.injector is not None:
         os.environ["NEMO_INJECTOR"] = args.injector
+    if args.profile_mode is not None:
+        os.environ["NEMO_PROFILE"] = args.profile_mode
+    if args.calibrate:
+        code = _calibrate_main()
+        if not dirs:
+            return code
     if args.watch:
         return _watch_main(args, dirs[0])
 
@@ -421,6 +448,36 @@ def main(argv: list[str] | None = None) -> int:
                 httpd.serve_forever()
             except KeyboardInterrupt:
                 pass
+    return 0
+
+
+def _calibrate_main() -> int:
+    """--calibrate: force one bounded microprobe calibration for this
+    platform fingerprint and print the resolved constant table (env >
+    measured > seeded per row, the same precedence every consumer uses)."""
+    from nemo_tpu.platform import profile as pp
+
+    if pp.profile_mode() == "off":
+        print(
+            "platform profile disabled (NEMO_PROFILE=off); nothing to calibrate",
+            file=sys.stderr,
+        )
+        return 2
+    prof = pp.ensure_calibrated(force=True)
+    if prof is None:
+        print("calibration failed; constants stay seeded (see log)", file=sys.stderr)
+        return 1
+    fp = prof.fingerprint
+    print(
+        f"platform profile {prof.key} ({fp['platform']}/{fp['device_kind']} "
+        f"x{fp['device_count']}, jax {fp['jax_version']}) calibrated in "
+        f"{prof.calibration_wall_s:.2f}s -> {pp.profile_path(prof.key)}"
+    )
+    for row in pp.constant_sources():
+        note = ""
+        if row["source"] == "env" and row["measured"] is not None:
+            note = f"  (measured {row['measured']:.6g})"
+        print(f"  {row['name']:>24} = {row['value']} [{row['source']}]{note}")
     return 0
 
 

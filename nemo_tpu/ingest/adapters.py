@@ -128,6 +128,19 @@ class FaultInjector:
     def load(self, corpus_dir: str, quarantine: bool | None = None) -> MollyOutput:
         raise NotImplementedError
 
+    def pack_steps(self, corpus_dir: str):
+        """Packed-array ingest through the seam: the (pre BatchArrays,
+        post BatchArrays, static kwargs) triple every analysis dispatch —
+        local or remote — consumes.  Default route: adapter load then the
+        pure-Python pack (the lib-less-host path, any layout);
+        :class:`MollyInjector` overrides with the packed-first host ETL.
+        The client chunked-upload paths (service/client.py:analyze_dir,
+        analyze_dir_pipelined) call THIS instead of a Molly-only packer,
+        so a non-Molly corpus streams to the sidecar unchanged."""
+        from nemo_tpu.models.pipeline_model import pack_molly_for_step
+
+        return pack_molly_for_step(self.load(corpus_dir))
+
     @classmethod
     def count_runs(cls, corpus_dir: str) -> int:
         """Cheap run count (index parse, no provenance) — watcher bookkeeping."""
@@ -170,6 +183,14 @@ class MollyInjector(FaultInjector):
 
     def load(self, corpus_dir: str, quarantine: bool | None = None) -> MollyOutput:
         return load_molly_output(corpus_dir, quarantine=quarantine)
+
+    def pack_steps(self, corpus_dir: str):
+        # Packed-first: the C++ engine or a warm corpus-store mmap when
+        # either can serve, the pure-Python pack otherwise — native.py
+        # owns that fallback ladder.
+        from nemo_tpu.ingest.native import pack_molly_dir
+
+        return pack_molly_dir(corpus_dir)
 
     @classmethod
     def count_runs(cls, corpus_dir: str) -> int:

@@ -56,6 +56,7 @@ from __future__ import annotations
 import collections
 import json
 import os
+import sys
 import threading
 import time
 
@@ -256,6 +257,16 @@ class FlightRecorder:
                 "metrics_delta": _metrics_mod.Metrics.delta(snap, base),
             },
         }
+        # Active platform profile + fingerprint (ISSUE 19): a breaker-trip
+        # dump must show which routing constants were live at the anomaly.
+        # sys.modules gate, never an import — this module stays stdlib-only
+        # and a process that never touched the profile has nothing to say.
+        pp = sys.modules.get("nemo_tpu.platform.profile")
+        if pp is not None:
+            try:
+                doc["otherData"]["platform_profile"] = pp.telemetry_section()
+            except Exception:  # lint: allow-silent-except — the dump must land even when the profile store is broken (docstring)
+                pass
         safe = "".join(c if c.isalnum() or c in "-_" else "_" for c in reason)
         os.makedirs(self.out_dir, exist_ok=True)
         path = os.path.join(self.out_dir, f"flightrec-{safe}-{self.pid}-{seq:03d}.json")

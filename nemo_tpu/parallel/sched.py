@@ -135,6 +135,22 @@ def _env_float(name: str, default: float) -> float:
     return val
 
 
+def _profile_value(name: str, seeded: float) -> float:
+    """Measured platform-profile value for one constant, or the seeded
+    default — the middle rung of the env > profile > seeded precedence
+    (platform/profile.py).  Callers pass the result as _env_float's
+    default, so an explicit env var still always wins.  Defensive: the
+    scheduler must keep working when the profile subsystem is absent or
+    broken (it is observability-adjacent, never load-bearing)."""
+    try:
+        from nemo_tpu.platform import profile as _pp
+
+        v = _pp.profile_value(name)
+    except Exception:  # lint: allow-silent-except — a broken profile store must degrade to seeded constants, not sink scheduling (docstring)
+        return seeded
+    return seeded if v is None else float(v)
+
+
 @dataclass
 class Job:
     """One schedulable bucket: identity for the cost model (verb, rows, V,
@@ -237,17 +253,32 @@ def default_models(
     work unit (BENCH sparse tier), and the device lane pays a fixed
     dispatch cost equal to the crossover budget's worth of host work —
     predictions then cross at exactly work ≈ NEMO_ANALYSIS_HOST_WORK, the
-    measured break-even PR 3 shipped.  Feedback refines both from there."""
-    host_unit = _env_float("NEMO_SCHED_HOST_UNIT", 1e-6)
-    device_unit = _env_float("NEMO_SCHED_DEVICE_UNIT", 5e-8)
+    measured break-even PR 3 shipped.  Feedback refines both from there.
+
+    With a measured platform profile active (ISSUE 19), every seed below
+    resolves env > profile > seeded — the profile's fitted walls replace
+    the hand-tuned constants unless the operator's env var pins them."""
+    host_unit = _env_float("NEMO_SCHED_HOST_UNIT", _profile_value("sched_host_unit", 1e-6))
+    device_unit = _env_float(
+        "NEMO_SCHED_DEVICE_UNIT", _profile_value("sched_device_unit", 5e-8)
+    )
     budget = host_work_budget
     if budget is None:
-        budget = int(os.environ.get("NEMO_ANALYSIS_HOST_WORK", "100000"))
+        env = os.environ.get("NEMO_ANALYSIS_HOST_WORK")
+        budget = (
+            int(env)
+            if env is not None
+            else int(_profile_value("analysis_host_work", 100000))
+        )
     # fixed + unit_d*budget == unit_h*budget: the two lines intersect at
     # exactly the budget (a fixed of budget*unit_h alone would put the
-    # break-even ~unit_d/unit_h above it).
+    # break-even ~unit_d/unit_h above it).  A measured profile supplies
+    # its fitted intercept directly instead of the derived seed.
     device_fixed = _env_float(
-        "NEMO_SCHED_DEVICE_FIXED", budget * max(host_unit - device_unit, 1e-12)
+        "NEMO_SCHED_DEVICE_FIXED",
+        _profile_value(
+            "sched_device_fixed", budget * max(host_unit - device_unit, 1e-12)
+        ),
     )
     # The sparse-device lane (ISSUE 10) pays the same per-dispatch fixed
     # cost class (RTT + program launch) but its per-unit work is
@@ -255,7 +286,10 @@ def default_models(
     # the host engine so an unmeasured scheduler prefers the dense MXU
     # dispatch (the measured small-V winner) and lets the EWMA feedback
     # promote the sparse lane where it actually wins.
-    sparse_device_unit = _env_float("NEMO_SCHED_SPARSE_DEVICE_UNIT", 2.5e-7)
+    sparse_device_unit = _env_float(
+        "NEMO_SCHED_SPARSE_DEVICE_UNIT",
+        _profile_value("sched_sparse_device_unit", 2.5e-7),
+    )
     return {
         "device": LaneModel(device_fixed, device_unit, hint=device_hint),
         "sparse_device": LaneModel(device_fixed, sparse_device_unit),
@@ -486,6 +520,16 @@ def session_models(
     global _SESSION_MODELS
     if _SESSION_MODELS is None:
         _SESSION_MODELS = default_models(host_work_budget, device_hint)
+        # Cross-session scheduler memory (ISSUE 19): seed the fresh models'
+        # per-(verb,V,E) EWMA tables from the platform profile's folded-back
+        # walls, and register the shutdown fold-back.  Best-effort — the
+        # scheduler never depends on the profile store being healthy.
+        try:
+            from nemo_tpu.platform import profile as _pp
+
+            _pp.warm_start(_SESSION_MODELS)
+        except Exception:  # lint: allow-silent-except — a broken profile store must degrade to cold models, not sink scheduling (docstring)
+            pass
     elif device_hint is not None and _SESSION_MODELS["device"].hint is None:
         _SESSION_MODELS["device"].hint = device_hint
     return _SESSION_MODELS

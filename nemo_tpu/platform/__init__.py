@@ -1,0 +1,32 @@
+"""Measured platform profiles (ISSUE 19): a bounded self-calibration
+profiler plus the persistent, fingerprint-keyed profile that replaces the
+hand-tuned routing defaults — env > measured profile > seeded defaults,
+recorded per constant.  See platform/profile.py for the precedence and
+invalidation contract, platform/calibrate.py for the probe suite."""
+
+from .fingerprint import fingerprint_key, platform_fingerprint
+from .profile import (
+    PROFILE_ABI_VERSION,
+    PlatformProfile,
+    active_profile,
+    constant_sources,
+    ensure_calibrated,
+    profile_mode,
+    profile_value,
+    reset_active_profile,
+    telemetry_section,
+)
+
+__all__ = [
+    "PROFILE_ABI_VERSION",
+    "PlatformProfile",
+    "active_profile",
+    "constant_sources",
+    "ensure_calibrated",
+    "fingerprint_key",
+    "platform_fingerprint",
+    "profile_mode",
+    "profile_value",
+    "reset_active_profile",
+    "telemetry_section",
+]

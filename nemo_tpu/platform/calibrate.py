@@ -1,0 +1,269 @@
+"""The bounded microprobe suite: measure the platform, fit the constants.
+
+``run_calibration`` dispatches a few-second probe set through the SAME
+code paths the deployment uses — the fused analysis verb via
+``LocalExecutor.run`` at two batch widths of the exact stress signature
+the pipeline compiles (utils/prewarm.py:stress_signature, so the probe
+compiles land in the shared jit + persistent caches and a serve boot's
+prewarm reuses them), the sparse host engine via
+``ops/sparse_host.sparse_analysis_step`` on the same packed arrays, a
+host->device transfer-bandwidth sample, and the compile wall of the cold
+fused dispatch — then fits the routing constants:
+
+  * ``sched_host_unit``       host wall / work (work = B x (V + E), the
+                              route planner's unit)
+  * ``sched_device_unit``     slope of the two warm device walls over work
+  * ``sched_device_fixed``    their intercept (dispatch RTT + launch)
+  * ``analysis_host_work``    fixed / (host_unit - device_unit) — where
+                              the two lane lines cross, the PR-3 break-even
+                              re-derived from measurement
+  * ``synth_host_work``       same crossover (the seeded 1:1 economics)
+  * ``diff_host_work``        20x the analysis crossover (the seeded
+                              2M:100k ratio, anchored to the measured value)
+  * ``sched_sparse_device_unit``  5x the measured device unit (the seeded
+                              ratio; no sparse-device probe dispatches)
+  * ``sched_flops_per_s``     the cost table's FLOPs estimate over the
+                              warm wall (measured only when the dispatch
+                              was costed)
+  * ``sparse_device_mem_mb``  25% of the PJRT per-device bytes_limit on
+                              real accelerators; stays SEEDED on cpu
+                              (host "device memory" is just RAM)
+  * ``sparse_device_density`` stays seeded everywhere (no giant-V probe
+                              fits in the budget) — recorded honestly as
+                              measured=False
+
+Every probe runs under an obs span (``profile:probe.<name>``) and checks
+the wall-clock deadline (``NEMO_PROFILE_BUDGET_S``) between steps —
+running out of budget keeps the partial fit, and any probe failure raises
+out to ``ensure_calibrated``'s seeded fallback.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from nemo_tpu import obs
+from nemo_tpu.obs import log as _obs_log
+
+from .fingerprint import platform_fingerprint
+from .profile import PlatformProfile, profile_budget_s
+
+_log = _obs_log.get_logger("nemo.platform")
+
+#: Probe-corpus runs and the two fused batch widths: big enough to expose
+#: the per-row slope, small enough that both compiles + warm reps fit the
+#: default budget on a 1-core CPU container.
+_PROBE_RUNS = 8
+_PROBE_WIDTHS = (8, 32)
+_WARM_REPS = 3
+_TRANSFER_MB = 4
+
+
+def _median(xs: list[float]) -> float:
+    s = sorted(xs)
+    return s[len(s) // 2]
+
+
+def _probe_transfer(prof: PlatformProfile) -> None:
+    """Host->device bandwidth: device_put of a few-MB array, warm median.
+    Recorded as a probe (audit/bench attribution), not fitted into a
+    routing constant directly — upload cost is already inside the measured
+    device fixed/unit walls."""
+    import jax
+
+    buf = np.zeros((_TRANSFER_MB * 1024 * 1024 // 4,), dtype=np.float32)
+    walls = []
+    with obs.span("profile:probe.transfer", mb=_TRANSFER_MB):
+        for _ in range(_WARM_REPS):
+            t0 = time.perf_counter()
+            jax.block_until_ready(jax.device_put(buf))
+            walls.append(time.perf_counter() - t0)
+    prof.probes["transfer_bytes_per_s"] = buf.nbytes / max(_median(walls), 1e-9)
+
+
+def _probe_fused(b_pad: int, deadline: float) -> dict | None:
+    """One fused-verb probe at batch width ``b_pad``: the exact deployment
+    jit signature (prewarm derivation), dispatched through LocalExecutor —
+    the real device boundary, chaos/cost/metrics included.  Returns
+    {work, cold_s, warm_s, v, e, rows} or None when the deadline passed
+    before this width started."""
+    if time.perf_counter() >= deadline:
+        return None
+    from nemo_tpu.backend.jax_backend import LocalExecutor
+    from nemo_tpu.models.case_studies import CASE_STUDIES
+    from nemo_tpu.models.pipeline_model import BatchArrays
+    from nemo_tpu.utils.prewarm import stress_signature
+
+    family = sorted(CASE_STUDIES)[0]
+    pre_p, post_p, static = stress_signature(family, _PROBE_RUNS, b_pad)
+    arrays = {f"pre_{f}": getattr(pre_p, f) for f in BatchArrays.FIELDS} | {
+        f"post_{f}": getattr(post_p, f) for f in BatchArrays.FIELDS
+    }
+    v, e = int(static["v"]), int(np.asarray(pre_p.edge_src).shape[1])
+    ex = LocalExecutor()
+
+    def dispatch() -> float:
+        import jax
+
+        obs.metrics.inc("profile.probe.dispatches")
+        t0 = time.perf_counter()
+        out = ex.run("fused", arrays, static, rows=_PROBE_RUNS)
+        jax.block_until_ready([a for a in out.values() if a is not None])
+        return time.perf_counter() - t0
+
+    with obs.span("profile:probe.fused", b=b_pad, v=v, e=e):
+        cold = dispatch()
+        warm = []
+        for _ in range(_WARM_REPS):
+            if time.perf_counter() >= deadline:
+                break
+            warm.append(dispatch())
+    return {
+        "b": b_pad,
+        "v": v,
+        "e": e,
+        "work": b_pad * (v + e),
+        "cold_s": cold,
+        "warm_s": _median(warm) if warm else cold,
+        "arrays": (pre_p, post_p, static),
+    }
+
+
+def _probe_host(fused_probe: dict) -> dict:
+    """Sparse-host wall on the SAME packed arrays as the widest fused
+    probe — apples-to-apples work units for the crossover fit."""
+    from nemo_tpu.ops.sparse_host import sparse_analysis_step
+
+    pre_p, post_p, static = fused_probe["arrays"]
+    walls = []
+    with obs.span("profile:probe.sparse_host", b=fused_probe["b"]):
+        for _ in range(_WARM_REPS):
+            t0 = time.perf_counter()
+            sparse_analysis_step(
+                pre_p,
+                post_p,
+                v=int(static["v"]),
+                pre_tid=int(static["pre_tid"]),
+                post_tid=int(static["post_tid"]),
+                num_tables=int(static["num_tables"]),
+                comp_linear=bool(static.get("comp_linear", False)),
+            )
+            walls.append(time.perf_counter() - t0)
+    return {"work": fused_probe["work"], "wall_s": _median(walls)}
+
+
+def _flops_rate(fused_probe: dict) -> float | None:
+    """Effective FLOPs/s from the cost table entry the probe dispatch just
+    indexed (backend/jax_backend.py:_COST_BY_CLASS) over its warm wall —
+    None when XLA cost analysis was unavailable for the signature."""
+    from nemo_tpu.backend.jax_backend import _COST_BY_CLASS
+
+    entry = _COST_BY_CLASS.get(("fused", fused_probe["v"], fused_probe["e"]))
+    if entry is None:
+        return None
+    rec, rec_rows = entry
+    if not rec.get("flops"):
+        return None
+    flops = float(rec["flops"]) / rec_rows * fused_probe["b"]
+    return flops / max(fused_probe["warm_s"], 1e-9)
+
+
+def _device_mem_mb() -> float | None:
+    """25% of the smallest per-device bytes_limit on real accelerators
+    (the dense-route watermark headroom); None on cpu — there the "device
+    memory" is host RAM and the seeded watermark already encodes the
+    giant-V escape economics."""
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return None
+    limits = []
+    for d in jax.devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:  # lint: allow-silent-except — memory_stats is optional per PJRT backend (docstring)
+            stats = None
+        if stats and stats.get("bytes_limit"):
+            limits.append(int(stats["bytes_limit"]))
+    if not limits:
+        return None
+    return min(limits) * 0.25 / 1e6
+
+
+def run_calibration() -> PlatformProfile:
+    """Run the probe suite and return the fitted (unsaved) profile."""
+    budget = profile_budget_s()
+    t_start = time.perf_counter()
+    deadline = t_start + budget
+    prof = PlatformProfile(platform_fingerprint())
+
+    _probe_transfer(prof)
+
+    points = []
+    for b_pad in _PROBE_WIDTHS:
+        p = _probe_fused(b_pad, deadline)
+        if p is None:
+            break
+        points.append(p)
+    if not points:
+        raise RuntimeError(
+            f"calibration budget ({budget:.1f}s) expired before the first "
+            "fused probe completed"
+        )
+    host = _probe_host(points[-1])
+
+    prof.probes["fused"] = [
+        {k: v for k, v in p.items() if k != "arrays"} for p in points
+    ]
+    prof.probes["sparse_host"] = host
+    prof.probes["compile_wall_s"] = max(p["cold_s"] - p["warm_s"] for p in points)
+
+    host_unit = host["wall_s"] / max(host["work"], 1)
+    if len(points) >= 2:
+        dw = points[-1]["work"] - points[0]["work"]
+        device_unit = max(
+            (points[-1]["warm_s"] - points[0]["warm_s"]) / max(dw, 1), 1e-12
+        )
+    else:
+        # Budget ran out after one width: keep the seeded slope, fit only
+        # the intercept from the single measured point.
+        device_unit = 5e-8
+    device_fixed = max(
+        points[0]["warm_s"] - device_unit * points[0]["work"], 1e-6
+    )
+    crossover = device_fixed / max(host_unit - device_unit, 1e-12)
+    analysis_work = int(min(max(crossover, 1_000), 100_000_000))
+
+    prof.set_constant("sched_host_unit", host_unit)
+    prof.set_constant("sched_device_unit", device_unit, measured=len(points) >= 2)
+    prof.set_constant("sched_device_fixed", device_fixed)
+    prof.set_constant("sched_sparse_device_unit", device_unit * 5)
+    prof.set_constant("analysis_host_work", analysis_work)
+    prof.set_constant("synth_host_work", analysis_work)
+    prof.set_constant("diff_host_work", min(analysis_work * 20, 2_000_000_000))
+
+    rate = _flops_rate(points[-1])
+    if rate is not None:
+        prof.set_constant("sched_flops_per_s", rate)
+
+    mem_mb = _device_mem_mb()
+    if mem_mb is not None:
+        prof.set_constant("sparse_device_mem_mb", mem_mb)
+    else:
+        prof.set_constant("sparse_device_mem_mb", 256.0, measured=False)
+    prof.set_constant("sparse_device_density", 1.0 / 256.0, measured=False)
+
+    prof.calibration_wall_s = time.perf_counter() - t_start
+    obs.metrics.gauge("profile.calibration_s", prof.calibration_wall_s)
+    _log.info(
+        "profile.calibrated",
+        wall_s=round(prof.calibration_wall_s, 3),
+        host_unit=host_unit,
+        device_unit=device_unit,
+        device_fixed=device_fixed,
+        analysis_host_work=analysis_work,
+        points=len(points),
+    )
+    return prof

@@ -1,0 +1,45 @@
+"""Platform fingerprint — the identity a measured profile is keyed by.
+
+A profile is only trustworthy on the hardware/software stack it was
+measured on, so every constant the calibrator persists is keyed by the
+5-tuple the routing economics actually depend on: the jax platform
+(cpu/tpu/gpu), the device kind string, the device count, the jax version
+(XLA codegen changes move walls), and the analysis kernel ABI (a kernel
+rewrite invalidates measured dispatch costs as surely as new silicon).
+Any change produces a different key, so a stale profile is never loaded —
+it is simply never found, and the first run on the new stack recalibrates
+loudly (platform/profile.py:ensure_calibrated).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+
+def platform_fingerprint() -> dict:
+    """The identity dict (JSON-able, stable key order via sorted dump).
+    Imports jax lazily: fingerprinting must be callable from stdlib-only
+    surfaces (obs/flight.py embeds it) without forcing a jax init there —
+    those callers only ever see it through an already-imported profile
+    module."""
+    import jax
+
+    from nemo_tpu.analysis.delta import ANALYSIS_ABI_VERSION
+
+    devices = jax.devices()
+    return {
+        "platform": jax.default_backend(),
+        "device_kind": devices[0].device_kind if devices else "none",
+        "device_count": len(devices),
+        "jax_version": jax.__version__,
+        "analysis_abi": int(ANALYSIS_ABI_VERSION),
+    }
+
+
+def fingerprint_key(fp: dict) -> str:
+    """Short stable content key of a fingerprint dict — the profile file
+    name component (profile-<key>.json) and the cross-check stamp inside
+    the file."""
+    blob = json.dumps(fp, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:16]

@@ -1,0 +1,479 @@
+"""The persistent platform profile: measured routing constants + precedence.
+
+One JSON document per platform fingerprint (platform/fingerprint.py),
+stored under the shared cache root (``~/.cache/nemo_tpu/platform/
+profile-<key>.json``, honoring ``XDG_CACHE_HOME``; ``NEMO_PROFILE_DIR``
+relocates it).  It holds the calibrator's fitted constants (platform/
+calibrate.py), the probe measurements they were fitted from, and the
+scheduler's per-(verb, V, E) EWMA walls folded back at clean shutdown —
+the cross-session warm start.
+
+Every consumer resolves each constant with ONE precedence rule, recorded
+per constant so telemetry can show where a number came from:
+
+    env var set        -> ``env``      (the operator always wins; the
+                                        consumer's own parser still applies,
+                                        so legacy env semantics are exact)
+    measured profile   -> ``measured`` (this module's ``profile_value``)
+    neither            -> ``seeded``   (the hand-tuned PR-3/4 defaults)
+
+``NEMO_PROFILE`` gates the whole subsystem: ``auto`` (default) loads the
+fingerprint's profile and calibrates once when none exists, ``off``
+disables both load and calibration (every constant resolves env/seeded —
+bit-for-bit today's behavior), ``force`` recalibrates even over an
+existing profile.  Invalidation semantics: a fingerprint change simply
+misses the keyed file and recalibrates loudly; a CORRUPT profile file
+falls back to seeded defaults with ``profile.stale`` counted (corruption
+is a storage fault, not a reason to burn a calibration the operator
+didn't ask for).
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import tempfile
+import threading
+import time
+
+from nemo_tpu import obs
+from nemo_tpu.obs import log as _obs_log
+from nemo_tpu.utils.env import env_choice, env_float
+
+from .fingerprint import fingerprint_key, platform_fingerprint
+
+_log = _obs_log.get_logger("nemo.platform")
+
+#: Bump when the profile document schema changes incompatibly; a mismatch
+#: reads as corrupt (seeded fallback + profile.stale), never as measured.
+PROFILE_ABI_VERSION = 1
+
+#: constant name -> (env var, seeded default, constant-group).  The seeded
+#: defaults are the documented hand-tuned values each consumer carries —
+#: kept HERE only for the telemetry table; consumers keep their own
+#: defaults so NEMO_PROFILE=off touches nothing.  sched_device_fixed's
+#: seed is derived (budget x unit spread, parallel/sched.py:default_models),
+#: hence None.
+CONSTANTS: dict[str, tuple[str, float | None, str]] = {
+    "analysis_host_work": ("NEMO_ANALYSIS_HOST_WORK", 100000, "routing"),
+    "synth_host_work": ("NEMO_SYNTH_HOST_WORK", 100000, "routing"),
+    "diff_host_work": ("NEMO_DIFF_HOST_WORK", 2000000, "routing"),
+    "sparse_device_mem_mb": ("NEMO_SPARSE_DEVICE_MEM_MB", 256.0, "routing"),
+    "sparse_device_density": ("NEMO_SPARSE_DEVICE_DENSITY", 1.0 / 256.0, "routing"),
+    "sched_host_unit": ("NEMO_SCHED_HOST_UNIT", 1e-6, "sched"),
+    "sched_device_unit": ("NEMO_SCHED_DEVICE_UNIT", 5e-8, "sched"),
+    "sched_sparse_device_unit": ("NEMO_SCHED_SPARSE_DEVICE_UNIT", 2.5e-7, "sched"),
+    "sched_device_fixed": ("NEMO_SCHED_DEVICE_FIXED", None, "sched"),
+    "sched_flops_per_s": ("NEMO_SCHED_FLOPS_PER_S", 5e9, "pricing"),
+}
+
+#: Encoded profile.source.<group> gauge values (federation-friendly).
+_SOURCE_CODE = {"seeded": 0, "measured": 1, "env": 2}
+
+
+def profile_mode() -> str:
+    """``NEMO_PROFILE``: auto | off | force.  Loud policy — this knob pins
+    which constants route the whole corpus."""
+    return env_choice("NEMO_PROFILE", "auto", ("auto", "off", "force"))
+
+
+def profile_budget_s() -> float:
+    """``NEMO_PROFILE_BUDGET_S`` (default 8): wall-clock budget for one
+    calibration.  Probes check the deadline between steps and early-stop
+    keeping partial fits (unfitted constants stay seeded)."""
+    return env_float("NEMO_PROFILE_BUDGET_S", 8.0, minimum=0.5)
+
+
+def profile_dir() -> str:
+    d = os.environ.get("NEMO_PROFILE_DIR")
+    if d:
+        return d
+    cache = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache"
+    )
+    return os.path.join(cache, "nemo_tpu", "platform")
+
+
+def profile_path(key: str) -> str:
+    return os.path.join(profile_dir(), f"profile-{key}.json")
+
+
+class PlatformProfile:
+    """In-memory view of one profile document (the JSON schema, 1:1)."""
+
+    def __init__(
+        self,
+        fingerprint: dict,
+        constants: dict | None = None,
+        probes: dict | None = None,
+        ewma: dict | None = None,
+        calibration_wall_s: float = 0.0,
+        created: float | None = None,
+        updated: float | None = None,
+    ) -> None:
+        self.fingerprint = dict(fingerprint)
+        self.key = fingerprint_key(self.fingerprint)
+        #: name -> {"value": float, "measured": bool} — measured=False
+        #: entries are honest "still seeded" records (e.g. the density
+        #: crossover on a platform where no sparse probe ran).
+        self.constants = dict(constants or {})
+        #: Raw probe measurements the fit came from (audit trail).
+        self.probes = dict(probes or {})
+        #: lane -> {"verb|v|e": EWMA seconds-per-row} — the scheduler's
+        #: cross-session memory (fold_back_session / warm_start).
+        self.ewma = {lane: dict(d) for lane, d in (ewma or {}).items()}
+        self.calibration_wall_s = float(calibration_wall_s)
+        self.created = float(created if created is not None else time.time())
+        self.updated = float(updated if updated is not None else self.created)
+
+    def measured_value(self, name: str) -> float | None:
+        rec = self.constants.get(name)
+        if rec and rec.get("measured") and rec.get("value") is not None:
+            return float(rec["value"])
+        return None
+
+    def set_constant(self, name: str, value: float, measured: bool = True) -> None:
+        self.constants[name] = {"value": float(value), "measured": bool(measured)}
+
+    def age_s(self) -> float:
+        return max(time.time() - self.updated, 0.0)
+
+    def to_doc(self) -> dict:
+        return {
+            "abi": PROFILE_ABI_VERSION,
+            "fingerprint": self.fingerprint,
+            "key": self.key,
+            "constants": self.constants,
+            "probes": self.probes,
+            "ewma": self.ewma,
+            "calibration_wall_s": self.calibration_wall_s,
+            "created": self.created,
+            "updated": self.updated,
+        }
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "PlatformProfile":
+        if doc.get("abi") != PROFILE_ABI_VERSION:
+            raise ValueError(f"profile ABI {doc.get('abi')!r} != {PROFILE_ABI_VERSION}")
+        prof = cls(
+            doc["fingerprint"],
+            constants=doc.get("constants"),
+            probes=doc.get("probes"),
+            ewma=doc.get("ewma"),
+            calibration_wall_s=doc.get("calibration_wall_s", 0.0),
+            created=doc.get("created"),
+            updated=doc.get("updated"),
+        )
+        if doc.get("key") != prof.key:
+            raise ValueError(
+                f"profile key {doc.get('key')!r} does not match its own "
+                f"fingerprint ({prof.key})"
+            )
+        return prof
+
+    def save(self) -> str:
+        """Atomic write (tmp + rename) — a crashed process never leaves a
+        half-written profile for the next boot to read as corrupt."""
+        path = profile_path(self.key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        fd, tmp = tempfile.mkstemp(
+            dir=os.path.dirname(path), prefix=".profile-", suffix=".tmp"
+        )
+        try:
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                json.dump(self.to_doc(), f, indent=1, sort_keys=True)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return path
+
+
+# ---------------------------------------------------------------------------
+# process-global active profile
+# ---------------------------------------------------------------------------
+
+_LOCK = threading.Lock()
+#: "unloaded" sentinel vs "loaded but None" (mode off / no file / corrupt).
+_UNSET = object()
+_ACTIVE: object = _UNSET
+#: Whether THIS process already ran a calibration (force recalibrates once
+#: per process, not once per corpus).
+_CALIBRATED = False
+#: The last load found a PRESENT but unreadable/mismatched file — the
+#: corruption latch ensure_calibrated consults so a storage fault falls
+#: back to seeded defaults instead of burning a surprise recalibration
+#: (``force`` still recalibrates over it, by explicit request).
+_CORRUPT = False
+_ATEXIT_REGISTERED = False
+
+
+def reset_active_profile() -> None:
+    """Forget the cached profile + calibration/corruption latches (tests)."""
+    global _ACTIVE, _CALIBRATED, _CORRUPT
+    with _LOCK:
+        _ACTIVE = _UNSET
+        _CALIBRATED = False
+        _CORRUPT = False
+
+
+def _load_for_fingerprint() -> PlatformProfile | None:
+    """Load the current fingerprint's profile file, or None when missing.
+    A present-but-unreadable file is the CORRUPTION case: seeded fallback,
+    ``profile.stale`` counted, warning logged — never a surprise
+    recalibration over a storage fault (the ``_CORRUPT`` latch)."""
+    global _CORRUPT
+    fp = platform_fingerprint()
+    path = profile_path(fingerprint_key(fp))
+    if not os.path.isfile(path):
+        return None
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        prof = PlatformProfile.from_doc(doc)
+        if prof.fingerprint != fp:
+            raise ValueError("embedded fingerprint does not match this platform")
+        return prof
+    except (OSError, ValueError, KeyError, TypeError) as ex:
+        _CORRUPT = True
+        obs.metrics.inc("profile.stale")
+        _log.warning(
+            "profile.stale", path=path, error=str(ex), action="seeded defaults"
+        )
+        return None
+
+
+def active_profile() -> PlatformProfile | None:
+    """The loaded profile for this process, or None (mode off, no file
+    yet, or a corrupt file).  Loads at most once per process; NEVER
+    calibrates — that is ensure_calibrated's job, called from the backend
+    setup path where probe dispatches are legal."""
+    global _ACTIVE
+    with _LOCK:
+        if _ACTIVE is _UNSET:
+            if profile_mode() == "off":
+                _ACTIVE = None
+            else:
+                prof = _load_for_fingerprint()
+                if prof is not None:
+                    obs.metrics.inc("profile.loaded")
+                    _register_fold_back_locked()
+                _ACTIVE = prof
+            _record_metrics_locked()
+        return _ACTIVE  # type: ignore[return-value]
+
+
+def ensure_calibrated(force: bool = False) -> PlatformProfile | None:
+    """The calibration trigger (backend/jax_backend.py:init_graph_db, the
+    CLI --calibrate verb, serve boot): under ``auto`` with no profile on
+    disk run ONE bounded microprobe suite and persist it; ``force`` (the
+    env mode or the keyword — the CLI verb's explicit request)
+    recalibrates once per process even over an existing file; ``off``
+    does nothing.  Never raises — a failed calibration logs, counts
+    ``profile.error``, and leaves every constant seeded."""
+    global _ACTIVE, _CALIBRATED
+    mode = profile_mode()
+    if mode == "off":
+        return active_profile()
+    force = force or mode == "force"
+    prof = active_profile()
+    with _LOCK:
+        # Missing profile -> calibrate; CORRUPT file -> seeded fallback
+        # (storage faults never burn a calibration) unless forced.
+        want = (prof is None and not _CORRUPT) or (force and not _CALIBRATED)
+        if not want:
+            return prof
+        _CALIBRATED = True
+    fp = platform_fingerprint()
+    _log.warning(
+        "profile.calibrating",
+        fingerprint=fp,
+        reason="forced" if force else "no profile for this fingerprint",
+        budget_s=profile_budget_s(),
+    )
+    try:
+        from .calibrate import run_calibration
+
+        new = run_calibration()
+        new.save()
+        obs.metrics.inc("profile.calibrated")
+    except Exception as ex:
+        obs.metrics.inc("profile.error")
+        _log.warning("profile.calibration_failed", error=str(ex), action="seeded defaults")
+        return prof
+    with _LOCK:
+        _ACTIVE = new
+        _register_fold_back_locked()
+        _record_metrics_locked()
+    return new
+
+
+def profile_value(name: str) -> float | None:
+    """The MEASURED value of one constant, or None when the profile is
+    off/absent or the constant stayed seeded.  Consumers call this as
+    their default when the env var is unset — env precedence lives in the
+    consumer, so legacy env parsing is untouched."""
+    prof = active_profile()
+    return None if prof is None else prof.measured_value(name)
+
+
+def _constant_rows(prof: PlatformProfile | None) -> list[dict]:
+    rows = []
+    for name, (env_var, seeded, group) in CONSTANTS.items():
+        measured = None if prof is None else prof.measured_value(name)
+        env_raw = os.environ.get(env_var)
+        if env_raw is not None:
+            source, value = "env", env_raw
+        elif measured is not None:
+            source, value = "measured", measured
+        else:
+            source, value = "seeded", seeded
+        rows.append(
+            {
+                "name": name,
+                "env": env_var,
+                "group": group,
+                "source": source,
+                "value": value,
+                "measured": measured,
+            }
+        )
+    return rows
+
+
+def constant_sources() -> list[dict]:
+    """Per-constant resolution table (telemetry + flight recorder): the
+    resolved value, its source (env > measured > seeded), and the measured
+    record even when an env override wins — overriding must not suppress
+    the measurement."""
+    return _constant_rows(active_profile())
+
+
+def _record_metrics_locked() -> None:
+    """profile.source.<group> / profile.age_s / profile.calibration_s
+    gauges — gauges so the fleet federation surface (obs/federation.py)
+    rolls them up per replica for free."""
+    try:
+        prof = _ACTIVE if isinstance(_ACTIVE, PlatformProfile) else None
+        groups: dict[str, int] = {}
+        for row in _constant_rows(prof):
+            code = _SOURCE_CODE[row["source"]]
+            groups[row["group"]] = max(groups.get(row["group"], 0), code)
+        for group, code in groups.items():
+            obs.metrics.gauge(f"profile.source.{group}", code)
+        if prof is not None:
+            obs.metrics.gauge("profile.age_s", prof.age_s())
+            obs.metrics.gauge("profile.calibration_s", prof.calibration_wall_s)
+    except Exception:  # lint: allow-silent-except — metrics are observability, never control flow (docstring)
+        pass
+
+
+def telemetry_section() -> dict:
+    """The ``platform_profile`` section of telemetry.json (rendered as a
+    report table by report/assets/app.js) — also embedded verbatim in
+    flight-recorder bundles and BENCH captures."""
+    prof = active_profile()
+    sect: dict = {"mode": profile_mode(), "constants": constant_sources()}
+    if prof is not None:
+        sect.update(
+            fingerprint=prof.fingerprint,
+            key=prof.key,
+            calibration_wall_s=round(prof.calibration_wall_s, 4),
+            age_s=round(prof.age_s(), 1),
+            ewma_classes={lane: len(d) for lane, d in prof.ewma.items()},
+        )
+    return sect
+
+
+# ---------------------------------------------------------------------------
+# cross-session scheduler memory (EWMA fold-back + warm start)
+# ---------------------------------------------------------------------------
+
+
+def _ewma_key(verb: str, v: int, e: int) -> str:
+    return f"{verb}|{v}|{e}"
+
+
+def _ewma_unkey(key: str) -> tuple[str, int, int] | None:
+    parts = key.split("|")
+    if len(parts) != 3:
+        return None
+    try:
+        return parts[0], int(parts[1]), int(parts[2])
+    except ValueError:
+        return None
+
+
+def warm_start(models: dict) -> None:
+    """Seed freshly-built session LaneModels' per-(verb,V,E) EWMA tables
+    from the profile's folded-back walls (parallel/sched.py:session_models
+    calls this once per process) — a new session predicts from the LAST
+    session's measurements instead of the static seed line."""
+    prof = active_profile()
+    if prof is None:
+        return
+    loaded = 0
+    for lane, model in models.items():
+        for key, per_row in prof.ewma.get(lane, {}).items():
+            parsed = _ewma_unkey(key)
+            if parsed is not None and per_row > 0:
+                model.per_row[parsed] = float(per_row)
+                loaded += 1
+    if loaded:
+        obs.metrics.inc("profile.ewma_warm_start", loaded)
+    _register_fold_back()
+
+
+def _register_fold_back() -> None:
+    with _LOCK:
+        _register_fold_back_locked()
+
+
+def _register_fold_back_locked() -> None:
+    global _ATEXIT_REGISTERED
+    if not _ATEXIT_REGISTERED:
+        atexit.register(fold_back_session)
+        _ATEXIT_REGISTERED = True
+
+
+def fold_back_session() -> None:
+    """At clean shutdown, merge this session's measured per-(verb,V,E)
+    EWMA walls (parallel/sched._SESSION_MODELS) back into the profile and
+    rewrite it atomically — staleness-stamped (``updated``) and
+    fingerprint-keyed, so the next session on the SAME platform warm
+    starts and a different platform never sees these walls.  Never raises
+    (registered atexit)."""
+    try:
+        with _LOCK:
+            prof = _ACTIVE if isinstance(_ACTIVE, PlatformProfile) else None
+        if prof is None:
+            return
+        import sys
+
+        sch = sys.modules.get("nemo_tpu.parallel.sched")
+        if sch is None:
+            return
+        models = getattr(sch, "_SESSION_MODELS", None)
+        if not models:
+            return
+        folded = 0
+        for lane, model in models.items():
+            table = prof.ewma.setdefault(lane, {})
+            for (verb, v, e), per_row in getattr(model, "per_row", {}).items():
+                key = _ewma_key(verb, v, e)
+                old = table.get(key)
+                table[key] = (
+                    float(per_row) if old is None else 0.5 * float(old) + 0.5 * float(per_row)
+                )
+                folded += 1
+        if not folded:
+            return
+        prof.updated = time.time()
+        prof.save()
+        obs.metrics.inc("profile.fold_back", folded)
+    except Exception:  # lint: allow-silent-except — shutdown persistence is best-effort; a failed fold-back must not mask the process's real exit (docstring)
+        pass

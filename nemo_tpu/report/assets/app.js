@@ -352,6 +352,33 @@ async function telemetry() {
     body.append(telemetryTable("Analysis routes", routeRows));
   }
 
+  // Platform profile (nemo_tpu/platform, ISSUE 19): the routing constants
+  // live for this run and where each came from — env override, measured
+  // calibration, or the hand-tuned seed — plus the calibration
+  // fingerprint, wall, and age.
+  const prof = data.platform_profile;
+  if (prof && (prof.constants || []).length) {
+    const fmt = (v) =>
+      typeof v === "number" && !Number.isInteger(v) ? v.toPrecision(4) : v;
+    const profRows = prof.constants.map((c) => [
+      `${c.name} (${c.source})`,
+      c.source === "env" && c.measured != null
+        ? `${fmt(c.value)} (measured ${fmt(c.measured)})`
+        : fmt(c.value),
+    ]);
+    profRows.push(["profile mode", prof.mode]);
+    if (prof.fingerprint) {
+      const fp = prof.fingerprint;
+      profRows.push([
+        "fingerprint",
+        `${fp.platform}/${fp.device_kind} ×${fp.device_count}, jax ${fp.jax_version}, abi ${fp.analysis_abi}`,
+      ]);
+      profRows.push(["calibration wall", `${(prof.calibration_wall_s * 1e3).toFixed(0)} ms`]);
+      profRows.push(["profile age", `${prof.age_s} s`]);
+    }
+    body.append(telemetryTable("Platform profile", profRows));
+  }
+
   // Memory watermarks (device peaks where the backend exposes them, host
   // peak RSS always).
   const mem = data.memory || {};

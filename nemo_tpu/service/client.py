@@ -770,7 +770,8 @@ def analyze_dirs(
     """Pipelined multi-corpus analysis with TRUE ingest/compute overlap
     (SURVEY.md §2.3 pipeline-parallel row; VERDICT r1 item 5).
 
-    A producer thread packs each Molly directory (natively when available)
+    A producer thread packs each sweep directory through the injector seam
+    (ingest/adapters.py — natively when the adapter's layout supports it)
     and feeds a bounded queue; the bidi AnalyzeStream RPC consumes from the
     queue, so directory k+1 is parsing/packing on the host WHILE directory
     k executes on the sidecar's device.  queue_depth bounds host memory
@@ -787,12 +788,12 @@ def analyze_dirs(
     timings = {"pack_s": 0.0, "stream_s": 0.0, "wall_s": 0.0, "overlap": overlap}
 
     def chunks():
-        from nemo_tpu.ingest.native import pack_molly_dir
+        from nemo_tpu.ingest.adapters import resolve_injector
 
         for i, d in enumerate(molly_dirs):
             t0 = time.perf_counter()
             with obs.span("pack:dir", ordinal=i):
-                pre, post, static = pack_molly_dir(d)
+                pre, post, static = resolve_injector(d).pack_steps(d)
             timings["pack_s"] += time.perf_counter() - t0
             yield (i, pre, post, static)
 
@@ -932,8 +933,10 @@ def _merge_chunk_outputs(
 
 
 def analyze_dir(target: str, molly_dir: str, chunk_runs: int = 0) -> dict[str, np.ndarray]:
-    """Native-pack a Molly directory and analyze it remotely, optionally
-    streamed in chunks of chunk_runs runs.
+    """Pack a sweep directory through the injector seam
+    (ingest/adapters.py — Molly gets the native packed-first ETL,
+    trace-JSON the adapter load + Python pack) and analyze it remotely,
+    optionally streamed in chunks of chunk_runs runs.
 
     Chunked results are merged to be equivalent to one unchunked call: every
     chunk gets the corpus's good run (row 0) prepended so the differential
@@ -941,9 +944,9 @@ def analyze_dir(target: str, molly_dir: str, chunk_runs: int = 0) -> dict[str, n
     the prototype reductions see it; the duplicate row is dropped from
     per-run outputs and the cross-chunk reductions are re-combined.
     """
-    from nemo_tpu.ingest.native import pack_molly_dir
+    from nemo_tpu.ingest.adapters import resolve_injector
 
-    pre, post, static = pack_molly_dir(molly_dir)
+    pre, post, static = resolve_injector(molly_dir).pack_steps(molly_dir)
     b = int(np.asarray(pre.is_goal).shape[0])
     with RemoteAnalyzer(target=target) as client:
         client.wait_ready()
@@ -989,6 +992,7 @@ def analyze_dir_pipelined(
     import os
 
     from nemo_tpu.graphs.packed import CorpusVocab, pack_graph
+    from nemo_tpu.ingest.adapters import MollyInjector, resolve_injector
     from nemo_tpu.ingest.datatypes import RunData
     from nemo_tpu.ingest.molly import load_run_prov
     from nemo_tpu.models.pipeline_model import graphs_to_step
@@ -1003,17 +1007,18 @@ def analyze_dir_pipelined(
     overlap = effective_cpu_count() > 1
     timings = {"pack_s": 0.0, "stream_s": 0.0, "wall_s": 0.0, "overlap": overlap}
 
-    with open(os.path.join(molly_dir, "runs.json"), "r", encoding="utf-8") as f:
-        raw_runs = json.load(f)
-    n = len(raw_runs)
+    injector = resolve_injector(molly_dir)
+    n = injector.count_runs(molly_dir)
     if n == 0:
-        raise SidecarError(f"no runs in {molly_dir} (empty runs.json)")
+        raise SidecarError(
+            f"no runs in {molly_dir} (empty {type(injector).index_file})"
+        )
     chunk_runs = max(1, chunk_runs)
     spans, pad_to = _uniform_spans(n, chunk_runs)
 
     from nemo_tpu.ingest.native import packed_host_available
 
-    if packed_host_available(molly_dir):
+    if type(injector).native_capable and packed_host_available(molly_dir):
         # Packed-first producer: ONE C++ parse of the whole directory (~6x
         # the Python per-chunk parser's throughput) — or, on any host, ONE
         # mmap load from a warm corpus store — then chunks are plain
@@ -1045,7 +1050,12 @@ def analyze_dir_pipelined(
                 timings["pack_s"] += time.perf_counter() - t0
                 yield chunk
 
-    else:
+    elif isinstance(injector, MollyInjector):
+        # Lib-less Molly host: the layout is one file per run, so the
+        # producer parses + packs incrementally — chunk k+1's JSON work
+        # genuinely overlaps chunk k's device execution.
+        with open(os.path.join(molly_dir, "runs.json"), "r", encoding="utf-8") as f:
+            raw_runs = json.load(f)
         vocab = CorpusVocab()
         good: dict = {}  # filled by chunk 0: {"rid", "pre", "post"}
 
@@ -1074,6 +1084,30 @@ def analyze_dir_pipelined(
                 pre_b, post_b, static = graphs_to_step(rids, pres, posts, vocab)
                 timings["pack_s"] += time.perf_counter() - t0
                 yield (ci, pre_b, post_b, static)
+
+    else:
+        # Generic injector (e.g. trace-json): a single-document layout
+        # has no per-run file boundary to parse incrementally, so the
+        # producer packs the whole sweep ONCE through the seam and chunks
+        # are host row slices — analyze_dir's chunk shape, streamed.  The
+        # slices (and any later chunks' wider-vocab merges) still overlap
+        # the device stream; only the initial pack is serial.
+        def chunks():
+            t0 = time.perf_counter()
+            with obs.span("pack:corpus"):
+                pre_b, post_b, static = injector.pack_steps(molly_dir)
+            timings["pack_s"] += time.perf_counter() - t0
+            for ci, (s, e) in enumerate(spans):
+                t0 = time.perf_counter()
+                with obs.span("pack:chunk", chunk=ci):
+                    chunk = (
+                        ci,
+                        _chunk_rows(pre_b, s, e, with_baseline=ci > 0, pad_to=pad_to),
+                        _chunk_rows(post_b, s, e, with_baseline=ci > 0, pad_to=pad_to),
+                        static,
+                    )
+                timings["pack_s"] += time.perf_counter() - t0
+                yield chunk
 
     results = _stream_pipelined(
         target, len(spans), chunks(), timings, queue_depth, threaded=overlap

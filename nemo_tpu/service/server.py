@@ -1264,6 +1264,16 @@ def _prewarm_async() -> None:
             from nemo_tpu.models.case_studies import CASE_STUDIES
             from nemo_tpu.utils.prewarm import prewarm_family
 
+            # Calibrate the platform profile FIRST (ISSUE 19): the probe
+            # suite compiles the same stress-floor signatures prewarm is
+            # about to warm, so a cold replica pays those compiles once —
+            # and the sidecar's scheduler boots on measured constants
+            # instead of seeds.  No-op when a profile already exists or
+            # NEMO_PROFILE=off.
+            from nemo_tpu.platform import profile as _pp
+
+            _pp.ensure_calibrated()
+
             for name in sorted(CASE_STUDIES):
                 # "chunk" warms only the sidecar's streamed-chunk
                 # signature (the shape every pipelined client dispatches);

@@ -21,6 +21,11 @@ os.environ.setdefault("NEMO_CORPUS_CACHE", "off")
 # ... nor the analysis result cache (nemo_tpu/store/rcache.py): the delta
 # tests opt back in per-test with explicit roots under tmp_path.
 os.environ.setdefault("NEMO_RESULT_CACHE", "off")
+# ... nor the persistent platform profile (nemo_tpu/platform): probe
+# dispatches and measured routing constants would make the suite depend on
+# the machine's cache root; the profile tests opt back in per-test with
+# monkeypatched NEMO_PROFILE + NEMO_PROFILE_DIR under tmp_path.
+os.environ.setdefault("NEMO_PROFILE", "off")
 
 _platform = os.environ.get("NEMO_TEST_PLATFORM", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")

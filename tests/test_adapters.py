@@ -219,3 +219,33 @@ def test_trace_analyze_dir_via_sidecar(tmp_path, sidecar, monkeypatch):
         np.testing.assert_array_equal(
             np.asarray(out_m[k]), np.asarray(out_t[k]), err_msg=k
         )
+
+
+def test_trace_chunked_upload_via_seam(tmp_path, sidecar):
+    """The CLIENT-side chunked-upload path through the seam (ROADMAP 5b):
+    a trace-JSON corpus streams to the sidecar via analyze_chunks
+    (analyze_dir chunk_runs) and the pipelined single-dir producer's
+    generic pack-once branch — both must merge to the adapter's own
+    unchunked local analysis, exactly."""
+    pytest.importorskip("grpc")
+    import numpy as np
+
+    from nemo_tpu.models.pipeline_model import analysis_step
+    from nemo_tpu.service.client import analyze_dir, analyze_dir_pipelined
+
+    src = write_corpus(SynthSpec(n_runs=7, seed=9), str(tmp_path / "m"))
+    td = adapters.molly_to_trace(src, str(tmp_path / "t"))
+    inj = adapters.resolve_injector(td)
+    assert inj.name == "trace-json"
+    pre, post, static = inj.pack_steps(td)
+    want = analysis_step(pre, post, **static)
+
+    chunked = analyze_dir(sidecar, td, chunk_runs=3)
+    piped, timings = analyze_dir_pipelined(sidecar, td, chunk_runs=3)
+    assert timings["pack_s"] > 0
+    for got in (chunked, piped):
+        assert set(got) == set(want)
+        for k in want:
+            np.testing.assert_array_equal(
+                got[k], np.asarray(want[k]), err_msg=k
+            )

@@ -1,0 +1,237 @@
+"""Platform-profile invalidation matrix (ISSUE 19, nemo_tpu/platform).
+
+The contract under test: a fingerprint change misses the keyed file and
+recalibrates loudly; a CORRUPT profile file falls back to seeded defaults
+with ``profile.stale`` counted and never burns a surprise recalibration;
+an env override wins without suppressing the measured record; the
+scheduler's per-(verb, V, E) EWMA walls fold back at shutdown and warm
+start the next session.  Calibration itself is faked fast here — the real
+bounded probe suite is exercised by ``test_real_calibration_is_bounded``
+and the validate profile-smoke (utils/validate_smoke.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from nemo_tpu import obs
+from nemo_tpu.platform import profile as pp
+
+
+@pytest.fixture()
+def prof_env(tmp_path, monkeypatch):
+    """NEMO_PROFILE=auto with a throwaway profile dir; the process-global
+    active profile is reset around the test (the suite default is off —
+    tests/conftest.py)."""
+    monkeypatch.setenv("NEMO_PROFILE", "auto")
+    monkeypatch.setenv("NEMO_PROFILE_DIR", str(tmp_path / "plat"))
+    pp.reset_active_profile()
+    yield tmp_path
+    pp.reset_active_profile()
+
+
+def _fake_profile(**consts) -> pp.PlatformProfile:
+    prof = pp.PlatformProfile(pp.platform_fingerprint())
+    prof.calibration_wall_s = 0.01
+    for name, val in consts.items():
+        prof.set_constant(name, val)
+    return prof
+
+
+def _fake_calibration(monkeypatch, **consts):
+    """Replace the probe suite with an instant fit (ensure_calibrated
+    resolves run_calibration lazily, so patching the module works)."""
+    import nemo_tpu.platform.calibrate as cal
+
+    calls = []
+
+    def fake():
+        calls.append(1)
+        return _fake_profile(**consts)
+
+    monkeypatch.setattr(cal, "run_calibration", fake)
+    return calls
+
+
+def test_first_contact_calibrates_once_then_loads(prof_env, monkeypatch):
+    calls = _fake_calibration(monkeypatch, analysis_host_work=12345.0)
+    m0 = obs.metrics.snapshot()
+    prof = pp.ensure_calibrated()
+    assert prof is not None and calls == [1]
+    path = pp.profile_path(prof.key)
+    assert os.path.isfile(path)
+    assert pp.profile_value("analysis_host_work") == 12345.0
+
+    # A second process (simulated: reset the globals) loads the persisted
+    # file with ZERO calibrations — ensure_calibrated is satisfied.
+    pp.reset_active_profile()
+    assert pp.ensure_calibrated() is not None
+    assert calls == [1]
+    md = obs.Metrics.delta(obs.metrics.snapshot(), m0)["counters"]
+    assert md.get("profile.calibrated") == 1
+    assert md.get("profile.loaded") == 1
+
+
+def test_fingerprint_change_recalibrates_loudly(prof_env, monkeypatch):
+    calls = _fake_calibration(monkeypatch, analysis_host_work=12345.0)
+    key_a = pp.ensure_calibrated().key
+
+    # The platform changed (say, a different device count): the keyed
+    # file misses and a fresh calibration runs, under a DIFFERENT key —
+    # the old platform's constants are never silently reused.
+    fp_b = dict(pp.platform_fingerprint())
+    fp_b["device_count"] += 8
+    monkeypatch.setattr(pp, "platform_fingerprint", lambda: fp_b)
+    pp.reset_active_profile()
+    m0 = obs.metrics.snapshot()
+    prof_b = pp.ensure_calibrated()
+    assert calls == [1, 1]
+    assert prof_b.key != key_a
+    assert os.path.isfile(pp.profile_path(prof_b.key))
+    md = obs.Metrics.delta(obs.metrics.snapshot(), m0)["counters"]
+    assert md.get("profile.calibrated") == 1
+
+
+def test_corrupt_profile_is_seeded_not_recalibrated(prof_env, monkeypatch):
+    calls = _fake_calibration(monkeypatch, analysis_host_work=12345.0)
+    key = pp.ensure_calibrated().key
+
+    with open(pp.profile_path(key), "w", encoding="utf-8") as f:
+        f.write("{ not json")
+    pp.reset_active_profile()
+    m0 = obs.metrics.snapshot()
+    # A storage fault degrades to seeded defaults + profile.stale; it must
+    # NOT burn a calibration the operator didn't ask for.
+    assert pp.ensure_calibrated() is None
+    assert calls == [1]
+    assert pp.profile_value("analysis_host_work") is None
+    md = obs.Metrics.delta(obs.metrics.snapshot(), m0)["counters"]
+    assert md.get("profile.stale") == 1
+    assert not md.get("profile.calibrated")
+
+    # An ABI bump reads as corrupt too (schema change, same fallback).
+    doc = _fake_profile(analysis_host_work=1.0).to_doc()
+    doc["abi"] = pp.PROFILE_ABI_VERSION + 1
+    with open(pp.profile_path(key), "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+    pp.reset_active_profile()
+    assert pp.active_profile() is None
+    assert calls == [1]
+
+
+def test_env_override_wins_without_suppressing_measurement(prof_env, monkeypatch):
+    _fake_calibration(monkeypatch, analysis_host_work=12345.0)
+    pp.ensure_calibrated()
+    from nemo_tpu.backend.jax_backend import _analysis_host_work_budget
+
+    assert _analysis_host_work_budget() == 12345
+
+    monkeypatch.setenv("NEMO_ANALYSIS_HOST_WORK", "777")
+    assert _analysis_host_work_budget() == 777
+    row = {r["name"]: r for r in pp.constant_sources()}["analysis_host_work"]
+    assert row["source"] == "env"
+    assert row["value"] == "777"
+    assert row["measured"] == 12345.0  # the override records, never erases
+
+
+def test_profile_off_resolves_seeded(prof_env, monkeypatch):
+    calls = _fake_calibration(monkeypatch, analysis_host_work=12345.0)
+    pp.ensure_calibrated()
+    pp.reset_active_profile()
+    monkeypatch.setenv("NEMO_PROFILE", "off")
+    assert pp.ensure_calibrated() is None
+    assert pp.profile_value("analysis_host_work") is None
+    assert calls == [1]
+    from nemo_tpu.backend.jax_backend import _analysis_host_work_budget
+
+    assert _analysis_host_work_budget() == 100000  # the seeded default
+
+
+def test_sched_seeds_from_measured_profile(prof_env, monkeypatch):
+    _fake_calibration(
+        monkeypatch,
+        sched_host_unit=3e-7,
+        sched_device_unit=2e-6,
+        sched_device_fixed=0.004,
+    )
+    pp.ensure_calibrated()
+    from nemo_tpu.parallel import sched
+
+    models = sched.default_models()
+    assert models["host"].unit_s == 3e-7
+    assert models["device"].unit_s == 2e-6
+    assert models["device"].fixed_s == 0.004
+    # The operator's env still beats the measurement, via the consumer's
+    # own legacy parser.
+    monkeypatch.setenv("NEMO_SCHED_HOST_UNIT", "9e-7")
+    assert sched.default_models()["host"].unit_s == 9e-7
+
+
+def test_ewma_fold_back_round_trips(prof_env, monkeypatch):
+    _fake_calibration(monkeypatch, sched_host_unit=3e-7)
+    prof = pp.ensure_calibrated()
+    from nemo_tpu.parallel import sched
+
+    sched.reset_session_models()
+    try:
+        models = sched.session_models()
+        job = sched.Job(
+            index=0, verb="fused", rows=8, v=64, e=256, work=2560,
+            execute=lambda *a: None,
+        )
+        models["device"].observe(job, 0.005)
+        measured = models["device"].per_row[("fused", 64, 256)]
+        m0 = obs.metrics.snapshot()
+        pp.fold_back_session()
+        md = obs.Metrics.delta(obs.metrics.snapshot(), m0)["counters"]
+        assert md.get("profile.fold_back", 0) >= 1
+
+        # The persisted file carries the wall, staleness-stamped.
+        with open(pp.profile_path(prof.key), encoding="utf-8") as f:
+            doc = json.load(f)
+        assert doc["ewma"]["device"]["fused|64|256"] == pytest.approx(measured)
+        assert doc["updated"] >= doc["created"]
+
+        # Next session: fresh models warm start from the profile.
+        pp.reset_active_profile()
+        sched.reset_session_models()
+        m0 = obs.metrics.snapshot()
+        models2 = sched.session_models()
+        assert models2["device"].per_row[("fused", 64, 256)] == pytest.approx(measured)
+        md = obs.Metrics.delta(obs.metrics.snapshot(), m0)["counters"]
+        assert md.get("profile.ewma_warm_start", 0) >= 1
+    finally:
+        sched.reset_session_models()
+
+
+def test_telemetry_section_shape(prof_env, monkeypatch):
+    _fake_calibration(monkeypatch, analysis_host_work=12345.0)
+    pp.ensure_calibrated()
+    sect = pp.telemetry_section()
+    assert sect["mode"] == "auto"
+    assert sect["fingerprint"] == pp.platform_fingerprint()
+    rows = {r["name"]: r for r in sect["constants"]}
+    assert set(rows) == set(pp.CONSTANTS)
+    assert rows["analysis_host_work"]["source"] == "measured"
+    assert rows["sched_flops_per_s"]["source"] == "seeded"
+
+
+def test_real_calibration_is_bounded(prof_env):
+    """One REAL probe suite end-to-end: fits the routing constants inside
+    the wall budget and persists a loadable keyed file.  (~4s on a cold
+    jit cache; the acceptance bound is 10s.)"""
+    prof = pp.ensure_calibrated()
+    assert prof is not None
+    assert prof.calibration_wall_s < 10.0
+    for name in ("sched_host_unit", "sched_device_unit", "sched_device_fixed",
+                 "analysis_host_work", "sched_flops_per_s"):
+        assert prof.measured_value(name) is not None, name
+    pp.reset_active_profile()
+    m0 = obs.metrics.snapshot()
+    again = pp.active_profile()
+    assert again is not None and again.key == prof.key
+    md = obs.Metrics.delta(obs.metrics.snapshot(), m0)["counters"]
+    assert not md.get("profile.probe.dispatches")  # warm load probes nothing

@@ -24,7 +24,11 @@ Metrics compared (direction-aware; anything missing on either side skips):
     exactly the silent regression the crossover machinery can produce, so
     any shift past the threshold (absolute) flags in either direction;
   * serving-tier p50/p99 latency, throughput, coalesce ratio and rejects
-    under the standard concurrent-client load (``serve_tier``, ISSUE 8).
+    under the standard concurrent-client load (``serve_tier``, ISSUE 8);
+  * platform-profile tier (ISSUE 19): the bounded calibration wall, the
+    measured-profile crossover plan's wall and its ratio to the
+    hand-seeded plan, and fitted routing-constant drift vs the trailing
+    same-platform medians (relative, either direction).
 
 Accepts both raw bench result lines and the repo's ``BENCH_rNN.json``
 wrapper shape (``{"parsed": {...}}``).  Entries whose result carries an
@@ -70,7 +74,8 @@ def load_bench(path: str) -> dict:
 
 def extract_metrics(doc: dict) -> dict[str, tuple[float, str, str]]:
     """Bench doc -> {metric name: (value, direction, unit)} where direction
-    is 'higher' / 'lower' / 'split' (absolute-shift comparison)."""
+    is 'higher' / 'lower' / 'split' (absolute-shift comparison) / 'drift'
+    (relative move vs the median in EITHER direction)."""
     out: dict[str, tuple[float, str, str]] = {}
 
     def put(name: str, value, direction: str, unit: str) -> None:
@@ -127,6 +132,24 @@ def extract_metrics(doc: dict) -> dict[str, tuple[float, str, str]]:
     put("chaos_tier.faulted_overhead", chaos.get("faulted_overhead"), "lower", "ratio")
     put("chaos_tier.recovery_overhead", chaos.get("recovery_overhead"), "lower", "ratio")
     put("chaos_tier.failed_requests", chaos.get("failed_requests"), "split", "ratio")
+    # Profile tier (ISSUE 19): the calibration wall creeping up (the
+    # bounded microprobe suite is only viable while it stays a few
+    # seconds), the measured-profile plan's wall and its ratio to the
+    # hand-seeded plan (the acceptance bar: measured no slower), and
+    # fitted-constant drift vs the trailing same-platform medians — a
+    # measured constant jumping on the SAME fingerprint means the
+    # measurement (or the machine) changed, in either direction.
+    pt = doc.get("profile_tier") or {}
+    put("profile_tier.calibration_s", pt.get("calibration_s"), "lower", "s_fast")
+    put("profile_tier.measured_s", pt.get("measured_s"), "lower", "s_fast")
+    put(
+        "profile_tier.measured_vs_seeded",
+        pt.get("measured_vs_seeded"),
+        "lower",
+        "ratio",
+    )
+    for cname, cval in sorted((pt.get("constants") or {}).items()):
+        put(f"profile_tier.constant.{cname}", cval, "drift", "ratio")
     # Shard tier (ISSUE 7): mesh-scaling regressions — a width's analysis
     # wall creeping up, scaling efficiency collapsing, the per-bucket
     # gather wall growing, or the scheduler's steal behavior flipping.
@@ -448,6 +471,13 @@ def compare(
             delta = abs(cv - med)
             bad = delta > threshold
             rel = delta
+        elif direction == "drift":
+            # Fitted-constant drift (profile tier): the constants span ten
+            # orders of magnitude, so compare the RELATIVE move vs the
+            # trailing median — and in either direction, because a measured
+            # constant halving is as much a platform change as doubling.
+            rel = abs(cv - med) / abs(med) if med else 0.0
+            bad = rel > threshold
         elif direction == "higher":
             rel = (med - cv) / med if med else 0.0
             bad = rel > threshold
