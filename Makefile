@@ -5,7 +5,7 @@ NATIVE_LIB := native/build/libnemo_native.so
 REPORT_SRC := native/nemo_report.cpp
 REPORT_LIB := native/build/libnemo_report.so
 
-.PHONY: all native test bench bench-watch bench-trend prewarm validate trace-smoke obs-smoke store-smoke delta-smoke shard-smoke sparse-device-smoke serve-smoke fleet-smoke obs-fleet-smoke chaos-smoke stream-smoke synth-smoke watch-smoke profile-smoke lint-print lint-metrics clean reset proto neo4j-up neo4j-validate neo4j-down
+.PHONY: all native test bench bench-watch bench-trend prewarm validate trace-smoke obs-smoke store-smoke delta-smoke shard-smoke sparse-device-smoke serve-smoke fleet-smoke obs-fleet-smoke chaos-smoke stream-smoke synth-smoke watch-smoke profile-smoke query-smoke lint-print lint-metrics clean reset proto neo4j-up neo4j-validate neo4j-down
 
 all: native
 
@@ -165,6 +165,14 @@ watch-smoke:
 # (nemo_tpu/platform).
 profile-smoke:
 	python -m nemo_tpu.utils.validate_smoke --profile-smoke
+
+# Ad-hoc query-engine smoke (also the tail of `make validate`; ISSUE 20):
+# every fixed analysis verb executed as its query-layer program is
+# byte-identical to the native verb, a novel 3-pattern query's warm
+# repeat is a zero-kernel-dispatch result-cache hit, and the sidecar's
+# JSON-carried Query RPC round-trips the same document (nemo_tpu/query).
+query-smoke:
+	python -m nemo_tpu.utils.validate_smoke --query-smoke
 
 # Structured-logging contract: no bare print() in nemo_tpu/ outside the
 # CLI/harness allowlist (tools/lint_no_print.py).

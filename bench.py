@@ -550,6 +550,96 @@ def child_main() -> None:
     except Exception as ex:  # the synth tier must never sink the bench
         log(f"synth tier skipped: {type(ex).__name__}: {ex}")
 
+    # Query tier (ISSUE 20): the ad-hoc query engine (query/engine.py) — a
+    # NOVEL 3-pattern query (no canned verb computes it) at 1x (the base
+    # corpora) and over the full ~10k-run corpus (every family's big dir).
+    # Three walls per scale: the per-run pure-Python oracle
+    # (query/engine.py:oracle_query — the reference baseline the batched
+    # lanes are measured against), cold plan+execute through the scheduler
+    # (with the per-lane query.route.* split), and the warm repeat — a
+    # full-result rcache hit that MUST dispatch zero kernels and MUST come
+    # back under 2 s at the 10k scale (the ISSUE 20 acceptance bar,
+    # floored by tools/bench_trend.py).  Documents are asserted identical
+    # across all three paths.  Dedicated result-cache root; the corpus
+    # store is shared with the other tiers (same segments, and the query
+    # cache keys ride their fingerprints).
+    query_tier = None
+    try:
+        from nemo_tpu.analysis.delta import kernel_dispatch_count as _q_kdc
+        from nemo_tpu.analysis.pipeline import _ingest as _q_ingest
+        from nemo_tpu.query.engine import oracle_query as _q_oracle
+        from nemo_tpu.query.engine import run_query_text as _q_run
+        from nemo_tpu.query.lang import parse_query as _q_parse
+        from nemo_tpu.store import resolve_store as _q_store
+
+        q_text = (
+            "from pre "
+            "match goal[holds=true] -> @rule "
+            "match goal[holds=false] -*-> @rule[type=async] "
+            "match @goal -> rule -> goal "
+            "count by table"
+        )
+        q_ast = _q_parse(q_text)
+        q_rc = os.path.join(tmp, "query_result_cache")
+
+        def _q_strip(doc: dict) -> str:
+            return json.dumps(
+                {k: v for k, v in doc.items() if k != "stats"}, sort_keys=True
+            )
+
+        def _q_pass(mollys, **kw):
+            m0 = obs.metrics.snapshot()
+            t0 = time.perf_counter()
+            docs = [_q_run(q_text, m, result_cache=q_rc, **kw) for m in mollys]
+            wall = time.perf_counter() - t0
+            md = obs.Metrics.delta(obs.metrics.snapshot(), m0)["counters"]
+            routes = {
+                k[len("query.route."):]: int(v)
+                for k, v in sorted(md.items())
+                if k.startswith("query.route.")
+            }
+            return wall, _q_kdc(md), routes, docs
+
+        def _q_scale(dirs):
+            mollys = [_q_ingest(d, True, _q_store(None)) for d in dirs]
+            n_runs = sum(len(m.runs) for m in mollys)
+            t0 = time.perf_counter()
+            oracle_docs = [_q_oracle(q_ast, m) for m in mollys]
+            oracle_s = time.perf_counter() - t0
+            cold_s, cold_disp, routes, cold_docs = _q_pass(mollys)
+            warm_s, warm_disp, _, warm_docs = _q_pass(mollys)
+            if warm_disp != 0:
+                raise RuntimeError(
+                    f"warm query repeat dispatched {warm_disp} kernels (want 0)"
+                )
+            if any(d["stats"]["cache"] != "hit" for d in warm_docs):
+                raise RuntimeError("warm query repeat was not a full rcache hit")
+            for o, c, w in zip(oracle_docs, cold_docs, warm_docs):
+                if not (_q_strip(o) == _q_strip(c) == _q_strip(w)):
+                    raise RuntimeError("oracle/cold/warm query documents differ")
+            return {
+                "runs": n_runs,
+                "oracle_s": round(oracle_s, 3),
+                "cold_s": round(cold_s, 3),
+                "warm_s": round(warm_s, 4),
+                "cold_dispatches": cold_disp,
+                "warm_dispatches": warm_disp,
+                "routes": routes,
+                "speedup_cold": round(oracle_s / cold_s, 1) if cold_s else None,
+                "speedup_warm": round(oracle_s / warm_s, 1) if warm_s else None,
+            }
+
+        query_tier = {
+            "query": q_text,
+            "patterns": 3,
+            "at_1x": _q_scale(base_dirs),
+            "at_full": _q_scale([d for _, d in big_dirs]),
+            "byte_identical": True,
+        }
+        log(f"query tier (oracle vs cold vs warm-hit): {json.dumps(query_tier)}")
+    except Exception as ex:  # the query tier must never sink the bench
+        log(f"query tier skipped: {type(ex).__name__}: {ex}")
+
     # Adversarial tier (ISSUE 15): the named adversarial graph families
     # (models/synth.py:ADVERSARIAL_FAMILIES) as first-class bench rows —
     # deep chains, wide fan-out, near-duplicates, pathological vocab
@@ -2402,6 +2492,7 @@ def child_main() -> None:
         "ingest_tier": ingest_tier,
         "delta_tier": delta_tier,
         "synth_tier": synth_tier,
+        "query_tier": query_tier,
         "adversarial_tier": adversarial_tier,
         "watch_tier": watch_tier,
         "chaos_tier": chaos_tier,

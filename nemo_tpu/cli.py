@@ -50,6 +50,13 @@ def make_backend(name: str):
 
 
 def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "query":
+        # `nemo-tpu query "<text>" -faultInjOut DIR` — the ad-hoc query
+        # subcommand (nemo_tpu/query).  Dispatched before the main parser
+        # because the query text is positional and the main CLI is
+        # flag-only (Go flag-style reference parity).
+        return _query_main(argv[1:])
     parser = argparse.ArgumentParser(
         prog="nemo-tpu", description="Provenance-graph debugging of distributed protocols."
     )
@@ -432,16 +439,15 @@ def main(argv: list[str] | None = None) -> int:
         print(f"All done! Find the debug report here: {os.path.join(res.report_dir, 'index.html')}")
 
     if args.serve:
-        import functools
         import http.server
 
         # Multiple corpora: serve the results ROOT so every report is
         # reachable (results/<run_name>/index.html); a single corpus keeps
-        # the report itself as the document root, as before.
+        # the report itself as the document root, as before.  The handler
+        # adds POST /query over the in-memory corpora for the report's
+        # query box.
         serve_dir = result.report_dir if len(results) == 1 else args.results_dir
-        handler = functools.partial(
-            http.server.SimpleHTTPRequestHandler, directory=serve_dir
-        )
+        handler = _query_http_handler(serve_dir, _batch_molly_resolver(results))
         with http.server.ThreadingHTTPServer(("127.0.0.1", args.serve), handler) as httpd:
             print(f"Serving the report at http://127.0.0.1:{httpd.server_address[1]}/ (Ctrl-C to stop)")
             try:
@@ -449,6 +455,183 @@ def main(argv: list[str] | None = None) -> int:
             except KeyboardInterrupt:
                 pass
     return 0
+
+
+def _query_main(argv: list[str]) -> int:
+    """`nemo-tpu query`: compile one declarative query onto the batched
+    kernels and print the JSON result document (README "Ad-hoc queries").
+    Exit 0 on success, 2 on a query error (parse/validation/unknown name —
+    always loud, never an empty result)."""
+    import json
+
+    parser = argparse.ArgumentParser(
+        prog="nemo-tpu query",
+        description="Run one ad-hoc provenance query over a corpus directory.",
+    )
+    parser.add_argument(
+        "query",
+        help='query text, e.g. \'from pre match goal[holds=true] -> @rule '
+        "tables' (language reference: README \"Ad-hoc queries\")",
+    )
+    parser.add_argument(
+        "-faultInjOut",
+        "--fault-inj-out",
+        dest="fault_inj_out",
+        required=False,
+        help="fault injector output directory to query",
+    )
+    parser.add_argument(
+        "--injector",
+        default=None,
+        help="fault-injector adapter for ingest (default: sniff; env NEMO_INJECTOR)",
+    )
+    parser.add_argument("--corpus-cache", metavar="DIR", default=None)
+    parser.add_argument("--result-cache", metavar="DIR", default=None)
+    parser.add_argument(
+        "--platform",
+        default=None,
+        metavar="NAME",
+        help="jax platform (auto/cpu/tpu; default $NEMO_PLATFORM or auto)",
+    )
+    parser.add_argument(
+        "--explain",
+        action="store_true",
+        help="print the lowered kernel plan (one line per primitive) and exit "
+        "without executing",
+    )
+    parser.add_argument(
+        "--serial",
+        action="store_true",
+        help="drain query jobs serially instead of through the heterogeneous "
+        "scheduler (debugging)",
+    )
+    args = parser.parse_args(argv)
+
+    from nemo_tpu.query import QueryError, parse_query, plan_query
+
+    try:
+        q = parse_query(args.query)
+    except QueryError as ex:
+        print(f"query error: {ex}", file=sys.stderr)
+        return 2
+    if args.explain:
+        for line in plan_query(q).describe():
+            print(line)
+        return 0
+
+    if not args.fault_inj_out:
+        parser.error("-faultInjOut is required (unless --explain)")
+    if not os.path.isdir(args.fault_inj_out):
+        parser.error(f"fault injector output directory not found: {args.fault_inj_out}")
+    if args.corpus_cache is not None:
+        os.environ["NEMO_CORPUS_CACHE"] = args.corpus_cache
+    if args.result_cache is not None:
+        os.environ["NEMO_RESULT_CACHE"] = args.result_cache
+    if args.injector is not None:
+        os.environ["NEMO_INJECTOR"] = args.injector
+    try:
+        ensure_platform(args.platform)
+    except PlatformUnavailableError as e:
+        print(f"fatal: {e}", file=sys.stderr)
+        return 2
+    enable_compilation_cache()
+
+    from nemo_tpu.analysis.pipeline import _ingest
+    from nemo_tpu.query.engine import execute_query
+    from nemo_tpu.store import resolve_store
+
+    molly = _ingest(args.fault_inj_out, use_packed=True, store=resolve_store())
+    try:
+        doc = execute_query(q, molly, serial=args.serial)
+    except QueryError as ex:
+        print(f"query error: {ex}", file=sys.stderr)
+        return 2
+    json.dump(doc, sys.stdout, indent=2, sort_keys=True)
+    print()
+    return 0
+
+
+def _query_http_handler(serve_dir: str, resolve_molly):
+    """SimpleHTTPRequestHandler subclass serving ``serve_dir`` statically
+    PLUS a ``POST /query`` endpoint for the report front end's query box
+    (report/assets/app.js).  ``resolve_molly(request_dict)`` returns the
+    corpus to query — a closure over the in-memory result (batch mode) or
+    a store-warm re-ingest (watch mode).  Query errors come back as JSON
+    ``{"error": ...}`` with status 400, so the box can render them inline."""
+    import functools
+    import http.server
+    import json
+
+    class Handler(http.server.SimpleHTTPRequestHandler):
+        def do_POST(self):  # noqa: N802 - http.server API
+            if self.path.rstrip("/") != "/query":
+                self.send_error(404, "unknown POST endpoint (expected /query)")
+                return
+            try:
+                n = int(self.headers.get("Content-Length") or 0)
+                req = json.loads(self.rfile.read(n).decode("utf-8") or "{}")
+                if not isinstance(req, dict):
+                    raise ValueError("request body must be a JSON object")
+                from nemo_tpu.query import run_query_text
+
+                doc = run_query_text(str(req.get("query", "")), resolve_molly(req))
+                body, status = json.dumps(doc).encode("utf-8"), 200
+            except Exception as ex:  # loud to the query box, not a 500 page
+                body = json.dumps(
+                    {"error": f"{type(ex).__name__}: {ex}"}
+                ).encode("utf-8")
+                status = 400
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    return functools.partial(Handler, directory=serve_dir)
+
+
+def _batch_molly_resolver(results):
+    """Query-box corpus resolution for batch ``--serve``: one corpus binds
+    directly; several (results-root serving) need the report name the
+    front end sends (its first path segment)."""
+    by_name = {res.molly.run_name: res.molly for res in results}
+
+    def resolve(req: dict):
+        if len(by_name) == 1:
+            return next(iter(by_name.values()))
+        name = str(req.get("report", ""))
+        if name not in by_name:
+            from nemo_tpu.query import QueryError
+
+            raise QueryError(
+                f"query box needs a report name to pick the corpus; got "
+                f"{name!r} (one of: {', '.join(sorted(by_name))})"
+            )
+        return by_name[name]
+
+    return resolve
+
+
+def _watch_molly_resolver(sweep_dir: str, injector_arg):
+    """Query-box corpus resolution for watch mode: re-ingest through the
+    corpus store (warm hit mmaps in milliseconds), memoized on the
+    adapter's poll token so queries between sweep generations reuse one
+    MollyOutput and only a grown sweep re-ingests."""
+    memo: dict = {}
+
+    def resolve(req: dict):
+        from nemo_tpu.analysis.pipeline import _ingest
+        from nemo_tpu.ingest import adapters
+        from nemo_tpu.store import resolve_store
+
+        injector = adapters.resolve_injector(sweep_dir, injector_arg)
+        token = injector.poll_token(sweep_dir)
+        if memo.get("token") != token:
+            memo["molly"] = _ingest(sweep_dir, use_packed=True, store=resolve_store())
+            memo["token"] = token
+        return memo["molly"]
+
+    return resolve
 
 
 def _calibrate_main() -> int:
@@ -530,11 +713,12 @@ def _watch_main(args, sweep_dir: str) -> int:
 
     httpd = None
     if args.serve:
-        import functools
         import http.server
 
-        handler = functools.partial(
-            http.server.SimpleHTTPRequestHandler, directory=args.results_dir
+        # POST /query re-ingests through the corpus store (memoized on the
+        # adapter poll token), so the query box stays live mid-sweep.
+        handler = _query_http_handler(
+            args.results_dir, _watch_molly_resolver(sweep_dir, args.injector)
         )
         httpd = http.server.ThreadingHTTPServer(("127.0.0.1", args.serve), handler)
         threading.Thread(

@@ -171,6 +171,21 @@ class FaultInjector:
         untouched) — the replay driver's per-generation step."""
         raise NotImplementedError
 
+    @classmethod
+    def index_runs(cls, corpus_dir: str):
+        """Per-entry access to the raw index document, for layouts whose
+        WHOLE sweep lives inside the index file (trace.json): returns
+        ``(n_entries, parse, head)`` where ``parse(pos) -> RunData`` (may
+        raise on a malformed entry) and ``head(pos) -> (iteration,
+        success)`` reads just the baked-in identity pair.  The corpus
+        store's index-delta append path (store/__init__.py) consumes this
+        to confirm the stored entries unchanged and pack ONLY the appended
+        tail — the watch loop's O(new runs) growth story for non-Molly
+        injectors.  None (the default) means the layout has no
+        single-document growth story; Molly's per-run files ride the
+        dedicated runs.json append path instead."""
+        return None
+
 
 class MollyInjector(FaultInjector):
     """The Molly front end — the seam's first implementation, delegating to
@@ -382,6 +397,24 @@ class TraceJsonInjector(FaultInjector):
         os.makedirs(dst_dir, exist_ok=True)
         with open(os.path.join(dst_dir, TRACE_FILE), "w", encoding="utf-8") as fh:
             json.dump(doc, fh, indent=1)
+
+    @classmethod
+    def index_runs(cls, corpus_dir: str):
+        doc = _read_trace(corpus_dir)
+        spec = doc.get("spec") or {}
+        raws = doc.get("runs") or []
+
+        def parse(pos: int) -> RunData:
+            return _trace_run(spec, raws[pos])
+
+        def head(pos: int) -> tuple[int, bool]:
+            raw = raws[pos]
+            status = raw.get("status")
+            if status is None:
+                status = "success" if raw.get("outcome", "ok") == "ok" else "fail"
+            return int(raw["id"]), status == "success"
+
+        return len(raws), parse, head
 
 
 def _read_trace(corpus_dir: str) -> dict:

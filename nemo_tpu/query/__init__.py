@@ -1,0 +1,33 @@
+"""Ad-hoc provenance query engine (ISSUE 20 / ROADMAP item 3).
+
+The reference's real power was Cypher — arbitrary analyst questions over
+the provenance store, with the canned analyses just stored queries.  This
+package reopens that generality over the batched substrate: a small typed
+query language (:mod:`nemo_tpu.query.lang`), a planner lowering patterns
+onto the existing CSR kernel family (:mod:`nemo_tpu.query.plan`), an
+executor draining per-bucket Jobs through the heterogeneous scheduler with
+content-addressed result caching (:mod:`nemo_tpu.query.engine`), and the
+fixed pattern verbs re-expressed as query programs
+(:mod:`nemo_tpu.query.verbs`).
+
+Surfaces: ``nemo-tpu query`` (cli.py), the JSON-carried ``Query`` sidecar
+RPC (service/server.py), and the report front end's query box
+(report/assets/app.js) in ``--serve``/watch mode.
+"""
+
+from __future__ import annotations
+
+from nemo_tpu.query.engine import execute_query, oracle_query, run_query_text
+from nemo_tpu.query.lang import Query, QueryError, parse_query
+from nemo_tpu.query.plan import QueryPlan, plan_query
+
+__all__ = [
+    "Query",
+    "QueryError",
+    "QueryPlan",
+    "execute_query",
+    "oracle_query",
+    "parse_query",
+    "plan_query",
+    "run_query_text",
+]

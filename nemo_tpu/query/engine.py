@@ -1,0 +1,698 @@
+"""The query executor: segments -> scheduler Jobs -> order-insensitive reduce.
+
+Execution reuses the whole production spine, not a private path:
+
+* runs pack into the SAME power-of-two (V, E) buckets as the analysis
+  verbs (graphs/packed.py:bucketize), so compiled programs are shared
+  corpus-to-corpus;
+* each bucket becomes a ``parallel/sched.py`` :class:`Job` with the new
+  ``query`` verb class and ``lanes=("sparse_device", "host")`` — two
+  bit-identical evaluators over the same bound plan, so cost-model
+  routing, work stealing, dispatch deadlines, host failover and the
+  device circuit breaker all apply unchanged;
+* per-segment results are :class:`QueryPartial`\\ s — iteration-keyed plain
+  data with a commutative/associative merge, the ``SegmentPartial``
+  contract (analysis/delta.py) — cached per segment and as a full-result
+  blob in the result cache, content-addressed on (query AST hash, segment
+  fingerprints, analysis ABI) via ``blob_cache_key``.  A warm repeat is a
+  zero-kernel-dispatch rcache hit, exactly like a verb.
+
+Dispatch accounting: every bucket execution counts one
+``kernel.dispatches.query`` so ``kernel_dispatch_count`` (the zero-dispatch
+cache-hit assertion every smoke uses) covers the query engine too.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import NamedTuple
+
+import numpy as np
+
+from nemo_tpu import obs
+from nemo_tpu.query.lang import HOP_ADJ, Query, QueryError
+from nemo_tpu.query.plan import QueryPlan, plan_query
+
+
+# ---------------------------------------------------------------------------
+# the serializable intermediate
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class QueryPartial:
+    """One segment's slice of a query result: iteration-keyed plain data
+    (names, not vocab ids), JSON-serializable, merged order-insensitively.
+    ``per_run`` values depend on the aggregation — list[str] (tables),
+    int (count), bool (runs), dict[str, int] (count_by_table)."""
+
+    per_run: dict = field(default_factory=dict)  # iteration -> value
+    n_runs: int = 0
+
+    def to_json(self) -> dict:
+        return {
+            "per_run": {str(k): v for k, v in self.per_run.items()},
+            "n_runs": self.n_runs,
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "QueryPartial":
+        return cls(
+            per_run={int(k): v for k, v in d["per_run"].items()},
+            n_runs=int(d["n_runs"]),
+        )
+
+
+def merge_query_partials(parts: list) -> QueryPartial:
+    """Commutative/associative merge: segments own disjoint iteration sets,
+    so the union is order-insensitive (asserted under permutation by
+    tests/test_query.py)."""
+    out = QueryPartial()
+    for p in parts:
+        out.per_run.update(p.per_run)
+        out.n_runs += p.n_runs
+    return out
+
+
+def finalize(plan: QueryPlan, merged: QueryPartial) -> dict:
+    """Partial -> the result document.  Every rollup is computed from the
+    iteration-keyed map in sorted-key order, so the document bytes are a
+    pure function of content (cacheable byte-identically)."""
+    runs = {str(k): merged.per_run[k] for k in sorted(merged.per_run)}
+    doc: dict = {"agg": plan.agg, "graph": plan.graph, "n_runs": merged.n_runs}
+    if plan.agg == "tables":
+        doc["runs"] = runs
+        doc["distinct"] = sorted({t for v in merged.per_run.values() for t in v})
+    elif plan.agg == "count":
+        doc["runs"] = runs
+        doc["total"] = int(sum(merged.per_run.values()))
+    elif plan.agg == "runs":
+        doc["runs"] = sorted(k for k, v in merged.per_run.items() if v)
+    else:  # count_by_table
+        hist: dict = {}
+        for v in merged.per_run.values():
+            for t, n in v.items():
+                hist[t] = hist.get(t, 0) + int(n)
+        doc["by_table"] = {t: hist[t] for t in sorted(hist)}
+        doc["total"] = int(sum(hist.values()))
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# the two lane evaluators (bit-identical over one bound plan)
+# ---------------------------------------------------------------------------
+
+
+def _eval_device(batch, time_plane, bound, num_tables: int):
+    """Device lane: one jitted program per (bound plan, bucket shape) —
+    plane compares + ``_push_any``/``_reach_any`` waves, vmapped over the
+    run axis by construction ([B, V]/[B, E] planes)."""
+    import jax
+
+    from nemo_tpu.ops.sparse_device import resolve_wave_impl
+
+    out = _device_eval_jit(
+        np.asarray(batch.is_goal),
+        np.asarray(batch.node_mask),
+        np.asarray(batch.table_id),
+        np.asarray(batch.label_id),
+        time_plane,
+        np.asarray(batch.type_id),
+        np.asarray(batch.edge_src),
+        np.asarray(batch.edge_dst),
+        np.asarray(batch.edge_mask),
+        spec=bound,
+        v=batch.v,
+        num_tables=num_tables,
+        wave_impl=resolve_wave_impl(),
+        interpret=jax.default_backend() != "tpu",
+    )
+    return np.asarray(out)
+
+
+def _step_mask(planes: dict, step: tuple, xp):
+    """One step's node mask: the kind constraint & every plane compare.
+    Shared by both lanes (xp = jnp or np) so the boolean algebra cannot
+    drift between them.  The ``holds`` plane is true only on holding GOAL
+    nodes, and ``holds`` predicates only validate on goal steps (lang.py),
+    so the negated form needs no extra goal guard."""
+    is_goal = planes["is_goal"]
+    m = planes["node_mask"]
+    for test in step:
+        if test[0] == "kind":
+            if test[1] == "goal":
+                m = m & is_goal
+            elif test[1] == "rule":
+                m = m & ~is_goal
+            continue
+        fld, op, val = test
+        if fld == "holds":
+            want = bool(val) if op == "=" else not val
+            m = (m & planes["holds"]) if want else (m & ~planes["holds"])
+            continue
+        plane = planes[fld]
+        m = (m & (plane == val)) if op == "=" else (m & (plane != val))
+    return m
+
+
+def _eval_patterns(planes: dict, patterns: tuple, hop, zeros, xp):
+    """The forward/backward chain intersection, shared by both lanes:
+    ``hop(state, kind, fwd)`` is the lane's wave primitive."""
+    cap = zeros
+    for steps, hops, ci in patterns:
+        masks = [_step_mask(planes, s, xp) for s in steps]
+        fwd = [masks[0]]
+        for i, h in enumerate(hops):
+            fwd.append(masks[i + 1] & hop(fwd[i], h, True))
+        bwd = masks[-1]
+        for i in range(len(hops) - 1, ci - 1, -1):
+            bwd = masks[i] & hop(bwd, hops[i], False)
+        cap = cap | (fwd[ci] & bwd)
+    return cap
+
+
+def _device_eval_impl(
+    is_goal, node_mask, table_id, label_id, time_id, type_id,
+    edge_src, edge_dst, edge_mask,
+    spec: tuple, v: int, num_tables: int, wave_impl: str, interpret: bool,
+):
+    import jax.numpy as jnp
+
+    from nemo_tpu.ops.sparse_device import (
+        _condition_holds, _push_any, _reach_any,
+    )
+
+    patterns, needs_holds, cond_tid = spec
+    planes = {
+        "is_goal": is_goal, "node_mask": node_mask, "table": table_id,
+        "label": label_id, "time": time_id, "type": type_id,
+    }
+    if needs_holds:
+        ba = _BatchPlanes(
+            is_goal=is_goal, node_mask=node_mask, table_id=table_id,
+            edge_src=edge_src, edge_dst=edge_dst, edge_mask=edge_mask,
+        )
+        planes["holds"] = _condition_holds(ba, cond_tid, num_tables, v)
+
+    def hop(state, kind, fwd: bool):
+        src = edge_src if fwd else edge_dst
+        dst = edge_dst if fwd else edge_src
+        if kind == HOP_ADJ:
+            return _push_any(state, src, dst, edge_mask, v)
+        return _reach_any(state, src, dst, edge_mask, v, wave_impl, interpret)
+
+    zeros = jnp.zeros(is_goal.shape, dtype=bool)
+    return _eval_patterns(planes, patterns, hop, zeros, jnp)
+
+
+class _BatchPlanes(NamedTuple):
+    """The edge/node planes ``_condition_holds`` reads, as a jit-traceable
+    pytree (the verb path hands it a full BatchArrays; the query path only
+    has the planes)."""
+
+    is_goal: object
+    node_mask: object
+    table_id: object
+    edge_src: object
+    edge_dst: object
+    edge_mask: object
+
+
+_DEVICE_EVAL_JIT: list = []
+
+
+def _device_eval_jit(*args, **kw):
+    """Lazily-jitted device evaluator: one compiled program per (bound
+    plan, bucket shape) — the bound spec and shapes are jit-statics."""
+    if not _DEVICE_EVAL_JIT:
+        import jax
+
+        _DEVICE_EVAL_JIT.append(
+            jax.jit(
+                _device_eval_impl,
+                static_argnames=("spec", "v", "num_tables", "wave_impl", "interpret"),
+            )
+        )
+    return _DEVICE_EVAL_JIT[0](*args, **kw)
+
+
+def _eval_host(batch, time_plane, bound, num_tables: int):
+    """Host lane: the same boolean algebra over the flat-scatter CSR prep
+    (ops/sparse_host.py) — ``scat_any`` waves and ``bfs_any`` fix points.
+    Bit-identical to the device lane (asserted by tests/test_query.py)."""
+    from nemo_tpu.ops.sparse_host import _CondCSR, _condition_holds, bfs_any, build_csr
+
+    csr = _CondCSR(batch)
+    patterns, needs_holds, cond_tid = bound
+    planes = {
+        "is_goal": csr.is_goal, "node_mask": csr.node_mask, "table": csr.table,
+        "label": np.asarray(batch.label_id, dtype=np.int64),
+        "time": np.asarray(time_plane, dtype=np.int64),
+        "type": csr.type_id,
+    }
+    if needs_holds:
+        planes["holds"] = _condition_holds(csr, cond_tid, num_tables)
+
+    csrs: dict = {}
+
+    def hop(state, kind, fwd: bool):
+        at, frm = (csr.dst, csr.src) if fwd else (csr.src, csr.dst)
+        if kind == HOP_ADJ:
+            return csr.scat_any(at, state.ravel()[frm])
+        if fwd not in csrs:
+            csrs[fwd] = build_csr(frm, at, csr.n)
+        indptr, nbr = csrs[fwd]
+        return bfs_any(indptr, nbr, state.ravel()).reshape(csr.b, csr.v)
+
+    zeros = np.zeros((csr.b, csr.v), dtype=bool)
+    return _eval_patterns(planes, patterns, hop, zeros, np)
+
+
+# ---------------------------------------------------------------------------
+# map / extract
+# ---------------------------------------------------------------------------
+
+
+def _time_plane(batch) -> np.ndarray:
+    """[B, V] time-id plane (PackedBatch carries it only per graph)."""
+    out = np.full((len(batch.n_nodes), batch.v), -1, dtype=np.int32)
+    for i, g in enumerate(batch.graphs):
+        out[i, : g.n_nodes] = g.time_id
+    return out
+
+
+def _extract(plan: QueryPlan, batch, cap: np.ndarray, vocab) -> dict:
+    """Capture mask -> per-run plain-data values (names via the vocab)."""
+    table = np.asarray(batch.table_id)
+    out: dict = {}
+    for i, rid in enumerate(batch.run_ids):
+        m = cap[i]
+        if plan.agg == "tables":
+            out[rid] = sorted(
+                {vocab.tables[t] for t in np.unique(table[i][m]) if t >= 0}
+            )
+        elif plan.agg == "count":
+            out[rid] = int(m.sum())
+        elif plan.agg == "runs":
+            out[rid] = bool(m.any())
+        else:  # count_by_table
+            tids, counts = np.unique(table[i][m & (table[i] >= 0)], return_counts=True)
+            out[rid] = {vocab.tables[t]: int(n) for t, n in zip(tids, counts)}
+    return out
+
+
+def _filter_runs(runs: list, run_filter: str) -> list:
+    if run_filter == "failed":
+        return [r for r in runs if not r.succeeded]
+    if run_filter == "success":
+        return [r for r in runs if r.succeeded]
+    return list(runs)
+
+
+def _empty_value(agg: str):
+    """The aggregation value of a run with no captures (including runs whose
+    provenance is absent — total replication failures have no post graph):
+    present in the per-run map on EVERY lane and ingest path, so the object
+    and packed-first paths produce identical documents."""
+    return {"tables": [], "count": 0, "runs": False, "count_by_table": {}}[agg]
+
+
+def map_segment_runs(
+    plan: QueryPlan, runs: list, vocab, serial: bool = False, graph_of=None
+) -> QueryPartial:
+    """Map one segment's runs through the scheduler: bucketize, one Job per
+    bucket (verb="query"), drain on the heterogeneous scheduler.
+
+    ``graph_of(run) -> PackedGraph | None`` overrides graph materialization
+    — the packed-first ingest path (ingest/native.py RawProv) supplies lazy
+    array views over the native corpus instead of object repacks."""
+    from nemo_tpu.graphs.packed import pack_graph
+    from nemo_tpu.parallel.sched import HeterogeneousScheduler, Job
+
+    from nemo_tpu.graphs.packed import bucketize
+
+    selected = _filter_runs(runs, plan.run_filter)
+    part = QueryPartial(n_runs=len(selected))
+    if graph_of is None:
+        prov_of = (
+            (lambda r: r.pre_prov) if plan.graph == "pre" else (lambda r: r.post_prov)
+        )
+
+        def graph_of(r):
+            prov = prov_of(r)
+            return None if prov is None else pack_graph(prov, vocab)
+
+    rids, graphs, empty_rids = [], [], []
+    for r in selected:
+        g = graph_of(r)
+        if g is None or g.n_nodes == 0:
+            empty_rids.append(r.iteration)
+            continue
+        rids.append(r.iteration)
+        graphs.append(g)
+    part.per_run = {rid: _empty_value(plan.agg) for rid in empty_rids}
+    if not rids:
+        return part
+
+    batches = bucketize(rids, graphs)
+    bound = plan.bind(vocab)
+    num_tables = max(1, len(vocab.tables))
+    results: dict = part.per_run
+
+    def make_execute(batch):
+        def execute(lane: str, reason: str, stolen: bool) -> dict:
+            obs.metrics.inc("kernel.dispatches.query")
+            obs.metrics.inc(f"query.route.{lane}")
+            obs.metrics.inc("query.rows_scanned", len(batch.run_ids))
+            tp = _time_plane(batch) if plan.needs_time else np.full(
+                (len(batch.n_nodes), batch.v), -1, dtype=np.int32
+            )
+            if lane == "host":
+                cap = _eval_host(batch, tp, bound, num_tables)
+            else:
+                cap = _eval_device(batch, tp, bound, num_tables)
+            return {"cap": cap}
+
+        return execute
+
+    jobs = [
+        Job(
+            index=i,
+            verb="query",
+            rows=len(b.run_ids),
+            v=b.v,
+            e=b.e,
+            work=len(b.run_ids) * (b.v + b.e),
+            execute=make_execute(b),
+            lanes=("sparse_device", "host"),
+            source="query",
+        )
+        for i, b in enumerate(batches)
+    ]
+    outs = HeterogeneousScheduler().run(jobs, serial=serial)
+    for b, o in zip(batches, outs):
+        results.update(_extract(plan, b, o["cap"], vocab))
+    part.per_run = results
+    return part
+
+
+# ---------------------------------------------------------------------------
+# the corpus-level entry point
+# ---------------------------------------------------------------------------
+
+
+def corpus_vocab(molly):
+    """One deterministic corpus-wide vocabulary: interned in run order, the
+    exact order the packer itself uses — independent of cache state, so
+    bound plans and name validation never depend on which segments hit.
+    On the packed-first ingest path the native corpus already interned
+    (bit-identically to the Python path, native/nemo_native.cpp:ingest), so
+    the vocab rebuilds from its string lists — the jax_backend idiom."""
+    from nemo_tpu.graphs.packed import CorpusVocab
+
+    vocab = CorpusVocab()
+    nc = getattr(molly, "native_corpus", None)
+    if nc is not None:
+        for t in nc.tables:
+            vocab.tables.intern(t)
+        for lb in nc.labels:
+            vocab.labels.intern(lb)
+        for tm in nc.times:
+            vocab.times.intern(tm)
+        return vocab
+    for r in molly.runs:
+        for prov in (r.pre_prov, r.post_prov):
+            if prov is None:
+                continue
+            for g in prov.goals:
+                vocab.tables.intern(g.table)
+                vocab.labels.intern(g.label)
+                vocab.times.intern(g.time)
+            for ru in prov.rules:
+                vocab.tables.intern(ru.table)
+                vocab.labels.intern(ru.label)
+    return vocab
+
+
+def execute_query(
+    q: Query,
+    molly,
+    *,
+    result_cache: str | None = None,
+    use_cache: bool = True,
+    serial: bool = False,
+) -> dict:
+    """Plan + execute one query over an ingested corpus.  Returns the
+    result document plus execution stats.
+
+    Caching (two tiers, both content-addressed via
+    ``analysis/delta.py:blob_cache_key`` so the key covers every segment
+    fingerprint + the query AST hash + the analysis ABI):
+
+    * full-result blob (namespace ``query``) — a warm repeat returns it
+      with zero kernel dispatches;
+    * per-segment partial blobs (namespace ``query-partial``) — a grown
+      corpus maps only its NEW segments, the delta contract.
+    """
+    from nemo_tpu.analysis.delta import blob_cache_key, corpus_segments
+    from nemo_tpu.store.rcache import resolve_result_cache
+
+    with obs.span("query:plan", agg=q.agg, patterns=len(q.patterns)):
+        plan = plan_query(q)
+
+    seg_meta = getattr(molly, "store_segments", None)
+    rc = resolve_result_cache(result_cache) if use_cache else None
+    full_key = blob_cache_key("query", seg_meta, {"plan": plan.key})
+
+    if rc is not None and full_key is not None:
+        blob = rc.load_blob("query", full_key)
+        if blob is not None:
+            obs.metrics.inc("query.cache.hit")
+            doc = json.loads(blob.decode("utf-8"))
+            doc["stats"] = {"cache": "hit", "segments_mapped": 0}
+            return doc
+        obs.metrics.inc("query.cache.miss")
+
+    with obs.span("query:execute", plan=plan.key[:12]):
+        vocab = corpus_vocab(molly)
+        plan.validate_names(vocab)
+        segments = corpus_segments(molly)
+        graph_of = None
+        nc = getattr(molly, "native_corpus", None)
+        if nc is not None:
+            from nemo_tpu.graphs.packed import CorpusGraphs
+
+            cg = CorpusGraphs(nc)
+            row_by_iter = {int(it): i for i, it in enumerate(nc.iteration)}
+            graph_of = lambda r: cg.get(plan.graph, row_by_iter[r.iteration])  # noqa: E731
+        parts, mapped = [], 0
+        for seg in segments:
+            pkey = (
+                blob_cache_key(
+                    "query-partial",
+                    [{"fingerprint": seg.fingerprint}],
+                    {"plan": plan.key},
+                )
+                if seg.fingerprint is not None
+                else None
+            )
+            if rc is not None and pkey is not None:
+                blob = rc.load_blob("query-partial", pkey)
+                if blob is not None:
+                    obs.metrics.inc("query.partial.hit")
+                    parts.append(QueryPartial.from_json(json.loads(blob.decode("utf-8"))))
+                    continue
+            obs.metrics.inc("query.partial.miss")
+            part = map_segment_runs(
+                plan,
+                molly.runs[seg.start : seg.stop],
+                vocab,
+                serial=serial,
+                graph_of=graph_of,
+            )
+            mapped += 1
+            if rc is not None and pkey is not None:
+                rc.put_blob(
+                    "query-partial",
+                    pkey,
+                    json.dumps(part.to_json(), sort_keys=True).encode("utf-8"),
+                )
+            parts.append(part)
+        doc = finalize(plan, merge_query_partials(parts))
+
+    if rc is not None and full_key is not None:
+        rc.put_blob(
+            "query", full_key, json.dumps(doc, sort_keys=True).encode("utf-8")
+        )
+    obs.metrics.inc("query.executes")
+    doc["stats"] = {
+        "cache": "miss" if full_key is not None else "off",
+        "segments_mapped": mapped,
+    }
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# the per-run python oracle
+# ---------------------------------------------------------------------------
+
+
+def _oracle_holds(g, succ: dict, pred: dict, cond_tid: int) -> set:
+    """Per-graph pure-Python mirror of ops/condition.py:mark_condition_holds
+    (the reference both lanes' ``_condition_holds`` is measured against)."""
+    goals = range(g.n_goals)
+    tab = g.table_id
+    roots = [n for n in goals if int(tab[n]) == cond_tid and not pred.get(n)]
+    rules = {
+        d
+        for r in roots
+        for d in succ.get(r, ())
+        if d >= g.n_goals and int(tab[d]) == cond_tid
+    }
+    trig = {d for r in rules for d in succ.get(r, ()) if d < g.n_goals}
+    if not trig:
+        return set()
+    trig_tables = {int(tab[t]) for t in trig if int(tab[t]) >= 0}
+    return {
+        n
+        for n in goals
+        if int(tab[n]) == cond_tid
+        or (int(tab[n]) >= 0 and int(tab[n]) in trig_tables)
+    }
+
+
+def _oracle_eval_graph(g, bound: tuple) -> set:
+    """One graph's capture set, computed with dict/set traversal — the same
+    chain-intersection semantics as ``_eval_patterns`` but with none of its
+    machinery (no planes, no waves, no buckets)."""
+    patterns, needs_holds, cond_tid = bound
+    succ: dict = {}
+    pred: dict = {}
+    for s, d in g.edges:
+        succ.setdefault(int(s), []).append(int(d))
+        pred.setdefault(int(d), []).append(int(s))
+    holds = _oracle_holds(g, succ, pred, cond_tid) if needs_holds else set()
+    planes = {
+        "table": g.table_id, "label": g.label_id,
+        "time": g.time_id, "type": g.type_id,
+    }
+
+    def passes(i: int, step: tuple) -> bool:
+        for test in step:
+            if test[0] == "kind":
+                ok = (i < g.n_goals) if test[1] == "goal" else (i >= g.n_goals)
+            elif test[0] == "holds":
+                want = bool(test[2]) if test[1] == "=" else not test[2]
+                ok = (i in holds) == want
+            else:
+                fld, op, val = test
+                cur = int(planes[fld][i])
+                ok = (cur == val) if op == "=" else (cur != val)
+            if not ok:
+                return False
+        return True
+
+    def hop(state: set, kind, fwd: bool) -> set:
+        adj = succ if fwd else pred
+        if kind == HOP_ADJ:
+            return {d for s in state for d in adj.get(s, ())}
+        reach: set = set()
+        frontier = state
+        while frontier:
+            frontier = {d for s in frontier for d in adj.get(s, ())} - reach
+            reach |= frontier
+        return reach
+
+    cap: set = set()
+    for steps, hops, ci in patterns:
+        masks = [{i for i in range(g.n_nodes) if passes(i, s)} for s in steps]
+        fwd = [masks[0]]
+        for i, h in enumerate(hops):
+            fwd.append(masks[i + 1] & hop(fwd[i], h, True))
+        bwd = masks[-1]
+        for i in range(len(hops) - 1, ci - 1, -1):
+            bwd = masks[i] & hop(bwd, hops[i], False)
+        cap |= fwd[ci] & bwd
+    return cap
+
+
+def oracle_query(q: Query, molly) -> dict:
+    """Per-run pure-Python reference evaluator: the same result document as
+    :func:`execute_query`, computed one run at a time with dict/set graph
+    traversal — no bucketing, no scheduler, no vectorized wave kernels, no
+    caching.  The parity oracle of tests/test_query.py and the baseline the
+    bench's query tier measures the batched lanes against."""
+    from nemo_tpu.graphs.packed import pack_graph
+
+    plan = plan_query(q)
+    vocab = corpus_vocab(molly)
+    plan.validate_names(vocab)
+    bound = plan.bind(vocab)
+
+    nc = getattr(molly, "native_corpus", None)
+    if nc is not None:
+        from nemo_tpu.graphs.packed import CorpusGraphs
+
+        cg = CorpusGraphs(nc)
+        row_by_iter = {int(it): i for i, it in enumerate(nc.iteration)}
+        graph_of = lambda r: cg.get(plan.graph, row_by_iter[r.iteration])  # noqa: E731
+    else:
+        prov_of = (
+            (lambda r: r.pre_prov) if plan.graph == "pre" else (lambda r: r.post_prov)
+        )
+
+        def graph_of(r):
+            prov = prov_of(r)
+            return None if prov is None else pack_graph(prov, vocab)
+
+    selected = _filter_runs(molly.runs, plan.run_filter)
+    part = QueryPartial(n_runs=len(selected))
+    for r in selected:
+        g = graph_of(r)
+        if g is None or g.n_nodes == 0:
+            part.per_run[r.iteration] = _empty_value(plan.agg)
+            continue
+        cap = _oracle_eval_graph(g, bound)
+        tab = g.table_id
+        if plan.agg == "tables":
+            val = sorted({vocab.tables[int(tab[i])] for i in cap if int(tab[i]) >= 0})
+        elif plan.agg == "count":
+            val = len(cap)
+        elif plan.agg == "runs":
+            val = bool(cap)
+        else:  # count_by_table
+            hist: dict = {}
+            for i in cap:
+                t = int(tab[i])
+                if t >= 0:
+                    name = vocab.tables[t]
+                    hist[name] = hist.get(name, 0) + 1
+            val = hist
+        part.per_run[r.iteration] = val
+    doc = finalize(plan, part)
+    doc["stats"] = {"cache": "oracle", "segments_mapped": 0}
+    return doc
+
+
+def run_query_text(text: str, molly, **kw) -> dict:
+    """Text -> result document (the CLI/RPC/report-box entry point)."""
+    from nemo_tpu.query.lang import parse_query
+
+    obs.metrics.inc("query.compiles")
+    q = parse_query(text)
+    return execute_query(q, molly, **kw)
+
+
+# Re-exported for callers that build ASTs programmatically (query/verbs.py).
+__all__ = [
+    "QueryError",
+    "QueryPartial",
+    "corpus_vocab",
+    "execute_query",
+    "finalize",
+    "map_segment_runs",
+    "merge_query_partials",
+    "oracle_query",
+    "run_query_text",
+]

@@ -1,0 +1,189 @@
+"""The query planner: lower a validated AST onto the batched kernel family.
+
+A :class:`QueryPlan` is the symbolic lowering — per pattern, a conjunction
+of vectorized plane comparisons per step plus the hop sequence, with the
+derived-plane requirements (condition-holds, the time plane) hoisted out so
+the executor materializes each at most once per bucket.  Binding resolves
+predicate NAMES to vocabulary ids against the corpus vocab: the plan stays
+name-keyed (cacheable across corpora), the bound form is a flat hashable
+tuple — the jit-static argument of the device evaluator, so one compiled
+program serves every same-shape bucket of every query with the same bound
+structure.
+
+Pattern evaluation is the standard forward/backward chain intersection on
+the EXISTING frontier primitives (ops/sparse_device.py ``_push_any`` /
+``_reach_any``; ops/sparse_host.py ``scat_any`` / ``bfs_any``):
+
+    f[0]   = mask(step 0)
+    f[i]   = mask(step i) & hop_fwd(f[i-1])     (one wave, or >=1-hop reach)
+    b[k]   = mask(step k)
+    b[i]   = mask(step i) & hop_bwd(b[i+1])     (same wave, edges reversed)
+    capture= f[ci] & b[ci]
+
+``f[i] & b[i]`` is exact for chains: forward support proves a prefix path
+into the node, backward support proves a suffix path out of it, and their
+concatenation is a full match (predicates are node-local).  The query's
+capture set is the union over patterns — node-set semantics, which is what
+makes every aggregation an order-insensitive reduce.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from nemo_tpu.query.lang import HOP_ADJ, FIELDS, Query, QueryError
+
+#: type-name -> packed type id (graphs/packed.py _TYPE_IDS — kept in sync by
+#: tests/test_query.py's lowering units).
+_TYPE_IDS = {"": 0, "async": 1, "next": 2, "collapsed": 3}
+
+#: Bound-predicate sentinel for a name NO run in the bound segment interned:
+#: planes hold ids >= -1 (-1 = padding), so -2 never compares equal.  The
+#: corpus-level loud unknown-name check happens before binding
+#: (:meth:`QueryPlan.validate_names`); the sentinel covers names that exist
+#: in the corpus but not in one segment's vocabulary.
+_NO_ID = -2
+
+_NAME_VOCABS = {"table": "tables", "label": "labels", "time": "times"}
+
+
+@dataclass(frozen=True)
+class PatternPlan:
+    """One lowered chain: per-step test tuples + hops + capture index."""
+
+    #: per step: tuple of atomic tests, each ("kind", k) / (field, op, value)
+    steps: tuple
+    hops: tuple
+    capture: int
+
+
+@dataclass(frozen=True)
+class QueryPlan:
+    """The symbolic plan: name-keyed, hashable, content-addressed."""
+
+    graph: str
+    cond_tid: int  # pinned condition table id: "pre"=0 / "post"=1
+    run_filter: str
+    agg: str
+    needs_holds: bool
+    needs_time: bool
+    patterns: tuple
+    key: str  # == Query.ast_hash() — the plan is a pure function of the AST
+
+    # -- binding ----------------------------------------------------------
+    def names(self) -> dict:
+        """Vocabulary names the plan references, per name-valued field."""
+        out: dict = {f: set() for f in _NAME_VOCABS}
+        for p in self.patterns:
+            for step in p.steps:
+                for test in step:
+                    if test[0] in _NAME_VOCABS:
+                        out[test[0]].add(test[2])
+        return out
+
+    def validate_names(self, vocab) -> None:
+        """Loud corpus-level resolution check (the fail-fast half of the
+        env-knob policy): a name no run in the corpus ever interned is a
+        typo, not an empty result."""
+        for fld, wanted in self.names().items():
+            voc = getattr(vocab, _NAME_VOCABS[fld])
+            for name in sorted(wanted):
+                if voc.lookup(name) < 0:
+                    raise QueryError(
+                        f"unknown {fld} {name!r}: no run in this corpus "
+                        f"defines it (vocabulary has {len(voc)} {fld}s)"
+                    )
+
+    def bind(self, vocab) -> tuple:
+        """Resolve names -> ids against one vocabulary.  Returns the flat
+        hashable bound form the evaluators take as a jit-static:
+        ``(patterns, needs_holds, cond_tid)`` with every test an
+        ``(plane, op, int)`` triple."""
+        def bind_test(test: tuple) -> tuple:
+            if test[0] == "kind":
+                return test
+            fld, op, val = test
+            if fld in _NAME_VOCABS:
+                vid = getattr(vocab, _NAME_VOCABS[fld]).lookup(val)
+                return (fld, op, int(vid) if vid >= 0 else _NO_ID)
+            if fld == "type":
+                return (fld, op, _TYPE_IDS[val])
+            return (fld, op, bool(val))  # holds
+
+        pats = tuple(
+            (
+                tuple(tuple(bind_test(t) for t in step) for step in p.steps),
+                p.hops,
+                p.capture,
+            )
+            for p in self.patterns
+        )
+        return (pats, self.needs_holds, self.cond_tid)
+
+    # -- introspection ----------------------------------------------------
+    def describe(self) -> list[str]:
+        """The lowered kernel sequence, one line per primitive — what the
+        planner unit tests assert pattern -> kernel lowering against."""
+        out = [f"select graph={self.graph} runs={self.run_filter}"]
+        if self.needs_holds:
+            out.append(f"condition_holds tid={self.cond_tid}")
+        for pi, p in enumerate(self.patterns):
+            for si, step in enumerate(p.steps):
+                tests = " & ".join(
+                    f"kind={t[1]!r}" if t[0] == "kind"
+                    else f"{t[0]}{t[1]}{t[2]!r}"
+                    for t in step
+                )
+                out.append(f"p{pi} mask s{si}: {tests}")
+            for hi, hop in enumerate(p.hops):
+                kern = "push_any" if hop == HOP_ADJ else "reach_any"
+                out.append(f"p{pi} fwd {kern} s{hi}->s{hi + 1}")
+            for hi in range(len(p.hops) - 1, -1, -1):
+                kern = "push_any" if p.hops[hi] == HOP_ADJ else "reach_any"
+                out.append(f"p{pi} bwd {kern} s{hi + 1}->s{hi}")
+            out.append(f"p{pi} capture s{p.capture}: fwd & bwd")
+        out.append(f"reduce {self.agg}")
+        return out
+
+
+def plan_query(q: Query) -> QueryPlan:
+    """Lower a validated query to its plan.  Pure AST function — the plan
+    key IS the AST hash, so plan caching rides the query content address."""
+    from nemo_tpu import obs
+
+    q.validate()
+    needs_holds = False
+    needs_time = False
+    pats = []
+    for p in q.patterns:
+        steps = []
+        for s in p.steps:
+            tests: list = [("kind", s.kind)]
+            for pred in s.preds:
+                if pred.field == "holds":
+                    needs_holds = True
+                if pred.field == "time":
+                    needs_time = True
+                tests.append((pred.field, pred.op, pred.value))
+            steps.append(tuple(tests))
+        pats.append(
+            PatternPlan(steps=tuple(steps), hops=p.hops, capture=p.capture_index)
+        )
+    plan = QueryPlan(
+        graph=q.graph,
+        cond_tid=0 if q.graph == "pre" else 1,  # CorpusVocab pins pre=0/post=1
+        run_filter=q.run_filter,
+        agg=q.agg,
+        needs_holds=needs_holds,
+        needs_time=needs_time,
+        patterns=tuple(pats),
+        key=q.ast_hash(),
+    )
+    obs.metrics.inc("query.plans")
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# lowering sanity: every field the language admits has a lowering here
+# ---------------------------------------------------------------------------
+assert set(_NAME_VOCABS) | {"type", "holds"} == set(FIELDS)

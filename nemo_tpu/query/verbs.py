@@ -1,0 +1,165 @@
+"""Fixed analysis verbs expressed as query-layer programs.
+
+The forcing function of the query subsystem (ISSUE 20): the pattern-shaped
+analysis verbs — the reference's actual Cypher queries
+(corrections.go:30-34, corrections.go:121-125, extensions.go:63-67, plus
+the achieved-antecedent gate) — each have a query-layer program here whose
+result is BYTE-IDENTICAL to the native verb's.  ``make query-smoke``
+asserts the parity for every entry; tests/test_query.py asserts it per
+lane against the per-run python oracles too.
+
+The transform-shaped verbs (chain contraction, prototype depth ordering,
+the differential frontier) are NOT pattern queries — they stay native, but
+execute on the same kernels the planner lowers onto; see ARCHITECTURE.md
+"The query engine".
+
+Native-side twins: :func:`native_verb_result` computes the same-shaped
+per-run map THROUGH the fixed verb path (backend kernels / host oracles),
+so parity checks compare two independently-derived documents.
+"""
+
+from __future__ import annotations
+
+from nemo_tpu.query.lang import (
+    HOP_ADJ,
+    Pattern,
+    Pred,
+    Query,
+    Step,
+)
+
+_GOAL_HOLDS = Step(kind="goal", preds=(Pred("holds", "=", True),))
+_GOAL_NOHOLD = Step(kind="goal", preds=(Pred("holds", "=", False),))
+_RULE = Step(kind="rule")
+_ASYNC = Step(kind="rule", preds=(Pred("type", "=", "async"),), capture=True)
+
+
+def _chain(*steps: Step) -> Pattern:
+    return Pattern(steps=tuple(steps), hops=(HOP_ADJ,) * (len(steps) - 1))
+
+
+#: verb name -> the query program computing it (all validated at import).
+VERB_QUERIES: dict[str, Query] = {
+    # Per-run achieved-antecedent goal count — the extensions gate
+    # (backend.achieved_pre_goal_counts): pre-condition goals whose
+    # condition holds, table == "pre".
+    "achieved_pre": Query(
+        graph="pre",
+        agg="count",
+        patterns=[
+            Pattern(
+                steps=(
+                    Step(
+                        kind="goal",
+                        preds=(
+                            Pred("holds", "=", True),
+                            Pred("table", "=", "pre"),
+                        ),
+                        capture=True,
+                    ),
+                )
+            )
+        ],
+    ),
+    # Pre-correction triggers (corrections.go:30-34 /
+    # analysis/queries.py:find_pre_triggers): aggregation rules `a` with a
+    # holding goal above and a non-holding goal below that still derives —
+    # captured as the distinct trigger-rule tables per run.
+    "pre_triggers": Query(
+        graph="pre",
+        agg="tables",
+        patterns=[
+            _chain(
+                _GOAL_HOLDS,
+                Step(kind="rule", capture=True),
+                _GOAL_NOHOLD,
+                _RULE,
+            )
+        ],
+    ),
+    # Post-correction triggers (corrections.go:121-125 /
+    # find_post_triggers): rules below a rule-derived holding goal whose
+    # own child goal fails but still derives.
+    "post_triggers": Query(
+        graph="post",
+        agg="tables",
+        patterns=[
+            _chain(
+                _RULE,
+                _GOAL_HOLDS,
+                Step(kind="rule", capture=True),
+                _GOAL_NOHOLD,
+                _RULE,
+            )
+        ],
+    ),
+    # Extension candidates (extensions.go:63-67 / the batched synth verb,
+    # ops/sparse_{device,host}.py:synth_ext_*): async rules on the
+    # antecedent's condition boundary — the union of the two reference
+    # disjuncts, each a chain capturing the async rule.
+    "ext_candidates": Query(
+        graph="pre",
+        agg="tables",
+        patterns=[
+            _chain(_GOAL_HOLDS, _ASYNC, _GOAL_NOHOLD, _RULE),  # cond_a
+            _chain(_GOAL_NOHOLD, _ASYNC),  # cond_b
+        ],
+    ),
+}
+
+for _q in VERB_QUERIES.values():
+    _q.validate()
+
+
+def verb_query(name: str) -> Query:
+    """The query program for one fixed verb (loud on unknown names)."""
+    if name not in VERB_QUERIES:
+        raise KeyError(
+            f"unknown verb {name!r} (expected one of {', '.join(VERB_QUERIES)})"
+        )
+    return VERB_QUERIES[name]
+
+
+def run_verb(name: str, molly, **kw) -> dict:
+    """Execute one fixed verb through the query layer."""
+    from nemo_tpu.query.engine import execute_query
+
+    return execute_query(verb_query(name), molly, **kw)
+
+
+def native_verb_result(name: str, backend) -> dict:
+    """The NATIVE verb's per-run result, shaped like the query document's
+    ``runs`` map — the byte-parity reference for :func:`run_verb`.
+
+    The backend must have ingested its corpus (``backend.molly`` set); the
+    trigger verbs walk the same kernel-holds PGraphs the corrections verb
+    consumes (``backend.raw``), synth candidates ride the batched synth
+    verb, achieved counts the fused achieved gate."""
+    from nemo_tpu.analysis.queries import find_post_triggers, find_pre_triggers
+
+    molly = backend.molly
+    if name in ("pre_triggers", "post_triggers"):
+        # The raw property-graphs mirror cond_holds from the fused kernel
+        # output; load_raw_provenance wires that mirror (idempotent — the
+        # fused dispatch is memoized per corpus).
+        backend.load_raw_provenance()
+    if name == "achieved_pre":
+        return {str(k): v for k, v in backend.achieved_pre_goal_counts().items()}
+    if name == "ext_candidates":
+        iters = [r.iteration for r in molly.runs]
+        return {str(k): v for k, v in backend.synth_candidates(iters).items()}
+    if name == "pre_triggers":
+        return {
+            str(r.iteration): sorted(
+                {t.agg.table for t in find_pre_triggers(backend.raw[(r.iteration, "pre")])}
+            )
+            for r in molly.runs
+        }
+    if name == "post_triggers":
+        return {
+            str(r.iteration): sorted(
+                {t.rule.table for t in find_post_triggers(backend.raw[(r.iteration, "post")])}
+            )
+            for r in molly.runs
+        }
+    raise KeyError(f"unknown verb {name!r}")

@@ -352,6 +352,31 @@ async function telemetry() {
     body.append(telemetryTable("Analysis routes", routeRows));
   }
 
+  // Ad-hoc queries (nemo_tpu/query, ISSUE 20): how many queries this
+  // process compiled/executed, the two cache tiers' hit split, and the
+  // scheduler-lane routing of query kernel dispatches.
+  const queryRows = [];
+  for (const [key, label] of [
+    ["query.compiles", "queries compiled"],
+    ["query.executes", "queries executed"],
+    ["query.cache.hit", "full-result cache hits"],
+    ["query.cache.miss", "full-result cache misses"],
+    ["query.partial.hit", "segment partials from cache"],
+    ["query.partial.miss", "segment partials mapped fresh"],
+    ["query.rows_scanned", "rows scanned"],
+    ["kernel.dispatches.query", "kernel dispatches"],
+  ]) {
+    if (allCounters[key]) queryRows.push([label, allCounters[key]]);
+  }
+  for (const [k, v] of Object.entries(allCounters).sort()) {
+    if (k.startsWith("query.route.")) {
+      queryRows.push([`lane ${k.slice("query.route.".length)}`, v]);
+    }
+  }
+  if (queryRows.length) {
+    body.append(telemetryTable("Queries", queryRows));
+  }
+
   // Platform profile (nemo_tpu/platform, ISSUE 19): the routing constants
   // live for this run and where each came from — env override, measured
   // calibration, or the hand-tuned seed — plus the calibration
@@ -401,6 +426,56 @@ async function telemetry() {
     body.append(el("p", { class: "empty-note" }, `trace id ${data.trace_id}`));
   }
   document.getElementById("telemetry").hidden = false;
+}
+
+function queryBox() {
+  // Ad-hoc query box (ISSUE 20, nemo_tpu/query): the serving handler
+  // (cli.py:_query_http_handler) adds POST /query next to the static
+  // report, compiling the text onto the batched kernels server-side.  The
+  // box only appears under an HTTP origin — on file:// there is no
+  // endpoint to post to.
+  if (!location.protocol.startsWith("http")) return;
+  const section = document.getElementById("query");
+  const form = document.getElementById("query-form");
+  const input = document.getElementById("query-input");
+  const status = document.getElementById("query-status");
+  const result = document.getElementById("query-result");
+  form.addEventListener("submit", async (ev) => {
+    ev.preventDefault();
+    const text = input.value.trim();
+    if (!text) return;
+    status.textContent = "running…";
+    result.hidden = true;
+    // Multi-corpus serving roots the server at the results directory; the
+    // first path segment names this report's corpus for the resolver.
+    const report = location.pathname.split("/").filter(Boolean)[0] || "";
+    const t0 = performance.now();
+    try {
+      const resp = await fetch("/query", {
+        method: "POST",
+        headers: { "Content-Type": "application/json" },
+        body: JSON.stringify({ query: text, report }),
+      });
+      const doc = await resp.json();
+      if (!resp.ok || doc.error) {
+        status.textContent = doc.error || `query failed (HTTP ${resp.status})`;
+        status.classList.add("status-fail");
+        return;
+      }
+      status.classList.remove("status-fail");
+      const stats = doc.stats || {};
+      status.textContent =
+        `${doc.n_runs} runs, agg ${doc.agg} over ${doc.graph} — ` +
+        `${(performance.now() - t0).toFixed(0)} ms ` +
+        `(cache ${stats.cache || "?"}, ${stats.segments_mapped ?? "?"} segments mapped)`;
+      result.textContent = JSON.stringify(doc, null, 2);
+      result.hidden = false;
+    } catch (e) {
+      status.textContent = `query failed: ${e}`;
+      status.classList.add("status-fail");
+    }
+  });
+  section.hidden = false;
 }
 
 function runLink(iter) {
@@ -529,6 +604,7 @@ async function main() {
   telemetry(); // independent of the run data; never blocks the report
   quarantine(); // likewise — a healthy corpus has no quarantine.json
   repairs(); // likewise — ranked repair synthesis when repairs.json exists
+  queryBox(); // likewise — live only under the serving handler's /query
   const resp = await fetch("debugging.json");
   const runs = await resp.json();
 

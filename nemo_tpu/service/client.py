@@ -146,6 +146,12 @@ class RemoteAnalyzer:
             request_serializer=lambda d: _json.dumps(d).encode("utf-8"),
             response_deserializer=pb.AnalyzeResponse.FromString,
         )
+        # Ad-hoc query RPC (ISSUE 20): JSON both ways.
+        self._query = self._channel.unary_unary(
+            f"/{SERVICE}/Query",
+            request_serializer=lambda d: _json.dumps(d).encode("utf-8"),
+            response_deserializer=lambda b: _json.loads(b.decode("utf-8")),
+        )
         # Server-streaming variant: JSON request, JSON event stream back
         # (results carry the serialized AnalyzeResponse base64-embedded).
         self._analyze_dir_stream = self._channel.unary_stream(
@@ -384,6 +390,44 @@ class RemoteAnalyzer:
             # via the shared cache tier.
             obs.metrics.inc(f"rpc.analyze_dir_fleet.{fleet}")
         return codec.outputs_from_pb(resp)
+
+    def query_remote(
+        self,
+        molly_dir: str,
+        query: str,
+        corpus_cache: str | None = None,
+        result_cache: str | None = None,
+    ) -> dict:
+        """Run one ad-hoc provenance query server-side (ISSUE 20): ship the
+        directory path + query TEXT; the sidecar compiles and executes it
+        on the batched kernels (nemo_tpu/query) and returns the JSON
+        result document.  Trailing ``nemo-rcache``/``nemo-coalesce``
+        statuses land in the ``rpc.query_rcache.*`` /
+        ``rpc.query_coalesce.*`` counters; a malformed query raises
+        INVALID_ARGUMENT carrying the parser's message."""
+        import os
+
+        req: dict = {"dir": os.path.abspath(molly_dir), "query": query}
+        if corpus_cache is not None:
+            req["corpus_cache"] = corpus_cache
+        if result_cache is not None:
+            req["result_cache"] = result_cache
+        obs.metrics.inc("rpc.bytes_sent", len(_json.dumps(req).encode("utf-8")))
+        doc, call = self._call(self._query, req, name="Query")
+        obs.metrics.inc(
+            "rpc.bytes_received", len(_json.dumps(doc).encode("utf-8"))
+        )
+        try:
+            trailing = dict(call.trailing_metadata() or ())
+        except Exception:
+            trailing = {}
+        status = trailing.get("nemo-rcache")
+        if status:
+            obs.metrics.inc(f"rpc.query_rcache.{status}")
+        coalesce = trailing.get("nemo-coalesce")
+        if coalesce:
+            obs.metrics.inc(f"rpc.query_coalesce.{coalesce}")
+        return doc
 
     def analyze_dir_stream(
         self, molly_dirs, corpus_cache=None, result_cache=None, watch=None

@@ -208,6 +208,13 @@ class _SpanCollection:
                 obs_trace.finish()
 
 
+class _QueryRpcError(Exception):
+    """A query-layer error (unknown vocabulary name, bad run filter)
+    surfacing through the Query RPC's single-flight machinery — mapped to
+    INVALID_ARGUMENT at the handler boundary (including for coalesce
+    subscribers, who receive the leader's failure re-raised)."""
+
+
 class _Impl:
     """Method implementations; one fused-step jit cache per process.
 
@@ -1045,6 +1052,129 @@ class _Impl:
             _rpc_observed("AnalyzeDirStream", t0, col.tid)
             col.release()
 
+    def query(self, request: dict, context) -> bytes:
+        """Ad-hoc provenance query RPC (ISSUE 20): the request is a JSON
+        object ``{"dir": ..., "query": <text>, optional "corpus_cache",
+        optional "result_cache"}`` — protoc-free like AnalyzeDir — and the
+        response is the JSON result document (nemo_tpu/query) as bytes.
+
+        Admission, tracing, caching, and coalescing follow the AnalyzeDir
+        contract: the sidecar ingests the directory through its own corpus
+        store, the query executes through ``execute_query`` (whose
+        two-tier rcache is content-addressed on segment fingerprints + the
+        query AST hash), concurrent identical requests single-flight on
+        that same content address, and the trailing metadata carries
+        ``nemo-rcache``/``nemo-coalesce`` statuses.  A malformed query is
+        INVALID_ARGUMENT with the parser's loud message, never an empty
+        result."""
+        t0 = time.perf_counter()
+        if not isinstance(request, dict):
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "Query request must be a JSON object",
+            )
+        d = request.get("dir", "")
+        if not d or not os.path.isdir(d):
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"not a directory on the sidecar host: {d!r}",
+            )
+        text = request.get("query", "")
+        if not text or not isinstance(text, str):
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "Query request needs a non-empty 'query' string",
+            )
+        from nemo_tpu.query import QueryError, parse_query, plan_query
+
+        try:
+            q = parse_query(text)
+            plan = plan_query(q)
+        except QueryError as ex:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"query error: {ex}")
+        ticket, col = self._admit_traced(context, "Query")
+        try:
+            try:
+                payload, meta = self._query_payload(request, d, q, plan, col.tid)
+            except _QueryRpcError as ex:
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT, f"query error: {ex}"
+                )
+            context.set_trailing_metadata(
+                col.trailing()
+                + (
+                    ("nemo-rcache", meta["rcache"]),
+                    ("nemo-coalesce", meta["coalesce"]),
+                )
+            )
+            return payload
+        finally:
+            _rpc_observed("Query", t0, col.tid)
+            col.release()
+            ticket.release()
+
+    def _query_payload(
+        self, request: dict, d: str, q, plan, trace_id: str | None
+    ) -> tuple[bytes, dict]:
+        """One query request -> (JSON document bytes, meta).  Single-flight
+        on the query's content address — the same (segment fingerprints +
+        AST hash) key execute_query blobs the full result under — so a
+        herd of identical ad-hoc queries costs one execution."""
+        from nemo_tpu.analysis.delta import blob_cache_key
+        from nemo_tpu.analysis.pipeline import _ingest
+        from nemo_tpu.query import QueryError
+        from nemo_tpu.query.engine import execute_query
+        from nemo_tpu.store import corpus_cache_dir, resolve_store
+        from nemo_tpu.store.rcache import result_cache_dir
+
+        with obs.span("serve:Query", dir=os.path.basename(d), trace_id=trace_id):
+            req_cache = request.get("corpus_cache")
+            client_opt_out = (
+                req_cache is not None and corpus_cache_dir(req_cache) is None
+            )
+            store = None if client_opt_out else resolve_store()
+            molly = _ingest(d, use_packed=True, store=store)
+            req_rc = request.get("result_cache")
+            rc_opt_out = req_rc is not None and result_cache_dir(req_rc) is None
+            seg_meta = getattr(molly, "store_segments", None)
+            content_key = (
+                None
+                if rc_opt_out
+                else blob_cache_key("query", seg_meta, {"plan": plan.key})
+            )
+            obs.metrics.inc("serve.query")
+
+            def _execute() -> tuple[bytes, dict]:
+                try:
+                    doc = execute_query(q, molly, use_cache=not rc_opt_out)
+                except QueryError as ex:
+                    # Unknown vocabulary name etc.: surface as the RPC
+                    # error contract, not an UNKNOWN traceback.
+                    raise _QueryRpcError(str(ex)) from ex
+                rstat = doc.get("stats", {}).get("cache", "off")
+                return json.dumps(doc, sort_keys=True).encode("utf-8"), {
+                    "rcache": rstat
+                }
+
+            if content_key is None:
+                payload, meta = _execute()
+                meta["coalesce"] = "off"
+                obs.metrics.inc("serve.coalesce.off")
+                return payload, meta
+            role, flight = self.flights.join(content_key)
+            if role == "leader":
+                try:
+                    payload, meta = _execute()
+                except BaseException as ex:
+                    self.flights.fail(flight, ex)
+                    raise
+                self.flights.complete(flight, payload, meta)
+                obs.metrics.inc("serve.coalesce.leader")
+                return payload, dict(meta, coalesce="leader")
+            obs.metrics.inc("serve.coalesce.hit")
+            payload, meta = flight.wait_result()
+            return payload, dict(meta, coalesce="hit")
+
     def kernel(self, request: pb.KernelRequest, context) -> pb.KernelResponse:
         """Named device-kernel dispatch for the ServiceBackend: the request's
         (verb, arrays, params) triple runs through the same LocalExecutor the
@@ -1134,6 +1264,16 @@ def make_server(port: int = 0, max_workers: int | None = None) -> tuple[grpc.Ser
             impl.analyze_dir_stream,
             request_deserializer=lambda b: json.loads(b.decode("utf-8")),
             response_serializer=lambda d: json.dumps(d).encode("utf-8"),
+        ),
+        # Ad-hoc query RPC (ISSUE 20): JSON request in, the query result
+        # document as JSON bytes out — same protoc-free generic-handler
+        # pattern as AnalyzeDir (the serializer passes bytes through).
+        "Query": grpc.unary_unary_rpc_method_handler(
+            impl.query,
+            request_deserializer=lambda b: json.loads(b.decode("utf-8")),
+            response_serializer=lambda m: (
+                m if isinstance(m, bytes) else m.SerializeToString()
+            ),
         ),
         "Kernel": grpc.unary_unary_rpc_method_handler(
             impl.kernel,

@@ -86,6 +86,19 @@ def attach_store_provenance(obj, store_dir: str, header: dict) -> None:
 _log = obs_log.get_logger("nemo.store")
 
 
+def _index_file(corpus_dir: str) -> str:
+    """The layout's index file (ingest/adapters.py seam), recorded in the
+    stored source so classification and the append dispatch stay
+    injector-agnostic on later loads.  Unsniffable directories default to
+    the Molly index — the pre-seam behavior."""
+    try:
+        from nemo_tpu.ingest.adapters import resolve_injector
+
+        return resolve_injector(corpus_dir).index_file or "runs.json"
+    except Exception:
+        return "runs.json"
+
+
 def resolve_store(arg: str | None = None) -> "CorpusStore | None":
     root = corpus_cache_dir(arg)
     return CorpusStore(root) if root else None
@@ -334,7 +347,7 @@ class CorpusStore:
         mutated during the (minutes-long at scale) parse mismatches the
         stored fingerprint on the next load instead of being served as a
         HIT."""
-        return snapshot_source(corpus_dir)
+        return snapshot_source(corpus_dir, index_file=_index_file(corpus_dir))
 
     def put(self, corpus_dir: str, molly, snapshot: dict | None = None):
         """Populate (or replace) the store for ``corpus_dir`` from a parsed
@@ -364,7 +377,9 @@ class CorpusStore:
         workers = store_workers_default()
         with obs.span("ingest:store_populate", dir=os.path.basename(corpus_dir)):
             payload = payload_from_molly(molly)
-            snap = snapshot or snapshot_source(corpus_dir)
+            snap = snapshot or snapshot_source(
+                corpus_dir, index_file=_index_file(corpus_dir)
+            )
             # Quarantined runs (ISSUE 9): the store persists only the
             # HEALTHY rows but records the quarantine set — each record
             # carries the stats of its run's files, so a later load serves
@@ -534,8 +549,16 @@ class CorpusStore:
         runs (pure-Python loader, positions >= n_stored) against the stored
         vocabulary and publish them as a fresh segment.  Returns the new
         header, or None when the old entries cannot be confirmed unchanged
-        (the caller then treats the store as stale)."""
+        (the caller then treats the store as stale).  Dispatches on the
+        stored index file: Molly's runs.json rides the per-run-file path,
+        single-document layouts (trace.json) the index-delta path."""
         try:
+            src = header.get("source") or {}
+            index_file = src.get("index_file") or "runs.json"
+            if index_file != "runs.json":
+                return self._append_index_locked(
+                    store_dir, header, corpus_dir, index_file
+                )
             return self._append_locked(store_dir, header, corpus_dir)
         except Exception as ex:
             obs.metrics.inc("store.append_failed")
@@ -756,6 +779,204 @@ class CorpusStore:
             repaired=len([p for p in new_positions if p < n_old]),
             quarantined=len(final_q),
             total_runs=len(raw_runs),
+            segment=seg_name if new_runs else None,
+        )
+        return header
+
+    def _append_index_locked(
+        self, store_dir: str, header, corpus_dir: str, index_file: str
+    ) -> dict | None:
+        """Index-delta append for single-document layouts (ingest/adapters
+        injectors whose whole sweep lives INSIDE the index file, trace.json
+        first): growth rewrites the one document, so there are no new
+        per-run files to fingerprint — instead the injector's
+        ``index_runs`` seam re-opens the document, the stored entries are
+        confirmed unchanged (baked-in id/status of EVERY row plus the full
+        canonical head fragment of a bounded <=64-row spread, the same
+        budget as the runs.json weak check), and only entries past the
+        stored count pack into a fresh segment.  This is what keeps the
+        live watch loop O(new runs) for non-Molly injectors."""
+        from nemo_tpu.graphs.packed import CorpusVocab
+        from nemo_tpu.ingest.adapters import INJECTORS
+        from nemo_tpu.ingest.molly import quarantine_record
+        from nemo_tpu.store.npack import _head_bytes
+        from nemo_tpu.store.reader import _decode_vocab, build_corpus, open_segments
+        from nemo_tpu.utils.env import quarantine_enabled
+
+        inj = next(
+            (c for c in INJECTORS.values() if c.index_file == index_file), None
+        )
+        if inj is None:
+            return None  # no registered injector owns this layout any more
+        with self._lock(store_dir), obs.span(
+            "ingest:store_append", dir=os.path.basename(corpus_dir)
+        ):
+            # Re-read under the lock: a concurrent appender may have won.
+            header = self._read_header(store_dir)
+            if not isinstance(header, dict):
+                return None
+            state = classify_source(header, corpus_dir)
+            if state == HIT:
+                return header
+            if state != GROWN:
+                return None
+            src = header["source"]
+            n_old = int(src["n_runs"])
+            # Snapshot BEFORE parsing (same fail-safe direction as the
+            # runs.json append); the fast-mode partial snapshot stats
+            # nothing beyond the index + sample here — this layout has no
+            # per-run files.
+            snap = (
+                snapshot_source(corpus_dir, index_file=index_file)
+                if fingerprint_mode() == "full"
+                else snapshot_source_appended(
+                    corpus_dir, n_old, index_file=index_file
+                )
+            )
+            idx = inj.index_runs(corpus_dir)
+            if idx is None:
+                return None
+            n_total, parse_entry, entry_head = idx
+            if n_total < n_old:
+                return None
+            qrecs_old = list(header.get("quarantined") or ())
+            q_old_pos = {int(r["position"]) for r in qrecs_old}
+            if n_total == n_old and not q_old_pos:
+                return None
+
+            def refused(pos: int, why: str) -> None:
+                _log.warning(
+                    "store.append_refused", corpus=corpus_dir, row=pos, detail=why
+                )
+
+            # Old-entry confirmation.  The document was REWRITTEN (that is
+            # what growth looks like here) and its object wrapper's tail
+            # moves on every append, so there is no byte-prefix shortcut:
+            # verify every stored row's identity pair, then re-parse a
+            # bounded spread through the injector's own converter and
+            # compare the canonical head fragments — which also catches a
+            # changed sweep-level spec, since it bakes into every head.
+            seg_readers, vocab_rd, _ = open_segments(store_dir, header, verify=False)
+            old = build_corpus(store_dir, header, seg_readers, vocab_rd)
+            rows_pos = stored_positions(header)
+            n_stored = len(rows_pos)
+            try:
+                for row, pos in enumerate(rows_pos):
+                    it, success = entry_head(pos)
+                    if it != int(old.iteration[row]) or success != bool(
+                        old.success[row]
+                    ):
+                        refused(
+                            pos, f"old {index_file} entries changed; store is stale"
+                        )
+                        return None
+                stride = max(1, n_stored // 64)
+                check = sorted(set(range(0, n_stored, stride)) | {0, n_stored - 1})
+                for row in check:
+                    pos = rows_pos[row]
+                    if _head_bytes(parse_entry(pos)) != old.run_head_json(row):
+                        refused(
+                            pos, "old run head fragment changed; store is stale"
+                        )
+                        return None
+            except Exception as ex:
+                refused(
+                    -1,
+                    f"old {index_file} entry no longer parses "
+                    f"({type(ex).__name__}: {ex}); store is stale",
+                )
+                return None
+            # Stored vocabulary, extended in place by the new graphs.
+            vocab = CorpusVocab()
+            for part in ("tables", "labels", "times"):
+                v = getattr(vocab, part)
+                for s in _decode_vocab(vocab_rd, part):
+                    v.intern(s)
+            # Candidates: the appended tail plus EVERY previously
+            # quarantined position — a single document has no per-file
+            # repair tripwire, so each index rewrite re-attempts the
+            # quarantined entries (free: the document is already in hand).
+            quarantine = quarantine_enabled()
+            candidates = sorted(q_old_pos | set(range(n_old, n_total)))
+            new_runs, new_positions, new_q = [], [], []
+            for pos in candidates:
+                try:
+                    run = parse_entry(pos)
+                except Exception as ex:
+                    if not quarantine:
+                        return None  # stale -> the caller reparses, loudly
+                    rid = None
+                    try:
+                        rid = entry_head(pos)[0]
+                    except Exception:  # lint: allow-silent-except — the entry already failed to parse (quarantined just below); the head probe only enriches the record with an iteration id
+                        pass
+                    new_q.append(quarantine_record(pos, rid, index_file, ex))
+                    continue
+                new_runs.append(run)
+                new_positions.append(pos)
+            for rec in new_q:
+                rec["files"] = []  # no watched files: repairs ride the index stat
+                obs.metrics.inc("ingest.quarantined")
+            final_q = sorted(new_q, key=lambda r: int(r["position"]))
+
+            seg_name = f"seg-{len(header['segments']):03d}"
+            segments = header["segments"]
+            if new_runs:
+                payload = payload_from_runs(new_runs, vocab)
+                tmp_seg = os.path.join(
+                    store_dir, f"{seg_name}.tmp-{uuid.uuid4().hex[:8]}"
+                )
+                try:
+                    seg_entry = write_segment(
+                        tmp_seg, payload, store_workers_default()
+                    )
+                    seg_entry["name"] = seg_name
+                    # No per-run source files on this layout: the position
+                    # fingerprint is empty and content identity rides the
+                    # packed-shard checksums + the index stat instead.
+                    seg_entry["source_fp"] = segment_source_fp_positions(
+                        snap, new_positions
+                    )
+                    if final_q or qrecs_old or new_positions != list(
+                        range(n_old, n_old + len(new_positions))
+                    ):
+                        seg_entry["positions"] = list(new_positions)
+                    os.rename(tmp_seg, os.path.join(store_dir, seg_name))
+                except BaseException:
+                    shutil.rmtree(tmp_seg, ignore_errors=True)
+                    raise
+                segments = segments + [seg_entry]
+            elif not new_q:
+                return None
+            if new_runs:
+                vshard = write_vocab(
+                    os.path.join(store_dir, f"vocab-{len(segments):04d}.bin"),
+                    _VocabView(vocab),
+                )
+            else:
+                vshard = header["vocab_shard"]
+            source = source_from_snapshot(snap, n_total)
+            source["dir"] = os.path.realpath(corpus_dir)
+            header = dict(
+                header, source=source, vocab_shard=vshard, segments=segments
+            )
+            header["quarantined"] = final_q
+            if not final_q:
+                header.pop("quarantined", None)
+            tmp_header = os.path.join(
+                store_dir, f"header.json.tmp-{uuid.uuid4().hex[:8]}"
+            )
+            with open(tmp_header, "w", encoding="utf-8") as fh:
+                json.dump(header, fh, indent=1)
+            os.replace(tmp_header, os.path.join(store_dir, "header.json"))
+        obs.metrics.inc("store.append")
+        _log.info(
+            "store.appended",
+            corpus=corpus_dir,
+            new_runs=len(new_runs),
+            repaired=len([p for p in new_positions if p < n_old]),
+            quarantined=len(final_q),
+            total_runs=n_total,
             segment=seg_name if new_runs else None,
         )
         return header

@@ -363,12 +363,21 @@ def _fp(lines: list[str]) -> str:
     return hashlib.sha256("\n".join(sorted(lines)).encode()).hexdigest()
 
 
-def snapshot_source(corpus_dir: str, with_stats: bool = True) -> dict:
-    """Raw (name, size, mtime_ns) snapshot of the Molly directory, taken
+def snapshot_source(
+    corpus_dir: str, with_stats: bool = True, index_file: str = "runs.json"
+) -> dict:
+    """Raw (name, size, mtime_ns) snapshot of the sweep directory, taken
     BEFORE a writer parses it: a file mutated DURING the (minutes-long at
     scale) parse then mismatches the stored pre-parse fingerprint on the
     next load — the fail-safe direction.  ``runs_prefix_sha`` is captured
-    here too (the bytes could likewise change under the parse)."""
+    here too (the bytes could likewise change under the parse).
+
+    ``index_file`` is the layout's index (ingest/adapters.py:
+    FaultInjector.index_file — runs.json for Molly, trace.json for the
+    trace layout): it legitimately changes on append, so it is pulled out
+    of the class fingerprints into the separately-compared ``runs_json``
+    stat slot.  The name is recorded so classification and the append
+    path stay injector-agnostic on load."""
     # Dir mtime BEFORE the enumeration: entry creates/deletes/renames bump
     # it, so a load whose dir mtime still matches can skip the enumeration
     # entirely (classify_source tier 0).  Files added between this stat and
@@ -380,7 +389,7 @@ def snapshot_source(corpus_dir: str, with_stats: bool = True) -> dict:
     with os.scandir(corpus_dir) as it:
         for entry in it:
             name = entry.name
-            if name == "runs.json":
+            if name == index_file:
                 st = entry.stat()
                 runs_json = [st.st_size, st.st_mtime_ns]
                 continue
@@ -394,10 +403,11 @@ def snapshot_source(corpus_dir: str, with_stats: bool = True) -> dict:
     return {
         "dir_mtime_ns": dir_mtime_ns,
         "runs_json": runs_json,
+        "index_file": index_file,
         "entries": entries,
         "with_stats": with_stats,
         "runs_prefix_sha": _runs_prefix_sha(
-            corpus_dir, (runs_json or [0])[0]
+            corpus_dir, (runs_json or [0])[0], index_file
         )
         if with_stats
         else None,
@@ -405,7 +415,10 @@ def snapshot_source(corpus_dir: str, with_stats: bool = True) -> dict:
 
 
 def snapshot_source_appended(
-    corpus_dir: str, n_old: int, extra_positions: set | None = None
+    corpus_dir: str,
+    n_old: int,
+    extra_positions: set | None = None,
+    index_file: str = "runs.json",
 ) -> dict:
     """Partial pre-parse snapshot for the APPEND path in ``fast``
     fingerprint mode: one names-only enumeration plus stats for exactly
@@ -435,7 +448,7 @@ def snapshot_source_appended(
     with os.scandir(corpus_dir) as it:
         for entry in it:
             name = entry.name
-            if name == "runs.json":
+            if name == index_file:
                 st = entry.stat()
                 runs_json = [st.st_size, st.st_mtime_ns]
                 continue
@@ -473,10 +486,13 @@ def snapshot_source_appended(
     return {
         "dir_mtime_ns": dir_mtime_ns,
         "runs_json": runs_json,
+        "index_file": index_file,
         "entries": entries,
         "with_stats": False,
         "sample": sampled,
-        "runs_prefix_sha": _runs_prefix_sha(corpus_dir, (runs_json or [0])[0]),
+        "runs_prefix_sha": _runs_prefix_sha(
+            corpus_dir, (runs_json or [0])[0], index_file
+        ),
     }
 
 
@@ -531,6 +547,11 @@ def source_from_snapshot(snap: dict, n_old: int, exclude: set | None = None) -> 
         "n_runs": n_old,
         "runs_prefix_sha": snap.get("runs_prefix_sha"),
     }
+    # Non-default index files (trace.json) are recorded so classification
+    # and the append dispatch stay injector-agnostic; the Molly default is
+    # omitted to keep legacy headers byte-compatible.
+    if (snap.get("index_file") or "runs.json") != "runs.json":
+        out["index_file"] = snap["index_file"]
     for cls, recs in classes.items():
         out[f"{cls}_names_fp"] = _fp([n for n, _, _ in recs])
         if with_stats:
@@ -549,23 +570,33 @@ def source_from_snapshot(snap: dict, n_old: int, exclude: set | None = None) -> 
 
 
 def scan_source(
-    corpus_dir: str, n_old: int, with_stats: bool = True, exclude: set | None = None
+    corpus_dir: str,
+    n_old: int,
+    with_stats: bool = True,
+    exclude: set | None = None,
+    index_file: str = "runs.json",
 ) -> dict:
     """One-shot snapshot + classification (the load-side compare path)."""
     return source_from_snapshot(
-        snapshot_source(corpus_dir, with_stats), n_old, exclude=exclude
+        snapshot_source(corpus_dir, with_stats, index_file=index_file),
+        n_old,
+        exclude=exclude,
     )
 
 
-def _runs_prefix_sha(corpus_dir: str, nbytes: int) -> str | None:
-    """SHA-256 of runs.json's first ``nbytes - 1`` bytes: an append that
-    re-serializes the same old entries plus new ones keeps this prefix when
-    the producer's serializer is stable — the strong old-entry check the
-    append path prefers over the cheap iteration/status comparison."""
+def _runs_prefix_sha(
+    corpus_dir: str, nbytes: int, index_file: str = "runs.json"
+) -> str | None:
+    """SHA-256 of the index file's first ``nbytes - 1`` bytes: an append
+    that re-serializes the same old entries plus new ones keeps this prefix
+    when the producer's serializer is stable — the strong old-entry check
+    the runs.json append path prefers over the cheap iteration/status
+    comparison.  (Single-document layouts wrap their runs in a JSON object
+    whose tail rewrites on growth, so their append path never trusts it.)"""
     try:
         sha = hashlib.sha256()
         remaining = max(0, nbytes - 1)
-        with open(os.path.join(corpus_dir, "runs.json"), "rb") as fh:
+        with open(os.path.join(corpus_dir, index_file), "rb") as fh:
             while remaining:
                 chunk = fh.read(min(1 << 20, remaining))
                 if not chunk:
@@ -719,6 +750,7 @@ def classify_source(header: dict, corpus_dir: str) -> str:
     operator repaired a run) -> GROWN, so the append path re-ingests
     exactly the repaired positions."""
     src = header.get("source") or {}
+    index_file = src.get("index_file") or "runs.json"
     qrecs = header.get("quarantined") or ()
     qnames = quarantine_file_names(qrecs)
     full = fingerprint_mode() == "full"
@@ -730,13 +762,13 @@ def classify_source(header: dict, corpus_dir: str) -> str:
         # enumerating names costs more than the whole mmap load).
         try:
             st = os.stat(corpus_dir)
-            # Non-Molly layouts (ingest/adapters.py) have no runs.json at
-            # all: the stored snapshot recorded None, and the index file's
-            # freshness rides the `other` class fingerprint + stat sample
-            # like any regular file.  One appearing later bumps the dir
-            # mtime, so tier 0 falls through to the scan below.
+            # The index file is whichever one the store was populated
+            # against (ingest/adapters.py seam; legacy headers default to
+            # Molly's runs.json).  A snapshot that saw no index at all
+            # recorded None; one appearing later bumps the dir mtime, so
+            # tier 0 falls through to the scan below.
             rj = (
-                os.stat(os.path.join(corpus_dir, "runs.json"))
+                os.stat(os.path.join(corpus_dir, index_file))
                 if src.get("runs_json") is not None
                 else None
             )
@@ -757,7 +789,11 @@ def classify_source(header: dict, corpus_dir: str) -> str:
         # Something moved: fall through to the name-level scan to tell
         # GROWN from STALE.
     cur = scan_source(
-        corpus_dir, int(src.get("n_runs", 0)), with_stats=full, exclude=qnames
+        corpus_dir,
+        int(src.get("n_runs", 0)),
+        with_stats=full,
+        exclude=qnames,
+        index_file=index_file,
     )
     if full:
         base_ok = cur["old_fp"] == src.get("old_fp") and cur["other_fp"] == src.get(
@@ -784,6 +820,18 @@ def classify_source(header: dict, corpus_dir: str) -> str:
     # written over stray future-run files cannot tell them apart — rebuild).
     if (
         cur["n_new_files"] > 0
+        and int(src.get("n_new_files", 0)) == 0
+        and cur["runs_json"] != src.get("runs_json")
+    ):
+        return GROWN
+    # Single-document layouts (trace.json): growth happens INSIDE the index
+    # file and no per-run files ever appear, so an index-only change with
+    # every other file intact is the append candidate — the append path
+    # re-verifies the old entries before trusting it (and refuses, loudly,
+    # when they moved, which downgrades to the full reparse).
+    if (
+        index_file != "runs.json"
+        and cur["n_new_files"] == 0
         and int(src.get("n_new_files", 0)) == 0
         and cur["runs_json"] != src.get("runs_json")
     ):

@@ -3187,6 +3187,168 @@ def profile_smoke() -> int:
     return 0
 
 
+def query_smoke() -> int:
+    """Ad-hoc query-engine smoke (`make query-smoke`, also the tail of
+    `make validate`; ISSUE 20):
+
+      * every fixed analysis verb in query/verbs.py:VERB_QUERIES, executed
+        as its query-layer program, is BYTE-identical to the native verb's
+        per-run result (two independently-derived documents);
+      * a novel 3-pattern query compiles cold (plan + execute, kernel
+        dispatches > 0) and its warm repeat is a full-result rcache hit
+        with ZERO kernel dispatches, document-identical;
+      * the sidecar's JSON-carried Query RPC round-trips the same document
+        and a malformed query is INVALID_ARGUMENT, not a crash.
+    """
+    import importlib.util
+
+    from nemo_tpu.utils.jax_config import pin_platform
+
+    pin_platform("cpu")
+    prior_knobs = {
+        k: os.environ.pop(k, None)
+        for k in (
+            "NEMO_CORPUS_CACHE",
+            "NEMO_RESULT_CACHE",
+            "NEMO_STORE_FINGERPRINT",
+            "NEMO_INJECTOR",
+            "NEMO_ANALYSIS_IMPL",
+        )
+    }
+    try:
+        return _query_smoke_inner(importlib.util.find_spec("grpc") is not None)
+    finally:
+        for k, v in prior_knobs.items():
+            if v is not None:
+                os.environ[k] = v
+
+
+def _query_smoke_inner(have_grpc: bool) -> int:
+    from nemo_tpu import obs
+    from nemo_tpu.analysis.pipeline import _ingest
+    from nemo_tpu.backend.jax_backend import JaxBackend
+    from nemo_tpu.models.synth import SynthSpec, write_corpus
+    from nemo_tpu.query import run_query_text
+    from nemo_tpu.query.verbs import VERB_QUERIES, native_verb_result, run_verb
+    from nemo_tpu.store import resolve_store
+
+    with tempfile.TemporaryDirectory(prefix="nemo_query_smoke_") as tmp:
+        os.environ["NEMO_CORPUS_CACHE"] = os.path.join(tmp, "corpus_cache")
+        os.environ["NEMO_RESULT_CACHE"] = os.path.join(tmp, "result_cache")
+        corpus = write_corpus(SynthSpec(n_runs=8, seed=2, eot=6), tmp)
+        molly = _ingest(corpus, use_packed=True, store=resolve_store())
+
+        # 1. Fixed verbs as query-layer programs: byte parity per verb
+        # against the native verb path (backend kernels / host oracles).
+        backend = JaxBackend()
+        backend.init_graph_db("", molly)
+        for name in VERB_QUERIES:
+            got = run_verb(name, molly, use_cache=False)["runs"]
+            want = native_verb_result(name, backend)
+            if json.dumps(got, sort_keys=True).encode() != json.dumps(
+                want, sort_keys=True
+            ).encode():
+                print(
+                    f"query-smoke: verb {name!r} as a query DIVERGES from "
+                    f"the native verb: query={got} native={want}",
+                    file=sys.stderr,
+                )
+                return 1
+
+        # 2. Novel 3-pattern query: cold = plan + execute with kernel
+        # dispatches; warm = full-result cache hit with zero dispatches.
+        text = (
+            "from pre match goal[holds=true] -> @rule "
+            "match goal[holds=false] -*-> @rule[type=async] "
+            "match @goal[table=pre] count by table"
+        )
+
+        def run_once():
+            m0 = obs.metrics.snapshot()
+            doc = run_query_text(text, molly)
+            mc = obs.Metrics.delta(obs.metrics.snapshot(), m0)["counters"]
+            disp = sum(
+                v for k, v in mc.items() if k.startswith("kernel.dispatches.")
+            )
+            return doc, mc, disp
+
+        cold, _mc_cold, disp_cold = run_once()
+        if cold["stats"]["cache"] != "miss" or disp_cold == 0:
+            print(
+                "query-smoke: cold query expected a cache miss with kernel "
+                f"dispatches, got stats={cold['stats']} dispatches={disp_cold}",
+                file=sys.stderr,
+            )
+            return 1
+        warm, mc_warm, disp_warm = run_once()
+        if (
+            warm["stats"]["cache"] != "hit"
+            or disp_warm != 0
+            or not mc_warm.get("query.cache.hit")
+        ):
+            print(
+                "query-smoke: warm repeat expected a zero-dispatch full-result "
+                f"cache hit, got stats={warm['stats']} dispatches={disp_warm} "
+                f"counters={ {k: v for k, v in mc_warm.items() if k.startswith('query.')} }",
+                file=sys.stderr,
+            )
+            return 1
+        strip = lambda d: {k: v for k, v in d.items() if k != "stats"}  # noqa: E731
+        if strip(warm) != strip(cold):
+            print("query-smoke: warm document DIVERGES from cold", file=sys.stderr)
+            return 1
+
+        # 3. Sidecar Query RPC round-trip (JSON-carried, protoc-free).
+        if have_grpc:
+            import grpc
+
+            from nemo_tpu.service.client import RemoteAnalyzer
+            from nemo_tpu.service.server import make_server
+
+            server, port = make_server(port=0)
+            server.start()
+            try:
+                with RemoteAnalyzer(target=f"localhost:{port}") as c:
+                    remote = c.query_remote(corpus, text)
+                    if strip(remote) != strip(cold):
+                        print(
+                            "query-smoke: sidecar Query document DIVERGES "
+                            f"from local: remote={strip(remote)}",
+                            file=sys.stderr,
+                        )
+                        return 1
+                    try:
+                        c.query_remote(corpus, "from nowhere tables")
+                    except grpc.RpcError as ex:
+                        if ex.code() != grpc.StatusCode.INVALID_ARGUMENT:
+                            print(
+                                "query-smoke: malformed query expected "
+                                f"INVALID_ARGUMENT, got {ex.code()}",
+                                file=sys.stderr,
+                            )
+                            return 1
+                    else:
+                        print(
+                            "query-smoke: malformed query did not error",
+                            file=sys.stderr,
+                        )
+                        return 1
+            finally:
+                server.stop(None)
+        print(
+            "query-smoke: ok — "
+            f"{len(VERB_QUERIES)} fixed verbs byte-identical as query "
+            f"programs, novel 3-pattern query cold ({disp_cold} kernel "
+            "dispatches) -> warm full-result cache hit with 0 dispatches"
+            + (
+                ", sidecar Query RPC round-trip identical"
+                if have_grpc
+                else " (grpc unavailable: RPC leg skipped)"
+            )
+        )
+    return 0
+
+
 def main() -> int:
     from nemo_tpu.analysis.pipeline import run_debug
     from nemo_tpu.backend.jax_backend import JaxBackend
@@ -3420,7 +3582,14 @@ def main() -> int:
     # contact, a second process boots measured with zero probe
     # dispatches, env overrides win the precedence, and report trees are
     # byte-identical across profile-on / profile-off / env-forced runs.
-    return profile_smoke()
+    rc = profile_smoke()
+    if rc:
+        return rc
+    # Ad-hoc query-engine contract (also standalone: make query-smoke;
+    # ISSUE 20): every fixed verb byte-identical as a query-layer program,
+    # a novel 3-pattern query's warm repeat a zero-dispatch rcache hit,
+    # and the sidecar Query RPC round-trip document-identical.
+    return query_smoke()
 
 
 if __name__ == "__main__":
@@ -3459,4 +3628,6 @@ if __name__ == "__main__":
         sys.exit(synth_smoke())
     if "--watch-smoke" in sys.argv:
         sys.exit(watch_smoke())
+    if "--query-smoke" in sys.argv:
+        sys.exit(query_smoke())
     sys.exit(main())

@@ -1,0 +1,367 @@
+"""Ad-hoc query engine (ISSUE 20).
+
+Covers the four layers of query/:
+
+* parser + validation matrix — the compact text form round-trips into the
+  typed AST, and every class of malformed query raises a QueryError naming
+  the junk token (the loud env-knob policy, never a silent empty result);
+* planner lowering units — pattern -> kernel-sequence assertions against
+  ``QueryPlan.describe()``, derived-plane flags, name binding (including
+  the segment-local _NO_ID sentinel vs the corpus-level loud unknown);
+* lane/oracle parity — the device and host evaluators are bit-identical
+  over the same bound plan and buckets, and both match the per-run pure
+  Python oracle's documents across synth, case-study and adversarial
+  corpora on both ingest paths;
+* reduce + cache — segment-partial merge is permutation-invariant, a warm
+  repeat is a zero-dispatch full-result hit, a changed AST misses, and a
+  grown corpus maps ONLY its new segment (partial hits for the old).
+"""
+
+import json
+import random
+
+import numpy as np
+import pytest
+
+from nemo_tpu import obs
+from nemo_tpu.analysis.delta import kernel_dispatch_count
+from nemo_tpu.analysis.pipeline import _ingest
+from nemo_tpu.graphs.packed import CorpusVocab, bucketize, pack_graph
+from nemo_tpu.models.case_studies import CASE_STUDIES, write_case_study
+from nemo_tpu.models.synth import (
+    ADVERSARIAL_FAMILIES,
+    SynthSpec,
+    adversarial_spec,
+    grow_corpus_dir,
+    write_corpus,
+)
+from nemo_tpu.query import engine as qengine
+from nemo_tpu.query.engine import (
+    QueryPartial,
+    corpus_vocab,
+    execute_query,
+    finalize,
+    merge_query_partials,
+    oracle_query,
+    run_query_text,
+)
+from nemo_tpu.query.lang import (
+    HOP_ADJ,
+    HOP_REACH,
+    Pred,
+    QueryError,
+    parse_query,
+)
+from nemo_tpu.query.plan import _NO_ID, plan_query
+from nemo_tpu.store import resolve_store
+
+
+def _strip(doc: dict) -> str:
+    return json.dumps(
+        {k: v for k, v in doc.items() if k != "stats"}, sort_keys=True
+    )
+
+
+def _counters_delta(fn):
+    m0 = obs.metrics.snapshot()
+    out = fn()
+    return out, obs.Metrics.delta(obs.metrics.snapshot(), m0)["counters"]
+
+
+# ---------------------------------------------------------------------------
+# parser + validation matrix
+# ---------------------------------------------------------------------------
+
+
+def test_parse_full_query_round_trip():
+    q = parse_query(
+        'from post match goal[holds=true] -> @rule[type=async] -*-> goal '
+        'match rule[table="a b", label!=x] where run.failed count'
+    )
+    assert q.graph == "post"
+    assert q.run_filter == "failed"
+    assert q.agg == "count"
+    p0, p1 = q.patterns
+    assert [s.kind for s in p0.steps] == ["goal", "rule", "goal"]
+    assert p0.hops == (HOP_ADJ, HOP_REACH)
+    assert p0.capture_index == 1  # explicit @
+    assert p0.steps[0].preds == (Pred("holds", "=", True),)
+    assert p1.steps[0].preds == (Pred("table", "=", "a b"), Pred("label", "!=", "x"))
+    assert p1.capture_index == 0  # default: the last step of the chain
+
+
+def test_parse_defaults_and_count_by_table():
+    q = parse_query("match goal")
+    assert (q.graph, q.run_filter, q.agg) == ("pre", "all", "tables")
+    assert q.patterns[0].capture_index == 0
+    assert parse_query("match goal count by table").agg == "count_by_table"
+    assert parse_query("match goal runs").agg == "runs"
+
+
+@pytest.mark.parametrize(
+    ("text", "fragment"),
+    [
+        ("select goal", "unknown clause"),
+        ("from neither match goal", "unknown graph"),
+        ("match wat", "unknown step kind"),
+        ("match goal[frobs=1]", "unknown predicate field"),
+        ("match goal[holds=maybe]", "takes true/false"),
+        ("match rule[holds=true]", "does not apply"),
+        ("match goal[type=async]", "does not apply"),
+        ("match rule[type=weird]", "unknown rule type"),
+        ("match goal where run.sometimes", "unknown run filter"),
+        ("match goal count tables", "more than one aggregation"),
+        ("match @goal -> @rule", "at most one @capture"),
+        ("match goal count by label", "unsupported"),
+        ("match goal where failed", "where takes"),
+        ("match goal ->", "unexpected end"),
+        ("count", "no match clause"),
+    ],
+)
+def test_malformed_queries_raise_loudly(text, fragment):
+    with pytest.raises(QueryError, match=fragment):
+        parse_query(text)
+
+
+def test_ast_hash_is_a_content_address():
+    a = parse_query("from pre  match  goal[holds=true] ->  @rule   count")
+    b = parse_query("from pre match goal[holds=true] -> @rule count")
+    assert a.ast_hash() == b.ast_hash()  # formatting is not meaning
+    c = parse_query("from pre match goal[holds=true] -> @rule tables")
+    d = parse_query("from post match goal[holds=true] -> @rule count")
+    assert len({a.ast_hash(), c.ast_hash(), d.ast_hash()}) == 3
+
+
+# ---------------------------------------------------------------------------
+# planner lowering units
+# ---------------------------------------------------------------------------
+
+
+def test_plan_lowers_hops_onto_the_kernel_family():
+    q = parse_query(
+        "from pre match goal[holds=true] -*-> @rule[type=next] -> goal count"
+    )
+    plan = plan_query(q)
+    d = plan.describe()
+    assert d[0] == "select graph=pre runs=all"
+    assert d[1] == "condition_holds tid=0"  # holds predicate hoists the plane
+    assert "p0 fwd reach_any s0->s1" in d
+    assert "p0 fwd push_any s1->s2" in d
+    assert "p0 bwd push_any s2->s1" in d
+    assert "p0 bwd reach_any s1->s0" in d
+    assert "p0 capture s1: fwd & bwd" in d
+    assert d[-1] == "reduce count"
+    assert plan.needs_holds and not plan.needs_time
+    assert plan.cond_tid == 0
+    assert plan.key == q.ast_hash()  # the plan is a pure function of the AST
+
+
+def test_plan_flags_and_cond_tid():
+    plan = plan_query(parse_query("from post match goal[time=t1] tables"))
+    assert plan.cond_tid == 1  # CorpusVocab pins pre=0 / post=1
+    assert plan.needs_time and not plan.needs_holds
+    assert "condition_holds" not in " ".join(plan.describe())
+
+
+def test_plan_bind_resolves_names_and_sentinels():
+    plan = plan_query(parse_query("match goal[table=somewhere] count"))
+    # Empty segment vocab: the name binds to the never-equal sentinel
+    # (segment-local miss is an empty result, not an error) ...
+    pats, needs_holds, cond_tid = plan.bind(CorpusVocab())
+    assert pats[0][0][0] == (("kind", "goal"), ("table", "=", _NO_ID))
+    assert (needs_holds, cond_tid) == (False, 0)
+    # ... but the corpus-level check is LOUD: a name no run interned is a
+    # typo, not an empty result.
+    with pytest.raises(QueryError, match="unknown table 'somewhere'"):
+        plan.validate_names(CorpusVocab())
+
+
+def test_unknown_name_raises_at_execute(tmp_path):
+    d = write_corpus(SynthSpec(n_runs=4, seed=5), str(tmp_path))
+    molly = _ingest(d, True, None)
+    with pytest.raises(QueryError, match="unknown table"):
+        run_query_text("match goal[table=never_interned] count", molly)
+
+
+# ---------------------------------------------------------------------------
+# lane / oracle parity
+# ---------------------------------------------------------------------------
+
+#: Novel shapes spanning every aggregation, both hop kinds, holds (the
+#: derived plane), type/label predicates, negation, multi-pattern union,
+#: capture positions, and the run filter.
+PARITY_QUERIES = [
+    "from pre match goal[holds=true] -> @rule match goal[holds=false] -*-> "
+    "@rule[type=async] match @goal -> rule -> goal count by table",
+    "from post match @goal[holds=true] tables",
+    "from post match @rule -> goal[holds=false] runs",
+    "from pre match rule[type=async] -> @goal -*-> rule count",
+    "from pre where run.failed match @goal -*-> rule[type!=next] count by table",
+]
+
+
+def _query_corpora(tmp_path):
+    return [
+        write_corpus(SynthSpec(n_runs=8, seed=2, eot=6), str(tmp_path)),
+        write_case_study(
+            "ZK-1270-racing-sent-flag", n_runs=6, seed=11, out_dir=str(tmp_path)
+        ),
+        write_corpus(adversarial_spec("cycles", n_runs=6, seed=13), str(tmp_path)),
+    ]
+
+
+def test_device_and_host_lanes_are_bit_identical(tmp_path):
+    for d in _query_corpora(tmp_path):
+        molly = _ingest(d, False, None)
+        vocab = corpus_vocab(molly)
+        for text in PARITY_QUERIES:
+            plan = plan_query(parse_query(text))
+            bound = plan.bind(vocab)
+            num_tables = max(1, len(vocab.tables))
+            prov_of = (
+                (lambda r: r.pre_prov)
+                if plan.graph == "pre"
+                else (lambda r: r.post_prov)
+            )
+            rids, graphs = [], []
+            for r in molly.runs:
+                prov = prov_of(r)
+                if prov is None:
+                    continue
+                g = pack_graph(prov, vocab)
+                if g.n_nodes:
+                    rids.append(r.iteration)
+                    graphs.append(g)
+            for batch in bucketize(rids, graphs):
+                tp = qengine._time_plane(batch)
+                host = qengine._eval_host(batch, tp, bound, num_tables)
+                device = np.asarray(
+                    qengine._eval_device(batch, tp, bound, num_tables)
+                )
+                np.testing.assert_array_equal(host, device, err_msg=text)
+
+
+@pytest.mark.parametrize("packed", [True, False])
+def test_engine_matches_python_oracle(tmp_path, packed):
+    for d in _query_corpora(tmp_path):
+        molly = _ingest(d, packed, None)
+        for text in PARITY_QUERIES:
+            q = parse_query(text)
+            engine_doc = execute_query(q, molly, use_cache=False)
+            oracle_doc = oracle_query(q, molly)
+            assert _strip(engine_doc) == _strip(oracle_doc), (d, text)
+
+
+def test_oracle_parity_across_all_families(tmp_path):
+    """Every case-study family + every adversarial synth family: the
+    scheduler-routed engine and the per-run Python oracle agree on every
+    parity query's document."""
+    dirs = [
+        write_case_study(name, n_runs=4, seed=11, out_dir=str(tmp_path))
+        for name in sorted(CASE_STUDIES)
+    ] + [
+        write_corpus(adversarial_spec(fam, n_runs=4, seed=13), str(tmp_path))
+        for fam in ADVERSARIAL_FAMILIES
+    ]
+    for d in dirs:
+        molly = _ingest(d, True, None)
+        for text in PARITY_QUERIES:
+            q = parse_query(text)
+            assert _strip(execute_query(q, molly, use_cache=False)) == _strip(
+                oracle_query(q, molly)
+            ), (d, text)
+
+
+def test_serial_and_scheduled_execution_agree(tmp_path):
+    d = write_corpus(SynthSpec(n_runs=8, seed=2, eot=6), str(tmp_path))
+    molly = _ingest(d, True, None)
+    q = parse_query(PARITY_QUERIES[0])
+    a = execute_query(q, molly, use_cache=False, serial=True)
+    b = execute_query(q, molly, use_cache=False)
+    assert _strip(a) == _strip(b)
+
+
+# ---------------------------------------------------------------------------
+# reduce: permutation invariance
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    ("agg", "values"),
+    [
+        ("tables", [["a", "c"], ["b"], [], ["a"]]),
+        ("count", [3, 0, 7, 1]),
+        ("runs", [True, False, True, False]),
+        ("count_by_table", [{"a": 2}, {}, {"a": 1, "b": 3}, {"b": 1}]),
+    ],
+)
+def test_reduce_is_permutation_invariant(agg, values):
+    text = {
+        "tables": "match goal tables",
+        "count": "match goal count",
+        "runs": "match goal runs",
+        "count_by_table": "match goal count by table",
+    }[agg]
+    plan = plan_query(parse_query(text))
+    parts = [
+        QueryPartial(per_run={i: v}, n_runs=1) for i, v in enumerate(values)
+    ]
+    want = finalize(plan, merge_query_partials(parts))
+    for seed in range(5):
+        shuffled = list(parts)
+        random.Random(seed).shuffle(shuffled)
+        assert finalize(plan, merge_query_partials(shuffled)) == want
+
+
+# ---------------------------------------------------------------------------
+# cache: warm hit, AST invalidation, segment-delta mapping
+# ---------------------------------------------------------------------------
+
+
+def test_query_cache_hit_invalidation_and_segment_delta(tmp_path):
+    full = write_corpus(SynthSpec(n_runs=12, seed=2, eot=6), str(tmp_path / "full"))
+    d = str(tmp_path / "sweep")
+    grow_corpus_dir(full, d, 9)
+    store = resolve_store(str(tmp_path / "cc"))
+    rc = str(tmp_path / "rc")
+    molly = _ingest(d, True, store)
+    text = PARITY_QUERIES[0]
+
+    cold, md = _counters_delta(lambda: run_query_text(text, molly, result_cache=rc))
+    assert cold["stats"]["cache"] == "miss"
+    assert cold["stats"]["segments_mapped"] == 1
+    assert kernel_dispatch_count(md) > 0
+
+    warm, md = _counters_delta(lambda: run_query_text(text, molly, result_cache=rc))
+    assert warm["stats"] == {"cache": "hit", "segments_mapped": 0}
+    assert kernel_dispatch_count(md) == 0  # the zero-dispatch contract
+    assert int(md.get("query.cache.hit", 0)) == 1
+    assert _strip(warm) == _strip(cold)
+
+    # A different AST is a different content address: no stale bytes served.
+    other, md = _counters_delta(
+        lambda: run_query_text(
+            "from pre match @goal[holds=true] count", molly, result_cache=rc
+        )
+    )
+    assert other["stats"]["cache"] == "miss"
+    assert _strip(other) != _strip(cold)
+
+    # Grown corpus: the old segment's partial hits, ONLY the new one maps.
+    grow_corpus_dir(full, d, 12)
+    molly2 = _ingest(d, True, store)
+    grown, md = _counters_delta(lambda: run_query_text(text, molly2, result_cache=rc))
+    assert grown["stats"]["cache"] == "miss"
+    assert grown["stats"]["segments_mapped"] == 1
+    assert int(md.get("query.partial.hit", 0)) == 1
+    scratch = execute_query(parse_query(text), molly2, use_cache=False)
+    assert _strip(grown) == _strip(scratch)
+
+
+def test_cache_off_paths_report_their_state(tmp_path):
+    d = write_corpus(SynthSpec(n_runs=4, seed=5), str(tmp_path))
+    molly = _ingest(d, True, None)  # no store -> no fingerprints -> cache off
+    doc = run_query_text("match goal count", molly, result_cache=str(tmp_path / "rc"))
+    assert doc["stats"]["cache"] == "off"
+    q = parse_query("match goal count")
+    assert oracle_query(q, molly)["stats"]["cache"] == "oracle"

@@ -754,3 +754,135 @@ def test_populate_sweeps_aged_wreckage(tmp_path):
     assert not os.path.exists(old_tmp)
     assert not os.path.exists(inner_tmp)
     assert os.path.exists(fresh_tmp)
+
+
+# ---------------------------------------------- trace index-delta append
+
+
+def _trace_generations(tmp_path, n_total=8):
+    """A trace-JSON sweep directory plus a grow(n) step that replays the
+    first n runs of the finished sweep (the replay driver's per-generation
+    materialize_prefix)."""
+    from nemo_tpu.ingest import adapters
+
+    src = write_corpus(SynthSpec(n_runs=n_total, seed=3), str(tmp_path / "m"))
+    full = adapters.molly_to_trace(src, str(tmp_path / "full"))
+    sweep = str(tmp_path / "sweep")
+
+    def grow(n):
+        adapters.TraceJsonInjector.materialize_prefix(full, sweep, n)
+
+    return sweep, grow
+
+
+def test_trace_append_three_generation_replay(tmp_path):
+    """ISSUE 20 satellite: a 3-generation trace.json replay maps only the
+    NEW runs per generation — one index-delta append per growth step, each
+    fresh segment holding exactly the appended entries, and the final
+    store decoded-equal to a repack-from-scratch."""
+    from nemo_tpu.ingest import adapters
+
+    sweep, grow = _trace_generations(tmp_path, n_total=8)
+    grow(3)
+    store = CorpusStore(str(tmp_path / "cache"))
+    inj = adapters.resolve_injector(sweep)
+    assert inj.name == "trace-json"
+    assert store.put(sweep, inj.load(sweep))
+    header = store._read_header(store.store_dir(sweep))
+    assert header["source"]["index_file"] == "trace.json"
+    assert [int(s["n_runs"]) for s in header["segments"]] == [3]
+
+    for gen, (n, segs) in enumerate([(6, [3, 3]), (8, [3, 3, 2])]):
+        grow(n)
+        assert store.probe(sweep) == "grown"
+        warm, mc = _store_delta(lambda: store.load_packed(sweep))
+        assert warm is not None, f"generation {gen}"
+        assert mc.get("store.append") == 1 and mc.get("store.hit") == 1
+        header = store._read_header(store.store_dir(sweep))
+        assert [int(s["n_runs"]) for s in header["segments"]] == segs
+        assert warm.native_corpus.n_runs == n
+        # Settled index -> plain multi-segment HIT, no further append.
+        again, mc2 = _store_delta(lambda: store.load_packed(sweep))
+        assert again is not None and "store.append" not in mc2
+
+    nw = store.load_packed(sweep).native_corpus
+    fresh = CorpusStore(str(tmp_path / "cache_fresh"))
+    assert fresh.put(sweep, inj.load(sweep))
+    nf = fresh.load_packed(sweep).native_corpus
+    assert nf.n_runs == nw.n_runs == 8
+    assert sorted(nf.tables) == sorted(nw.tables)
+    assert sorted(nf.labels) == sorted(nw.labels)
+    assert sorted(nf.times) == sorted(nw.times)
+    assert (nf.v, nf.e, nf.max_depth) == (nw.v, nw.e, nw.max_depth)
+    for i in range(8):
+        assert nf.run_head_json(i) == nw.run_head_json(i)
+        for cond in ("pre", "post"):
+            assert nf.prov_json(cond, i) == nw.prov_json(cond, i)
+            assert nf.lazy_node_ids(cond, i) == nw.lazy_node_ids(cond, i)
+
+
+def test_trace_append_refused_when_old_entries_mutated(tmp_path):
+    """Growing trace.json while ALSO rewriting a stored entry (or the
+    sweep-level spec, which bakes into every head fragment) must not splice
+    stale rows — the append refuses and the store goes stale."""
+    sweep, grow = _trace_generations(tmp_path, n_total=8)
+    grow(5)
+    store = CorpusStore(str(tmp_path / "cache"))
+    from nemo_tpu.ingest import adapters
+
+    assert store.put(sweep, adapters.TraceJsonInjector().load(sweep))
+    tf = os.path.join(sweep, "trace.json")
+
+    grow(8)
+    with open(tf) as fh:
+        doc = json.load(fh)
+    doc["runs"][0]["id"] = int(doc["runs"][0]["id"]) + 1000
+    with open(tf, "w") as fh:
+        json.dump(doc, fh, indent=1)
+    loaded, mc = _store_delta(lambda: store.load_packed(sweep))
+    assert loaded is None
+    assert mc.get("store.stale") == 1 and not mc.get("store.append")
+
+    # Spec mutation: id/status pairs all still match, so only the spread's
+    # re-parsed head fragments can catch it.
+    grow(8)
+    with open(tf) as fh:
+        doc = json.load(fh)
+    doc["spec"]["eot"] = int(doc["spec"].get("eot", 0)) + 7
+    with open(tf, "w") as fh:
+        json.dump(doc, fh, indent=1)
+    loaded, mc = _store_delta(lambda: store.load_packed(sweep))
+    assert loaded is None
+    assert mc.get("store.stale") == 1 and not mc.get("store.append")
+
+
+def test_trace_append_reingests_repaired_quarantined_entry(tmp_path):
+    """A trace entry quarantined at populate is re-attempted on every index
+    rewrite (single documents have no per-file repair tripwire): once the
+    producer re-emits it intact, the next append re-ingests it alongside
+    the appended tail."""
+    from nemo_tpu.ingest import adapters
+
+    sweep, grow = _trace_generations(tmp_path, n_total=8)
+    grow(5)
+    tf = os.path.join(sweep, "trace.json")
+    with open(tf) as fh:
+        doc = json.load(fh)
+    doc["runs"][2]["id"] = "not-an-int"
+    with open(tf, "w") as fh:
+        json.dump(doc, fh, indent=1)
+    store = CorpusStore(str(tmp_path / "cache"))
+    molly = adapters.TraceJsonInjector().load(sweep)
+    assert [r["position"] for r in molly.quarantined] == [2]
+    assert store.put(sweep, molly)
+    header = store._read_header(store.store_dir(sweep))
+    assert [r["position"] for r in header["quarantined"]] == [2]
+    assert header["segments"][0]["positions"] == [0, 1, 3, 4]
+
+    grow(8)  # replays the pristine sweep: entry 2 is repaired + 3 appended
+    warm, mc = _store_delta(lambda: store.load_packed(sweep))
+    assert warm is not None and mc.get("store.append") == 1
+    header = store._read_header(store.store_dir(sweep))
+    assert "quarantined" not in header
+    assert header["segments"][1]["positions"] == [2, 5, 6, 7]
+    assert warm.native_corpus.n_runs == 8

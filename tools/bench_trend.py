@@ -300,6 +300,23 @@ def extract_metrics(doc: dict) -> dict[str, tuple[float, str, str]]:
         "higher",
         "ratio",
     )
+    # Query tier (ISSUE 20): the ad-hoc query engine's three walls per
+    # scale — cold plan+execute creeping up, the warm full-result rcache
+    # hit regressing (s_fast floors: the acceptance bar is sub-2-second at
+    # the 10k scale and the healthy value is far under it, so the
+    # seconds-scale floor would mask a 10x regression), or the batched
+    # engine's speedup over the per-run Python oracle collapsing.
+    qt = doc.get("query_tier") or {}
+    for scale in ("at_1x", "at_full"):
+        row = qt.get(scale) or {}
+        put(f"query_tier.{scale}.cold_s", row.get("cold_s"), "lower", "s_fast")
+        put(f"query_tier.{scale}.warm_s", row.get("warm_s"), "lower", "s_fast")
+        put(
+            f"query_tier.{scale}.speedup_cold",
+            row.get("speedup_cold"),
+            "higher",
+            "ratio",
+        )
     st = doc.get("stream_tier") or {}
     put("stream_tier.runs_per_s", st.get("runs_per_s"), "higher", "ratio")
     put(
@@ -410,6 +427,12 @@ STREAM_RSS_CEILING_MB = 4096.0
 #: first capture.
 WATCH_RSS_CEILING_MB = 4096.0
 
+#: Absolute ceiling on the query tier's warm wall at the full ~10k-run
+#: scale (seconds): the ISSUE-20 acceptance bar is a novel 3-pattern query
+#: answered under 2 s warm — meaningful against zero history, like the RSS
+#: ceilings above.
+QUERY_WARM_CEILING_S = 2.0
+
 
 def ceiling_violations(candidate: dict) -> list[dict]:
     """History-independent absolute bounds (the stream tier's RSS ceiling,
@@ -436,6 +459,18 @@ def ceiling_violations(candidate: dict) -> list[dict]:
                 "metric": "watch_tier.steady_rss_mb",
                 "candidate": round(float(v), 1),
                 "ceiling_mb": WATCH_RSS_CEILING_MB,
+                "direction": "ceiling",
+                "regressed": True,
+            }
+        )
+    qt = candidate.get("query_tier") or {}
+    v = (qt.get("at_full") or {}).get("warm_s")
+    if isinstance(v, (int, float)) and v > QUERY_WARM_CEILING_S:
+        out.append(
+            {
+                "metric": "query_tier.at_full.warm_s",
+                "candidate": round(float(v), 4),
+                "ceiling_s": QUERY_WARM_CEILING_S,
                 "direction": "ceiling",
                 "regressed": True,
             }
@@ -584,9 +619,11 @@ def main(argv: list[str] | None = None) -> int:
     # Absolute ceilings apply regardless of history (stream-tier RSS bound).
     ceilings = ceiling_violations(candidate)
     for c in ceilings:
+        unit = "s" if "ceiling_s" in c else "MB"
+        bound = c.get("ceiling_s", c.get("ceiling_mb"))
         _log(
-            f"bench-trend: {c['metric']}: {c['candidate']} MB exceeds the "
-            f"absolute ceiling {c['ceiling_mb']} MB [REGRESSED]"
+            f"bench-trend: {c['metric']}: {c['candidate']} {unit} exceeds the "
+            f"absolute ceiling {bound} {unit} [REGRESSED]"
         )
     if len(usable) < args.min_history:
         _log(
